@@ -1,0 +1,88 @@
+"""Benchmark: training tokens/sec/chip on the flagship model family.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: decoder-LM training throughput (tokens/sec/chip) in bf16 with the
+fused train step. ``vs_baseline`` reports achieved MFU relative to the
+reference's published 54%-of-peak Ulysses number
+(`blogs/deepspeed-ulysses/README.md:81-83` — the only hardware-normalized
+efficiency figure the reference publishes), i.e. vs_baseline = MFU / 0.54.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    # ~124M-param GPT-2-small-shaped llama-style model, seq 1024 — big enough
+    # to saturate the MXU on one chip, small enough to fit v5e HBM with Adam.
+    if on_tpu:
+        cfg = TransformerConfig(vocab_size=32000, hidden_size=768, num_layers=12, num_heads=12,
+                                intermediate_size=3072, max_seq_len=1024, norm="rmsnorm", positions="rotary",
+                                mlp="swiglu", dtype=jnp.bfloat16, attention_impl="reference", remat=True)
+        micro, seq, steps, warmup = 8, 1024, 10, 3
+    else:  # CI / CPU smoke mode
+        cfg = TransformerConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+                                intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
+                                attention_impl="reference")
+        micro, seq, steps, warmup = 2, 256, 3, 1
+
+    model = TransformerLM(cfg)
+    n_chips = len(jax.devices())
+    config = {
+        "train_batch_size": micro * n_chips,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.0}},
+        "zero_optimization": {"stage": 1 if n_chips > 1 else 0},
+        "bf16": {"enabled": bool(on_tpu)},
+        "steps_per_print": 10**9,
+        "tpu": {"mesh": {"data": n_chips}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, size=(config["train_batch_size"], seq), dtype=np.int32)}
+
+    def _sync():
+        # a host fetch is the only reliable barrier on tunneled runtimes
+        return float(np.asarray(engine.state["step"]))
+
+    for _ in range(warmup):
+        engine.train_batch(batch)
+    _sync()
+    t0 = time.time()
+    for _ in range(steps):
+        engine.train_batch(batch)
+    _sync()
+    dt = time.time() - t0
+
+    tokens = steps * config["train_batch_size"] * seq
+    tok_per_sec_per_chip = tokens / dt / n_chips
+
+    n_params = model.num_params()
+    # fwd+bwd ≈ 6 FLOPs/param/token + attention term
+    attn_flops_per_token = 12 * cfg.num_layers * cfg.hidden_size * seq  # 2*2*3 * L * H * S
+    flops_per_token = 6 * n_params + attn_flops_per_token
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    mfu = tok_per_sec_per_chip * flops_per_token / peak
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.54, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""deepspeed_tpu — a TPU-native training & inference framework with the
+capabilities of DeepSpeed (reference: schoi-habana/DeepSpeed v0.12.4).
+
+Public API mirrors the reference ``deepspeed/__init__.py``:
+``initialize`` (:64), ``init_inference`` (:273), ``add_config_arguments``
+(:250) — with JAX-native semantics: the "module" is a model object exposing
+``init``/``loss`` (see ``models.transformer.TransformerLM``), the optimizer is
+an optax transformation, and all parallelism is carried by one device mesh.
+"""
+
+__version__ = "0.1.0"
+__git_hash__ = None
+__git_branch__ = None
+
+from .accelerator import get_accelerator, set_accelerator
+from . import comm as _comm_pkg
+from .comm import comm as dist
+from .runtime.config import DeepSpeedConfig
+from .runtime.engine import DeepSpeedEngine
+from .parallel import MeshConfig, groups
+from .utils.logging import logger, log_dist
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mesh=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               example_batch=None):
+    """Initialize the engine (reference ``deepspeed.initialize`` signature,
+    ``deepspeed/__init__.py:64``). Returns (engine, optimizer, dataloader,
+    lr_scheduler) like the reference.
+
+    - ``model``: object with ``init(rng, example) -> params`` and
+      ``loss(params, batch, rng) -> loss`` (e.g. ``models.llama2()``); or any
+      callable ``(params, batch, rng) -> loss`` paired with
+      ``model_parameters`` as initial params.
+    - ``config``: dict or path to a DeepSpeed-style JSON config.
+    - ``mesh``: optional pre-built ``jax.sharding.Mesh``; otherwise built from
+      the config's ``tpu.mesh`` section over all visible devices.
+    """
+    assert model is not None, "deepspeed_tpu.initialize: model is required"
+    if config is None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config") and args.deepspeed_config:
+        config = args.deepspeed_config
+    assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
+
+    ds_config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config, mesh=mesh, mpu=mpu)
+
+    if callable(model) and not hasattr(model, "init"):
+        model = _FunctionalModel(model, model_parameters)
+
+    engine = DeepSpeedEngine(model=model,
+                             config=ds_config,
+                             optimizer=optimizer,
+                             lr_scheduler=lr_scheduler,
+                             mesh=mesh,
+                             example_batch=example_batch,
+                             training_data=training_data,
+                             collate_fn=collate_fn)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+class _FunctionalModel:
+    """Adapter: bare loss function + initial params → model protocol."""
+
+    def __init__(self, loss_fn, init_params):
+        self._loss_fn = loss_fn
+        self._params = init_params
+
+    def init(self, rng, example_batch=None):
+        assert self._params is not None, "pass model_parameters with a bare loss function"
+        return self._params
+
+    def loss(self, params, batch, rng=None):
+        try:
+            return self._loss_fn(params, batch, rng)
+        except TypeError:
+            return self._loss_fn(params, batch)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Reference ``deepspeed.init_inference`` (:273): build an InferenceEngine
+    around a model with TP sharding and fused kernels."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import DeepSpeedInferenceConfig
+
+    if config is None:
+        config = kwargs
+    ds_config = config if isinstance(config, DeepSpeedInferenceConfig) else DeepSpeedInferenceConfig(**(config or {}))
+    return InferenceEngine(model, ds_config)
+
+
+def add_config_arguments(parser):
+    """Reference ``deepspeed.add_config_arguments`` (:250)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code, no impact on DS itself)")
+    group.add_argument("--deepspeed_config", default=None, type=str, help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale", default=False, action="store_true", help=argparse_dep("--deepspeed"))
+    group.add_argument("--deepscale_config", default=None, type=str, help=argparse_dep("--deepspeed_config"))
+    return parser
+
+
+def argparse_dep(new):
+    return f"Deprecated, use {new}"

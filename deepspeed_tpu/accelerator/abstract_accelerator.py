@@ -1,0 +1,165 @@
+"""Accelerator abstraction.
+
+TPU-native re-design of the reference ``accelerator/abstract_accelerator.py:10``
+(``DeepSpeedAccelerator`` ABC, ~60 abstract methods). The reference surface is
+torch-shaped (Streams/Events, ``torch.cuda``-style RNG and memory stats); on
+JAX the equivalents are platform queries, ``device.memory_stats()``, async
+dispatch barriers, and PRNG keys — so the ABC here keeps the reference's
+*capability groups* (device APIs, RNG, synchronization, memory stats, dtype
+support, communication backend name, op-builder hook) with JAX-idiomatic
+signatures. Everything above this layer calls ``get_accelerator()`` instead of
+touching ``jax.devices()`` directly, exactly as the reference routes everything
+through ``get_accelerator()`` instead of ``torch.cuda``.
+"""
+
+import abc
+from abc import ABC
+
+
+class DeepSpeedAccelerator(ABC):
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # ---- Device APIs ----
+    @abc.abstractmethod
+    def is_synchronized_device(self):
+        """True when kernels are dispatched synchronously (CPU)."""
+        ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        """Return the jax.Device for ``device_index`` (default: first local)."""
+        ...
+
+    @abc.abstractmethod
+    def set_device(self, device_index):
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device_name(self):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self):
+        """Local (addressable) device count."""
+        ...
+
+    @abc.abstractmethod
+    def global_device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        ...
+
+    # ---- RNG APIs (JAX PRNG-key based; reference uses torch RNG state) ----
+    @abc.abstractmethod
+    def manual_seed(self, seed):
+        ...
+
+    @abc.abstractmethod
+    def initial_seed(self):
+        ...
+
+    @abc.abstractmethod
+    def rng_key(self):
+        """Current PRNG key (replaces get_rng_state)."""
+        ...
+
+    @abc.abstractmethod
+    def split_rng_key(self, num=2):
+        ...
+
+    # ---- Memory management ----
+    @abc.abstractmethod
+    def empty_cache(self):
+        ...
+
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def reset_peak_memory_stats(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index=None):
+        ...
+
+    # ---- Data types ----
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self):
+        ...
+
+    # ---- Communication backend ----
+    @abc.abstractmethod
+    def communication_backend_name(self):
+        """'xla' for TPU (collectives lower to XLA ICI/DCN ops), cf. the
+        reference's 'nccl'/'hccl'/'ccl' (``hpu_accelerator.py:19``)."""
+        ...
+
+    # ---- Tracing / profiling ----
+    @abc.abstractmethod
+    def range_push(self, msg):
+        ...
+
+    @abc.abstractmethod
+    def range_pop(self):
+        ...
+
+    # ---- Op builder hook (reference abstract_accelerator.py:245-258) ----
+    @abc.abstractmethod
+    def op_builder_dir(self):
+        ...
+
+    @abc.abstractmethod
+    def create_op_builder(self, class_name):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, class_name):
+        ...
+
+    # ---- Capability flags ----
+    @abc.abstractmethod
+    def is_available(self):
+        ...
+
+    @abc.abstractmethod
+    def supports_pallas(self):
+        """Whether Pallas TPU kernels can run on this accelerator."""
+        ...
+
+    def is_triton_supported(self):
+        return False

@@ -1,0 +1,58 @@
+"""CPU accelerator (testing / host-emulation backend).
+
+Analog of the reference ``accelerator/cpu_accelerator.py`` (282 LoC). Used by
+the test suite with ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` to
+emulate an N-device mesh on host, mirroring how the reference runs its suite
+on whatever accelerator ``get_accelerator()`` resolves to.
+"""
+
+import jax.numpy as jnp
+
+from .tpu_accelerator import TPU_Accelerator
+
+try:
+    import psutil
+
+    _PSUTIL = True
+except ImportError:  # pragma: no cover
+    _PSUTIL = False
+
+
+class CPU_Accelerator(TPU_Accelerator):
+
+    def __init__(self):
+        super().__init__(platform="cpu")
+        self._name = "cpu"
+        # Host-loop collectives still lower to XLA ops on the CPU backend.
+        self._communication_backend_name = "xla"
+
+    def is_synchronized_device(self):
+        return True
+
+    def memory_allocated(self, device_index=None):
+        if _PSUTIL:
+            return psutil.Process().memory_info().rss
+        return 0
+
+    def total_memory(self, device_index=None):
+        if _PSUTIL:
+            return psutil.virtual_memory().total
+        return 0
+
+    def available_memory(self, device_index=None):
+        if _PSUTIL:
+            return psutil.virtual_memory().available
+        return 0
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return False
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.int8, jnp.int32]
+
+    def supports_pallas(self):
+        # Pallas kernels run on CPU only in interpret mode.
+        return False

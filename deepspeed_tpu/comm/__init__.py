@@ -1,0 +1,3 @@
+from .comm import *  # noqa: F401,F403
+from .comm import cdb, init_distributed, get_rank, get_world_size, get_local_rank, barrier, is_initialized
+from . import functional

@@ -1,0 +1,100 @@
+"""Communication backend objects.
+
+Analog of the reference ``deepspeed/comm/backend.py:25`` (``Backend`` base) and
+``comm/torch.py:99`` (``TorchBackend``). The TPU backend has two planes:
+
+  - the traced plane (``comm/functional.py``) — collectives that compile into
+    the step program and ride ICI/DCN; and
+  - this host control plane — process bootstrap (``jax.distributed``),
+    barriers, small host-value broadcasts, used outside ``jit`` the way the
+    reference uses a gloo/TCP store for KVS bootstrap (``comm/ccl.py:45-57``).
+"""
+
+import os
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class Backend:
+
+    def __init__(self, name="backend", rank=0, size=1):
+        self.name = name
+        self.world_group = None
+        self.world_size = size
+        self.world_rank = rank
+        self.initialized = False
+
+    def is_initialized(self):
+        return self.initialized
+
+    def new_group(self, ranks):
+        # On TPU sub-groups are mesh axes; host-plane groups are not needed.
+        raise NotImplementedError()
+
+    def init_process_group(self):
+        self.initialized = True
+
+
+class XlaBackend(Backend):
+    """Host control plane over the JAX runtime.
+
+    ``communication_backend_name() == 'xla'`` selects this backend the same way
+    'hccl' selects Habana's (reference ``deepspeed/__init__.py:134``).
+    """
+
+    def __init__(self, init_method=None, rank=-1, world_size=-1, name="xla", timeout=None):
+        super().__init__(name=name)
+        self._multiprocess = False
+        self._maybe_init_jax_distributed(init_method, rank, world_size)
+        import jax
+
+        self.world_rank = jax.process_index()
+        self.world_size = jax.process_count()
+        self.initialized = True
+
+    def _maybe_init_jax_distributed(self, init_method, rank, world_size):
+        import jax
+
+        coord = os.environ.get("DSTPU_COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        n_proc = int(os.environ.get("DSTPU_NUM_PROCESSES", os.environ.get("WORLD_SIZE", world_size)) or -1)
+        proc_id = int(os.environ.get("DSTPU_PROCESS_ID", os.environ.get("RANK", rank)) or -1)
+        if coord is not None and n_proc > 1:
+            try:
+                jax.distributed.initialize(coordinator_address=coord, num_processes=n_proc, process_id=proc_id)
+                self._multiprocess = True
+            except Exception as e:  # already initialized or single-host
+                logger.warning(f"jax.distributed.initialize skipped: {e}")
+
+    # ---- host-plane ops ----
+    def get_rank(self):
+        return self.world_rank
+
+    def get_world_size(self):
+        return self.world_size
+
+    def barrier(self):
+        if self.world_size > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+
+    def broadcast_host(self, value, src=0):
+        """Broadcast a small host pytree from process ``src`` (control plane)."""
+        if self.world_size == 1:
+            return value
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(value, is_source=(self.world_rank == src))
+
+    def all_gather_host(self, value):
+        if self.world_size == 1:
+            return [value]
+        from jax.experimental import multihost_utils
+
+        arr = multihost_utils.process_allgather(np.asarray(value))
+        return list(arr)
+
+    def destroy_process_group(self):
+        self.initialized = False

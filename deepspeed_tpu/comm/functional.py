@@ -1,0 +1,115 @@
+"""In-program (traced) collectives.
+
+The hot-path half of the comm backend (SURVEY.md §2.5 "TPU equivalent"): these
+run *inside* ``jit``/``shard_map`` over mesh axis names and lower to XLA
+collectives on ICI/DCN. They carry the same names as the reference
+``deepspeed/comm/comm.py`` API (``all_reduce:482``, ``all_gather:227``,
+``reduce_scatter_tensor:279``, ``all_to_all_single:330``…) so code reading the
+reference maps 1:1, but the ``group=`` argument is a mesh axis name (or tuple
+of names) rather than a torch process group.
+"""
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisNames = Union[str, Sequence[str]]
+
+
+class ReduceOp:
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+
+
+def _axis(group: AxisNames):
+    if isinstance(group, (list, tuple)) and len(group) == 1:
+        return group[0]
+    return group
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: AxisNames = "data"):
+    axis = _axis(group)
+    if op == ReduceOp.SUM:
+        return lax.psum(tensor, axis)
+    if op == ReduceOp.AVG:
+        return lax.pmean(tensor, axis)
+    if op == ReduceOp.MAX:
+        return lax.pmax(tensor, axis)
+    if op == ReduceOp.MIN:
+        return lax.pmin(tensor, axis)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(tensor), axis))
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def inference_all_reduce(tensor, group: AxisNames = "model"):
+    """TP partial-sum combine on the inference path (reference comm.py:499)."""
+    return lax.psum(tensor, _axis(group))
+
+
+def all_gather(tensor, group: AxisNames = "data", axis: int = 0, tiled: bool = True):
+    """Gather shards along ``axis``. ``tiled=True`` concatenates (the
+    ``all_gather_into_tensor`` layout); ``tiled=False`` stacks a new dim."""
+    return lax.all_gather(tensor, _axis(group), axis=axis, tiled=tiled)
+
+
+all_gather_into_tensor = all_gather
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group: AxisNames = "data", scatter_dimension: int = 0):
+    axis = _axis(group)
+    out = lax.psum_scatter(tensor, axis, scatter_dimension=scatter_dimension, tiled=True)
+    if op == ReduceOp.AVG:
+        out = out / lax.psum(1, axis)
+    return out
+
+
+reduce_scatter_tensor = reduce_scatter
+
+
+def all_to_all_single(tensor, group: AxisNames = "seq", split_axis: int = 0, concat_axis: int = 0):
+    """Split along ``split_axis`` across the group and concat received chunks
+    along ``concat_axis`` (reference comm.py:330). This is the Ulysses /
+    MoE-dispatch primitive."""
+    return lax.all_to_all(tensor, _axis(group), split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(tensor, src_index: int = 0, group: AxisNames = "data"):
+    """Broadcast the ``src_index`` shard to all members of the group."""
+    axis = _axis(group)
+    full = lax.all_gather(tensor, axis, axis=0, tiled=False)
+    return jax.tree_util.tree_map(lambda x: x[src_index], full)
+
+
+def ppermute(tensor, perm, group: AxisNames = "pipe"):
+    """Neighbor exchange — the pipeline p2p primitive (reference
+    ``runtime/pipe/p2p.py`` send/recv pairs become a single collective)."""
+    return lax.ppermute(tensor, _axis(group), perm=perm)
+
+
+def send_recv_next(tensor, group: AxisNames = "pipe", size: int = None):
+    """Shift +1 along the ring: stage i's value arrives at stage i+1."""
+    axis = _axis(group)
+    n = size if size is not None else lax.psum(1, axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(tensor, axis, perm=perm)
+
+
+def send_recv_prev(tensor, group: AxisNames = "pipe", size: int = None):
+    axis = _axis(group)
+    n = size if size is not None else lax.psum(1, axis)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    return lax.ppermute(tensor, axis, perm=perm)
+
+
+def axis_index(group: AxisNames):
+    return lax.axis_index(_axis(group))
+
+
+def axis_size(group: AxisNames):
+    return lax.psum(1, _axis(group))

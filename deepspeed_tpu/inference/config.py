@@ -1,0 +1,61 @@
+"""Inference config.
+
+Mirrors the reference ``deepspeed/inference/config.py`` (304 LoC,
+``DeepSpeedInferenceConfig``: dtype, tensor_parallel, moe, quant,
+zero-inference knobs) with the same JSON field names.
+"""
+
+from typing import Any, Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """``tensor_parallel`` block (reference class of the same name)."""
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field([1], alias="num_experts")
+    type: str = "standard"
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    num_bits: int = 8
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Reference ``DeepSpeedInferenceConfig`` field surface."""
+    kernel_inject: bool = Field(False, alias="kernel_injection")
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = Field({}, alias="tp")
+    enable_cuda_graph: bool = False  # accepted for parity; no-op on TPU (XLA compiles whole graphs)
+    zero: dict = {}
+    triangular_masking: bool = Field(True, alias="tm")
+    moe: DeepSpeedMoEConfig = {}
+    quant: QuantizationConfig = {}
+    checkpoint: Optional[str] = None
+    base_dir: str = ""
+    max_tokens: int = Field(1024, alias="max_out_tokens")
+    min_out_tokens: int = Field(1, alias="min_out_tokens")
+    transposed_mode: bool = False
+    replace_with_kernel_inject: bool = Field(False, alias="replace_method_kernel")
+    injection_policy: Optional[dict] = Field(None, alias="injection_dict")
+    injection_policy_tuple: Optional[tuple] = None
+    replace_method: str = "auto"
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        return {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16, "float16": jnp.float16, "fp16": jnp.float16,
+                "half": jnp.float16, "float32": jnp.float32, "fp32": jnp.float32, "int8": jnp.int8}.get(
+                    str(self.dtype).replace("torch.", ""), jnp.bfloat16)

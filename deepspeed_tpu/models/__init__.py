@@ -1,0 +1,3 @@
+from .transformer import TransformerConfig, TransformerLM, reference_attention
+from .llama import llama2, llama2_config
+from .gpt import gpt2, gpt2_config

@@ -1,0 +1,23 @@
+"""GPT-2/NeoX-style configs (LayerNorm + learned positions + gelu, biases,
+tied embeddings) — the reference's gpt2/gptneox containers
+(``module_inject/containers/gpt2.py``, ``gptneox.py``)."""
+
+from .transformer import TransformerConfig, TransformerLM
+
+
+def gpt2_config(size: str = "small", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(vocab_size=50257, hidden_size=128, num_layers=2, num_heads=4, max_seq_len=512),
+        "small": dict(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12, max_seq_len=1024),
+        "medium": dict(vocab_size=50257, hidden_size=1024, num_layers=24, num_heads=16, max_seq_len=1024),
+        "large": dict(vocab_size=50257, hidden_size=1280, num_layers=36, num_heads=20, max_seq_len=1024),
+        "xl": dict(vocab_size=50257, hidden_size=1600, num_layers=48, num_heads=25, max_seq_len=1024),
+    }
+    base = dict(presets[size], norm="layernorm", positions="learned", mlp="gelu", use_bias=True,
+                tie_embeddings=True)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def gpt2(size: str = "small", **overrides) -> TransformerLM:
+    return TransformerLM(gpt2_config(size, **overrides))

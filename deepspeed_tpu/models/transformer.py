@@ -1,0 +1,430 @@
+"""TPU-first decoder-only transformer.
+
+This is the framework's flagship training model family, covering the model
+space of the reference's containers (``deepspeed/module_inject/containers/``
+gpt2…llama2, ``model_implementations/``): configurable norm (LayerNorm /
+RMSNorm), positions (learned / rotary), MLP (gelu / SwiGLU), GQA, tied or
+untied LM head. Design choices are TPU-native, not a port:
+
+  * **Scan-stacked layers**: all L blocks live in single stacked arrays
+    ([L, ...]) consumed by ``lax.scan`` — one block compiled once, and when
+    ZeRO-3 shards the stacked arrays over the data axis, XLA's scan lowering
+    all-gathers exactly one layer's params per iteration: the same per-submodule
+    allgather/release lifecycle the reference drives with module hooks
+    (``partitioned_param_coordinator.py:256 fetch_sub_module``), but from the
+    compiler.
+  * **Mixed precision by policy**: params fp32 (master weights, reference
+    ``bf16_optimizer.py``), compute in bf16 on the MXU.
+  * **Remat**: ``jax.checkpoint`` with a named policy replaces the reference's
+    activation-checkpointing machinery (``activation_checkpointing/checkpointing.py``).
+  * **Parallelism by sharding**: TP via PartitionRules over the ``model`` axis,
+    sequence parallel via Ulysses sharding constraints, batch over ``data``.
+"""
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..runtime.zero.partition import PartitionRules
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    intermediate_size: Optional[int] = None  # default 4x (gelu) or 8/3x (swiglu)
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # GQA; None = MHA
+    max_seq_len: int = 2048
+    norm: str = "rmsnorm"  # 'rmsnorm' | 'layernorm'
+    positions: str = "rotary"  # 'rotary' | 'learned'
+    mlp: str = "swiglu"  # 'swiglu' | 'gelu'
+    use_bias: bool = False
+    tie_embeddings: bool = False
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16  # compute dtype; params are fp32 masters
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+    attention_impl: str = "auto"  # 'auto' | 'reference' | 'flash'
+    sequence_parallel: bool = False  # Ulysses sharding constraints
+    dropout: float = 0.0
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            if self.mlp == "swiglu":
+                self.intermediate_size = int(8 * self.hidden_size / 3 / 128 + 1) * 128
+            else:
+                self.intermediate_size = 4 * self.hidden_size
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        assert self.hidden_size % self.num_heads == 0
+        assert self.num_heads % self.num_kv_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+# ---------------------------------------------------------------------------
+# Param init
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
+    """fp32 master params; stacked [L, ...] block arrays for lax.scan."""
+    L, H, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    k = jax.random.split(rng, 12)
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in))
+
+    blocks = {
+        "ln1_scale": jnp.ones((L, H), jnp.float32),
+        "wq": dense_init(k[0], (L, H, nq * d), H),
+        "wk": dense_init(k[1], (L, H, nkv * d), H),
+        "wv": dense_init(k[2], (L, H, nkv * d), H),
+        "wo": dense_init(k[3], (L, nq * d, H), nq * d) / math.sqrt(2 * L),
+        "ln2_scale": jnp.ones((L, H), jnp.float32),
+        "w_up": dense_init(k[4], (L, H, F), H),
+        "w_down": dense_init(k[5], (L, F, H), F) / math.sqrt(2 * L),
+    }
+    if cfg.mlp == "swiglu":
+        blocks["w_gate"] = dense_init(k[6], (L, H, F), H)
+    if cfg.norm == "layernorm":
+        blocks["ln1_bias"] = jnp.zeros((L, H), jnp.float32)
+        blocks["ln2_bias"] = jnp.zeros((L, H), jnp.float32)
+    if cfg.use_bias:
+        blocks["bq"] = jnp.zeros((L, nq * d), jnp.float32)
+        blocks["bk"] = jnp.zeros((L, nkv * d), jnp.float32)
+        blocks["bv"] = jnp.zeros((L, nkv * d), jnp.float32)
+        blocks["bo"] = jnp.zeros((L, H), jnp.float32)
+        blocks["b_up"] = jnp.zeros((L, F), jnp.float32)
+        blocks["b_down"] = jnp.zeros((L, H), jnp.float32)
+
+    params = {
+        "embed": {"embedding": jax.random.normal(k[7], (cfg.vocab_size, H), jnp.float32) * 0.02},
+        "blocks": blocks,
+        "final_norm": {"scale": jnp.ones((H, ), jnp.float32)},
+    }
+    if cfg.norm == "layernorm":
+        params["final_norm"]["bias"] = jnp.zeros((H, ), jnp.float32)
+    if cfg.positions == "learned":
+        params["pos_embed"] = {"embedding": jax.random.normal(k[8], (cfg.max_seq_len, H), jnp.float32) * 0.02}
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": dense_init(k[9], (H, cfg.vocab_size), H)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# TP partition rules (composed with ZeRO by ZeroShardingPolicy)
+# ---------------------------------------------------------------------------
+
+def partition_rules(cfg: Optional[TransformerConfig] = None) -> PartitionRules:
+    """Megatron-style TP sharding over the ``model`` mesh axis: qkv/up
+    column-parallel, out/down row-parallel, vocab-sharded embeddings — the
+    layout the reference's AutoTP infers (``module_inject/auto_tp.py:187``)."""
+    return PartitionRules([
+        (r"embed/embedding", P(MODEL_AXIS, None)),
+        (r"pos_embed/embedding", P(None, None)),
+        (r"blocks/w[qkv]$", P(None, None, MODEL_AXIS)),
+        (r"blocks/b[qkv]$", P(None, MODEL_AXIS)),
+        (r"blocks/wo$", P(None, MODEL_AXIS, None)),
+        (r"blocks/(w_up|w_gate)$", P(None, None, MODEL_AXIS)),
+        (r"blocks/b_up$", P(None, MODEL_AXIS)),
+        (r"blocks/w_down$", P(None, MODEL_AXIS, None)),
+        (r"lm_head/kernel", P(None, MODEL_AXIS)),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _norm(x, scale, bias, kind, eps):
+    x32 = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+        out = x32 * scale
+    else:
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean((x32 - mu)**2, axis=-1, keepdims=True)
+        out = (x32 - mu) * jax.lax.rsqrt(var + eps) * scale + (bias if bias is not None else 0.0)
+    return out.astype(x.dtype)
+
+
+def rope_table(cfg: TransformerConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    d = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta**(jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.einsum("s,f->sf", positions.astype(jnp.float32), inv_freq)
+    return jnp.sin(freqs), jnp.cos(freqs)
+
+
+def apply_rope(x, sin, cos):
+    """x: [B, S, n, d]; sin/cos: [S, d/2] (broadcast over batch/heads)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    sin = sin[None, :, None, :]
+    cos = cos[None, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def reference_attention(q, k, v, causal=True, segment_ids=None):
+    """jnp einsum attention — the numerics baseline every Pallas kernel is
+    tested against (mirrors reference tests/unit/ops strategy)."""
+    B, S, nq, d = q.shape
+    nkv = k.shape[2]
+    group = nq // nkv
+    qf = q.astype(jnp.float32) / math.sqrt(d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    qf = qf.reshape(B, S, nkv, group, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qf, kf)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        scores = jnp.where(seg_mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bkgst,btkd->bskgd", probs, vf)
+    return ctx.reshape(B, S, nq, d).astype(q.dtype)
+
+
+def _attention(cfg: TransformerConfig, q, k, v):
+    impl = cfg.attention_impl
+    if impl == "auto":
+        try:
+            import jax
+
+            impl = "flash" if jax.default_backend() == "tpu" else "reference"
+        except Exception:
+            impl = "reference"
+    if impl == "flash":
+        from ..ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    return reference_attention(q, k, v, causal=True)
+
+
+def _block(cfg: TransformerConfig, x, layer, sin, cos):
+    """One transformer block; ``layer`` holds this layer's slice of the
+    stacked arrays."""
+    dt = cfg.dtype
+    B, S, H = x.shape
+    nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    h = _norm(x, layer["ln1_scale"], layer.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+    q = jnp.einsum("bsh,hd->bsd", h, layer["wq"].astype(dt))
+    k = jnp.einsum("bsh,hd->bsd", h, layer["wk"].astype(dt))
+    v = jnp.einsum("bsh,hd->bsd", h, layer["wv"].astype(dt))
+    if cfg.use_bias:
+        q = q + layer["bq"].astype(dt)
+        k = k + layer["bk"].astype(dt)
+        v = v + layer["bv"].astype(dt)
+    q = q.reshape(B, S, nq, d)
+    k = k.reshape(B, S, nkv, d)
+    v = v.reshape(B, S, nkv, d)
+    if cfg.positions == "rotary":
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+
+    if cfg.sequence_parallel:
+        from ..sequence.layer import ulysses_attention_gspmd
+
+        ctx = ulysses_attention_gspmd(partial(_attention, cfg), q, k, v)
+    else:
+        ctx = _attention(cfg, q, k, v)
+    ctx = ctx.reshape(B, S, nq * d)
+    attn_out = jnp.einsum("bsd,dh->bsh", ctx, layer["wo"].astype(dt))
+    if cfg.use_bias:
+        attn_out = attn_out + layer["bo"].astype(dt)
+    x = x + attn_out
+
+    h = _norm(x, layer["ln2_scale"], layer.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+    up = jnp.einsum("bsh,hf->bsf", h, layer["w_up"].astype(dt))
+    if cfg.use_bias:
+        up = up + layer["b_up"].astype(dt)
+    if cfg.mlp == "swiglu":
+        gate = jnp.einsum("bsh,hf->bsf", h, layer["w_gate"].astype(dt))
+        act = jax.nn.silu(gate) * up
+    else:
+        act = jax.nn.gelu(up)
+    down = jnp.einsum("bsf,fh->bsh", act, layer["w_down"].astype(dt))
+    if cfg.use_bias:
+        down = down + layer["b_down"].astype(dt)
+    x = x + down
+    return _activation_constraint(cfg, x)
+
+
+def _activation_constraint(cfg: TransformerConfig, x):
+    """Pin activation layout [B, S, H]: batch over data, sequence over seq."""
+    try:
+        return lax.with_sharding_constraint(x, P(DATA_AXIS, SEQ_AXIS if cfg.sequence_parallel else None, None))
+    except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
+        return x
+
+
+def forward(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array) -> jax.Array:
+    """Token ids [B, S] → logits [B, S, V]."""
+    dt = cfg.dtype
+    B, S = input_ids.shape
+    x = params["embed"]["embedding"].astype(dt)[input_ids]
+    if cfg.positions == "learned":
+        x = x + params["pos_embed"]["embedding"].astype(dt)[:S][None]
+    x = _activation_constraint(cfg, x)
+
+    positions = jnp.arange(S)
+    sin, cos = rope_table(cfg, positions) if cfg.positions == "rotary" else (None, None)
+
+    block_fn = partial(_block, cfg)
+    if cfg.remat:
+        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+        block_fn = jax.checkpoint(block_fn, policy=policy, static_argnums=())
+
+    def scan_body(carry, layer):
+        return block_fn(carry, layer, sin, cos), None
+
+    x, _ = lax.scan(scan_body, x, params["blocks"])
+    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["embedding"].astype(dt))
+    else:
+        logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(dt))
+    return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache inference path (v1 inference engine; reference
+# ``ops/transformer/inference`` fused qkv+rotary+kv-append+softmax_context)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: int, dtype=None):
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, batch_size, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype), "length": jnp.zeros([], jnp.int32)}
+
+
+def _cached_attention(cfg, q, ck, cv, q_pos0, cache_len_total):
+    """q: [B, T, nq, d] at absolute positions q_pos0..q_pos0+T-1; ck/cv:
+    [B, Smax, nkv, d] (positions < cache_len_total are valid)."""
+    B, T, nq, d = q.shape
+    Smax = ck.shape[1]
+    nkv = ck.shape[2]
+    group = nq // nkv
+    qf = q.astype(jnp.float32).reshape(B, T, nkv, group, d) / math.sqrt(d)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qf, ck.astype(jnp.float32))
+    k_pos = jnp.arange(Smax)[None, None, None, None, :]
+    q_pos = (q_pos0 + jnp.arange(T))[None, None, None, :, None]
+    mask = (k_pos <= q_pos) & (k_pos < cache_len_total)
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bkgts,bskd->btkgd", probs, cv.astype(jnp.float32))
+    return ctx.reshape(B, T, nq * d).astype(q.dtype)
+
+
+def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
+    """Prefill/decode step: consumes tokens at positions [len, len+T), appends
+    their k/v into the cache and returns (logits [B, T, V], new_cache)."""
+    dt = cfg.dtype
+    B, T = input_ids.shape
+    start = cache["length"]
+    x = params["embed"]["embedding"].astype(dt)[input_ids]
+    if cfg.positions == "learned":
+        pos_table = params["pos_embed"]["embedding"].astype(dt)
+        x = x + jax.lax.dynamic_slice_in_dim(pos_table, start, T, axis=0)[None]
+    positions = start + jnp.arange(T)
+    sin, cos = rope_table(cfg, positions) if cfg.positions == "rotary" else (None, None)
+    nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def scan_body(carry, layer_and_cache):
+        x = carry
+        layer, ck, cv = layer_and_cache
+        h = _norm(x, layer["ln1_scale"], layer.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+        q = jnp.einsum("bsh,hd->bsd", h, layer["wq"].astype(dt))
+        k = jnp.einsum("bsh,hd->bsd", h, layer["wk"].astype(dt))
+        v = jnp.einsum("bsh,hd->bsd", h, layer["wv"].astype(dt))
+        if cfg.use_bias:
+            q, k, v = q + layer["bq"].astype(dt), k + layer["bk"].astype(dt), v + layer["bv"].astype(dt)
+        q = q.reshape(B, T, nq, d)
+        k = k.reshape(B, T, nkv, d)
+        v = v.reshape(B, T, nkv, d)
+        if cfg.positions == "rotary":
+            q = apply_rope(q, sin, cos)
+            k = apply_rope(k, sin, cos)
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), start, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), start, axis=1)
+        ctx = _cached_attention(cfg, q, ck, cv, start, start + T)
+        x = x + jnp.einsum("bsd,dh->bsh", ctx, layer["wo"].astype(dt)) + \
+            (layer["bo"].astype(dt) if cfg.use_bias else 0.0)
+        h = _norm(x, layer["ln2_scale"], layer.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        up = jnp.einsum("bsh,hf->bsf", h, layer["w_up"].astype(dt))
+        if cfg.use_bias:
+            up = up + layer["b_up"].astype(dt)
+        act = jax.nn.silu(jnp.einsum("bsh,hf->bsf", h, layer["w_gate"].astype(dt))) * up \
+            if cfg.mlp == "swiglu" else jax.nn.gelu(up)
+        down = jnp.einsum("bsf,fh->bsh", act, layer["w_down"].astype(dt))
+        if cfg.use_bias:
+            down = down + layer["b_down"].astype(dt)
+        return x + down, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["embedding"].astype(dt))
+    else:
+        logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(dt))
+    new_cache = {"k": new_k, "v": new_v, "length": start + T}
+    return logits.astype(jnp.float32), new_cache
+
+
+def loss_fn(cfg: TransformerConfig, params, batch, rng=None):
+    """Next-token cross entropy. ``batch``: dict with 'input_ids' [B, S] and
+    optional 'labels' (defaults to shifted input) and 'loss_mask'."""
+    input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+    logits = forward(cfg, params, input_ids)
+    if isinstance(batch, dict) and "labels" in batch:
+        labels = batch["labels"]
+        shift_logits, shift_labels = logits, labels
+    else:
+        shift_logits = logits[:, :-1]
+        shift_labels = input_ids[:, 1:]
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    token_ll = jnp.take_along_axis(logp, shift_labels[..., None], axis=-1)[..., 0]
+    if isinstance(batch, dict) and "loss_mask" in batch:
+        mask = batch["loss_mask"][:, :token_ll.shape[1]].astype(jnp.float32)
+        return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return -token_ll.mean()
+
+
+class TransformerLM:
+    """Model object consumed by ``deepspeed_tpu.initialize``: bundles config,
+    init, loss and TP partition rules (the engine's model protocol)."""
+
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+
+    def init(self, rng, example_batch=None):
+        return init_params(self.config, rng)
+
+    def apply(self, params, input_ids):
+        return forward(self.config, params, input_ids)
+
+    def loss(self, params, batch, rng=None):
+        return loss_fn(self.config, params, batch, rng)
+
+    def partition_rules(self):
+        return partition_rules(self.config)
+
+    def num_params(self, params=None):
+        if params is None:
+            params = jax.eval_shape(lambda r: init_params(self.config, r), jax.random.PRNGKey(0))
+        return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))

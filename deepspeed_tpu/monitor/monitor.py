@@ -1,0 +1,118 @@
+"""Metric event sinks.
+
+Analog of the reference ``deepspeed/monitor/monitor.py:29`` — ``MonitorMaster``
+fans ``write_events([(name, value, step), ...])`` out to TensorBoard / W&B /
+CSV sinks. Only the process-0 host writes (rank gating identical to the
+reference's ``self.enabled and rank == 0`` checks).
+"""
+
+import os
+import csv as _csv
+
+from ..comm import get_rank
+
+
+class Monitor:
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+        self.enabled = getattr(monitor_config, "enabled", False)
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.enabled = tensorboard_config.enabled and get_rank() == 0
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                log_dir = os.path.join(tensorboard_config.output_path or "./runs", tensorboard_config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except Exception:
+                self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if self.enabled and self.summary_writer is not None:
+            for event in event_list:
+                self.summary_writer.add_scalar(*event)
+            if flush:
+                self.summary_writer.flush()
+
+    def flush(self):
+        if self.summary_writer is not None:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        self.enabled = wandb_config.enabled and get_rank() == 0
+        if self.enabled:
+            try:
+                import wandb
+
+                wandb.init(project=wandb_config.project, group=wandb_config.group, entity=wandb_config.team)
+                self._wandb = wandb
+            except Exception:
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if self.enabled:
+            for name, value, step in event_list:
+                self._wandb.log({name: value}, step=int(step))
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.filenames = {}
+        self.enabled = csv_config.enabled and get_rank() == 0
+        self.output_path = csv_config.output_path or "./csv_monitor"
+        self.job_name = csv_config.job_name
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            safe = name.replace("/", "_")
+            path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
+            new = safe not in self.filenames
+            self.filenames[safe] = path
+            with open(path, "a", newline="") as f:
+                w = _csv.writer(f)
+                if new:
+                    w.writerow(["step", safe])
+                w.writerow([int(step), float(value)])
+
+
+class MonitorMaster(Monitor):
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.tb_monitor = None
+        self.wandb_monitor = None
+        self.csv_monitor = None
+        self.enabled = monitor_config.enabled
+        if get_rank() == 0:
+            if monitor_config.tensorboard.enabled:
+                self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+            if monitor_config.wandb.enabled:
+                self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+            if monitor_config.csv_monitor.enabled:
+                self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+
+    def write_events(self, event_list):
+        if get_rank() == 0:
+            for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+                if m is not None:
+                    m.write_events(event_list)

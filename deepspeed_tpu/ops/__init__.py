@@ -1,0 +1,50 @@
+"""Op registry.
+
+Analog of the reference ``op_builder/all_ops.py`` registry +
+``op_builder/builder.py`` JIT machinery. On TPU there is nothing to compile at
+import time — Pallas kernels are traced/compiled by XLA on first call — so a
+"builder" here is a lazy import handle that reports availability, mirroring
+``ds_report``'s compatibility matrix semantics.
+"""
+
+import importlib
+
+
+class OpBuilder:
+
+    NAME = "base"
+
+    def __init__(self, module_path, symbol=None):
+        self.module_path = module_path
+        self.symbol = symbol
+
+    def is_compatible(self):
+        try:
+            importlib.import_module(self.module_path)
+            return True
+        except Exception:
+            return False
+
+    def load(self):
+        mod = importlib.import_module(self.module_path)
+        return getattr(mod, self.symbol) if self.symbol else mod
+
+
+def _builder(name, module_path, symbol=None):
+    b = OpBuilder(module_path, symbol)
+    b.NAME = name
+    return b
+
+
+# Registry keyed by the reference builder class names (op_builder/*.py) so
+# get_accelerator().create_op_builder("FusedAdamBuilder") resolves here.
+op_registry = {
+    "FusedAdamBuilder": _builder("fused_adam", "deepspeed_tpu.ops.adam.fused_adam"),
+    "FusedLambBuilder": _builder("fused_lamb", "deepspeed_tpu.runtime.optimizers"),
+    "CPUAdamBuilder": _builder("cpu_adam", "deepspeed_tpu.ops.adam.fused_adam"),
+    "QuantizerBuilder": _builder("quantizer", "deepspeed_tpu.ops.pallas.quant"),
+    "FlashAttnBuilder": _builder("flash_attn", "deepspeed_tpu.ops.pallas.flash_attention"),
+    "RaggedOpsBuilder": _builder("ragged_ops", "deepspeed_tpu.ops.pallas.paged_attention"),
+    "InferenceCoreBuilder": _builder("inference_core_ops", "deepspeed_tpu.ops.pallas.rmsnorm"),
+    "AsyncIOBuilder": _builder("async_io", "deepspeed_tpu.ops.aio"),
+}

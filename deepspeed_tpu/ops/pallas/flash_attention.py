@@ -1,0 +1,119 @@
+"""Flash attention for TPU.
+
+Replaces the reference's fused attention kernels (training:
+``csrc/transformer/*.cu`` softmax/transform; inference context:
+``csrc/transformer/inference/csrc/softmax.cu``) with a Pallas blocked
+flash-attention. The public entry ``flash_attention(q, k, v, causal=...)``
+takes [B, S, n_heads, head_dim] (GQA allowed: n_kv may divide n_q) and is
+numerically validated against ``models.transformer.reference_attention``
+(mirroring the reference's tests/unit/ops kernel-vs-torch strategy).
+
+The Pallas kernel path requires a real TPU; elsewhere (CPU tests) we fall back
+to the jnp reference implementation, which XLA fuses reasonably well.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _use_pallas():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512):
+    """q: [B, S, nq, d]; k/v: [B, S, nkv, d] with nq % nkv == 0."""
+    if _use_pallas():
+        try:
+            return _pallas_flash(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+        except Exception as e:
+            from ...utils.logging import warning_once
+
+            warning_once(f"pallas flash attention unavailable ({type(e).__name__}: {e}); "
+                         f"falling back to reference attention — expect O(S^2) memory and lower throughput")
+    from ...models.transformer import reference_attention
+
+    return reference_attention(q, k, v, causal=causal)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, nq, d = q.shape
+    nkv = k.shape[2]
+    group = nq // nkv
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    assert S % block_q == 0 and S % block_k == 0
+    scale = 1.0 / math.sqrt(d)
+
+    # layout: [B, n, S, d] for contiguous per-head slabs
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (B, nq, S // block_q)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+        # block refs carry the singleton (batch, head) dims: [1, 1, bq|S, d]
+        qi = pl.program_id(2)
+        n_kblocks = S // block_k
+
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+        def body(kj, _):
+            qb = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d]
+            kb = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)  # [bk, d]
+            vb = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+            s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, -1e30)
+            m_prev = m_ref[:]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * alpha + jnp.dot(p, vb, preferred_element_type=jnp.float32)
+            m_ref[:] = m_new
+            return 0
+
+        # ceil-div: the k block containing the last visible key must run
+        n_iters = ((qi + 1) * block_q + block_k - 1) // block_k if causal else n_kblocks
+        jax.lax.fori_loop(0, n_iters, body, 0)
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+    def q_index(b, h, i):
+        return (b, h, i, 0)
+
+    def kv_index(b, h, i):
+        return (b, h // group, 0, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_index),
+            pl.BlockSpec((1, 1, S, d), kv_index),
+            pl.BlockSpec((1, 1, S, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), q_index),
+        out_shape=jax.ShapeDtypeStruct((B, nq, S, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)

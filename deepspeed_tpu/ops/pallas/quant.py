@@ -1,0 +1,75 @@
+"""Blockwise quantization kernels.
+
+TPU equivalent of the reference quantization suite
+(``csrc/quantization/{quantize,dequantize,quant_reduce,...}.cu``, 2,289 LoC,
+exposed via ``QuantizerBuilder``) which powers ZeRO++'s quantized-weight
+all-gather (qwZ) and quantized-gradient all-to-all reduce (qgZ,
+``runtime/comm/coalesced_collectives.py:31``). Here quant/dequant are
+jnp-level (XLA fuses the scale/round chain into surrounding ops); the
+symmetric int8 blockwise format matches the reference's group-wise scheme.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_blockwise(x: jax.Array, block_size: int = 256, dtype=jnp.int8) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-block int8 quantization of the last axis.
+
+    Returns (q, scales) with q: same shape as x in int8, scales:
+    x.shape[:-1] + [n_blocks] in fp32.
+    """
+    orig_shape = x.shape
+    n = orig_shape[-1]
+    pad = (-n) % block_size
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    blocks = x.reshape(*x.shape[:-1], -1, block_size).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scale = absmax / 127.0
+    q = jnp.round(blocks / jnp.maximum(scale, 1e-12)).astype(dtype)
+    q = q.reshape(*x.shape[:-1], -1)
+    if pad:
+        q = q[..., :n]
+    return q, scale[..., 0]
+
+
+def dequantize_blockwise(q: jax.Array, scales: jax.Array, block_size: int = 256) -> jax.Array:
+    n = q.shape[-1]
+    pad = (-n) % block_size
+    if pad:
+        q = jnp.pad(q, [(0, 0)] * (q.ndim - 1) + [(0, pad)])
+    blocks = q.reshape(*q.shape[:-1], -1, block_size).astype(jnp.float32)
+    x = blocks * scales[..., None]
+    x = x.reshape(*q.shape[:-1], -1)
+    if pad:
+        x = x[..., :n]
+    return x
+
+
+def quantized_all_gather(x, axis_name: str, block_size: int = 256):
+    """ZeRO++ qwZ: all-gather int8 + local dequant — 4x less ICI traffic than
+    fp32 all-gather (reference ``partition_parameters.py:1139`` quantized
+    handles). In-jit only."""
+    q, s = quantize_blockwise(x, block_size)
+    q_full = jax.lax.all_gather(q, axis_name, axis=0, tiled=True)
+    s_full = jax.lax.all_gather(s, axis_name, axis=0, tiled=True)
+    return dequantize_blockwise(q_full, s_full, block_size)
+
+
+def quantized_psum_scatter(x, axis_name: str, block_size: int = 256):
+    """ZeRO++ qgZ-style reduced-precision gradient reduce-scatter (reference
+    ``all_to_all_quant_reduce`` coalesced_collectives.py:31): quantize, a2a,
+    local dequant+reduce. In-jit only."""
+    n_dev = jax.lax.psum(1, axis_name)
+    q, s = quantize_blockwise(x, block_size)
+    # all-to-all: each device receives its shard from every peer
+    q_sh = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    s_sh = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    deq = dequantize_blockwise(q_sh, s_sh, block_size)
+    # sum the n_dev received contributions (concatenated along axis 0)
+    parts = jnp.split(deq, n_dev, axis=0)
+    return functools.reduce(jnp.add, parts)

@@ -1,0 +1,33 @@
+"""Fused RMSNorm / LayerNorm.
+
+TPU analog of the reference inference norm kernels
+(``csrc/transformer/inference/csrc/{layer_norm,rms_norm}.cu`` and v2
+``kernels/core_ops/cuda_rms_norm``). jnp-level: XLA fuses the reduction +
+scale chain; kept as a named op so models and inference modules share one
+numerics-tested implementation.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, scale, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    out = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps) * scale
+    return out.astype(x.dtype)
+
+
+def layer_norm(x, scale, bias=None, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu)**2, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps) * scale
+    if bias is not None:
+        out = out + bias
+    return out.astype(x.dtype)
+
+
+def rms_norm_residual(x, residual, scale, eps: float = 1e-5):
+    """Fused residual-add + rmsnorm (reference ``pre_rms_norm`` pattern)."""
+    s = x + residual
+    return rms_norm(s, scale, eps), s

@@ -1,0 +1,4 @@
+from .mesh import (MeshConfig, build_mesh, single_device_mesh, mesh_axis_size, replicated, sharding, DATA_AXIS,
+                   MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS, AXIS_ORDER)
+from .topology import (ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology, PipelineParallelGrid)
+from . import groups

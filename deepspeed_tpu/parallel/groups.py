@@ -1,0 +1,163 @@
+"""Parallel "group" registry.
+
+TPU-native analog of the reference ``deepspeed/utils/groups.py`` (562 LoC:
+``initialize:51``, ``_get_expert_parallel_ranks:179``,
+``_get_sequence_parallel_group:468``, ``_create_zero_param_parallel_group:505``).
+The reference hands out torch process-group handles; here a "group" is a tuple
+of mesh axis names — the unit that ``jax.lax`` collectives and
+``PartitionSpec``s consume. A module that would have called
+``dist.all_reduce(x, group=get_data_parallel_group())`` instead applies
+``jax.lax.psum(x, axis_name=get_data_parallel_group())`` inside ``shard_map``,
+or simply annotates shardings and lets XLA insert the collective.
+"""
+
+from typing import Optional, Tuple
+
+from .mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, MeshConfig, build_mesh, mesh_axis_size)
+from ..utils.logging import log_dist
+
+_WORLD_MESH = None
+_MESH_CONFIG = None
+_EXPERT_PARALLEL_SIZE = 1
+# ZeRO++ hpZ secondary partition size (reference groups.py:505): number of
+# data-axis neighbors forming the intra-node secondary shard sub-mesh.
+_ZERO_PARAM_INTRA_PARALLEL_SIZE = None
+mesh = None  # public alias of the world mesh (like reference `groups.mpu`)
+
+
+def initialize_mesh(mesh_config: Optional[MeshConfig] = None, devices=None, ep_size: int = 1):
+    """Create the world mesh; analog of ``groups.initialize(ep_size, mpu)``."""
+    global _WORLD_MESH, _MESH_CONFIG, _EXPERT_PARALLEL_SIZE, mesh
+    _MESH_CONFIG = mesh_config or MeshConfig()
+    if ep_size > 1:
+        _MESH_CONFIG.expert = ep_size
+    _WORLD_MESH = build_mesh(_MESH_CONFIG, devices=devices)
+    _EXPERT_PARALLEL_SIZE = max(1, _MESH_CONFIG.expert)
+    mesh = _WORLD_MESH
+    log_dist(f"initialized device mesh {dict(_WORLD_MESH.shape)} ep_size={_EXPERT_PARALLEL_SIZE}", ranks=[0])
+    return _WORLD_MESH
+
+
+def set_mesh(new_mesh, ep_size: int = 1):
+    """Inject an externally built mesh (analog of passing an mpu object)."""
+    global _WORLD_MESH, _EXPERT_PARALLEL_SIZE, mesh
+    _WORLD_MESH = new_mesh
+    _EXPERT_PARALLEL_SIZE = ep_size
+    mesh = new_mesh
+    return _WORLD_MESH
+
+
+def get_mesh():
+    assert _WORLD_MESH is not None, "mesh not initialized; call initialize_mesh() or deepspeed_tpu.initialize()"
+    return _WORLD_MESH
+
+
+def is_initialized():
+    return _WORLD_MESH is not None
+
+
+def reset():
+    global _WORLD_MESH, _MESH_CONFIG, _EXPERT_PARALLEL_SIZE, _ZERO_PARAM_INTRA_PARALLEL_SIZE, mesh
+    _WORLD_MESH = None
+    _MESH_CONFIG = None
+    _EXPERT_PARALLEL_SIZE = 1
+    _ZERO_PARAM_INTRA_PARALLEL_SIZE = None
+    mesh = None
+
+
+# ---- group accessors: each returns the mesh axis name(s) of that dimension ----
+
+def get_data_parallel_group() -> Tuple[str, ...]:
+    """ZeRO/DP sharding axes. When sequence parallelism is on, ZeRO shards over
+    (data, seq) — the reference's ``seq_data_parallel_group`` (engine.py:1546)."""
+    if mesh_axis_size(get_mesh(), SEQ_AXIS) > 1:
+        return (DATA_AXIS, SEQ_AXIS)
+    return (DATA_AXIS, )
+
+
+def get_pure_data_parallel_group() -> Tuple[str, ...]:
+    return (DATA_AXIS, )
+
+
+def get_model_parallel_group() -> Tuple[str, ...]:
+    return (MODEL_AXIS, )
+
+
+get_tensor_model_parallel_group = get_model_parallel_group
+
+
+def get_pipe_parallel_group() -> Tuple[str, ...]:
+    return (PIPE_AXIS, )
+
+
+def get_sequence_parallel_group() -> Tuple[str, ...]:
+    return (SEQ_AXIS, )
+
+
+def get_sequence_data_parallel_group() -> Tuple[str, ...]:
+    return (DATA_AXIS, SEQ_AXIS)
+
+
+def get_expert_parallel_group(group_name: str = "default") -> Tuple[str, ...]:
+    """Experts shard over the leading slice of the (data, seq) axes; all-to-all
+    dispatch runs over these axes (reference ``_create_expert_and_data_parallel``
+    groups.py:113)."""
+    return get_data_parallel_group()
+
+
+def get_expert_data_parallel_group(group_name: str = "default") -> Tuple[str, ...]:
+    return get_data_parallel_group()
+
+
+# ---- sizes / ranks ----
+
+def get_data_parallel_world_size() -> int:
+    m = get_mesh()
+    out = 1
+    for a in get_data_parallel_group():
+        out *= mesh_axis_size(m, a)
+    return out
+
+
+def get_model_parallel_world_size() -> int:
+    return mesh_axis_size(get_mesh(), MODEL_AXIS)
+
+
+get_tensor_model_parallel_world_size = get_model_parallel_world_size
+
+
+def get_pipe_parallel_world_size() -> int:
+    return mesh_axis_size(get_mesh(), PIPE_AXIS)
+
+
+def get_sequence_parallel_world_size() -> int:
+    return mesh_axis_size(get_mesh(), SEQ_AXIS)
+
+
+def get_expert_parallel_world_size(group_name: str = "default") -> int:
+    return _EXPERT_PARALLEL_SIZE
+
+
+def get_expert_data_parallel_world_size(group_name: str = "default") -> int:
+    return max(1, get_data_parallel_world_size() // max(1, _EXPERT_PARALLEL_SIZE))
+
+
+def get_world_size() -> int:
+    return get_mesh().size
+
+
+# ---- ZeRO++ hpZ secondary partition (reference groups.py:505) ----
+
+def create_zero_param_parallel_group(group_size: int):
+    global _ZERO_PARAM_INTRA_PARALLEL_SIZE
+    dp = get_data_parallel_world_size()
+    assert dp % group_size == 0, f"hpZ group size {group_size} must divide dp world size {dp}"
+    _ZERO_PARAM_INTRA_PARALLEL_SIZE = group_size
+
+
+def get_zero_param_intra_parallel_group_world_size():
+    return _ZERO_PARAM_INTRA_PARALLEL_SIZE
+
+
+def _get_expert_parallel_size():
+    return _EXPERT_PARALLEL_SIZE

@@ -1,0 +1,99 @@
+"""Device-mesh construction — the spine of all parallelism.
+
+The reference builds torch process groups per parallel dimension
+(``deepspeed/utils/groups.py``: DP/TP/EP/SP/hpZ, plus the pipe topology grid in
+``runtime/pipe/topology.py``). The TPU-native equivalent is ONE
+``jax.sharding.Mesh`` whose named axes carry every parallel dimension; XLA then
+lowers sharding annotations to collectives over ICI/DCN. Axis vocabulary:
+
+  - ``data``    — data parallel / ZeRO sharding axis (reference DP + ZeRO groups)
+  - ``model``   — tensor parallel (reference TP/mpu groups)
+  - ``pipe``    — pipeline stages (reference PipelineParallelGrid)
+  - ``seq``     — Ulysses/ring sequence parallel (reference sequence groups)
+  - ``expert``  — expert parallel (reference EP groups); folded into ``data``
+                  when experts ride the data axis, as the reference's
+                  expert-data groups do (groups.py:113-294)
+
+Axis sizes with value ``-1`` absorb the remaining devices (like a reshape).
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+# Canonical axis order: pipe-major so pipeline stages land on contiguous
+# device blocks (ICI neighbors), then data, then seq, then model innermost so
+# TP rides the fastest ICI links — mirroring the reference's default
+# "pipe-data-model" topology order (pipe/topology.py:244) with seq added.
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclass
+class MeshConfig:
+    """Axis sizes for the global device mesh (TPU section of the JSON config)."""
+
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1  # expert <= data * seq; experts shard over (data, seq) axes
+    axis_order: Sequence[str] = field(default_factory=lambda: list(AXIS_ORDER))
+
+    def resolve(self, n_devices: int) -> dict:
+        sizes = {PIPE_AXIS: self.pipe, DATA_AXIS: self.data, SEQ_AXIS: self.seq, MODEL_AXIS: self.model}
+        unknown = [k for k, v in sizes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
+        known = int(np.prod([v for v in sizes.values() if v != -1]))
+        if unknown:
+            if n_devices % known != 0:
+                raise ValueError(f"device count {n_devices} not divisible by fixed axes product {known}")
+            sizes[unknown[0]] = n_devices // known
+        total = int(np.prod(list(sizes.values())))
+        if total != n_devices:
+            raise ValueError(f"mesh axes {sizes} product {total} != device count {n_devices}")
+        dp_sp = sizes[DATA_AXIS] * sizes[SEQ_AXIS]
+        if self.expert not in (1, ) and dp_sp % self.expert != 0:
+            raise ValueError(f"expert parallel size {self.expert} must divide data*seq ({dp_sp})")
+        return sizes
+
+
+def build_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
+    """Build the global mesh.
+
+    Device order follows ``jax.devices()`` which on TPU enumerates in
+    ICI-topology order; the axis order above therefore keeps ``model``
+    (highest-traffic collectives) on nearest neighbors.
+    """
+    config = config or MeshConfig()
+    devices = devices if devices is not None else jax.devices()
+    sizes = config.resolve(len(devices))
+    shape = [sizes[a] for a in config.axis_order]
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(config.axis_order))
+
+
+def single_device_mesh(device=None) -> Mesh:
+    device = device or jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), axis_names=AXIS_ORDER)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,33 @@
+"""Pluggable checkpoint engine interface.
+
+Mirrors the reference ``runtime/checkpoint_engine/checkpoint_engine.py:9``
+(``CheckpointEngine`` with create/save/load/commit). Implementations:
+``OrbaxCheckpointEngine`` (sharded tensorstore layout — the TPU analog of
+``TorchCheckpointEngine``) and room for async engines (the reference's
+``NebulaCheckpointEngine`` analog is orbax async save).
+"""
+
+
+class CheckpointEngine(object):
+
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag):
+        """Log the start of a new checkpoint (reference semantics)."""
+        pass
+
+    def makedirs(self, path, exist_ok=False):
+        import os
+
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def save(self, state_dict, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None, template=None):
+        raise NotImplementedError
+
+    def commit(self, tag):
+        """Flag a checkpoint complete (atomic-visibility point)."""
+        raise NotImplementedError

@@ -1,0 +1,102 @@
+"""Config model base utilities.
+
+Analog of the reference ``deepspeed/runtime/config_utils.py`` whose
+``DeepSpeedConfigModel`` pydantic base adds deprecated-field migration and
+"auto" value support. We keep the same class name and behavior on pydantic v2.
+"""
+
+from functools import reduce
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Pydantic base for all config blocks.
+
+    Supports the reference's deprecated-field pattern: declare a field with
+    ``json_schema_extra={"deprecated": True, "new_param": "other_field"}`` and
+    the value migrates to the replacement on validation.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="allow",
+        protected_namespaces=(),
+        arbitrary_types_allowed=True,
+    )
+
+    def __init__(self, strict=False, **data):
+        if not strict:  # This is temporary until we refactor all DS configs, allows HF to load models
+            data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method")}
+        super().__init__(**data)
+        self._deprecated_fields_check()
+
+    def _process_deprecated_field(self, dep_field):
+        fields_set = self.model_fields_set
+        kwargs = type(self).model_fields[dep_field].json_schema_extra or {}
+        new_param_fn = kwargs.get("new_param_fn", lambda x: x)
+        param_value = new_param_fn(getattr(self, dep_field))
+        new_param = kwargs.get("new_param", "")
+        dep_msg = kwargs.get("deprecated_msg", "")
+        if dep_field in fields_set:
+            from ..utils.logging import logger
+
+            logger.warning(f"Config parameter {dep_field} is deprecated" +
+                           (f" use {new_param} instead" if new_param else "") + (f". {dep_msg}" if dep_msg else ""))
+            if new_param and kwargs.get("set_new_param", True):
+                # Remove the deprecate field if there is a replacing field
+                try:
+                    delattr(self, dep_field)
+                except Exception:
+                    pass
+                # Set the new param value
+                new_param_nested = new_param.split(".")
+                if len(new_param_nested) > 1:
+                    # If the new param exists in a subconfig, we need to get
+                    # the fields set for that subconfig
+                    pydantic_config = reduce(getattr, new_param_nested[:-1], self)
+                    fields_set = pydantic_config.model_fields_set
+                else:
+                    pydantic_config = self
+                new_param_name = new_param_nested[-1]
+                assert (new_param_name not in fields_set
+                        ), f"Cannot provide deprecated parameter '{dep_field}' and replacing parameter '{new_param}' together"
+                setattr(pydantic_config, new_param_name, param_value)
+
+    def _deprecated_fields_check(self):
+        for field_name, field_info in type(self).model_fields.items():
+            kwargs = field_info.json_schema_extra
+            if isinstance(kwargs, dict) and kwargs.get("deprecated", False):
+                self._process_deprecated_field(field_name)
+
+
+def get_scalar_param(param_dict: Dict[str, Any], param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys when parsing JSON (reference behavior)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError("Duplicate keys in DeepSpeed config: {}".format(keys))
+    return d
+
+
+class ScientificNotationEncoder:
+    pass

@@ -1,0 +1,672 @@
+"""DeepSpeedEngine — the training orchestrator.
+
+TPU-native analog of the reference ``deepspeed/runtime/engine.py:175``
+(``DeepSpeedEngine(torch.nn.Module)``, 3,606 LoC: ``forward:1809``,
+``backward:1950``, ``step:2152``, ``save_checkpoint:3069``,
+``load_checkpoint:2721``). Design (SURVEY.md §7 "hard parts" #5): the
+reference's eager-looking ``forward/backward/step`` contract is preserved as a
+thin stateful wrapper over a *functional, fully-jitted* core:
+
+  * ``_train_step_fn``: (state, batch, rng) -> (state, metrics) — fused
+    fwd+bwd+clip+update, with gradient accumulation as a ``lax.scan`` over
+    microbatches. All ZeRO collectives are XLA-inserted from the sharding
+    annotations computed by ``ZeroShardingPolicy`` (see zero/partition.py).
+  * ``forward``/``backward``/``step``: the 3-call eager API accumulates
+    gradients into a sharded buffer and applies the update at the GAS
+    boundary — bitwise the same math, for drop-in DeepSpeed ergonomics.
+
+State lives in one donated pytree (params / opt_state / step / loss-scale),
+so each step updates HBM in place — the analog of the reference's fused
+multi-tensor optimizer applying updates without extra copies.
+"""
+
+import os
+import time
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .config import DeepSpeedConfig
+from .lr_schedules import get_lr_schedule_fn, LRScheduler
+from .optimizers import build_optimizer
+from .zero.partition import ZeroShardingPolicy, PartitionRules, constrain
+from ..accelerator import get_accelerator
+from ..comm import comm as dist
+from ..monitor.monitor import MonitorMaster
+from ..parallel import groups
+from ..parallel.mesh import DATA_AXIS, SEQ_AXIS, MeshConfig, build_mesh
+from ..utils.logging import logger, log_dist
+from ..utils.timer import (SynchronizedWallClockTimer, NoopTimer, ThroughputTimer, FORWARD_GLOBAL_TIMER,
+                           BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER)
+
+LATEST_FILE = "latest"  # reference `latest` tag file semantics
+
+
+class EngineTimers:
+    """Reference ``engine.py:140`` — micro/global timer split."""
+
+    def __init__(self, enable_micro_timers, enable_global_timers):
+        self.timers = SynchronizedWallClockTimer() if (enable_micro_timers or enable_global_timers) else NoopTimer()
+        self.enabled = enable_micro_timers or enable_global_timers
+
+
+class DeepSpeedEngine:
+
+    def __init__(self,
+                 model,
+                 config: DeepSpeedConfig,
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 lr_scheduler=None,
+                 mesh=None,
+                 example_batch=None,
+                 training_data=None,
+                 collate_fn=None,
+                 dont_change_device=False,
+                 seed: int = 42):
+        self.module = model
+        self.config = config
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.training_dataloader = None
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self._step_metrics = {}
+        self._grad_acc_buffer = None
+        self._pending_batches = []
+        self._compiled = {}
+        self._train_mode = True
+
+        # --- distributed bring-up (reference __init__.py:133 init_distributed) ---
+        if not dist.is_initialized():
+            dist.init_distributed(dist_backend=get_accelerator().communication_backend_name())
+
+        # --- mesh: single source of truth for all parallel dims ---
+        if mesh is not None:
+            self.mesh = groups.set_mesh(mesh, ep_size=getattr(config.tpu_config, "expert", 1))
+        elif groups.is_initialized():
+            self.mesh = groups.get_mesh()
+        else:
+            self.mesh = groups.initialize_mesh(config.tpu_config.mesh_config())
+        config.mesh = self.mesh
+
+        # ZeRO shards over (data, seq) when SP is on, but the *batch* triad is
+        # governed by the pure data axis — SP ranks share samples and split the
+        # sequence dim (reference distinguishes dp vs seq_dp groups the same
+        # way, engine.py:1143-1156).
+        self.dp_world_size = groups.get_data_parallel_world_size()
+        self.mp_world_size = groups.get_model_parallel_world_size()
+        self.seq_world_size = groups.get_sequence_parallel_world_size()
+        self.batch_dp_world_size = self.mesh.shape.get(DATA_AXIS, 1)
+        config.resolve_batch_config(self.batch_dp_world_size)
+
+        # --- precision policy ---
+        self.compute_dtype = (jnp.bfloat16 if config.bfloat16_enabled else
+                              (jnp.float16 if config.fp16_enabled else jnp.float32))
+        self.fp16_enabled = config.fp16_enabled
+        self.bfloat16_enabled = config.bfloat16_enabled
+        self.dynamic_loss_scale = self.fp16_enabled and config.loss_scale == 0
+
+        # --- ZeRO sharding policy ---
+        rules = model.partition_rules() if hasattr(model, "partition_rules") else PartitionRules()
+        mics = config.zero_config.mics_shard_size
+        self.zero_policy = ZeroShardingPolicy(self.mesh, stage=config.zero_optimization_stage, tp_rules=rules,
+                                              mics_shard_size=mics)
+        self.zero_enabled = config.zero_enabled
+
+        # --- optimizer chain ---
+        self.lr_schedule_fn, self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+        self.optimizer = self._configure_optimizer(optimizer)
+
+        # --- state init, sharded at construction (zero.Init equivalent:
+        #     params materialize directly into their shards, reference
+        #     partition_parameters.py:762) ---
+        self._rng = jax.random.PRNGKey(seed)
+        self.state = self._init_state(example_batch)
+
+        # --- data pipeline ---
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
+
+        # --- aux subsystems ---
+        self.monitor = MonitorMaster(config.monitor_config)
+        self.engine_timers = EngineTimers(enable_micro_timers=config.wall_clock_breakdown,
+                                          enable_global_timers=config.wall_clock_breakdown)
+        self.tput_timer = ThroughputTimer(config=None, batch_size=self.train_batch_size(),
+                                          steps_per_output=config.steps_per_print)
+        from .checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
+
+        self.checkpoint_engine = OrbaxCheckpointEngine(async_save=config.checkpoint_config.async_save)
+        if config.flops_profiler_config.enabled:
+            from ..profiling.flops_profiler import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(self)
+        log_dist(
+            f"DeepSpeedEngine ready: zero_stage={config.zero_optimization_stage} "
+            f"dtype={self.compute_dtype.__name__} mesh={dict(self.mesh.shape)} "
+            f"micro_bsz={config.train_micro_batch_size_per_gpu} gas={config.gradient_accumulation_steps}",
+            ranks=[0])
+
+    # ------------------------------------------------------------------
+    # configuration
+    # ------------------------------------------------------------------
+    def _configure_lr_scheduler(self, client_scheduler):
+        """Reference ``engine.py:911``: client scheduler wins, else config."""
+        if client_scheduler is not None:
+            if callable(client_scheduler) and not isinstance(client_scheduler, LRScheduler):
+                return client_scheduler, LRScheduler(client_scheduler)
+            return client_scheduler.schedule_fn, client_scheduler
+        name = self.config.scheduler_name
+        if name is not None:
+            base_lr = (self.config.optimizer_params or {}).get("lr", 1e-3)
+            fn = get_lr_schedule_fn(name, self.config.scheduler_params or {}, base_lr=base_lr)
+            return fn, LRScheduler(fn)
+        return None, None
+
+    def _configure_optimizer(self, client_optimizer):
+        """Reference ``engine.py:1227``: wrap client optimizer or build from
+        config; grad clipping composes in front (clip-by-global-norm is the
+        reference's ``unscale_and_clip_grads`` stage_1_and_2.py:1955)."""
+        if client_optimizer is not None:
+            tx = client_optimizer
+        else:
+            params = dict(self.config.optimizer_params or {})
+            lr = self.lr_schedule_fn if self.lr_schedule_fn is not None else params.get("lr", 1e-3)
+            tx = build_optimizer(self.config.optimizer_name, params, lr=lr)
+        chain = []
+        if self.config.gradient_clipping and self.config.gradient_clipping > 0:
+            chain.append(optax.clip_by_global_norm(self.config.gradient_clipping))
+        chain.append(tx)
+        return optax.chain(*chain) if len(chain) > 1 else tx
+
+    # ------------------------------------------------------------------
+    # state init
+    # ------------------------------------------------------------------
+    def _init_state(self, example_batch=None):
+        init_rng, self._rng = jax.random.split(self._rng)
+        param_shapes = jax.eval_shape(lambda r: self.module.init(r, example_batch), init_rng)
+        param_shardings = self.zero_policy.param_shardings(param_shapes)
+        opt_shapes = jax.eval_shape(self.optimizer.init, param_shapes)
+        opt_shardings = self.zero_policy.opt_state_shardings(opt_shapes, param_shapes)
+        scalar = NamedSharding(self.mesh, P())
+
+        state_shardings = {
+            "params": param_shardings,
+            "opt_state": opt_shardings,
+            "step": scalar,
+            "loss_scale": scalar,
+            "good_steps": scalar,
+        }
+        self._state_shardings = state_shardings
+
+        @partial(jax.jit, out_shardings=state_shardings)
+        def init_fn(rng):
+            params = self.module.init(rng, example_batch)
+            return {
+                "params": params,
+                "opt_state": self.optimizer.init(params),
+                "step": jnp.zeros([], jnp.int32),
+                "loss_scale": jnp.asarray(
+                    float(self.config.loss_scale) if (self.fp16_enabled and self.config.loss_scale) else
+                    (float(self.config.initial_dynamic_scale) if self.fp16_enabled else 1.0), jnp.float32),
+                "good_steps": jnp.zeros([], jnp.int32),
+            }
+
+        with self.mesh:
+            state = init_fn(init_rng)
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(state["params"]))
+        log_dist(f"initialized {n_params/1e6:.2f}M params sharded over mesh", ranks=[0])
+        return state
+
+    # ------------------------------------------------------------------
+    # functional core
+    # ------------------------------------------------------------------
+    def _loss_fn(self, params, batch, rng):
+        if hasattr(self.module, "loss"):
+            out = self.module.loss(params, batch, rng)
+        else:
+            out = self.module(params, batch, rng)
+        if isinstance(out, tuple):
+            return out[0], out[1] if len(out) > 1 else {}
+        return out, {}
+
+    def _microbatch_grads(self, params, batch, rng, loss_scale):
+        """One microbatch fwd+bwd. Loss is scaled for fp16 (reference
+        ``_scale_loss_by_gas``+loss scaler); grads are unscaled outside."""
+
+        def scaled_loss(p):
+            loss, aux = self._loss_fn(p, batch, rng)
+            return loss * loss_scale, (loss, aux)
+
+        grads, (loss, _aux) = jax.grad(scaled_loss, has_aux=True)(params)
+        grads = constrain(grads, self.zero_policy.grad_specs(params), self.mesh)
+        return grads, loss
+
+    def _apply_update(self, state, grads, grad_norm_ok):
+        """Unscale, update, advance loss scale — skipping on overflow
+        (reference ``has_overflow`` stage_1_and_2.py:2002 + DynamicLossScaler)."""
+        params, opt_state = state["params"], state["opt_state"]
+        inv_scale = 1.0 / state["loss_scale"]
+        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv_scale, grads)
+
+        finite = jnp.logical_and(
+            grad_norm_ok,
+            jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)])))
+
+        updates, new_opt_state = self.optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+
+        def sel(a, b):
+            return jnp.where(finite, a, b)
+
+        params = jax.tree_util.tree_map(sel, new_params, params)
+        opt_state = jax.tree_util.tree_map(sel, new_opt_state, opt_state)
+
+        # dynamic loss scale state machine
+        if self.fp16_enabled and self.dynamic_loss_scale:
+            args = self.config.dynamic_loss_scale_args
+            window, min_scale = args["scale_window"], args["min_scale"]
+            good = jnp.where(finite, state["good_steps"] + 1, 0)
+            scale = jnp.where(finite,
+                              jnp.where(good >= window, state["loss_scale"] * 2.0, state["loss_scale"]),
+                              jnp.maximum(state["loss_scale"] * 0.5, min_scale))
+            good = jnp.where(good >= window, 0, good)
+        else:
+            scale, good = state["loss_scale"], state["good_steps"]
+
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + finite.astype(jnp.int32),
+            "loss_scale": scale,
+            "good_steps": good,
+        }, finite
+
+    def _build_train_step(self, gas: int):
+        """Fused train step: scan over ``gas`` microbatches then update."""
+
+        def train_step(state, batches, rng):
+            params = state["params"]
+            grad_specs = self.zero_policy.grad_specs(params)
+
+            def micro(carry, mb):
+                acc, rng = carry
+                rng, sub = jax.random.split(rng)
+                grads, loss = self._microbatch_grads(params, mb, sub, state["loss_scale"])
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                acc = constrain(acc, grad_specs, self.mesh)
+                return (acc, rng), loss
+
+            zeros = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            zeros = constrain(zeros, grad_specs, self.mesh)
+            if gas == 1:
+                one = jax.tree_util.tree_map(lambda x: x[0], batches)
+                (acc, _), losses = micro((zeros, rng), one)
+                losses = losses[None]
+            else:
+                (acc, _), losses = jax.lax.scan(micro, (zeros, rng), batches)
+            acc = jax.tree_util.tree_map(lambda g: g / gas, acc)
+            new_state, finite = self._apply_update(state, acc, jnp.array(True))
+            grad_norm = optax.global_norm(acc)
+            metrics = {
+                "loss": jnp.mean(losses),
+                "grad_norm": grad_norm,
+                "overflow": jnp.logical_not(finite),
+                "lr": (self.lr_schedule_fn(state["step"]) if self.lr_schedule_fn is not None else
+                       jnp.asarray((self.config.optimizer_params or {}).get("lr", 0.0))),
+            }
+            return new_state, metrics
+
+        donate = (0, ) if self.config.tpu_config.donate_buffers else ()
+        return jax.jit(train_step, donate_argnums=donate, out_shardings=(self._state_shardings, None))
+
+    # ------------------------------------------------------------------
+    # public API — fused path
+    # ------------------------------------------------------------------
+    def train_batch(self, batch=None, data_iter=None):
+        """Run one full training step (all microbatches + optimizer update).
+
+        ``batch``: pytree with leading dim ``gas * micro_bsz`` (host local),
+        or ``data_iter`` yielding microbatches. Returns the mean loss.
+        This is the performant path (one compiled program per step), the
+        analog of PipelineEngine.train_batch (reference pipe/engine.py:348)
+        generalized to all parallel modes.
+        """
+        gas = self.config.gradient_accumulation_steps
+        micro = self.config.train_micro_batch_size_per_gpu
+        if batch is None:
+            assert data_iter is not None
+            mbs = [next(data_iter) for _ in range(gas)]
+            batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *mbs)
+        else:
+            batch = jax.tree_util.tree_map(lambda x: np.asarray(x).reshape(gas, -1, *np.shape(x)[1:]), batch)
+
+        if "train_step" not in self._compiled:
+            self._compiled["train_step"] = self._build_train_step(gas)
+        step_rng, self._rng = jax.random.split(self._rng)
+        self.tput_timer.start()
+        with self.mesh:
+            batch = self._shard_batch(batch, leading=("mb", ))
+            self.state, metrics = self._compiled["train_step"](self.state, batch, step_rng)
+        self.global_steps += 1
+        self.micro_steps += gas
+        self.global_samples += self.train_batch_size()
+        self.tput_timer.stop(global_step=True)
+        if self.fp16_enabled and bool(metrics["overflow"]):
+            self.skipped_steps += 1
+        self._record_metrics(metrics)
+        return metrics["loss"]
+
+    def _shard_batch(self, batch, leading=()):
+        """Place host batch onto the mesh: batch dim over data axes, sequence
+        dim over the seq axis when sequence parallelism is enabled."""
+        def place(x):
+            x = np.asarray(x)
+            nlead = len(leading)
+            spec = [None] * x.ndim
+            if x.ndim > nlead:
+                spec[nlead] = DATA_AXIS
+            if self.seq_world_size > 1 and x.ndim > nlead + 1:
+                spec[nlead + 1] = SEQ_AXIS
+            s = NamedSharding(self.mesh, P(*spec))
+            return jax.make_array_from_process_local_data(s, x)
+
+        return jax.tree_util.tree_map(place, batch)
+
+    # ------------------------------------------------------------------
+    # public API — eager 3-call path (drop-in DeepSpeed ergonomics)
+    # ------------------------------------------------------------------
+    def forward(self, batch, rng=None):
+        """Compute loss for one microbatch (reference ``forward:1809``).
+
+        Forward and backward share one compiled value_and_grad program: the
+        grads computed here are stashed and consumed by the matching
+        ``backward()`` call, so the 3-call API costs the same FLOPs as the
+        fused path (no forward recomputation). Thanks to async dispatch the
+        returned loss is a future; nothing blocks until the value is read.
+        """
+        fwd_rng, self._rng = jax.random.split(self._rng)
+        if not self._train_mode:  # eval: loss only, no grads
+            if "loss" not in self._compiled:
+                self._compiled["loss"] = jax.jit(lambda p, b, r: self._loss_fn(p, b, r)[0])
+            with self.mesh:
+                return self._compiled["loss"](self.state["params"], self._shard_batch(batch), fwd_rng)
+        if "grads" not in self._compiled:
+
+            def gfn(params, batch, rng, scale):
+                return self._microbatch_grads(params, batch, rng, scale)
+
+            self._compiled["grads"] = jax.jit(gfn)
+        with self.mesh:
+            batch = self._shard_batch(batch)
+            grads, loss = self._compiled["grads"](self.state["params"], batch, fwd_rng, self.state["loss_scale"])
+        self._pending_batches.append(grads)
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None, retain_graph=False):
+        """Accumulate grads for the last forward microbatch (reference
+        ``backward:1950``). The sharded accumulation buffer realizes ZeRO-2:
+        with stage>=2 each device holds only its gradient shard."""
+        assert self._pending_batches, "backward() called without a prior forward()"
+        grads = self._pending_batches.pop(0)
+        with self.mesh:
+            if self._grad_acc_buffer is None:
+                self._grad_acc_buffer = grads
+            else:
+                if "grad_add" not in self._compiled:
+                    self._compiled["grad_add"] = jax.jit(
+                        lambda a, b: jax.tree_util.tree_map(jnp.add, a, b), donate_argnums=(0, ))
+                self._grad_acc_buffer = self._compiled["grad_add"](self._grad_acc_buffer, grads)
+        self.micro_steps += 1
+        return loss
+
+    def is_gradient_accumulation_boundary(self):
+        """Reference ``engine.py`` same name: true when the next step() will
+        apply the optimizer."""
+        return len(self._pending_batches) == 0 and self._grad_acc_buffer is not None and \
+            self.micro_steps % self.config.gradient_accumulation_steps == 0
+
+    def step(self):
+        """Apply the optimizer at the GAS boundary (reference ``step:2152``)."""
+        gas = self.config.gradient_accumulation_steps
+        if self.micro_steps % gas != 0:
+            return  # mid-accumulation micro-step, nothing to do
+        assert self._grad_acc_buffer is not None, "step() called with no accumulated gradients"
+        if "apply" not in self._compiled:
+
+            def apply_fn(state, grads):
+                grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+                new_state, finite = self._apply_update(state, grads, jnp.array(True))
+                return new_state, finite
+
+            self._compiled["apply"] = jax.jit(apply_fn, donate_argnums=(0, 1),
+                                              out_shardings=(self._state_shardings, None))
+        with self.mesh:
+            self.state, finite = self._compiled["apply"](self.state, self._grad_acc_buffer)
+        self._grad_acc_buffer = None
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        if not bool(finite):
+            self.skipped_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+
+    # ------------------------------------------------------------------
+    # introspection (reference engine getters)
+    # ------------------------------------------------------------------
+    def get_global_grad_norm(self):
+        return self._step_metrics.get("grad_norm")
+
+    def get_lr(self):
+        if self.lr_schedule_fn is not None:
+            return [float(self.lr_schedule_fn(int(self.state["step"])))]
+        return [float((self.config.optimizer_params or {}).get("lr", 0.0))]
+
+    @property
+    def loss_scale(self):
+        return float(self.state["loss_scale"])
+
+    def train_batch_size(self):
+        return self.config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self.config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self):
+        return self.config.zero_optimization_stage
+
+    def get_batch_info(self):
+        return (self.train_batch_size(), self.train_micro_batch_size_per_gpu(), self.gradient_accumulation_steps())
+
+    def _record_metrics(self, metrics):
+        self._step_metrics = {k: v for k, v in metrics.items()}
+        if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
+            events = [("Train/Samples/train_loss", float(metrics["loss"]), self.global_samples),
+                      ("Train/Samples/lr", float(metrics["lr"]), self.global_samples)]
+            if self.fp16_enabled:
+                events.append(("Train/Samples/loss_scale", self.loss_scale, self.global_samples))
+            self.monitor.write_events(events)
+        if self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} loss={float(metrics['loss']):.4f} "
+                     f"lr={float(metrics['lr']):.3e} gnorm={float(metrics['grad_norm']):.3f}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # data pipeline (reference ``deepspeed_io`` engine.py:1716)
+    # ------------------------------------------------------------------
+    def _process_dp_coord(self):
+        """(dp_rank, dp_world) of THIS process along the batch data axis.
+
+        With model/seq axes spanning processes, multiple processes belong to
+        the same data-parallel replica and must draw the SAME samples; the
+        coordinate is derived from which data-axis indices this process's
+        addressable devices cover, not from the raw process rank."""
+        try:
+            mesh_devs = self.mesh.devices  # ndarray indexed by axis order
+            axis_names = list(self.mesh.axis_names)
+            data_dim = axis_names.index(DATA_AXIS)
+            import numpy as _np
+
+            proc = jax.process_index()
+            coords = set()
+            it = _np.nditer(_np.empty(mesh_devs.shape), flags=["multi_index"])
+            for _ in it:
+                d = mesh_devs[it.multi_index]
+                if d.process_index == proc:
+                    coords.add(it.multi_index[data_dim])
+            dp_size = mesh_devs.shape[data_dim]
+            coords = sorted(coords)
+            n_owned = len(coords)
+            if n_owned == 0 or dp_size % n_owned != 0:
+                return dist.get_rank(), dist.get_world_size()
+            return coords[0] // n_owned, dp_size // n_owned
+        except Exception:
+            return dist.get_rank(), dist.get_world_size()
+
+    def deepspeed_io(self, dataset, batch_size=None, route="train", collate_fn=None, num_local_io_workers=None,
+                     data_sampler=None):
+        from .dataloader import DeepSpeedDataLoader
+
+        dp_rank, dp_world = self._process_dp_coord()
+        return DeepSpeedDataLoader(dataset,
+                                   batch_size=batch_size or self.config.train_micro_batch_size_per_gpu,
+                                   collate_fn=collate_fn,
+                                   drop_last=self.config.dataloader_drop_last,
+                                   data_parallel_rank=dp_rank,
+                                   data_parallel_world_size=dp_world)
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference save_checkpoint:3069 / load_checkpoint:2721)
+    # ------------------------------------------------------------------
+    def _ckpt_state(self, client_state=None):
+        leaves, treedef = jax.tree_util.tree_flatten(self.state["opt_state"])
+        return {
+            "module": self.state["params"],
+            "optimizer": {str(i): l for i, l in enumerate(leaves)},
+            "scalars": {
+                "step": self.state["step"],
+                "loss_scale": self.state["loss_scale"],
+                "good_steps": self.state["good_steps"],
+            },
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "skipped_steps": self.skipped_steps,
+            "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
+            "ds_config": self.config.param_dict,
+            "ds_version": "0.1.0-tpu",
+            **(client_state or {}),
+        }
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        self._checkpoint_tag_validation(tag)
+        path = os.path.join(save_dir, str(tag))
+        self.checkpoint_engine.create(tag)
+        self.checkpoint_engine.save(self._ckpt_state(client_state), path)
+        self.checkpoint_engine.commit(tag)
+        if save_latest and dist.get_rank() == 0:
+            os.makedirs(save_dir, exist_ok=True)
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+        dist.barrier()
+        log_dist(f"saved checkpoint {path}", ranks=[0])
+        return True
+
+    def _checkpoint_tag_validation(self, tag):
+        """All ranks must agree on the tag (reference ``engine.py:3052``)."""
+        if not self.config.checkpoint_tag_validation_enabled:
+            return
+        import zlib
+
+        tags = dist.all_gather_host(zlib.crc32(str(tag).encode()))
+        if any(t != tags[0] for t in tags):
+            msg = f"checkpoint tag '{tag}' differs across ranks"
+            if self.config.checkpoint_tag_validation_fail:
+                raise ValueError(msg)
+            logger.warning(msg)
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True, load_optimizer_states=True,
+                        load_lr_scheduler_states=True, load_module_only=False, custom_load_fn=None):
+        if tag is None:
+            latest_path = os.path.join(load_dir, LATEST_FILE)
+            if os.path.isfile(latest_path):
+                with open(latest_path, "r") as f:
+                    tag = f.read().strip()
+            else:
+                logger.warning(f"no 'latest' file at {latest_path}, nothing loaded")
+                return None, {}
+        path = os.path.join(load_dir, str(tag))
+
+        leaves, treedef = jax.tree_util.tree_flatten(self.state["opt_state"])
+        template = {
+            "module": jax.tree_util.tree_map(_as_shape_struct, self.state["params"],
+                                             self._state_shardings["params"]),
+            "optimizer": {str(i): _as_shape_struct(l, _shard_of(l)) for i, l in enumerate(leaves)},
+            "scalars": {k: _as_shape_struct(self.state[k], _shard_of(self.state[k]))
+                        for k in ("step", "loss_scale", "good_steps")},
+        }
+        loaded = self.checkpoint_engine.load(path, template=template)
+        params = loaded["module"]
+        state = dict(self.state)
+        state["params"] = params
+        if load_optimizer_states and not load_module_only and "optimizer" in loaded:
+            opt_leaves = [loaded["optimizer"][str(i)] for i in range(len(leaves))]
+            state["opt_state"] = jax.tree_util.tree_unflatten(treedef, opt_leaves)
+        for k in ("step", "loss_scale", "good_steps"):
+            if "scalars" in loaded and k in loaded["scalars"]:
+                state[k] = loaded["scalars"][k]
+        self.state = state
+        self.global_steps = int(loaded.get("global_steps", 0))
+        self.global_samples = int(loaded.get("global_samples", 0))
+        self.skipped_steps = int(loaded.get("skipped_steps", 0))
+        if load_lr_scheduler_states and self.lr_scheduler is not None and loaded.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(loaded["lr_scheduler"])
+        client_state = {k: v for k, v in loaded.items()
+                        if k not in ("module", "optimizer", "scalars", "global_steps", "global_samples",
+                                     "skipped_steps", "lr_scheduler", "ds_config", "ds_version")}
+        log_dist(f"loaded checkpoint {path}", ranks=[0])
+        return path, client_state
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin", exclude_frozen_parameters=False):
+        """Gather full (unsharded) bf16 weights for export (reference
+        ``save_16bit_model`` engine.py:3552 / ``_zero3_consolidated_16bit_state_dict``)."""
+        full = jax.device_get(
+            jax.jit(lambda p: jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), p),
+                    out_shardings=jax.tree_util.tree_map(lambda _: NamedSharding(self.mesh, P()),
+                                                         self.state["params"]))(self.state["params"]))
+        if dist.get_rank() == 0:
+            os.makedirs(save_dir, exist_ok=True)
+            import pickle
+
+            with open(os.path.join(save_dir, save_filename), "wb") as f:
+                pickle.dump(jax.tree_util.tree_map(np.asarray, full), f)
+        dist.barrier()
+        return True
+
+    # convenience (torch-style mode flags; eval() makes forward() loss-only)
+    def eval(self):
+        self._train_mode = False
+        return self
+
+    def train(self, mode=True):
+        self._train_mode = bool(mode)
+        return self
+
+
+def _as_shape_struct(x, sharding=None):
+    return jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=sharding)
+
+
+def _shard_of(x):
+    return getattr(x, "sharding", None)

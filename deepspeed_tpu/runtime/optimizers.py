@@ -1,0 +1,94 @@
+"""Optimizer construction from DeepSpeed config names.
+
+Analog of the reference ``engine.py:1275 _configure_basic_optimizer`` which
+instantiates Adam/AdamW/FusedAdam/CPUAdam/Lamb/OneBit*/Lion/Adagrad by config
+name. On TPU every optimizer is an optax ``GradientTransformation`` whose
+update runs *inside* the compiled step — the "fused optimizer kernel" of the
+reference (``csrc/adam/multi_tensor_adam.cu``) is subsumed by XLA fusing the
+elementwise update chain; a Pallas fused-Adam kernel is provided in
+``deepspeed_tpu.ops.adam`` for explicit control of the HBM traffic.
+
+1-bit optimizers (reference ``runtime/fp16/onebit/*``) use error-feedback sign
+compression of the gradient exchange; here the compression is applied to the
+cross-data-axis gradient reduction via int8 quantized collectives
+(``deepspeed_tpu.ops.pallas.quant``).
+"""
+
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+import optax
+
+from .constants import (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER, LAMB_OPTIMIZER, SGD_OPTIMIZER,
+                        LION_OPTIMIZER, ADAGRAD_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER,
+                        ZERO_ONE_ADAM_OPTIMIZER)
+from ..utils.logging import logger
+
+ScalarOrSchedule = Union[float, Callable]
+
+
+def _adam_args(params: dict):
+    return dict(
+        b1=params.get("betas", (0.9, 0.999))[0],
+        b2=params.get("betas", (0.9, 0.999))[1],
+        eps=params.get("eps", 1e-8),
+    )
+
+
+def build_optimizer(name: Optional[str],
+                    params: Optional[dict] = None,
+                    lr: Optional[ScalarOrSchedule] = None,
+                    mu_dtype=None) -> optax.GradientTransformation:
+    """Map a DeepSpeed optimizer block to an optax transformation chain."""
+    params = dict(params or {})
+    name = (name or ADAMW_OPTIMIZER).lower()
+    learning_rate = lr if lr is not None else params.get("lr", 1e-3)
+    wd = params.get("weight_decay", 0.0)
+
+    if name in (ADAM_OPTIMIZER, FUSED_ADAM_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
+        # DeepSpeed 'adam' honors adam_w_mode (default True) → AdamW semantics
+        adam_w_mode = params.get("adam_w_mode", True)
+        if name != ADAM_OPTIMIZER:
+            logger.info(f"optimizer '{name}' maps to fused adam with compressed gradient reduction on TPU")
+        if adam_w_mode:
+            return optax.adamw(learning_rate, weight_decay=wd, mu_dtype=mu_dtype, **_adam_args(params))
+        tx = optax.adam(learning_rate, mu_dtype=mu_dtype, **_adam_args(params))
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
+    if name == ADAMW_OPTIMIZER:
+        return optax.adamw(learning_rate, weight_decay=wd, mu_dtype=mu_dtype, **_adam_args(params))
+    if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
+        # reference FusedLamb (csrc/lamb/fused_lamb_cuda.cu): per-layer trust ratio
+        return optax.lamb(learning_rate, weight_decay=wd, **_adam_args(params))
+    if name == LION_OPTIMIZER:
+        betas = params.get("betas", (0.9, 0.99))
+        return optax.lion(learning_rate, b1=betas[0], b2=betas[1], weight_decay=wd)
+    if name == SGD_OPTIMIZER:
+        tx = optax.sgd(learning_rate, momentum=params.get("momentum", 0.0), nesterov=params.get("nesterov", False))
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
+    if name == ADAGRAD_OPTIMIZER:
+        return optax.adagrad(learning_rate, eps=params.get("eps", 1e-10))
+    raise ValueError(f"Unknown optimizer '{name}'")
+
+
+def master_weight_wrapper(tx: optax.GradientTransformation, compute_dtype=jnp.bfloat16) -> optax.GradientTransformation:
+    """fp32 master weights for bf16/fp16 params.
+
+    The reference keeps fp32 masters inside FP16_Optimizer/BF16_Optimizer
+    (``runtime/bf16_optimizer.py:30``); on TPU the idiom is: params stored
+    fp32, cast to bf16 for compute (mixed-precision policy in the model), so
+    the optimizer itself always sees fp32. This wrapper upcasts incoming
+    grads to fp32 before the update for the case where grads arrive in bf16.
+    """
+
+    def init_fn(params):
+        return tx.init(params)
+
+    def update_fn(updates, state, params=None, **extra):
+        updates = optax.tree_utils.tree_cast(updates, jnp.float32)
+        return tx.update(updates, state, params, **extra)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,109 @@
+"""DeepSpeed-Ulysses sequence parallelism, TPU-native.
+
+Analog of the reference ``deepspeed/sequence/layer.py`` (113 LoC):
+``single_all_to_all:15`` / ``_SeqAllToAll:44`` / ``DistributedAttention:60``.
+The decomposition is identical — all-to-all(scatter heads, gather sequence)
+before local attention, all-to-all(scatter sequence, gather heads) after — but
+on TPU it exists in two equivalent forms:
+
+1. **GSPMD form** (``ulysses_attention_gspmd``): inside plain ``jit`` we only
+   annotate shardings — activations arrive sharded over the ``seq`` axis
+   [B, S/sp, H]; constraining q/k/v to head-sharded [B, S, n/sp, d] makes XLA
+   insert exactly the all-to-all the reference issues by hand. This is the
+   production path: the collective rides ICI and overlaps with the qkv matmul.
+
+2. **shard_map form** (``DistributedAttention``): explicit
+   ``lax.all_to_all`` over the ``seq`` mesh axis, for use inside
+   ``shard_map``-style code and for tests that check the collective layout.
+
+Parity bar (SURVEY.md §5 long-context): same a2a decomposition, per-link
+communication volume O(S·H/P) independent of sequence parallel degree.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import SEQ_AXIS, DATA_AXIS
+
+
+def single_all_to_all(x, scatter_idx: int, gather_idx: int, axis_name: str = SEQ_AXIS):
+    """Reference ``sequence/layer.py:15`` — tiled all-to-all moving shards from
+    dim ``gather_idx`` (gathered) to dim ``scatter_idx`` (scattered)."""
+    return lax.all_to_all(x, axis_name, split_axis=scatter_idx, concat_axis=gather_idx, tiled=True)
+
+
+class _SeqAllToAll:
+    """Functional stand-in for the reference autograd.Function (:44); JAX
+    differentiates ``lax.all_to_all`` natively so no custom VJP is needed."""
+
+    @staticmethod
+    def apply(group, x, scatter_idx, gather_idx):
+        return single_all_to_all(x, scatter_idx, gather_idx, axis_name=group)
+
+
+class DistributedAttention:
+    """Reference ``sequence/layer.py:60`` — wraps any local attention.
+
+    Expects q/k/v of shape [B, S/sp, n_heads, head_dim] (sequence sharded);
+    runs the wrapped attention on [B, S, n_heads/sp, head_dim] (heads
+    sharded); returns [B, S/sp, n_heads, head_dim].
+
+    Use inside ``shard_map`` over a mesh containing ``sequence_process_group``
+    as an axis name.
+    """
+
+    def __init__(self,
+                 local_attention: Callable,
+                 sequence_process_group: str = SEQ_AXIS,
+                 scatter_idx: int = 2,
+                 gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.spg = sequence_process_group
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        q = _SeqAllToAll.apply(self.spg, query, self.scatter_idx, self.gather_idx)
+        k = _SeqAllToAll.apply(self.spg, key, self.scatter_idx, self.gather_idx)
+        v = _SeqAllToAll.apply(self.spg, value, self.scatter_idx, self.gather_idx)
+        ctx = self.local_attn(q, k, v, *args, **kwargs)
+        # scatter back along sequence, gather heads
+        return _SeqAllToAll.apply(self.spg, ctx, self.gather_idx, self.scatter_idx)
+
+
+def ulysses_qkv_constraint(x, mesh=None, batch_axes=(DATA_AXIS, ), seq_axis=SEQ_AXIS):
+    """GSPMD head-sharding constraint for q/k/v [B, S, n, d]: puts the seq
+    mesh axis on the head dim, triggering XLA's all-to-all."""
+    spec = P(tuple(batch_axes), None, seq_axis, None)
+    return lax.with_sharding_constraint(x, spec if mesh is None else jax.NamedSharding(mesh, spec))
+
+
+def ulysses_output_constraint(x, mesh=None, batch_axes=(DATA_AXIS, ), seq_axis=SEQ_AXIS):
+    """GSPMD constraint restoring sequence sharding on attention output."""
+    spec = P(tuple(batch_axes), seq_axis, None, None)
+    return lax.with_sharding_constraint(x, spec if mesh is None else jax.NamedSharding(mesh, spec))
+
+
+def ulysses_attention_gspmd(attn_fn: Callable,
+                            query,
+                            key,
+                            value,
+                            *args,
+                            batch_axes=(DATA_AXIS, ),
+                            seq_axis: str = SEQ_AXIS,
+                            **kwargs):
+    """GSPMD-form Ulysses: sharding constraints around ``attn_fn``.
+
+    q/k/v: [B, S, n_heads, head_dim] global shapes, activations sharded
+    (B over data axes, S over seq axis). XLA lowers the two constraint
+    boundaries to the pair of all-to-alls of the reference implementation.
+    """
+    q = ulysses_qkv_constraint(query, batch_axes=batch_axes, seq_axis=seq_axis)
+    k = ulysses_qkv_constraint(key, batch_axes=batch_axes, seq_axis=seq_axis)
+    v = ulysses_qkv_constraint(value, batch_axes=batch_axes, seq_axis=seq_axis)
+    ctx = attn_fn(q, k, v, *args, **kwargs)
+    return ulysses_output_constraint(ctx, batch_axes=batch_axes, seq_axis=seq_axis)

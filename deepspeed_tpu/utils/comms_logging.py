@@ -1,0 +1,120 @@
+"""Communication logging.
+
+Analog of the reference ``deepspeed/utils/comms_logging.py`` (178 LoC:
+``CommsLogger`` with per-op size/latency/busbw stats and ``log_summary``).
+On TPU most collectives are compiled into the program, so per-op host timing
+only applies to control-plane ops; traced collectives are recorded with their
+message sizes at trace time and attributed latency from profiler runs.
+"""
+
+import math
+
+from .logging import log_dist
+
+
+def get_caller_func(frame=3):
+    import sys
+
+    return sys._getframe(frame).f_code.co_name
+
+
+def calc_bw_log(comm_op, size, duration):
+    """algbw/busbw math, mirroring the reference implementation."""
+    n = 8  # mesh-degree placeholder when axis size unknown at log time
+    duration = max(duration, 1e-9)
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter", "reduce_scatter_tensor"):
+        size *= n
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op == "all_reduce":
+        tput = size * 2 / duration
+        busbw = (size / duration) * (2 * (n - 1) / n)
+    else:
+        tput = size / duration
+        busbw = tput
+    tput /= 1e9
+    busbw /= 1e9
+    duration_ms = duration * 1e3
+    return tput, busbw, duration_ms
+
+
+class CommsLogger:
+
+    def __init__(self, enabled=False, verbose=False, prof_all=True, debug=False, prof_ops=None):
+        self.comms_dict = {}
+        self.verbose = verbose
+        self.debug = debug
+        self.prof_ops = prof_ops or []
+        self.prof_all = prof_all
+        self.enabled = enabled
+
+    def configure(self, comms_config):
+        self.enabled = comms_config.comms_logger_enabled
+        if self.enabled:
+            self.verbose = comms_config.comms_logger.verbose
+            self.debug = comms_config.comms_logger.debug
+            self.prof_ops = comms_config.comms_logger.prof_ops
+            self.prof_all = comms_config.comms_logger.prof_all
+
+    def start_profiling_comms(self):
+        self.prof_all = True
+
+    def stop_profiling_comms(self):
+        self.prof_all = False
+
+    def start_profiling_op(self, op_name_list):
+        self.prof_ops = list(set(self.prof_ops) | set(op_name_list))
+
+    def stop_profiling_op(self, op_name_list):
+        self.prof_ops = [op for op in self.prof_ops if op not in op_name_list]
+
+    def append(self, raw_name, record_name, latency, msg_size):
+        algbw, busbw, duration_ms = calc_bw_log(raw_name, msg_size, latency)
+        if record_name in self.comms_dict:
+            if msg_size in self.comms_dict[record_name]:
+                self.comms_dict[record_name][msg_size][0] += 1
+                self.comms_dict[record_name][msg_size][1].append(duration_ms)
+                self.comms_dict[record_name][msg_size][2].append(algbw)
+                self.comms_dict[record_name][msg_size][3].append(busbw)
+            else:
+                self.comms_dict[record_name][msg_size] = [1, [duration_ms], [algbw], [busbw]]
+        else:
+            self.comms_dict[record_name] = {msg_size: [1, [duration_ms], [algbw], [busbw]]}
+        if self.verbose:
+            log_dist(f"rank=0 | comm op: {record_name} | time (ms): {duration_ms:.2f} | "
+                     f"msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw:.2f} | busbw (Gbps): {busbw:.2f}",
+                     ranks=[0])
+
+    def log_all(self, print_log=True, show_straggler=False):
+        from .timer import trim_mean
+
+        if print_log:
+            print("{:<20} {:<20} {:<20} {:<20} {:<20} {:<20}".format("Comm. Op", "Message Size", "Count",
+                                                                     "Total Latency(ms)", "Avg Latency(ms)",
+                                                                     "tput_avg (Gbps)"))
+        for record_name in self.comms_dict.keys():
+            if print_log:
+                print(record_name)
+            for msg_size, vals in sorted(self.comms_dict[record_name].items()):
+                count = vals[0]
+                total_lat = sum(vals[1])
+                avg_lat = trim_mean(list(vals[1]), 0.1)
+                avg_algbw = trim_mean(list(vals[2]), 0.1)
+                if print_log:
+                    print("{:<20} {:<20} {:<20} {:<20} {:<20} {:<20}".format(
+                        " ", convert_size(msg_size), count, f"{total_lat: .2f}", f"{avg_lat: .2f}",
+                        f"{avg_algbw: .2f}"))
+        return self.comms_dict
+
+
+def convert_size(size_bytes):
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return "%s %s" % (s, size_name[i])

@@ -1,0 +1,49 @@
+"""Test harness.
+
+The reference spawns N torch processes per test (``tests/unit/common.py:324``
+``DistributedTest``). The TPU-native analog (SURVEY.md §4 takeaway): a single
+process with N virtual devices — ``xla_force_host_platform_device_count`` —
+so every mesh/sharding/collective path runs exactly as it would on an N-chip
+slice, minus the ICI. Env vars must be set before jax import.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The image's sitecustomize may pre-import jax against a real accelerator;
+# force a clean CPU re-init so the 8 virtual devices take effect.
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge  # noqa: E402
+
+xla_bridge._clear_backends()
+assert len(jax.devices()) >= 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_groups():
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    yield
+    groups.reset()
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs
+
+
+def tiny_batch(batch_size=8, seq=32, vocab=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(batch_size, seq), dtype=np.int32)}

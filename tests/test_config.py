@@ -1,0 +1,87 @@
+"""Config-system tests, mirroring reference tests/unit/runtime/test_ds_config_dict.py."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triad_all_given():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+                           "gradient_accumulation_steps": 2})
+    cfg.resolve_batch_config(dp_world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triad_fill_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4})
+    cfg.resolve_batch_config(dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triad_fill_train():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2})
+    cfg.resolve_batch_config(dp_world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triad_mismatch_raises():
+    cfg = DeepSpeedConfig({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4,
+                           "gradient_accumulation_steps": 2})
+    with pytest.raises(AssertionError):
+        cfg.resolve_batch_config(dp_world_size=4)
+
+
+def test_batch_triad_nothing_raises():
+    cfg = DeepSpeedConfig({})
+    with pytest.raises(DeepSpeedConfigError):
+        cfg.resolve_batch_config(dp_world_size=4)
+
+
+def test_zero_config_parses():
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_prefetch_bucket_size": 1000,
+            "offload_optimizer": {"device": "cpu"},
+        },
+    })
+    assert cfg.zero_optimization_stage == 3
+    assert cfg.zero_config.prefetch_bucket_size == 1000
+    assert cfg.zero_config.offload_optimizer_device == "cpu"
+    assert cfg.zero_enabled
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1, "fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_deprecated_cpu_offload_migrates():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                           "zero_optimization": {"stage": 2, "cpu_offload": True}})
+    assert cfg.zero_config.offload_optimizer is not None
+    assert cfg.zero_config.offload_optimizer_device == "cpu"
+
+
+def test_bf16_legacy_key():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1, "bfloat16": {"enabled": True}})
+    assert cfg.bfloat16_enabled
+
+
+def test_tpu_mesh_section():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1, "tpu": {"mesh": {"data": 2, "model": 4}}})
+    mc = cfg.tpu_config.mesh_config()
+    sizes = mc.resolve(8)
+    assert sizes["data"] == 2 and sizes["model"] == 4 and sizes["pipe"] == 1
+
+
+def test_scheduler_optimizer_blocks():
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    })
+    assert cfg.optimizer_name == "adamw"
+    assert cfg.optimizer_params["lr"] == 3e-4
+    assert cfg.scheduler_name == "WarmupLR"

@@ -1,0 +1,144 @@
+"""End-to-end engine tests across ZeRO stages — the analog of the reference's
+crown-jewel tests/unit/runtime/zero/test_zero.py, on an 8-virtual-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.parallel import groups
+
+from conftest import tiny_batch
+
+
+def tiny_model(**over):
+    cfg = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=64,
+               intermediate_size=128, attention_impl="reference", dtype=jnp.float32)
+    cfg.update(over)
+    return TransformerLM(TransformerConfig(**cfg))
+
+
+def ds_config(stage=0, **over):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "tpu": {"mesh": {"data": 8}},
+        "steps_per_print": 100,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _losses_after_steps(engine, n=4, bsz=16):
+    losses = []
+    for i in range(n):
+        batch = tiny_batch(batch_size=bsz, seq=32, seed=i % 2)
+        losses.append(float(engine.train_batch(batch)))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stage_trains(stage, eight_devices):
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=ds_config(stage))
+    losses = _losses_after_steps(engine, n=5)
+    assert losses[-1] < losses[0], f"stage {stage}: loss did not decrease: {losses}"
+
+
+def test_zero3_params_sharded(eight_devices):
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=ds_config(3))
+    # at least the big stacked block arrays must be sharded over data
+    wq = engine.state["params"]["blocks"]["wq"]
+    assert not wq.sharding.is_fully_replicated
+    n_local = sum(s.data.size for s in wq.addressable_shards)
+    assert n_local == wq.size  # single process owns all shards, but...
+    shard0 = wq.addressable_shards[0].data
+    assert shard0.size == wq.size // 8
+
+
+def test_zero1_opt_sharded_params_replicated(eight_devices):
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=ds_config(1))
+    wq = engine.state["params"]["blocks"]["wq"]
+    assert wq.sharding.is_fully_replicated
+    opt_leaves = [l for l in jax.tree_util.tree_leaves(engine.state["opt_state"]) if l.ndim > 1]
+    assert any(not l.sharding.is_fully_replicated for l in opt_leaves)
+
+
+def test_stage_parity(eight_devices):
+    """All ZeRO stages are the same math: losses must match across stages."""
+    ref = None
+    for stage in (0, 1, 2, 3):
+        groups.reset()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=ds_config(stage))
+        losses = _losses_after_steps(engine, n=3)
+        if ref is None:
+            ref = losses
+        else:
+            np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_eager_api_matches_fused(eight_devices):
+    """forward/backward/step 3-call API computes the same update as train_batch."""
+    cfg = ds_config(2)
+    m = tiny_model()
+    e1, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+    e2, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+    batch = tiny_batch(batch_size=16, seq=32, seed=0)
+
+    e1.train_batch(batch)
+
+    loss = e2.forward(batch)
+    e2.backward(loss)
+    e2.step()
+
+    p1 = jax.tree_util.tree_leaves(e1.state["params"])
+    p2 = jax.tree_util.tree_leaves(e2.state["params"])
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_gradient_accumulation(eight_devices):
+    cfg = ds_config(2)
+    cfg["gradient_accumulation_steps"] = 2
+    cfg["train_batch_size"] = 32
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=cfg)
+    batch = tiny_batch(batch_size=32, seq=32, seed=0)
+    loss = engine.train_batch(batch)
+    assert np.isfinite(float(loss))
+    assert engine.global_steps == 1
+
+
+def test_tp_zero_compose(eight_devices):
+    cfg = ds_config(3)
+    cfg["tpu"] = {"mesh": {"data": 4, "model": 2}}
+    cfg["train_batch_size"] = 8
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=cfg)
+    losses = _losses_after_steps(engine, n=4, bsz=8)
+    assert losses[-1] < losses[0]
+    # TP rule applied: wq sharded over model axis on last dim too
+    spec = engine.state["params"]["blocks"]["wq"].sharding.spec
+    assert "model" in str(spec)
+
+
+def test_sequence_parallel_ulysses(eight_devices):
+    cfg = ds_config(2)
+    cfg["tpu"] = {"mesh": {"data": 2, "seq": 4}}
+    cfg["train_batch_size"] = 8
+    cfg["train_micro_batch_size_per_gpu"] = 4
+    m = tiny_model(sequence_parallel=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+    losses = _losses_after_steps(engine, n=4, bsz=8)
+    assert losses[-1] < losses[0]
+
+
+def test_grad_clipping_runs(eight_devices):
+    cfg = ds_config(2)
+    cfg["gradient_clipping"] = 0.1
+    engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=cfg)
+    engine.train_batch(tiny_batch(batch_size=16, seq=32))
+    assert float(engine._step_metrics["grad_norm"]) >= 0

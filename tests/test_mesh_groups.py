@@ -1,0 +1,66 @@
+"""Mesh / topology / groups tests (reference tests/unit/ test_topology etc.)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel import (MeshConfig, build_mesh, groups, ProcessTopology, PipeModelDataParallelTopology,
+                                    PipelineParallelGrid)
+
+
+def test_mesh_resolve_auto():
+    mc = MeshConfig(data=-1, model=2)
+    sizes = mc.resolve(8)
+    assert sizes["data"] == 4 and sizes["model"] == 2
+
+
+def test_mesh_resolve_invalid():
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, model=3).resolve(8)
+
+
+def test_build_mesh_axes(eight_devices):
+    mesh = build_mesh(MeshConfig(data=2, model=2, seq=2))
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["model"] == 2
+    assert mesh.shape["seq"] == 2
+    assert mesh.shape["pipe"] == 1
+
+
+def test_groups_accessors(eight_devices):
+    groups.initialize_mesh(MeshConfig(data=4, model=2))
+    assert groups.get_data_parallel_world_size() == 4
+    assert groups.get_model_parallel_world_size() == 2
+    assert groups.get_world_size() == 8
+    assert groups.get_data_parallel_group() == ("data", )
+
+
+def test_groups_seq_data_fusion(eight_devices):
+    groups.initialize_mesh(MeshConfig(data=2, seq=2, model=2))
+    # ZeRO shards over (data, seq) when SP is on — reference seq_data group
+    assert groups.get_data_parallel_group() == ("data", "seq")
+    assert groups.get_data_parallel_world_size() == 4
+
+
+def test_topology_rank_math():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+    assert topo.get_rank(pipe=0, data=0) == 0
+    assert topo.get_rank(pipe=1, data=0) == 4
+    assert topo.get_dim("pipe") == 2
+    assert topo.world_size() == 8
+    assert topo.get_axis_list("pipe", 1) == [4, 5, 6, 7]
+
+
+def test_topology_comm_lists():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert len(pipe_lists) == 4
+    for lst in pipe_lists:
+        assert len(lst) == 2
+
+
+def test_pipeline_grid():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=1, num_dp=4)
+    grid = PipelineParallelGrid(topo, global_rank=5)
+    assert grid.get_pipe_parallel_world_size() == 2
+    assert grid.get_stage_id() == 1
+    assert grid.stage_to_global(0) == 1  # same data/model coord, stage 0
