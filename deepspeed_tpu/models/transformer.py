@@ -58,6 +58,15 @@ class TransformerConfig:
     attention_impl: str = "auto"  # 'auto' | 'reference' | 'flash'
     sequence_parallel: bool = False  # Ulysses sharding constraints
     dropout: float = 0.0
+    # MoE (reference deepspeed/moe): 0 = dense; experts shard over the data
+    # axes (expert parallelism); XLA inserts the dispatch/combine all-to-alls
+    # at the sharding-constraint boundaries.
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
+    moe_noisy_gate_policy: Optional[str] = None
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -95,11 +104,19 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
         "wv": dense_init(k[2], (L, H, nkv * d), H),
         "wo": dense_init(k[3], (L, nq * d, H), nq * d) / math.sqrt(2 * L),
         "ln2_scale": jnp.ones((L, H), jnp.float32),
-        "w_up": dense_init(k[4], (L, H, F), H),
-        "w_down": dense_init(k[5], (L, F, H), F) / math.sqrt(2 * L),
     }
-    if cfg.mlp == "swiglu":
-        blocks["w_gate"] = dense_init(k[6], (L, H, F), H)
+    if cfg.moe_num_experts > 0:
+        E = cfg.moe_num_experts
+        blocks["gate_wg"] = dense_init(k[4], (L, H, E), H)
+        blocks["moe_wi"] = dense_init(k[5], (L, E, H, F), H)
+        blocks["moe_wo"] = dense_init(k[6], (L, E, F, H), F) / math.sqrt(2 * L)
+        if cfg.mlp == "swiglu":
+            blocks["moe_wg"] = dense_init(k[10], (L, E, H, F), H)
+    else:
+        blocks["w_up"] = dense_init(k[4], (L, H, F), H)
+        blocks["w_down"] = dense_init(k[5], (L, F, H), F) / math.sqrt(2 * L)
+        if cfg.mlp == "swiglu":
+            blocks["w_gate"] = dense_init(k[6], (L, H, F), H)
     if cfg.norm == "layernorm":
         blocks["ln1_bias"] = jnp.zeros((L, H), jnp.float32)
         blocks["ln2_bias"] = jnp.zeros((L, H), jnp.float32)
@@ -142,6 +159,11 @@ def partition_rules(cfg: Optional[TransformerConfig] = None) -> PartitionRules:
         (r"blocks/(w_up|w_gate)$", P(None, None, MODEL_AXIS)),
         (r"blocks/b_up$", P(None, MODEL_AXIS)),
         (r"blocks/w_down$", P(None, MODEL_AXIS, None)),
+        # MoE: experts shard over the data axes (= expert parallelism; this IS
+        # their ZeRO sharding), FFN dims over model (TP inside each expert)
+        (r"blocks/gate_wg$", P(None, None, None)),
+        (r"blocks/(moe_wi|moe_wg)$", P(None, DATA_AXIS, None, MODEL_AXIS)),
+        (r"blocks/moe_wo$", P(None, DATA_AXIS, MODEL_AXIS, None)),
         (r"lm_head/kernel", P(None, MODEL_AXIS)),
     ])
 
@@ -215,9 +237,9 @@ def _attention(cfg: TransformerConfig, q, k, v):
     return reference_attention(q, k, v, causal=True)
 
 
-def _block(cfg: TransformerConfig, x, layer, sin, cos):
+def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None):
     """One transformer block; ``layer`` holds this layer's slice of the
-    stacked arrays."""
+    stacked arrays. Returns (x, moe_aux_loss)."""
     dt = cfg.dtype
     B, S, H = x.shape
     nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -250,6 +272,10 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos):
     x = x + attn_out
 
     h = _norm(x, layer["ln2_scale"], layer.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+    if cfg.moe_num_experts > 0:
+        down, l_aux = _moe_mlp(cfg, layer, h, rng)
+        x = x + down
+        return _activation_constraint(cfg, x), l_aux
     up = jnp.einsum("bsh,hf->bsf", h, layer["w_up"].astype(dt))
     if cfg.use_bias:
         up = up + layer["b_up"].astype(dt)
@@ -262,7 +288,57 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos):
     if cfg.use_bias:
         down = down + layer["b_down"].astype(dt)
     x = x + down
-    return _activation_constraint(cfg, x)
+    return _activation_constraint(cfg, x), jnp.zeros([], jnp.float32)
+
+
+def _moe_mlp(cfg: TransformerConfig, layer, h, rng=None):
+    """MoE FFN in GSPMD form: per-row top-k gating (moe/sharded_moe.py math),
+    dispatch to [B, E, C, M] slots, flip the sharding from batch-over-data to
+    experts-over-data (XLA lowers the constraint boundary to the dispatch
+    all-to-all of the reference's ``_AllToAll``), expert FFN, flip back,
+    combine."""
+    from ..moe.sharded_moe import top1gating, top2gating, multiplicative_jitter
+
+    dt = cfg.dtype
+    B, S, H = h.shape
+    E = cfg.moe_num_experts
+    gate_in = h.astype(jnp.float32)
+    if cfg.moe_noisy_gate_policy == "Jitter" and rng is not None:
+        rng, jit_key = jax.random.split(rng)
+        gate_in = multiplicative_jitter(gate_in, jit_key)
+    logits = jnp.einsum("bsh,he->bse", gate_in, layer["gate_wg"].astype(jnp.float32))
+
+    def gate_row(lg, key):
+        if cfg.moe_top_k == 1:
+            return top1gating(lg, cfg.moe_capacity_factor, cfg.moe_min_capacity,
+                              noisy_gate_policy=cfg.moe_noisy_gate_policy, rng=key,
+                              use_rts=key is not None)[:3]
+        return top2gating(lg, cfg.moe_capacity_factor, cfg.moe_min_capacity, rng=key)[:3]
+
+    if rng is not None:
+        keys = jax.random.split(rng, B)
+        l_aux, combine, dispatch = jax.vmap(gate_row)(logits, keys)
+    else:
+        l_aux, combine, dispatch = jax.vmap(lambda lg: gate_row(lg, None))(logits)
+
+    dispatched = jnp.einsum("bsec,bsm->becm", dispatch.astype(dt), h)
+    try:
+        dispatched = lax.with_sharding_constraint(dispatched, P(None, DATA_AXIS, None, None))
+    except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
+        pass
+    up = jnp.einsum("becm,emf->becf", dispatched, layer["moe_wi"].astype(dt))
+    if cfg.mlp == "swiglu":
+        gate = jnp.einsum("becm,emf->becf", dispatched, layer["moe_wg"].astype(dt))
+        hmid = jax.nn.silu(gate) * up
+    else:
+        hmid = jax.nn.gelu(up)
+    expert_out = jnp.einsum("becf,efm->becm", hmid, layer["moe_wo"].astype(dt))
+    try:
+        expert_out = lax.with_sharding_constraint(expert_out, P(DATA_AXIS, None, None, None))
+    except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
+        pass
+    out = jnp.einsum("bsec,becm->bsm", combine.astype(dt), expert_out)
+    return out, jnp.mean(l_aux)
 
 
 def _activation_constraint(cfg: TransformerConfig, x):
@@ -273,8 +349,8 @@ def _activation_constraint(cfg: TransformerConfig, x):
         return x
 
 
-def forward(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array) -> jax.Array:
-    """Token ids [B, S] → logits [B, S, V]."""
+def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array, rng=None):
+    """Token ids [B, S] → (logits [B, S, V], moe_aux_loss)."""
     dt = cfg.dtype
     B, S = input_ids.shape
     x = params["embed"]["embedding"].astype(dt)[input_ids]
@@ -290,16 +366,26 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         block_fn = jax.checkpoint(block_fn, policy=policy, static_argnums=())
 
-    def scan_body(carry, layer):
-        return block_fn(carry, layer, sin, cos), None
+    use_layer_keys = cfg.moe_num_experts > 0 and rng is not None
+    layer_keys = jax.random.split(rng, cfg.num_layers) if use_layer_keys else None
 
-    x, _ = lax.scan(scan_body, x, params["blocks"])
+    def scan_body(carry, xs):
+        layer, key = xs if use_layer_keys else (xs, None)
+        return block_fn(carry, layer, sin, cos, key)
+
+    xs = (params["blocks"], layer_keys) if use_layer_keys else params["blocks"]
+    x, l_auxs = lax.scan(scan_body, x, xs)
     x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["embedding"].astype(dt))
     else:
         logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(dt))
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), jnp.sum(l_auxs)
+
+
+def forward(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array) -> jax.Array:
+    """Token ids [B, S] → logits [B, S, V]."""
+    return forward_with_aux(cfg, params, input_ids)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -366,6 +452,9 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
         x = x + jnp.einsum("bsd,dh->bsh", ctx, layer["wo"].astype(dt)) + \
             (layer["bo"].astype(dt) if cfg.use_bias else 0.0)
         h = _norm(x, layer["ln2_scale"], layer.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        if cfg.moe_num_experts > 0:
+            down, _ = _moe_mlp(cfg, layer, h, rng=None)  # deterministic gating at inference
+            return x + down, (ck, cv)
         up = jnp.einsum("bsh,hf->bsf", h, layer["w_up"].astype(dt))
         if cfg.use_bias:
             up = up + layer["b_up"].astype(dt)
@@ -387,10 +476,11 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
 
 
 def loss_fn(cfg: TransformerConfig, params, batch, rng=None):
-    """Next-token cross entropy. ``batch``: dict with 'input_ids' [B, S] and
-    optional 'labels' (defaults to shifted input) and 'loss_mask'."""
+    """Next-token cross entropy (+ MoE aux loss). ``batch``: dict with
+    'input_ids' [B, S] and optional 'labels' (defaults to shifted input) and
+    'loss_mask'."""
     input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-    logits = forward(cfg, params, input_ids)
+    logits, moe_aux = forward_with_aux(cfg, params, input_ids, rng)
     if isinstance(batch, dict) and "labels" in batch:
         labels = batch["labels"]
         shift_logits, shift_labels = logits, labels
@@ -399,10 +489,11 @@ def loss_fn(cfg: TransformerConfig, params, batch, rng=None):
         shift_labels = input_ids[:, 1:]
     logp = jax.nn.log_softmax(shift_logits, axis=-1)
     token_ll = jnp.take_along_axis(logp, shift_labels[..., None], axis=-1)[..., 0]
+    aux = cfg.moe_aux_loss_coef * moe_aux if cfg.moe_num_experts > 0 else 0.0
     if isinstance(batch, dict) and "loss_mask" in batch:
         mask = batch["loss_mask"][:, :token_ll.shape[1]].astype(jnp.float32)
-        return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    return -token_ll.mean()
+        return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0) + aux
+    return -token_ll.mean() + aux
 
 
 class TransformerLM:

@@ -1,0 +1,2 @@
+from .layer import MoE
+from .sharded_moe import MOELayer, TopKGate, top1gating, top2gating

@@ -1,0 +1,84 @@
+"""User-facing MoE layer.
+
+Analog of the reference ``deepspeed/moe/layer.py:16`` (``MoE``): bundles a
+TopKGate + MOELayer + expert FFN and declares the expert-parallel degree. On
+TPU the "EP process group creation" (reference :85 via groups.py) amounts to
+recording the ep axis name; communication comes from ``lax.all_to_all`` in
+shard_map form or sharding constraints in GSPMD form.
+"""
+
+from typing import Callable, Optional
+
+import jax
+
+from .sharded_moe import MOELayer, TopKGate
+from ..parallel import groups
+from ..utils.logging import log_dist
+
+
+class MoE:
+
+    def __init__(self,
+                 hidden_size: int,
+                 expert=None,
+                 num_experts: int = 1,
+                 ep_size: int = 1,
+                 k: int = 1,
+                 capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4,
+                 use_residual: bool = False,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True,
+                 use_rts: bool = True,
+                 use_tutel: bool = False,
+                 enable_expert_tensor_parallelism: bool = False,
+                 top2_2nd_expert_sampling: bool = True,
+                 ffn_dim: Optional[int] = None,
+                 activation: Callable = jax.nn.gelu):
+        assert num_experts % ep_size == 0, f"Number of experts ({num_experts}) should be divisible by expert parallel size ({ep_size})"
+        self.ep_size = ep_size
+        self.num_experts = num_experts
+        self.num_local_experts = num_experts // ep_size
+        self.use_residual = use_residual
+        ffn_dim = ffn_dim or 4 * hidden_size
+        log_dist(f"Creating MoE layer with num_experts: {num_experts} | num_local_experts: "
+                 f"{self.num_local_experts} | expert_parallel_size: {ep_size}", ranks=[0])
+        gate = TopKGate(hidden_size, num_experts, k, capacity_factor, eval_capacity_factor, min_capacity,
+                        noisy_gate_policy, drop_tokens, use_rts, top2_2nd_expert_sampling)
+        ep_axis = None
+        if ep_size > 1:
+            ep_axis = groups.get_expert_parallel_group()
+            ep_axis = ep_axis[0] if len(ep_axis) == 1 else ep_axis
+        self.deepspeed_moe = MOELayer(gate, hidden_size, ffn_dim, self.num_local_experts, ep_axis=ep_axis,
+                                      ep_size=ep_size, activation=activation)
+        self.hidden_size = hidden_size
+
+    def init(self, rng):
+        rng, moe_rng = jax.random.split(rng)
+        params = {"moe": self.deepspeed_moe.init(moe_rng)}
+        if self.use_residual:
+            import math
+            import jax.numpy as jnp
+
+            k1, k2, k3 = jax.random.split(rng, 3)
+            F = self.deepspeed_moe.ffn_dim
+            params["residual_mlp"] = {
+                "wi": jax.random.normal(k1, (self.hidden_size, F), jnp.float32) / math.sqrt(self.hidden_size),
+                "wo": jax.random.normal(k2, (F, self.hidden_size), jnp.float32) / math.sqrt(F),
+            }
+            params["coefficient"] = jax.random.normal(k3, (self.hidden_size, 2), jnp.float32) * 0.02
+        return params
+
+    def __call__(self, params, hidden_states, rng=None, train=True):
+        """hidden_states: [S, M] (or [B*S, M] flattened). Returns
+        (output, l_aux) — reference returns (output, l_aux, exp_counts)."""
+        out, l_aux = self.deepspeed_moe(params["moe"], hidden_states, rng=rng, train=train)
+        if self.use_residual:
+            import jax.numpy as jnp
+
+            mlp = jax.nn.gelu(hidden_states @ params["residual_mlp"]["wi"].astype(hidden_states.dtype))
+            mlp = mlp @ params["residual_mlp"]["wo"].astype(hidden_states.dtype)
+            coef = jax.nn.softmax(hidden_states @ params["coefficient"].astype(hidden_states.dtype), axis=-1)
+            out = out * coef[..., 0:1] + mlp * coef[..., 1:2]
+        return out, l_aux

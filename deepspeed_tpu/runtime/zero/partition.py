@@ -85,10 +85,49 @@ def _axes_size(mesh: Mesh, axes: Sequence[str]) -> int:
     return out
 
 
+def sanitize_spec(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh, path: str = "") -> PartitionSpec:
+    """Drop spec entries whose mesh-axis product does not divide the dim size
+    (e.g. 4 experts over an 8-wide data axis): partial expert parallelism
+    degrades gracefully to replication of that dim — loudly, so a
+    misconfiguration (hidden size not divisible by the model axis) doesn't
+    silently disable TP."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    out = []
+    for i, e in enumerate(entries[:len(shape)]):
+        if e is None:
+            out.append(None)
+            continue
+        axes = e if isinstance(e, (tuple, list)) else (e, )
+        keep = []
+        size = shape[i]
+        for a in axes:
+            n = mesh.shape.get(a, 1)
+            if n <= 1:
+                continue
+            if size % n == 0:
+                keep.append(a)
+                size //= n
+            else:
+                logger.warning(f"partition rule for {path or 'param'} dim {i} (size {shape[i]}) is not divisible "
+                               f"by mesh axis '{a}' ({n}); replicating that dim instead")
+        out.append(tuple(keep) if len(keep) > 1 else (keep[0] if keep else None))
+    return PartitionSpec(*out)
+
+
 def add_data_axes(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh, data_axes: Sequence[str]) -> PartitionSpec:
     """FSDP-shard: attach the data axes to the largest unsharded divisible dim."""
     dp = _axes_size(mesh, data_axes)
     if dp <= 1 or len(shape) == 0:
+        return spec
+    # an axis may appear at most once in a PartitionSpec: if the TP/EP rules
+    # already consumed any of the data axes (e.g. expert weights sharded over
+    # 'data' on the expert dim — that IS the ZeRO sharding), leave it alone
+    used = set()
+    for e in spec:
+        if e is None:
+            continue
+        used.update(e if isinstance(e, (tuple, list)) else (e, ))
+    if used & set(data_axes):
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
     entries = entries[:len(shape)]
@@ -143,7 +182,9 @@ class ZeroShardingPolicy:
 
     # -- specs --------------------------------------------------------
     def tp_spec_tree(self, params):
-        return self.tp_rules.tree_specs(params)
+        specs = self.tp_rules.tree_specs(params)
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, x, s: sanitize_spec(s, np.shape(x), self.mesh, path=path_str(kp)), params, specs)
 
     def _sharded_spec_tree(self, params):
         tp = self.tp_spec_tree(params)

@@ -1,0 +1,146 @@
+"""MoE tests (reference tests/unit/moe/test_moe.py coverage style):
+gating invariants, dispatch/combine correctness, EP all-to-all under
+shard_map, and end-to-end MoE model training through the engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.moe import MoE, TopKGate, top1gating, top2gating
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.parallel import groups, MeshConfig
+
+from conftest import tiny_batch
+
+
+def test_top1gating_invariants():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (64, 8))
+    l_aux, combine, dispatch, cap = top1gating(logits, capacity_factor=1.0, min_capacity=4)
+    assert combine.shape == (64, 8, cap)
+    # each token goes to at most one (expert, slot)
+    per_token = np.asarray(dispatch.sum(axis=(1, 2)))
+    assert (per_token <= 1.0 + 1e-6).all()
+    # no expert buffer slot used twice
+    per_slot = np.asarray(dispatch.sum(axis=0))
+    assert (per_slot <= 1.0 + 1e-6).all()
+    # capacity respected
+    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+    assert (per_expert <= cap).all()
+    assert float(l_aux) > 0
+
+
+def test_top1gating_no_drop():
+    logits = jax.random.normal(jax.random.PRNGKey(1), (32, 4))
+    _, combine, dispatch, cap = top1gating(logits, 1.0, 4, drop_tokens=False)
+    assert cap == 32
+    per_token = np.asarray(dispatch.sum(axis=(1, 2)))
+    np.testing.assert_allclose(per_token, 1.0)  # nothing dropped
+
+
+def test_top2gating_invariants():
+    logits = jax.random.normal(jax.random.PRNGKey(2), (64, 8))
+    l_aux, combine, dispatch, cap = top2gating(logits, capacity_factor=1.0, min_capacity=4,
+                                               top2_2nd_expert_sampling=False)
+    per_token = np.asarray(dispatch.sum(axis=(1, 2)))
+    assert (per_token <= 2.0 + 1e-6).all()
+    per_slot = np.asarray(dispatch.sum(axis=0))
+    assert (per_slot <= 1.0 + 1e-6).all()
+    # combine weights of kept tokens sum to ~1 (normalized top-2)
+    kept = per_token == 2
+    cw = np.asarray(combine.sum(axis=(1, 2)))
+    np.testing.assert_allclose(cw[kept], 1.0, rtol=1e-5)
+
+
+def test_moe_layer_forward_identity_capacity():
+    """With capacity >= tokens and top-1, MoE output = per-token expert FFN
+    scaled by its gate value — verify against direct computation."""
+    layer = MoE(hidden_size=16, num_experts=4, k=1, capacity_factor=4.0, min_capacity=64, ffn_dim=32)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+    out, l_aux = layer(params, x, train=False)
+    assert out.shape == x.shape
+    # manual: gate -> expert assignment -> ffn
+    logits = x @ params["moe"]["gate"]["wg"]
+    gates = jax.nn.softmax(logits, axis=1)
+    idx = np.asarray(jnp.argmax(logits, axis=1))
+    wi, wo = params["moe"]["experts"]["wi"], params["moe"]["experts"]["wo"]
+    expected = []
+    for t in range(32):
+        e = idx[t]
+        g = float(gates[t, e])
+        h = jax.nn.gelu(x[t] @ wi[e])
+        expected.append(g * (h @ wo[e]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.stack(expected)), rtol=1e-4, atol=1e-5)
+
+
+def test_moe_ep_shard_map_matches_single(eight_devices):
+    """EP=4 via shard_map all-to-all must equal single-device MoE."""
+    from jax import shard_map
+
+    groups.initialize_mesh(MeshConfig(data=4, model=1, seq=1), devices=jax.devices()[:4])
+    mesh = groups.get_mesh()
+
+    single = MoE(hidden_size=16, num_experts=4, k=1, capacity_factor=8.0, eval_capacity_factor=8.0,
+                 min_capacity=8, ffn_dim=32)
+    params = single.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+    ref_out, _ = single(params, x, train=False)
+
+    ep = MoE(hidden_size=16, num_experts=4, ep_size=4, k=1, capacity_factor=8.0, eval_capacity_factor=8.0,
+             min_capacity=8, ffn_dim=32)
+
+    def shard_fn(p, xs):
+        out, l_aux = ep.deepspeed_moe(p, xs, train=False)
+        return out
+
+    # tokens sharded over data; experts sharded over data (1 local expert each)
+    sharded = shard_map(shard_fn, mesh=mesh,
+                        in_specs=({"gate": {"wg": P()},
+                                   "experts": {"wi": P("data"), "wo": P("data")}}, P("data")),
+                        out_specs=P("data"))
+    out = sharded(params["moe"], x)
+    # NOTE: per-shard gating computes capacity per 8-token shard; with
+    # capacity_factor high enough nothing drops, so results must match.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), rtol=1e-4, atol=1e-5)
+
+
+def _moe_model(**over):
+    cfg = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4, max_seq_len=64,
+               intermediate_size=64, attention_impl="reference", dtype=jnp.float32,
+               moe_num_experts=8, moe_capacity_factor=2.0)
+    cfg.update(over)
+    return TransformerLM(TransformerConfig(**cfg))
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_model_trains(top_k, eight_devices):
+    config = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 2},
+        "tpu": {"mesh": {"data": 8}},
+        "steps_per_print": 100,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_moe_model(moe_top_k=top_k), config=config)
+    # expert weights must be sharded over data (expert parallelism)
+    spec = engine.state["params"]["blocks"]["moe_wi"].sharding.spec
+    assert "data" in str(spec)
+    losses = [float(engine.train_batch(tiny_batch(16, 32, seed=i % 2))) for i in range(5)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_model_cache_inference_matches_forward():
+    """Fixed path: MoE models must be servable through forward_with_cache."""
+    from deepspeed_tpu.models.transformer import forward, forward_with_cache, init_kv_cache
+
+    m = _moe_model(moe_capacity_factor=8.0, moe_min_capacity=64)
+    params = m.init(jax.random.PRNGKey(0))
+    ids = np.random.default_rng(0).integers(0, 128, size=(2, 16), dtype=np.int32)
+    full = forward(m.config, params, ids)
+    cache = init_kv_cache(m.config, 2, 16, dtype=jnp.float32)
+    cached, _ = forward_with_cache(m.config, params, ids, cache)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(cached), rtol=1e-4, atol=1e-4)
