@@ -32,7 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
 from ..runtime.zero.partition import PartitionRules
 
 
@@ -153,17 +153,21 @@ def partition_rules(cfg: Optional[TransformerConfig] = None) -> PartitionRules:
     return PartitionRules([
         (r"embed/embedding", P(MODEL_AXIS, None)),
         (r"pos_embed/embedding", P(None, None)),
-        (r"blocks/w[qkv]$", P(None, None, MODEL_AXIS)),
-        (r"blocks/b[qkv]$", P(None, MODEL_AXIS)),
-        (r"blocks/wo$", P(None, MODEL_AXIS, None)),
-        (r"blocks/(w_up|w_gate)$", P(None, None, MODEL_AXIS)),
-        (r"blocks/b_up$", P(None, MODEL_AXIS)),
-        (r"blocks/w_down$", P(None, MODEL_AXIS, None)),
+        # blocks dim 0 is the stacked layer dim: sharding it over 'pipe' IS
+        # pipeline stage assignment (uniform partitioning, reference
+        # PipelineModule._partition_layers); dropped automatically at pipe=1
+        (r"blocks/w[qkv]$", P(PIPE_AXIS, None, MODEL_AXIS)),
+        (r"blocks/b[qkv]$", P(PIPE_AXIS, MODEL_AXIS)),
+        (r"blocks/wo$", P(PIPE_AXIS, MODEL_AXIS, None)),
+        (r"blocks/(w_up|w_gate)$", P(PIPE_AXIS, None, MODEL_AXIS)),
+        (r"blocks/b_up$", P(PIPE_AXIS, MODEL_AXIS)),
+        (r"blocks/w_down$", P(PIPE_AXIS, MODEL_AXIS, None)),
+        (r"blocks/(ln1_scale|ln2_scale|ln1_bias|ln2_bias|b_down|bo)$", P(PIPE_AXIS, None)),
         # MoE: experts shard over the data axes (= expert parallelism; this IS
         # their ZeRO sharding), FFN dims over model (TP inside each expert)
-        (r"blocks/gate_wg$", P(None, None, None)),
-        (r"blocks/(moe_wi|moe_wg)$", P(None, DATA_AXIS, None, MODEL_AXIS)),
-        (r"blocks/moe_wo$", P(None, DATA_AXIS, MODEL_AXIS, None)),
+        (r"blocks/gate_wg$", P(PIPE_AXIS, None, None)),
+        (r"blocks/(moe_wi|moe_wg)$", P(PIPE_AXIS, DATA_AXIS, None, MODEL_AXIS)),
+        (r"blocks/moe_wo$", P(PIPE_AXIS, DATA_AXIS, MODEL_AXIS, None)),
         (r"lm_head/kernel", P(None, MODEL_AXIS)),
     ])
 
@@ -237,9 +241,10 @@ def _attention(cfg: TransformerConfig, q, k, v):
     return reference_attention(q, k, v, causal=True)
 
 
-def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None):
+def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True):
     """One transformer block; ``layer`` holds this layer's slice of the
-    stacked arrays. Returns (x, moe_aux_loss)."""
+    stacked arrays. Returns (x, moe_aux_loss). ``constrain=False`` disables
+    GSPMD sharding constraints (for use inside shard_map pipeline stages)."""
     dt = cfg.dtype
     B, S, H = x.shape
     nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -273,9 +278,9 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None):
 
     h = _norm(x, layer["ln2_scale"], layer.get("ln2_bias"), cfg.norm, cfg.norm_eps)
     if cfg.moe_num_experts > 0:
-        down, l_aux = _moe_mlp(cfg, layer, h, rng)
+        down, l_aux = _moe_mlp(cfg, layer, h, rng, constrain=constrain)
         x = x + down
-        return _activation_constraint(cfg, x), l_aux
+        return _activation_constraint(cfg, x, enabled=constrain), l_aux
     up = jnp.einsum("bsh,hf->bsf", h, layer["w_up"].astype(dt))
     if cfg.use_bias:
         up = up + layer["b_up"].astype(dt)
@@ -288,10 +293,10 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None):
     if cfg.use_bias:
         down = down + layer["b_down"].astype(dt)
     x = x + down
-    return _activation_constraint(cfg, x), jnp.zeros([], jnp.float32)
+    return _activation_constraint(cfg, x, enabled=constrain), jnp.zeros([], jnp.float32)
 
 
-def _moe_mlp(cfg: TransformerConfig, layer, h, rng=None):
+def _moe_mlp(cfg: TransformerConfig, layer, h, rng=None, constrain=True):
     """MoE FFN in GSPMD form: per-row top-k gating (moe/sharded_moe.py math),
     dispatch to [B, E, C, M] slots, flip the sharding from batch-over-data to
     experts-over-data (XLA lowers the constraint boundary to the dispatch
@@ -322,10 +327,11 @@ def _moe_mlp(cfg: TransformerConfig, layer, h, rng=None):
         l_aux, combine, dispatch = jax.vmap(lambda lg: gate_row(lg, None))(logits)
 
     dispatched = jnp.einsum("bsec,bsm->becm", dispatch.astype(dt), h)
-    try:
-        dispatched = lax.with_sharding_constraint(dispatched, P(None, DATA_AXIS, None, None))
-    except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
-        pass
+    if constrain:
+        try:
+            dispatched = lax.with_sharding_constraint(dispatched, P(None, DATA_AXIS, None, None))
+        except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
+            pass
     up = jnp.einsum("becm,emf->becf", dispatched, layer["moe_wi"].astype(dt))
     if cfg.mlp == "swiglu":
         gate = jnp.einsum("becm,emf->becf", dispatched, layer["moe_wg"].astype(dt))
@@ -333,16 +339,19 @@ def _moe_mlp(cfg: TransformerConfig, layer, h, rng=None):
     else:
         hmid = jax.nn.gelu(up)
     expert_out = jnp.einsum("becf,efm->becm", hmid, layer["moe_wo"].astype(dt))
-    try:
-        expert_out = lax.with_sharding_constraint(expert_out, P(DATA_AXIS, None, None, None))
-    except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
-        pass
+    if constrain:
+        try:
+            expert_out = lax.with_sharding_constraint(expert_out, P(DATA_AXIS, None, None, None))
+        except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
+            pass
     out = jnp.einsum("bsec,becm->bsm", combine.astype(dt), expert_out)
     return out, jnp.mean(l_aux)
 
 
-def _activation_constraint(cfg: TransformerConfig, x):
+def _activation_constraint(cfg: TransformerConfig, x, enabled=True):
     """Pin activation layout [B, S, H]: batch over data, sequence over seq."""
+    if not enabled:
+        return x
     try:
         return lax.with_sharding_constraint(x, P(DATA_AXIS, SEQ_AXIS if cfg.sequence_parallel else None, None))
     except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
@@ -496,6 +505,58 @@ def loss_fn(cfg: TransformerConfig, params, batch, rng=None):
     return -token_ll.mean() + aux
 
 
+def pipeline_loss_fn(cfg: TransformerConfig, params, batches, rng=None, *, mesh, num_stages: int):
+    """Pipelined loss over microbatches [M, b, S] (runtime/pipe/spmd.py).
+
+    Embedding and head run replicated over the pipe axis; the L blocks are
+    split into ``num_stages`` contiguous slices (blocks dim 0 is sharded over
+    'pipe' — see partition_rules) and executed in a compiled fill/drain loop
+    with ppermute handoffs. jax.grad through this function generates the
+    backward pipeline automatically.
+    """
+    from ..runtime.pipe.spmd import pipeline_apply
+
+    ids = batches["input_ids"] if isinstance(batches, dict) else batches
+    M, B, S = ids.shape
+    dt = cfg.dtype
+    assert cfg.num_layers % num_stages == 0, (
+        f"num_layers {cfg.num_layers} must divide evenly into {num_stages} pipeline stages")
+    assert cfg.moe_num_experts == 0, "MoE+pipeline composition not supported yet"
+
+    x = params["embed"]["embedding"].astype(dt)[ids]  # [M, B, S, H]
+    if cfg.positions == "learned":
+        x = x + params["pos_embed"]["embedding"].astype(dt)[:S][None, None]
+    sin, cos = rope_table(cfg, jnp.arange(S)) if cfg.positions == "rotary" else (
+        jnp.zeros((S, 1)), jnp.zeros((S, 1)))
+
+    def stage_fn(blocks_local, xb, sin, cos):
+        def body(carry, layer):
+            y, _aux = _block(cfg, carry, layer, sin, cos, None, constrain=False)
+            return y, None
+
+        y, _ = lax.scan(body, xb, blocks_local)
+        return y
+
+    outs = pipeline_apply(stage_fn, params["blocks"], x, sin, cos, mesh=mesh, num_stages=num_stages,
+                          remat=True)  # [M, B, S, H]
+    h = _norm(outs, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("mbsh,vh->mbsv", h, params["embed"]["embedding"].astype(dt))
+    else:
+        logits = jnp.einsum("mbsh,hv->mbsv", h, params["lm_head"]["kernel"].astype(dt))
+    logits = logits.astype(jnp.float32)
+    if isinstance(batches, dict) and "labels" in batches:
+        shift_logits, labels = logits, batches["labels"]
+    else:
+        shift_logits, labels = logits[:, :, :-1], ids[:, :, 1:]
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    token_ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if isinstance(batches, dict) and "loss_mask" in batches:
+        mask = batches["loss_mask"][:, :, :token_ll.shape[2]].astype(jnp.float32)
+        return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return -token_ll.mean()
+
+
 class TransformerLM:
     """Model object consumed by ``deepspeed_tpu.initialize``: bundles config,
     init, loss and TP partition rules (the engine's model protocol)."""
@@ -511,6 +572,9 @@ class TransformerLM:
 
     def loss(self, params, batch, rng=None):
         return loss_fn(self.config, params, batch, rng)
+
+    def pipeline_loss(self, params, batches, rng=None, *, mesh, num_stages):
+        return pipeline_loss_fn(self.config, params, batches, rng, mesh=mesh, num_stages=num_stages)
 
     def partition_rules(self):
         return partition_rules(self.config)

@@ -103,8 +103,17 @@ class DeepSpeedEngine:
         self.dp_world_size = groups.get_data_parallel_world_size()
         self.mp_world_size = groups.get_model_parallel_world_size()
         self.seq_world_size = groups.get_sequence_parallel_world_size()
+        self.pipe_world_size = groups.get_pipe_parallel_world_size()
         self.batch_dp_world_size = self.mesh.shape.get(DATA_AXIS, 1)
         config.resolve_batch_config(self.batch_dp_world_size)
+        if self.pipe_world_size > 1:
+            # same constraint as the reference: PP composes with ZeRO<=1
+            # (PipelineEngine asserts zero stage < 2); and the SPMD pipeline
+            # v1 handles pipe x data only
+            assert config.zero_optimization_stage <= 1, "pipeline parallelism requires ZeRO stage <= 1"
+            assert hasattr(model, "pipeline_loss"), "model must provide pipeline_loss for pipeline parallelism"
+            assert self.seq_world_size == 1, "pipeline + sequence parallel composition not supported yet"
+            assert self.mp_world_size == 1, "pipeline + tensor parallel composition not supported yet"
 
         # --- precision policy ---
         self.compute_dtype = (jnp.bfloat16 if config.bfloat16_enabled else
@@ -290,6 +299,8 @@ class DeepSpeedEngine:
 
     def _build_train_step(self, gas: int):
         """Fused train step: scan over ``gas`` microbatches then update."""
+        if self.pipe_world_size > 1:
+            return self._build_pipeline_train_step()
 
         def train_step(state, batches, rng):
             params = state["params"]
@@ -312,19 +323,42 @@ class DeepSpeedEngine:
             else:
                 (acc, _), losses = jax.lax.scan(micro, (zeros, rng), batches)
             acc = jax.tree_util.tree_map(lambda g: g / gas, acc)
-            new_state, finite = self._apply_update(state, acc, jnp.array(True))
-            grad_norm = optax.global_norm(acc)
-            metrics = {
-                "loss": jnp.mean(losses),
-                "grad_norm": grad_norm,
-                "overflow": jnp.logical_not(finite),
-                "lr": (self.lr_schedule_fn(state["step"]) if self.lr_schedule_fn is not None else
-                       jnp.asarray((self.config.optimizer_params or {}).get("lr", 0.0))),
-            }
-            return new_state, metrics
+            return self._finalize_step(state, acc, jnp.mean(losses))
 
+        return self._jit_step(train_step)
+
+    def _build_pipeline_train_step(self):
+        """PP path: the gas microbatches ARE the pipeline microbatches
+        (reference PipelineEngine.train_batch consumes them the same way,
+        pipe/engine.py:348); one jitted program runs the whole 1F1B-equivalent
+        fill/drain loop forward AND backward."""
+
+        def train_step(state, batches, rng):
+            def scaled(p):
+                loss = self.module.pipeline_loss(p, batches, rng, mesh=self.mesh,
+                                                 num_stages=self.pipe_world_size)
+                return loss * state["loss_scale"], loss
+
+            grads, loss = jax.grad(scaled, has_aux=True)(state["params"])
+            return self._finalize_step(state, grads, loss)
+
+        return self._jit_step(train_step)
+
+    def _finalize_step(self, state, grads, mean_loss):
+        """Shared tail: apply update + build the step metrics dict."""
+        new_state, finite = self._apply_update(state, grads, jnp.array(True))
+        metrics = {
+            "loss": mean_loss,
+            "grad_norm": optax.global_norm(grads),
+            "overflow": jnp.logical_not(finite),
+            "lr": (self.lr_schedule_fn(state["step"]) if self.lr_schedule_fn is not None else
+                   jnp.asarray((self.config.optimizer_params or {}).get("lr", 0.0))),
+        }
+        return new_state, metrics
+
+    def _jit_step(self, fn):
         donate = (0, ) if self.config.tpu_config.donate_buffers else ()
-        return jax.jit(train_step, donate_argnums=donate, out_shardings=(self._state_shardings, None))
+        return jax.jit(fn, donate_argnums=donate, out_shardings=(self._state_shardings, None))
 
     # ------------------------------------------------------------------
     # public API — fused path
@@ -391,6 +425,9 @@ class DeepSpeedEngine:
         fused path (no forward recomputation). Thanks to async dispatch the
         returned loss is a future; nothing blocks until the value is read.
         """
+        assert self.pipe_world_size <= 1, (
+            "forward/backward/step are not supported with pipeline parallelism; use train_batch() "
+            "(same contract as the reference PipelineEngine)")
         fwd_rng, self._rng = jax.random.split(self._rng)
         if not self._train_mode:  # eval: loss only, no grads
             if "loss" not in self._compiled:

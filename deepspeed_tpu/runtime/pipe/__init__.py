@@ -1,0 +1,5 @@
+from .schedule import (PipeSchedule, TrainSchedule, InferenceSchedule, DataParallelSchedule, ForwardPass, BackwardPass,
+                       SendActivation, RecvActivation, SendGrad, RecvGrad, LoadMicroBatch, OptimizerStep, ReduceGrads,
+                       ReduceTiedGrads)
+from .module import PipelineModule, LayerSpec, TiedLayerSpec, partition_uniform, partition_balanced
+from .spmd import pipeline_apply

@@ -1,0 +1,263 @@
+"""Pipeline schedules.
+
+Analog of the reference ``deepspeed/runtime/pipe/schedule.py`` (494 LoC):
+``PipeSchedule:11`` ABC yielding instruction lists per step, ``TrainSchedule:189``
+(1F1B), ``InferenceSchedule:135``, and the instruction classes (:327-:475).
+
+On TPU the *executor* is not a host interpreter dispatching instructions — the
+whole pipeline compiles into one XLA program (see ``pipe/spmd.py``). These
+classes exist for (a) API parity, (b) host-side reasoning/tests about schedule
+structure, and (c) deriving tick counts and buffer requirements for the
+compiled loop.
+"""
+
+from abc import ABC, abstractmethod
+
+
+class PipeInstruction:
+    """Base instruction (reference :327)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        if self.kwargs:
+            return self.name + "(" + ", ".join(f"{k}={v}" for k, v in sorted(self.kwargs.items())) + ")"
+        return self.name
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule(ABC):
+    """Reference :11 — yields lists of instructions per step."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = self.stage_id - 1
+        self.next_stage = self.stage_id + 1
+
+    @abstractmethod
+    def steps(self):
+        ...
+
+    def num_pipe_buffers(self):
+        return self.micro_batches
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _buffer_idx(self, micro_batch_id):
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        self.it = None
+        return self
+
+    def __next__(self):
+        if self.it is None:
+            self.it = self.steps()
+        return next(self.it)
+
+
+class InferenceSchedule(PipeSchedule):
+    """Reference :135 — forward-only pipelining."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            cmds = []
+            micro_batch_id = step_id - self.stage_id
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
+            if self._valid_micro_batch(micro_batch_id):
+                cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                if not self.is_last_stage:
+                    # send happens after compute in the same step
+                    cmds.append(SendActivation(self._buffer_idx(micro_batch_id)))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """Reference :189 — 1F1B: steady state alternates one forward with one
+    backward, bounding live activations to ~num_stages microbatches."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds = []
+
+            # exchange activations/grads
+            if self._valid_micro_batch(prev_micro_batch_id):
+                if is_forward:
+                    if self._valid_stage(self.prev_stage):
+                        cmds.append(SendGrad(self._buffer_idx(prev_micro_batch_id)))
+                else:
+                    if self._valid_stage(self.next_stage):
+                        cmds.append(SendActivation(self._buffer_idx(prev_micro_batch_id)))
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    if self._valid_stage(self.prev_stage):
+                        cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
+                else:
+                    if self._valid_stage(self.next_stage):
+                        cmds.append(RecvGrad(self._buffer_idx(micro_batch_id)))
+
+            # load and compute
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    if self.is_first_stage or self.is_last_stage:
+                        cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
+                    cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                else:
+                    cmds.append(BackwardPass(self._buffer_idx(micro_batch_id)))
+
+            # model step at the end
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def _step_to_micro_batch(self, step_id):
+        """Reference mapping of global step -> (micro_batch, is_forward)."""
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif _is_odd(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        elif _is_odd(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        else:
+            raise AssertionError("unreachable")
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id):
+        base = step_id // 2
+        return int(base - self.stage_id // 2)
+
+    def _odd_step_forward_id(self, step_id):
+        base = (step_id - 1) // 2
+        return int(base - self.stage_id // 2)
+
+    def _even_step_backward_id(self, step_id):
+        base = step_id // 2
+        return int(base - self.stages + (self.stage_id + 1) // 2)
+
+    def _odd_step_backward_id(self, step_id):
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return int(base + self.stage_id // 2)
+
+    def num_pipe_buffers(self):
+        """1F1B needs stages - stage_id live buffers (reference :289)."""
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Reference trailing class — degenerate single-stage schedule."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1
+
+
+def _is_even(x):
+    return x % 2 == 0
+
+
+def _is_odd(x):
+    return x % 2 != 0
