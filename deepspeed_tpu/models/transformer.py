@@ -56,7 +56,8 @@ class TransformerConfig:
     remat: bool = False
     remat_policy: str = "nothing_saveable"
     attention_impl: str = "auto"  # 'auto' | 'reference' | 'flash'
-    sequence_parallel: bool = False  # Ulysses sharding constraints
+    sequence_parallel: bool = False  # Ulysses/ring sharding over the seq axis
+    sequence_parallel_impl: str = "ulysses"  # 'ulysses' (a2a) | 'ring' (ppermute)
     dropout: float = 0.0
     # MoE (reference deepspeed/moe): 0 = dense; experts shard over the data
     # axes (expert parallelism); XLA inserts the dispatch/combine all-to-alls
@@ -265,9 +266,21 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True)
         k = apply_rope(k, sin, cos)
 
     if cfg.sequence_parallel:
-        from ..sequence.layer import ulysses_attention_gspmd
+        if cfg.sequence_parallel_impl == "ring":
+            from ..parallel import groups
+            from ..parallel.mesh import mesh_axis_size
+            from ..sequence.ring import ring_attention_gspmd
 
-        ctx = ulysses_attention_gspmd(partial(_attention, cfg), q, k, v)
+            # degrade to plain attention when no mesh registry is live (same
+            # graceful behavior as ulysses' sharding constraints outside a mesh)
+            if groups.is_initialized() and mesh_axis_size(groups.get_mesh(), SEQ_AXIS) > 1:
+                ctx = ring_attention_gspmd(q, k, v, groups.get_mesh(), causal=True)
+            else:
+                ctx = _attention(cfg, q, k, v)
+        else:
+            from ..sequence.layer import ulysses_attention_gspmd
+
+            ctx = ulysses_attention_gspmd(partial(_attention, cfg), q, k, v)
     else:
         ctx = _attention(cfg, q, k, v)
     ctx = ctx.reshape(B, S, nq * d)
@@ -552,8 +565,12 @@ def pipeline_loss_fn(cfg: TransformerConfig, params, batches, rng=None, *, mesh,
     logp = jax.nn.log_softmax(shift_logits, axis=-1)
     token_ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     if isinstance(batches, dict) and "loss_mask" in batches:
+        # per-microbatch masked mean, then mean over microbatches — identical
+        # weighting to the non-pipeline path (loss_fn averaged over gas), so
+        # enabling pipe does not change the training objective
         mask = batches["loss_mask"][:, :, :token_ll.shape[2]].astype(jnp.float32)
-        return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        per_mb = -(token_ll * mask).sum(axis=(1, 2)) / jnp.maximum(mask.sum(axis=(1, 2)), 1.0)
+        return per_mb.mean()
     return -token_ll.mean()
 
 

@@ -128,20 +128,52 @@ class PipelineModule:
         if method == "uniform":
             return [1.0] * n
         if method == "parameters":
-            weights = []
-            for spec in self._layer_specs:
-                nparams = 0
-                target = spec.typename if isinstance(spec, LayerSpec) else spec
-                for v in getattr(target, "param_count", lambda: [0])() if callable(
-                        getattr(target, "param_count", None)) else [0]:
-                    nparams += v
-                weights.append(max(nparams, 1))
-            return weights
+            weights = [self._spec_param_count(spec) for spec in self._layer_specs]
+            if all(w is None for w in weights):
+                logger.warning(
+                    "partition_method='parameters' but no layer exposes a parameter count "
+                    "(param_count / num_params / params attrs); falling back to uniform partitioning")
+                return [1.0] * n
+            return [max(w, 1) if w is not None else 1 for w in weights]
         if method.startswith("type:"):
             pat = re.compile(method[5:], re.IGNORECASE)
             return [1.0 if pat.search(getattr(getattr(s, "typename", s), "__name__", str(s))) else 0.0
                     for s in self._layer_specs]
         raise NotImplementedError(f"Partitioning method {self.partition_method} not implemented")
+
+    @staticmethod
+    def _spec_param_count(spec):
+        """Parameter count of one layer spec, or None if undiscoverable.
+        Probes, in order: ``param_count`` (int or callable on the spec, its
+        class, or the built instance), ``num_params()``, and a ``params``
+        array pytree on the built instance."""
+        targets = [spec]
+        if isinstance(spec, LayerSpec):
+            targets.append(spec.typename)
+            try:
+                targets.append(spec.build())
+            except Exception:
+                pass
+        for t in targets:
+            pc = getattr(t, "param_count", None)
+            if pc is not None:
+                v = pc() if callable(pc) else pc
+                return int(np.sum(list(v))) if np.iterable(v) else int(v)
+            np_fn = getattr(t, "num_params", None)
+            if callable(np_fn):
+                try:
+                    return int(np_fn())
+                except Exception:
+                    pass
+            p = getattr(t, "params", None)
+            if p is not None:
+                try:
+                    import jax
+
+                    return int(sum(np.prod(np.shape(x)) for x in jax.tree_util.tree_leaves(p)))
+                except Exception:
+                    pass
+        return None
 
     def _partition_layers(self):
         method = self.partition_method.lower()

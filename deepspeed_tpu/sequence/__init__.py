@@ -1,1 +1,2 @@
 from .layer import DistributedAttention, single_all_to_all, ulysses_attention_gspmd
+from .ring import RingAttention, ring_attention, ring_attention_gspmd

@@ -1,0 +1,76 @@
+"""Ring attention vs full reference attention (kernel-vs-reference strategy,
+mirroring the reference's tests/unit/ops numerics tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel import MeshConfig, build_mesh
+from deepspeed_tpu.sequence import ring_attention_gspmd
+from deepspeed_tpu.models.transformer import reference_attention
+
+
+def _rand_qkv(rng, B=2, S=64, n=4, d=16, nkv=None):
+    kq, kk, kv = jax.random.split(rng, 3)
+    nkv = nkv or n
+    q = jax.random.normal(kq, (B, S, n, d), jnp.float32)
+    k = jax.random.normal(kk, (B, S, nkv, d), jnp.float32)
+    v = jax.random.normal(kv, (B, S, nkv, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(eight_devices, causal):
+    mesh = build_mesh(MeshConfig(data=2, seq=4, model=1))
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0))
+    out = jax.jit(lambda q, k, v: ring_attention_gspmd(q, k, v, mesh, causal=causal))(q, k, v)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gqa(eight_devices):
+    mesh = build_mesh(MeshConfig(data=1, seq=4, model=2))
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), n=4, nkv=2)
+    # heads sharded over model: GQA repeat happens per-shard
+    out = jax.jit(lambda q, k, v: ring_attention_gspmd(q, k, v, mesh, causal=True))(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_grads(eight_devices):
+    mesh = build_mesh(MeshConfig(data=2, seq=4, model=1))
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), B=2, S=32, n=2, d=8)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_gspmd(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_ring_end_to_end_engine(eight_devices):
+    """Full train step with ring-attention sequence parallelism through the
+    engine (analog of test_sequence_parallel_ulysses)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from conftest import tiny_batch
+
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "tpu": {"mesh": {"data": 2, "seq": 4}},
+    }
+    m = TransformerLM(TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                                        max_seq_len=64, intermediate_size=128, attention_impl="reference",
+                                        dtype=jnp.float32, sequence_parallel=True, sequence_parallel_impl="ring"))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+    losses = [float(engine.train_batch(tiny_batch(batch_size=8, seq=32, seed=i % 2))) for i in range(4)]
+    assert losses[-1] < losses[0], losses
