@@ -1,0 +1,29 @@
+"""Inference v2 configuration.
+
+Analog of the reference ``inference/v2/config_v2.py`` (RaggedInferenceEngineConfig
+with ``state_manager: DSStateManagerConfig`` and tensor-parallel settings).
+"""
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+
+@dataclass
+class DSStateManagerConfig:
+    max_tracked_sequences: int = 128
+    max_ragged_batch_size: int = 768
+    max_ragged_sequence_count: int = 64
+    max_context: int = 2048  # per-sequence context ceiling (blocks * block_size)
+    memory_config: str = "auto"  # 'auto' sizes the KV pool from free HBM
+    offload: bool = False  # reference kv_cache.py:169 offload hooks — not yet
+
+
+@dataclass
+class RaggedInferenceEngineConfig:
+    tensor_parallel_degree: int = 1
+    kv_block_size: int = 64
+    num_kv_blocks: int = 256  # pool size; 'auto' sizing TODO against HBM stats
+    kv_dtype: object = jnp.bfloat16
+    state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
+    use_pallas_kernels: str = "auto"  # 'auto' | 'never' | 'always'

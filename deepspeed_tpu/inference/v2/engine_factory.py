@@ -1,0 +1,37 @@
+"""Engine construction helpers.
+
+Analog of the reference ``inference/v2/engine_factory.py`` (``build_hf_engine``,
+``build_engine_from_ds_checkpoint:25`` — policy lookup by model type). Here
+the "policy" maps a model-family name to our native model configs; HF weight
+conversion lives in ``module_inject`` (AutoTP) and plugs in through
+``params``.
+"""
+
+from typing import Optional
+
+from .config_v2 import RaggedInferenceEngineConfig
+from .engine_v2 import InferenceEngineV2
+
+
+def build_engine(model, engine_config: Optional[RaggedInferenceEngineConfig] = None, params=None):
+    """Build an ``InferenceEngineV2`` from a framework model object."""
+    return InferenceEngineV2(model, engine_config, params=params)
+
+
+def build_model_engine(model_family: str, size: str = "tiny", engine_config=None, params=None, **cfg_over):
+    """Build by family name — the policy-map entry point (reference
+    ``engine_factory.py`` inventory: llama_v2 / mistral / opt)."""
+    from ... import models as M
+
+    family = model_family.lower().replace("-", "_")
+    builders = {
+        "llama": M.llama2,
+        "llama_v2": M.llama2,
+        "mistral": M.mistral,
+        "gpt2": M.gpt2,
+        "opt": M.opt,
+    }
+    if family not in builders:
+        raise ValueError(f"unknown model family {model_family!r}; have {sorted(builders)}")
+    model = builders[family](size, **cfg_over)
+    return InferenceEngineV2(model, engine_config, params=params)

@@ -1,0 +1,156 @@
+"""InferenceEngineV2 — continuous-batching ragged inference engine.
+
+Analog of the reference ``inference/v2/engine_v2.py:30`` (``put:107``,
+``query:153``, ``can_schedule:179``, ``flush:228``, ``serialize:237``). The
+serving loop is host-driven exactly like the reference's (MII calls put() with
+whatever mix of prefill chunks and decode steps the scheduler admitted); the
+device side is one jitted ragged forward per shape-bucket with the KV pools
+donated through, so steady-state decode reuses a single compiled program and
+the only host→device traffic is the packed batch descriptor arrays.
+"""
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.logging import log_dist
+from .config_v2 import RaggedInferenceEngineConfig
+from .model_implementations.flat_model import ragged_forward
+from .ragged.ragged_manager import DSStateManager
+from .ragged.ragged_wrapper import RaggedBatchWrapper
+from .scheduling_utils import SchedulingError, SchedulingResult
+
+
+class InferenceEngineV2:
+
+    def __init__(self, model, config: Optional[RaggedInferenceEngineConfig] = None, params=None):
+        """``model``: framework model object (e.g. ``models.llama2()``);
+        ``params``: trained param pytree (initialized randomly if omitted)."""
+        self.config = config or RaggedInferenceEngineConfig()
+        self.module = model
+        self.model_config = model.config
+        mc, ic = self.model_config, self.config
+
+        if params is None:
+            params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
+        self.params = params
+
+        bs = ic.kv_block_size
+        max_context = ic.state_manager.max_context
+        model_max = getattr(mc, "max_seq_len", None)
+        if model_max is not None and max_context > model_max:
+            # past max_seq_len a learned-position model would silently clamp
+            # its position gather — refuse to track context beyond the model
+            log_dist(f"clamping max_context {max_context} -> model max_seq_len {model_max}", ranks=[0])
+            max_context = model_max
+        self._max_context = max_context
+        self._max_blocks_per_seq = -(-max_context // bs)
+        self.state_manager = DSStateManager(
+            mc.num_layers, mc.num_kv_heads, mc.head_dim,
+            max_tracked_sequences=ic.state_manager.max_tracked_sequences,
+            num_blocks=ic.num_kv_blocks, block_size=bs, dtype=ic.kv_dtype)
+        self.batch = RaggedBatchWrapper(
+            max_ragged_batch_size=ic.state_manager.max_ragged_batch_size,
+            max_ragged_sequence_count=ic.state_manager.max_ragged_sequence_count,
+            max_blocks_per_seq=self._max_blocks_per_seq, block_size=bs)
+
+        if ic.use_pallas_kernels == "auto":
+            self._use_pallas = jax.default_backend() == "tpu"
+        else:
+            self._use_pallas = ic.use_pallas_kernels == "always"
+        self._compiled: Dict[Tuple[int, int], object] = {}
+        log_dist(
+            f"InferenceEngineV2 ready: blocks={ic.num_kv_blocks}x{bs} "
+            f"kv={self.state_manager.kv_cache.memory_bytes()/2**20:.0f}MiB "
+            f"max_batch_tokens={ic.state_manager.max_ragged_batch_size} pallas={self._use_pallas}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    def can_schedule(self, uids: Iterable[int], lengths: Iterable[int]) -> SchedulingResult:
+        """Admission control (reference ``engine_v2.py:179``): sequence,
+        token and KV-block budgets for the proposed batch."""
+        uids, lengths = list(uids), list(lengths)
+        cur_len = len(uids)
+        tokens = sum(lengths)
+        sm = self.config.state_manager
+
+        if cur_len > sm.max_ragged_sequence_count:
+            return SchedulingResult.BatchSequenceLimitExceeded
+        n_new = sum(1 for u in uids if self.state_manager.get_sequence(u) is None)
+        if self.state_manager.n_tracked_sequences + n_new > sm.max_tracked_sequences:
+            return SchedulingResult.EngineSequenceLimitExceeded
+        if tokens > sm.max_ragged_batch_size:
+            return SchedulingResult.TokenLimitExceeded
+
+        bs = self.config.kv_block_size
+        blocks_needed = 0
+        for u, n in zip(uids, lengths):
+            seq = self.state_manager.get_sequence(u)
+            total = n + (seq.seen_tokens if seq is not None else 0)
+            if total > self._max_blocks_per_seq * bs:
+                return SchedulingResult.KVCacheLimitExceeded
+            blocks_needed += (-(-total // bs) - (seq.cur_allocated_blocks if seq is not None else 0))
+        if blocks_needed > self.state_manager.free_blocks:
+            return SchedulingResult.KVCacheLimitExceeded
+        return SchedulingResult.Success
+
+    # ------------------------------------------------------------------
+    def put(self, batch_uids: List[int], batch_tokens: List[np.ndarray], do_checks: bool = True) -> np.ndarray:
+        """Run one ragged forward (reference ``put:107``). ``batch_tokens[i]``
+        are the new tokens of sequence ``batch_uids[i]`` (whole prompt for
+        prefill, one token for decode). Returns last-token logits
+        [len(batch_uids), vocab]."""
+        batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
+        if do_checks:
+            result = self.can_schedule(batch_uids, [t.size for t in batch_tokens])
+            if result is not SchedulingResult.Success:
+                raise SchedulingError(result)
+
+        self.batch.clear()
+        descs = []
+        for uid, toks in zip(batch_uids, batch_tokens):
+            seq = self.state_manager.get_or_create_sequence(uid)
+            self.state_manager.allocate_blocks(seq, toks.size)
+            seq.pre_forward(toks.size)
+            self.batch.insert_sequence(seq, toks)
+            descs.append(seq)
+        rb = self.batch.finalize()
+
+        fn = self._get_compiled(rb.token_ids.shape[0], rb.block_tables.shape[0])
+        kv = self.state_manager.kv_cache
+        logits, k_pool, v_pool = fn(self.params, jnp.asarray(rb.token_ids), jnp.asarray(rb.token_seq_idx),
+                                    jnp.asarray(rb.token_pos), jnp.asarray(rb.token_valid),
+                                    jnp.asarray(rb.block_tables), jnp.asarray(rb.last_token_idx),
+                                    kv.k_pool, kv.v_pool)
+        kv.update(k_pool, v_pool)
+        for seq in descs:
+            seq.post_forward()
+        return np.asarray(logits)[:rb.n_seqs]
+
+    # ------------------------------------------------------------------
+    def query(self, uid: Optional[int] = None):
+        """Sequence / engine state introspection (reference ``query:153``)."""
+        return self.state_manager.query(uid)
+
+    def flush(self, uid: int) -> None:
+        """Finish a sequence and release its KV blocks (reference ``flush:228``)."""
+        self.state_manager.flush_sequence(uid)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.state_manager.free_blocks
+
+    # ------------------------------------------------------------------
+    def _get_compiled(self, t_bucket: int, s_bucket: int):
+        key = (t_bucket, s_bucket)
+        if key not in self._compiled:
+            cfg, bs, use_pallas = self.model_config, self.config.kv_block_size, self._use_pallas
+
+            def fwd(params, token_ids, seq_idx, pos, valid, tables, last_idx, k_pool, v_pool):
+                return ragged_forward(cfg, bs, params, token_ids, seq_idx, pos, valid, tables,
+                                      last_idx, k_pool, v_pool, use_pallas=use_pallas)
+
+            self._compiled[key] = jax.jit(fwd, donate_argnums=(7, 8))
+            log_dist(f"compiled ragged forward bucket tokens={t_bucket} seqs={s_bucket}", ranks=[0])
+        return self._compiled[key]

@@ -1,0 +1,1 @@
+from .flat_model import ragged_forward

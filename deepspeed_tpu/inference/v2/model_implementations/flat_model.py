@@ -1,0 +1,115 @@
+"""Ragged (flat-token) transformer forward over a paged KV pool.
+
+This is the TPU analog of the reference FastGen data plane
+(``inference/v2/model_implementations/inference_transformer_base.py`` —
+``DSTransformerModelBase.forward``: per layer qkv gemm →
+``linear_blocked_kv_rotary`` (rotary + append to paged KV) → ``blocked_flash``
+attention over the block table → mlp → ``logits_gather`` for the last token of
+each sequence). Here the whole thing is ONE jitted function over bucket-padded
+arrays:
+
+  - tokens are a flat [T] buffer mixing prefill chunks and decode steps of
+    many sequences (Dynamic SplitFuse composition);
+  - KV append is a scatter into the flat pool at
+    ``block_table[seq, pos // bs] * bs + pos % bs`` (invalid/padding tokens
+    scatter out-of-bounds with mode='drop');
+  - attention gathers each sequence's context from the pool by block table
+    and masks ``ctx_pos <= token_pos`` — numerics-reference path; the Pallas
+    paged kernel (``ops/pallas/paged_attention.py``) replaces the gather on
+    real TPU;
+  - only each sequence's last token is projected to the vocabulary
+    (``logits_gather`` semantics).
+
+Works with the same stacked param pytree as ``models.transformer`` training,
+so a trained checkpoint serves directly.
+"""
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ....models.transformer import TransformerConfig, _norm, mlp_activation, rope_table, apply_rope
+
+
+def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, Any], token_ids, seq_idx, pos, valid,
+                   block_tables, last_idx, k_pool, v_pool, use_pallas: bool = False):
+    """Returns (last-token logits [S_pad, V], k_pool, v_pool).
+
+    token_ids/seq_idx/pos/valid: [T_pad]; block_tables: [S_pad, max_blocks];
+    last_idx: [S_pad]; k_pool/v_pool: [L, NB*bs, nkv, d] (donated).
+    """
+    dt = cfg.dtype
+    T = token_ids.shape[0]
+    S, max_blocks = block_tables.shape
+    C = max_blocks * block_size
+    nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    g = nq // nkv
+    pool_len = k_pool.shape[1]
+
+    x = params["embed"]["embedding"].astype(dt)[token_ids]  # [T, H]
+    if cfg.positions == "learned":
+        x = x + params["pos_embed"]["embedding"].astype(dt)[pos]
+    sin, cos = rope_table(cfg, pos) if cfg.positions == "rotary" else (None, None)
+
+    # flat KV slot of each token; padding tokens dropped via OOB scatter
+    slot = block_tables[seq_idx, pos // block_size] * block_size + pos % block_size
+    slot = jnp.where(valid, slot, pool_len)
+
+    def layer(x, blk_kv):
+        blk, k_pool_l, v_pool_l = blk_kv
+        h = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+        q = jnp.einsum("th,hd->td", h, blk["wq"].astype(dt)).reshape(T, nq, d)
+        k = jnp.einsum("th,hd->td", h, blk["wk"].astype(dt)).reshape(T, nkv, d)
+        v = jnp.einsum("th,hd->td", h, blk["wv"].astype(dt)).reshape(T, nkv, d)
+        if cfg.use_bias:
+            q = q + blk["bq"].astype(dt).reshape(nq, d)
+            k = k + blk["bk"].astype(dt).reshape(nkv, d)
+            v = v + blk["bv"].astype(dt).reshape(nkv, d)
+        if cfg.positions == "rotary":
+            q = apply_rope(q[None], sin, cos)[0]
+            k = apply_rope(k[None], sin, cos)[0]
+
+        # append this batch's KV to the paged pool (linear_blocked_kv_rotary)
+        k_pool_l = k_pool_l.at[slot].set(k.astype(k_pool_l.dtype), mode="drop")
+        v_pool_l = v_pool_l.at[slot].set(v.astype(v_pool_l.dtype), mode="drop")
+
+        from ....ops.pallas.paged_attention import paged_attention, paged_attention_reference
+
+        if use_pallas:
+            ctx = paged_attention(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos, block_size)
+        else:
+            ctx = paged_attention_reference(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos, block_size)
+
+        attn_out = jnp.einsum("td,dh->th", ctx.reshape(T, nq * d), blk["wo"].astype(dt))
+        if cfg.use_bias:
+            attn_out = attn_out + blk["bo"].astype(dt)
+        x = x + attn_out
+
+        h = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        up = jnp.einsum("th,hf->tf", h, blk["w_up"].astype(dt))
+        if cfg.use_bias:
+            up = up + blk["b_up"].astype(dt)
+        if cfg.mlp == "swiglu":
+            gate = jnp.einsum("th,hf->tf", h, blk["w_gate"].astype(dt))
+            act = mlp_activation(cfg, up, gate)
+        else:
+            act = mlp_activation(cfg, up)
+        down = jnp.einsum("tf,fh->th", act, blk["w_down"].astype(dt))
+        if cfg.use_bias:
+            down = down + blk["b_down"].astype(dt)
+        return x + down, (k_pool_l, v_pool_l)
+
+    def scan_body(x, blk_kv):
+        x, pools = layer(x, blk_kv)
+        return x, pools
+
+    x, (k_pool, v_pool) = jax.lax.scan(scan_body, x, (params["blocks"], k_pool, v_pool))
+
+    h = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+    h_last = h[last_idx]  # [S, H] — logits_gather: unembed only last tokens
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("sh,vh->sv", h_last, params["embed"]["embedding"].astype(dt))
+    else:
+        logits = jnp.einsum("sh,hv->sv", h_last, params["lm_head"]["kernel"].astype(dt))
+    return logits.astype(jnp.float32), k_pool, v_pool

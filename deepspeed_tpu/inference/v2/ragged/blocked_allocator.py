@@ -1,0 +1,57 @@
+"""Free-list KV block allocator.
+
+Analog of the reference ``inference/v2/ragged/blocked_allocator.py:11``
+(``BlockedAllocator``: fixed pool of KV-cache blocks handed out to sequences
+and returned on release). Host-side bookkeeping only — the device never sees
+this object, just the block-table arrays it produces.
+"""
+
+from typing import Iterable, Union
+
+import numpy as np
+
+
+class BlockedAllocator:
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"allocator requires at least 1 block, got {num_blocks}")
+        self._num_blocks = int(num_blocks)
+        # singly-linked free list in a flat array (same layout the reference
+        # keeps on-device; plain numpy here — it is pure host metadata)
+        self._next = np.arange(1, num_blocks + 1, dtype=np.int64)
+        self._head = 0
+        self._free = num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return self._free
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, num_blocks: int) -> np.ndarray:
+        """Pop ``num_blocks`` block ids; raises ValueError when exhausted
+        (reference ``blocked_allocator.py:50``)."""
+        if num_blocks < 1:
+            raise ValueError(f"must allocate at least 1 block, got {num_blocks}")
+        if num_blocks > self._free:
+            raise ValueError(f"requested {num_blocks} blocks, only {self._free} free")
+        out = np.empty(num_blocks, dtype=np.int64)
+        for i in range(num_blocks):
+            out[i] = self._head
+            self._head = self._next[self._head]
+        self._free -= num_blocks
+        return out
+
+    def free(self, blocks: Union[int, Iterable[int]]) -> None:
+        if isinstance(blocks, (int, np.integer)):
+            blocks = [int(blocks)]
+        for b in blocks:
+            b = int(b)
+            if not 0 <= b < self._num_blocks:
+                raise ValueError(f"invalid block id {b}")
+            self._next[b] = self._head
+            self._head = b
+            self._free += 1

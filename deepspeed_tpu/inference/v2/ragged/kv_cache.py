@@ -1,0 +1,59 @@
+"""Blocked (paged) KV cache on device.
+
+Analog of the reference ``inference/v2/ragged/kv_cache.py:40``
+(``BlockedKVCache``: device block pool fronted by a ``BlockedAllocator``).
+TPU-native layout: one stacked pool per cache group,
+
+    k_pool / v_pool : [num_layers, num_blocks * block_size, num_kv_heads, head_dim]
+
+i.e. the block dimension is flattened so a token's slot is the flat index
+``block_id * block_size + offset`` — scatter (append) and gather (attention)
+are then single-index operations that XLA lowers to efficient dynamic-slice /
+dynamic-update-slice, and the Pallas paged-attention kernel indexes the same
+flat pool. The pool shards over the ``model`` axis on the kv-head dim (TP).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .blocked_allocator import BlockedAllocator
+
+
+class BlockedKVCache:
+
+    def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int, num_blocks: int, block_size: int = 64,
+                 dtype=jnp.bfloat16, sharding=None):
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.dtype = dtype
+        self._allocator = BlockedAllocator(num_blocks)
+        shape = (num_layers, self.num_blocks * self.block_size, num_kv_heads, head_dim)
+        self.k_pool = jnp.zeros(shape, dtype)
+        self.v_pool = jnp.zeros(shape, dtype)
+        if sharding is not None:
+            self.k_pool = jax.device_put(self.k_pool, sharding)
+            self.v_pool = jax.device_put(self.v_pool, sharding)
+
+    @property
+    def free_blocks(self) -> int:
+        return self._allocator.free_blocks
+
+    def reserve(self, n_blocks: int) -> np.ndarray:
+        """Allocate ``n_blocks`` (reference ``kv_cache.py:147`` reserve)."""
+        return self._allocator.allocate(n_blocks)
+
+    def free(self, blocks) -> None:
+        self._allocator.free(blocks)
+
+    def update(self, k_pool, v_pool) -> None:
+        """Install the pools returned by the jitted forward (donated in/out)."""
+        self.k_pool, self.v_pool = k_pool, v_pool
+
+    def memory_bytes(self) -> int:
+        return 2 * self.k_pool.size * self.k_pool.dtype.itemsize

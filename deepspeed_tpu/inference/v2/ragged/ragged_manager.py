@@ -1,0 +1,71 @@
+"""Sequence state manager.
+
+Analog of the reference ``inference/v2/ragged/ragged_manager.py:19``
+(``DSStateManager``: tracked sequences → KV block tables, owns the
+``BlockedKVCache``).
+"""
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from ...config import DeepSpeedInferenceConfig  # noqa: F401  (parity import)
+from .blocked_allocator import BlockedAllocator  # noqa: F401
+from .kv_cache import BlockedKVCache
+from .sequence_descriptor import DSSequenceDescriptor
+
+
+class DSStateManager:
+
+    def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int, *, max_tracked_sequences: int = 128,
+                 num_blocks: int = 256, block_size: int = 64, dtype=jnp.bfloat16, kv_sharding=None):
+        self.max_tracked_sequences = max_tracked_sequences
+        self.block_size = block_size
+        self.kv_cache = BlockedKVCache(num_layers, num_kv_heads, head_dim, num_blocks, block_size, dtype=dtype,
+                                       sharding=kv_sharding)
+        self._seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def n_tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.kv_cache.free_blocks
+
+    def query(self, uid: Optional[int] = None):
+        """Reference ``engine_v2.query``-backing lookup: per-sequence state
+        or the (tracked, free-block) summary."""
+        if uid is None:
+            return {"tracked": self.n_tracked_sequences, "free_blocks": self.free_blocks}
+        return self._seqs.get(uid)
+
+    # -- lifecycle ---------------------------------------------------------
+    def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        """Reference ``ragged_manager.py:135``."""
+        seq = self._seqs.get(uid)
+        if seq is not None:
+            return seq
+        if len(self._seqs) >= self.max_tracked_sequences:
+            raise RuntimeError(f"already tracking {self.max_tracked_sequences} sequences")
+        seq = DSSequenceDescriptor(uid=uid, block_size=self.block_size)
+        self._seqs[uid] = seq
+        return seq
+
+    def allocate_blocks(self, seq: DSSequenceDescriptor, new_tokens: int) -> None:
+        """Reference ``model.maybe_allocate_kv`` → ``BlockedKVCache.reserve``."""
+        need = seq.blocks_needed(new_tokens)
+        if need > 0:
+            seq.extend_blocks(self.kv_cache.reserve(need))
+
+    def flush_sequence(self, uid: int) -> None:
+        """Release a finished sequence's blocks (reference ``flush:228``)."""
+        seq = self._seqs.pop(uid, None)
+        if seq is None:
+            return
+        if seq.kv_blocks:
+            self.kv_cache.free(seq.kv_blocks)

@@ -1,3 +1,5 @@
 from .transformer import TransformerConfig, TransformerLM, reference_attention
 from .llama import llama2, llama2_config
 from .gpt import gpt2, gpt2_config
+from .mistral import mistral, mistral_config
+from .opt import opt, opt_config

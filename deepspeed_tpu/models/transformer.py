@@ -47,7 +47,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     norm: str = "rmsnorm"  # 'rmsnorm' | 'layernorm'
     positions: str = "rotary"  # 'rotary' | 'learned'
-    mlp: str = "swiglu"  # 'swiglu' | 'gelu'
+    mlp: str = "swiglu"  # 'swiglu' | 'gelu' | 'relu'
     use_bias: bool = False
     tie_embeddings: bool = False
     rope_theta: float = 10000.0
@@ -226,6 +226,15 @@ def reference_attention(q, k, v, causal=True, segment_ids=None):
     return ctx.reshape(B, S, nq, d).astype(q.dtype)
 
 
+def mlp_activation(cfg: TransformerConfig, up, gate=None):
+    """Shared MLP nonlinearity (swiglu/gelu/relu — relu for OPT-era models)."""
+    if cfg.mlp == "swiglu":
+        return jax.nn.silu(gate) * up
+    if cfg.mlp == "relu":
+        return jax.nn.relu(up)
+    return jax.nn.gelu(up)
+
+
 def _attention(cfg: TransformerConfig, q, k, v):
     impl = cfg.attention_impl
     if impl == "auto":
@@ -299,9 +308,9 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True)
         up = up + layer["b_up"].astype(dt)
     if cfg.mlp == "swiglu":
         gate = jnp.einsum("bsh,hf->bsf", h, layer["w_gate"].astype(dt))
-        act = jax.nn.silu(gate) * up
+        act = mlp_activation(cfg, up, gate)
     else:
-        act = jax.nn.gelu(up)
+        act = mlp_activation(cfg, up)
     down = jnp.einsum("bsf,fh->bsh", act, layer["w_down"].astype(dt))
     if cfg.use_bias:
         down = down + layer["b_down"].astype(dt)

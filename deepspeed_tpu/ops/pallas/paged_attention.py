@@ -1,0 +1,147 @@
+"""Paged (blocked) attention over a flat KV pool — the FastGen data-plane
+kernel.
+
+Analog of the reference ``v2/kernels/ragged_ops/blocked_flash`` (CUDA flash
+attention adapted to paged KV block tables, SURVEY.md §2.3). TPU design: a
+Pallas kernel on a ``(tokens, kv_blocks)`` grid using
+``PrefetchScalarGridSpec`` so the K/V BlockSpec index maps read the *block
+table* (scalar-prefetched) — the DMA engine then streams exactly the KV
+blocks each token's sequence owns, straight from HBM, while the online
+softmax accumulates in VMEM scratch across the inner grid dimension.
+
+Token-level formulation: query token ``t`` belongs to ``seq_idx[t]`` at
+absolute position ``pos[t]`` and attends all cached positions ``<= pos[t]``.
+This covers prefill chunks and decode steps uniformly (Dynamic SplitFuse
+mixes both in one batch).
+
+``paged_attention_reference`` is the jnp gather implementation used for CPU
+tests and as the numerics oracle (reference test strategy: kernel vs
+reference, tests/unit/inference/v2/kernels).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int):
+    """q: [T, nq, d]; k_pool/v_pool: [pool_len, nkv, d] (one layer,
+    pool_len = num_blocks*block_size, may include one trailing scratch slot);
+    block_tables: [S, max_blocks]; seq_idx/pos: [T].
+    Returns [T, nq, d]."""
+    T, nq, d = q.shape
+    nkv = k_pool.shape[1]
+    if jax.default_backend() != "tpu" or nq < 8 or d % 128 != 0:
+        return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size)
+    try:
+        return _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx.astype(jnp.int32), pos.astype(jnp.int32),
+                             block_size=block_size)
+    except Exception as e:  # pragma: no cover — kernel bring-up safety net
+        from ...utils.logging import warning_once
+
+        warning_once(f"pallas paged attention unavailable ({type(e).__name__}: {e}); using gather fallback")
+        return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size)
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int):
+    """Gather-based oracle: materializes each sequence's context."""
+    T, nq, d = q.shape
+    nkv = k_pool.shape[1]
+    g = nq // nkv
+    S, max_blocks = block_tables.shape
+    C = max_blocks * block_size
+    ctx_slots = (block_tables[:, :, None] * block_size +
+                 jnp.arange(block_size, dtype=jnp.int32)[None, None, :]).reshape(S, C)
+    ctxk = k_pool[ctx_slots].astype(jnp.float32)  # [S, C, nkv, d]
+    ctxv = v_pool[ctx_slots].astype(jnp.float32)
+    qr = (q.astype(jnp.float32) / math.sqrt(d)).reshape(T, nkv, g, d)
+    s = jnp.einsum("tngd,tcnd->tngc", qr, ctxk[seq_idx])
+    causal = jnp.arange(C, dtype=jnp.int32)[None, :] <= pos[:, None]
+    s = jnp.where(causal[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("tngc,tcnd->tngd", p, ctxv[seq_idx])
+    return out.reshape(T, nq, d).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, nq, d = q.shape
+    nkv = k_pool.shape[1]
+    g = nq // nkv
+    S, max_blocks = block_tables.shape
+    # view the pool as whole blocks; drop any trailing scratch remainder
+    n_pool_blocks = k_pool.shape[0] // block_size
+    k4 = k_pool[:n_pool_blocks * block_size].reshape(n_pool_blocks, block_size, nkv, d)
+    v4 = v_pool[:n_pool_blocks * block_size].reshape(n_pool_blocks, block_size, nkv, d)
+    scale = 1.0 / math.sqrt(d)
+
+    grid = (T, max_blocks)
+
+    def q_map(t, j, seq_ref, pos_ref, bt_ref):
+        return (t, 0, 0)
+
+    def kv_map(t, j, seq_ref, pos_ref, bt_ref):
+        return (bt_ref[seq_ref[t], j], 0, 0, 0)
+
+    def kernel(seq_ref, pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+        t = pl.program_id(0)
+        j = pl.program_id(1)
+        my_pos = pos_ref[t]
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, -1e30)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        @pl.when(j * block_size <= my_pos)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32) * scale  # [nq, d]
+            kb = k_ref[0].astype(jnp.float32)  # [bs, nkv, d]
+            vb = v_ref[0].astype(jnp.float32)
+            # per-kv-head 2-D MXU dots (Mosaic has no mismatched-batch dots);
+            # nkv is small and static so the loop unrolls at trace time
+            s_heads = []
+            for n in range(nkv):
+                s_heads.append(jax.lax.dot(qb[n * g:(n + 1) * g], kb[:, n, :].T))  # [g, bs]
+            s = jnp.concatenate(s_heads, axis=0)  # [nq, bs]
+            kpos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, (nq, block_size), 1)
+            s = jnp.where(kpos <= my_pos, s, -1e30)
+            m_prev = m_ref[:]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)  # [nq, bs]
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            ctx_heads = []
+            for n in range(nkv):
+                ctx_heads.append(jax.lax.dot(p[n * g:(n + 1) * g], vb[:, n, :]))  # [g, d]
+            ctx = jnp.concatenate(ctx_heads, axis=0)  # [nq, d]
+            acc_ref[:] = acc_ref[:] * alpha + ctx
+            m_ref[:] = m_new
+
+        @pl.when(j == max_blocks - 1)
+        def _finalize():
+            o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, nq, d), q_map),
+            pl.BlockSpec((1, block_size, nkv, d), kv_map),
+            pl.BlockSpec((1, block_size, nkv, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, nq, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((nq, d), jnp.float32),
+            pltpu.VMEM((nq, 1), jnp.float32),
+            pltpu.VMEM((nq, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(kernel, grid_spec=grid_spec, out_shape=jax.ShapeDtypeStruct((T, nq, d), q.dtype),
+                          interpret=interpret)(seq_idx, pos, block_tables, q, k4, v4)

@@ -142,38 +142,45 @@ class PipelineModule:
         raise NotImplementedError(f"Partitioning method {self.partition_method} not implemented")
 
     @staticmethod
-    def _spec_param_count(spec):
-        """Parameter count of one layer spec, or None if undiscoverable.
-        Probes, in order: ``param_count`` (int or callable on the spec, its
-        class, or the built instance), ``num_params()``, and a ``params``
-        array pytree on the built instance."""
-        targets = [spec]
-        if isinstance(spec, LayerSpec):
-            targets.append(spec.typename)
+    def _probe_param_count(t):
+        pc = getattr(t, "param_count", None)
+        if pc is not None:
             try:
-                targets.append(spec.build())
-            except Exception:
-                pass
-        for t in targets:
-            pc = getattr(t, "param_count", None)
-            if pc is not None:
                 v = pc() if callable(pc) else pc
                 return int(np.sum(list(v))) if np.iterable(v) else int(v)
-            np_fn = getattr(t, "num_params", None)
-            if callable(np_fn):
-                try:
-                    return int(np_fn())
-                except Exception:
-                    pass
-            p = getattr(t, "params", None)
-            if p is not None:
-                try:
-                    import jax
+            except Exception:  # e.g. unbound instance method probed on the class
+                pass
+        np_fn = getattr(t, "num_params", None)
+        if callable(np_fn):
+            try:
+                return int(np_fn())
+            except Exception:
+                pass
+        p = getattr(t, "params", None)
+        if p is not None:
+            try:
+                import jax
 
-                    return int(sum(np.prod(np.shape(x)) for x in jax.tree_util.tree_leaves(p)))
+                return int(sum(np.prod(np.shape(x)) for x in jax.tree_util.tree_leaves(p)))
+            except Exception:
+                pass
+        return None
+
+    @classmethod
+    def _spec_param_count(cls, spec):
+        """Parameter count of one layer spec, or None if undiscoverable.
+        Probes ``param_count`` (int or callable), ``num_params()``, and a
+        ``params`` array pytree — on the spec and its class first, and only
+        builds the layer (lazily, once) if the cheap probes miss."""
+        n = cls._probe_param_count(spec)
+        if n is None and isinstance(spec, LayerSpec):
+            n = cls._probe_param_count(spec.typename)
+            if n is None:
+                try:
+                    n = cls._probe_param_count(spec.build())
                 except Exception:
                     pass
-        return None
+        return n
 
     def _partition_layers(self):
         method = self.partition_method.lower()
