@@ -75,6 +75,10 @@ class InferenceEngineV2:
         tokens = sum(lengths)
         sm = self.config.state_manager
 
+        if len(set(uids)) != len(uids):
+            # a uid twice in one batch would pack both chunks at the same
+            # positions and corrupt the KV cache — reject at admission
+            return SchedulingResult.BatchSequenceLimitExceeded
         if cur_len > sm.max_ragged_sequence_count:
             return SchedulingResult.BatchSequenceLimitExceeded
         n_new = sum(1 for u in uids if self.state_manager.get_sequence(u) is None)
@@ -88,7 +92,7 @@ class InferenceEngineV2:
         for u, n in zip(uids, lengths):
             seq = self.state_manager.get_sequence(u)
             total = n + (seq.seen_tokens if seq is not None else 0)
-            if total > self._max_blocks_per_seq * bs:
+            if total > self._max_context:
                 return SchedulingResult.KVCacheLimitExceeded
             blocks_needed += (-(-total // bs) - (seq.cur_allocated_blocks if seq is not None else 0))
         if blocks_needed > self.state_manager.free_blocks:

@@ -355,11 +355,8 @@ def _moe_mlp(cfg: TransformerConfig, layer, h, rng=None, constrain=True):
         except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
             pass
     up = jnp.einsum("becm,emf->becf", dispatched, layer["moe_wi"].astype(dt))
-    if cfg.mlp == "swiglu":
-        gate = jnp.einsum("becm,emf->becf", dispatched, layer["moe_wg"].astype(dt))
-        hmid = jax.nn.silu(gate) * up
-    else:
-        hmid = jax.nn.gelu(up)
+    gate = jnp.einsum("becm,emf->becf", dispatched, layer["moe_wg"].astype(dt)) if cfg.mlp == "swiglu" else None
+    hmid = mlp_activation(cfg, up, gate)
     expert_out = jnp.einsum("becf,efm->becm", hmid, layer["moe_wo"].astype(dt))
     if constrain:
         try:
@@ -489,8 +486,8 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
         up = jnp.einsum("bsh,hf->bsf", h, layer["w_up"].astype(dt))
         if cfg.use_bias:
             up = up + layer["b_up"].astype(dt)
-        act = jax.nn.silu(jnp.einsum("bsh,hf->bsf", h, layer["w_gate"].astype(dt))) * up \
-            if cfg.mlp == "swiglu" else jax.nn.gelu(up)
+        gate = jnp.einsum("bsh,hf->bsf", h, layer["w_gate"].astype(dt)) if cfg.mlp == "swiglu" else None
+        act = mlp_activation(cfg, up, gate)
         down = jnp.einsum("bsf,fh->bsh", act, layer["w_down"].astype(dt))
         if cfg.use_bias:
             down = down + layer["b_down"].astype(dt)

@@ -1,0 +1,4 @@
+from . import checkpointing
+from .checkpointing import (checkpoint, configure, is_configured, non_reentrant_checkpoint, reset,
+                            get_rng_tracker, model_parallel_rng_tracker_name, partition_activations_wrapper,
+                            CheckpointFunction, resolve_policy)

@@ -1,0 +1,296 @@
+"""Activation checkpointing — rematerialization policies over ``jax.checkpoint``.
+
+TPU-native analog of the reference Megatron-derived machinery
+(``deepspeed/runtime/activation_checkpointing/checkpointing.py``, 1,165 LoC:
+``CheckpointFunction:484``, ``non_reentrant_checkpoint:727``,
+``partition_activations:373``, ``CudaRNGStatesTracker:122``, ``configure:1073``).
+
+Design: on TPU the compiler owns the trade between recompute and HBM, so the
+reference's hand-rolled stash/partition/offload of saved tensors collapses
+into a *policy* handed to ``jax.checkpoint``:
+
+  * ``checkpoint(fn, *args)``            — remat ``fn`` under the configured
+    policy (reference ``CheckpointFunction.apply`` semantics; in JAX forward
+    outputs and recompute-in-backward are derived from one pure function, so
+    the reentrant/non-reentrant distinction disappears — both entry points map
+    to the same transform).
+  * ``partition_activations``            — instead of scattering saved tensors
+    across TP ranks (reference ``:373``), residuals carry a sharding
+    constraint over the (seq, model) axes so XLA stores each saved activation
+    sharded and all-gathers it at recompute time — same memory/comm trade,
+    compiler-scheduled.
+  * ``cpu_checkpointing``                — maps to ``jax.checkpoint`` +
+    host-offload of the named saved residuals where supported
+    (``save_and_offload_only_these_names``), else to ``nothing_saveable``
+    (recompute everything — strictly less HBM than host offload needs).
+  * RNG: dropout inside a remat'd function replays exactly because JAX PRNG
+    keys are explicit values — the entire reason the reference needs
+    ``CudaRNGStatesTracker`` (:122) to fork/restore device RNG states. A
+    tracker with the same API is provided for Megatron-style model code.
+"""
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ...parallel.mesh import MODEL_AXIS, SEQ_AXIS
+from ...utils.logging import logger
+
+# ---------------------------------------------------------------------------
+# policy registry
+# ---------------------------------------------------------------------------
+
+_POLICIES = {
+    # recompute everything (max memory savings) — the default, and the analog
+    # of the reference checkpointing every transformer block
+    "nothing_saveable": lambda: jax.checkpoint_policies.nothing_saveable,
+    # save matmul outputs, recompute elementwise — the sweet spot on TPU: the
+    # MXU work is saved, the (HBM-bound) elementwise chain is recomputed
+    "dots_saveable": lambda: jax.checkpoint_policies.dots_saveable,
+    "checkpoint_dots": lambda: jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable": lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "checkpoint_dots_with_no_batch_dims": lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "everything_saveable": lambda: jax.checkpoint_policies.everything_saveable,
+}
+
+
+def resolve_policy(name_or_policy):
+    """Resolve a policy name (config string) to a jax.checkpoint policy."""
+    if name_or_policy is None:
+        return jax.checkpoint_policies.nothing_saveable
+    if callable(name_or_policy):
+        return name_or_policy
+    try:
+        return _POLICIES[str(name_or_policy)]()
+    except KeyError:
+        raise ValueError(f"unknown remat policy '{name_or_policy}'; known: {sorted(_POLICIES)}")
+
+
+def offload_policy(names=("residual", )):
+    """Host-offload policy for ``cpu_checkpointing`` — saved residuals with
+    matching ``checkpoint_name`` live in pinned host RAM instead of HBM
+    (reference ``checkpoint_in_cpu`` / ``PartitionedTensor`` CPU path)."""
+    try:
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=list(names),
+            offload_src="device",
+            offload_dst="pinned_host")
+    except Exception:  # older jax without offload support
+        logger.warning("cpu_checkpointing: host offload unsupported by this jax; recomputing instead")
+        return jax.checkpoint_policies.nothing_saveable
+
+
+# ---------------------------------------------------------------------------
+# module state (mirrors the reference's module-level configure() globals)
+# ---------------------------------------------------------------------------
+
+class _CkptState:
+    configured = False
+    policy = None
+    partition_activations = False
+    cpu_checkpointing = False
+    contiguous_memory_optimization = False
+    num_checkpoints = None
+    synchronize = False
+    profile = False
+
+
+_state = _CkptState()
+
+
+def configure(mpu_=None,
+              deepspeed_config=None,
+              partition_activations=None,
+              contiguous_checkpointing=None,
+              checkpoint_in_cpu=None,
+              synchronize=None,
+              profile=None,
+              num_checkpoints=None,
+              remat_policy=None):
+    """Configure module-level checkpointing state (reference ``configure:1073``;
+    same precedence: explicit kwargs override the deepspeed_config block)."""
+    cfg = None
+    if deepspeed_config is not None:
+        from ..config import DeepSpeedConfig
+
+        ds = deepspeed_config if isinstance(deepspeed_config, DeepSpeedConfig) else DeepSpeedConfig(deepspeed_config)
+        cfg = ds.activation_checkpointing_config
+
+    def pick(explicit, from_cfg, default):
+        if explicit is not None:
+            return explicit
+        if cfg is not None:
+            return from_cfg(cfg)
+        return default
+
+    _state.partition_activations = pick(partition_activations, lambda c: c.partition_activations, False)
+    _state.contiguous_memory_optimization = pick(contiguous_checkpointing,
+                                                 lambda c: c.contiguous_memory_optimization, False)
+    _state.cpu_checkpointing = pick(checkpoint_in_cpu, lambda c: c.cpu_checkpointing, False)
+    _state.synchronize = pick(synchronize, lambda c: c.synchronize_checkpoint_boundary, False)
+    _state.profile = pick(profile, lambda c: c.profile, False)
+    _state.num_checkpoints = pick(num_checkpoints, lambda c: c.number_checkpoints, None)
+    policy_name = pick(remat_policy, lambda c: c.remat_policy, "nothing_saveable")
+    _state.policy = offload_policy() if _state.cpu_checkpointing else resolve_policy(policy_name)
+    _state.configured = True
+    logger.info(f"activation checkpointing configured: policy={policy_name} "
+                f"partition_activations={_state.partition_activations} cpu={_state.cpu_checkpointing}")
+
+
+def is_configured():
+    return _state.configured
+
+
+def reset():
+    """Reference ``reset()``: drop buffers between iterations. Stateless here
+    (XLA owns the buffers); clears config back to defaults."""
+    _state.__dict__.clear()
+    _state.configured = False
+    _state.policy = None
+    _state.partition_activations = False
+    _state.cpu_checkpointing = False
+
+
+def _activation_spec(ndim: int) -> PartitionSpec:
+    """Sharding for saved activations [batch, seq, ...]: batch over data is
+    already carried by the input sharding; partition_activations additionally
+    spreads the seq dim over (seq, model) so each TP rank stores 1/mp of every
+    residual — the exact memory effect of reference ``partition_activations:373``."""
+    if ndim >= 2:
+        return PartitionSpec(None, (SEQ_AXIS, MODEL_AXIS))
+    return PartitionSpec()
+
+
+def partition_activations_wrapper(fn: Callable) -> Callable:
+    """Wrap ``fn`` so its activation inputs (the tensors that become saved
+    residuals of the remat block) carry the partitioned-activation sharding
+    constraint. Only rank>=3 [batch, seq, ...] arrays are constrained —
+    parameter matrices (rank 2) keep their ZeRO/TP shardings untouched, like
+    the reference which partitions only the saved activations (:373)."""
+
+    def wrapped(*args, **kwargs):
+        def constrain(x):
+            if hasattr(x, "ndim") and x.ndim >= 3:
+                try:
+                    return jax.lax.with_sharding_constraint(x, _activation_spec(x.ndim))
+                except Exception:
+                    return x
+            return x
+
+        args = jax.tree_util.tree_map(constrain, args)
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def checkpoint(function: Callable, *args, policy=None, prevent_cse: bool = True, static_argnums=()):
+    """Checkpoint (remat) ``function`` applied to ``*args`` — drop-in for the
+    reference ``checkpoint()`` (``checkpointing.py:484`` CheckpointFunction).
+
+    With no args, returns the remat-wrapped function instead (decorator use).
+    """
+    if not _state.configured:
+        configure()
+    pol = resolve_policy(policy) if policy is not None else _state.policy
+    fn = function
+    if _state.partition_activations:
+        fn = partition_activations_wrapper(fn)
+    wrapped = jax.checkpoint(fn, policy=pol, prevent_cse=prevent_cse, static_argnums=static_argnums)
+    if not args:
+        return wrapped
+    return wrapped(*args)
+
+
+def non_reentrant_checkpoint(function: Callable, *args, **kwargs):
+    """Reference ``non_reentrant_checkpoint:727`` — identical to ``checkpoint``
+    here: jax.checkpoint re-derives the backward from the pure function, which
+    is exactly the non-reentrant (no redundant autograd graph) behavior."""
+    return checkpoint(function, *args, **kwargs)
+
+
+# alias matching the reference's exported class name
+CheckpointFunction = checkpoint
+
+
+def checkpoint_name(name: str, x):
+    """Tag an intermediate for name-based policies (offload / save lists)."""
+    from jax.ad_checkpoint import checkpoint_name as _cn
+
+    return _cn(x, name)
+
+
+# ---------------------------------------------------------------------------
+# RNG tracker (reference CudaRNGStatesTracker:122 — API parity for
+# Megatron-style model code; JAX keys are explicit so fork() just derives)
+# ---------------------------------------------------------------------------
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+def model_parallel_rng_tracker_name():
+    return _MODEL_PARALLEL_RNG_TRACKER_NAME
+
+
+class RNGStatesTracker:
+    """Named PRNG key registry with a fork() context manager.
+
+    The reference must save/restore device RNG *mutable state* around every
+    checkpointed region so dropout replays identically in recompute. JAX PRNG
+    keys are pure values threaded through the computation, so replay is
+    automatic; this tracker exists to give Megatron-style code (which calls
+    ``get_cuda_rng_tracker().fork()``) a home for named key streams.
+    """
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_.clear()
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise Exception(f"cuda rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def split(self, name=_MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Derive a fresh subkey from the named stream (advances the stream)."""
+        if name not in self.states_:
+            raise Exception(f"cuda rng state {name} is not added")
+        self.states_[name], sub = jax.random.split(self.states_[name])
+        return sub
+
+    @contextlib.contextmanager
+    def fork(self, name=_MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Context manager yielding a subkey for the region (reference forks
+        device RNG state; here the caller uses the yielded key explicitly)."""
+        yield self.split(name)
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker():
+    return _RNG_TRACKER
+
+
+# reference exports this under the CUDA name; keep an alias for drop-in code
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_reconfigure_tp_seed(seed):
+    """Reference ``model_parallel_cuda_manual_seed``: give each TP rank a
+    distinct dropout stream. With explicit keys we fold in the model-axis
+    index lazily at use; here we just (re)seed the named stream."""
+    tracker = get_rng_tracker()
+    tracker.states_.pop(_MODEL_PARALLEL_RNG_TRACKER_NAME, None)
+    tracker.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, seed)

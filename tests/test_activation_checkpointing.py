@@ -1,0 +1,125 @@
+"""Activation checkpointing (remat) tests — mirrors
+tests/unit/runtime/activation_checkpointing/ in the reference: checkpointed
+forward/backward must match the un-checkpointed values and grads exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ckpt
+
+
+@pytest.fixture(autouse=True)
+def _reset_ckpt():
+    ckpt.reset()
+    yield
+    ckpt.reset()
+
+
+def _mlp(params, x):
+    h = jnp.tanh(x @ params["w1"])
+    return jnp.sum((h @ params["w2"])**2)
+
+
+def test_partition_wrapper_leaves_params_alone():
+    """Rank-2 weights must NOT get the activation constraint (they carry
+    ZeRO/TP shardings); only rank>=3 activations are constrained."""
+    seen = {}
+
+    def fn(w, x):
+        seen["w"], seen["x"] = w, x
+        return jnp.sum(w) + jnp.sum(x)
+
+    wrapped = ckpt.partition_activations_wrapper(fn)
+    w = jnp.ones((4, 4))
+    x = jnp.ones((2, 3, 4))
+    wrapped(w, x)  # outside jit: constraint is a no-op but shapes flow through
+    assert seen["w"].shape == (4, 4) and seen["x"].shape == (2, 3, 4)
+
+
+def _params(key, d=16):
+    k1, k2 = jax.random.split(key)
+    return {"w1": jax.random.normal(k1, (d, 4 * d)), "w2": jax.random.normal(k2, (4 * d, d))}
+
+
+def test_checkpoint_matches_baseline():
+    ckpt.configure(remat_policy="nothing_saveable")
+    params = _params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+
+    base_val, base_grad = jax.value_and_grad(_mlp)(params, x)
+    ck_val, ck_grad = jax.value_and_grad(lambda p, x: ckpt.checkpoint(_mlp, p, x))(params, x)
+
+    assert np.allclose(base_val, ck_val)
+    for k in params:
+        assert np.allclose(base_grad[k], ck_grad[k], rtol=1e-5, atol=1e-5)
+
+
+def test_policy_names_resolve():
+    for name in ("nothing_saveable", "dots_saveable", "everything_saveable",
+                 "dots_with_no_batch_dims_saveable", "checkpoint_dots"):
+        assert ckpt.resolve_policy(name) is not None
+    with pytest.raises(ValueError):
+        ckpt.resolve_policy("bogus_policy")
+
+
+def test_configure_from_ds_config():
+    config = {
+        "train_batch_size": 8,
+        "activation_checkpointing": {
+            "partition_activations": True,
+            "remat_policy": "dots_saveable",
+            "profile": True,
+        },
+    }
+    ckpt.configure(deepspeed_config=config)
+    assert ckpt.is_configured()
+    assert ckpt._state.partition_activations
+    assert ckpt._state.profile
+
+
+def test_decorator_form_and_dropout_replay():
+    """Dropout inside a remat block must replay identically (the reference
+    needs the RNG tracker for this; JAX keys make it automatic)."""
+    ckpt.configure()
+
+    def block(x, key):
+        mask = jax.random.bernoulli(key, 0.5, x.shape)
+        return jnp.sum(jnp.where(mask, x, 0.0)**2)
+
+    remat_block = ckpt.checkpoint(block)
+    x = jax.random.normal(jax.random.PRNGKey(2), (32, 32))
+    key = jax.random.PRNGKey(3)
+    v1, g1 = jax.value_and_grad(block)(x, key)
+    v2, g2 = jax.value_and_grad(remat_block)(x, key)
+    assert np.allclose(v1, v2)
+    assert np.allclose(g1, g2)
+
+
+def test_rng_tracker():
+    tr = ckpt.get_rng_tracker()
+    tr.reset()
+    tr.add(ckpt.model_parallel_rng_tracker_name(), 1234)
+    with tr.fork() as k1:
+        pass
+    with tr.fork() as k2:
+        pass
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))  # stream advances
+    with pytest.raises(Exception):
+        tr.add(ckpt.model_parallel_rng_tracker_name(), 99)  # dup name rejected
+
+
+def test_partition_activations_inside_mesh():
+    """partition_activations path must compile and match numerics under a mesh."""
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.parallel.mesh import MeshConfig
+
+    mesh = groups.initialize_mesh(MeshConfig(data=2, model=2, seq=2))
+    ckpt.configure(partition_activations=True)
+    params = _params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 2, 16))
+    with mesh:
+        base = jax.value_and_grad(_mlp)(params, x)
+        ck = jax.jit(jax.value_and_grad(lambda p, x: ckpt.checkpoint(_mlp, p, x)))(params, x)
+    assert np.allclose(base[0], ck[0], rtol=1e-5)
