@@ -41,7 +41,7 @@ def _builder(name, module_path, symbol=None):
 op_registry = {
     "FusedAdamBuilder": _builder("fused_adam", "deepspeed_tpu.ops.adam.fused_adam"),
     "FusedLambBuilder": _builder("fused_lamb", "deepspeed_tpu.runtime.optimizers"),
-    "CPUAdamBuilder": _builder("cpu_adam", "deepspeed_tpu.ops.adam.fused_adam"),
+    "CPUAdamBuilder": _builder("cpu_adam", "deepspeed_tpu.ops.adam.cpu_adam", "DeepSpeedCPUAdam"),
     "QuantizerBuilder": _builder("quantizer", "deepspeed_tpu.ops.pallas.quant"),
     "FlashAttnBuilder": _builder("flash_attn", "deepspeed_tpu.ops.pallas.flash_attention"),
     "RaggedOpsBuilder": _builder("ragged_ops", "deepspeed_tpu.ops.pallas.paged_attention"),

@@ -1,0 +1,90 @@
+"""DeepSpeedCPUAdam — host-side fused Adam over fp32 masters.
+
+Python surface of ``ops/csrc/adam/cpu_adam.cpp`` (reference
+``deepspeed/ops/adam/cpu_adam.py`` → CPUAdamBuilder → csrc/adam/cpu_adam.cpp):
+the ZeRO-Offload optimizer. State (exp_avg / exp_avg_sq) lives in host numpy;
+``step`` runs the fused multithreaded C++ kernel per tensor and can emit bf16
+copies for device upload in the same pass (reference
+``ds_adam_step_plus_copy``).
+"""
+
+import ctypes
+import itertools
+
+import numpy as np
+
+from ..native import build_op
+
+_ids = itertools.count()
+
+
+def _lib():
+    lib = build_op("deepspeed_cpu_adam", ["adam/cpu_adam.cpp"])
+    if not getattr(lib, "_ds_typed", False):
+        lib.ds_adam_create.restype = ctypes.c_int
+        lib.ds_adam_create.argtypes = [ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                                       ctypes.c_float, ctypes.c_float, ctypes.c_int]
+        lib.ds_adam_destroy.restype = ctypes.c_int
+        lib.ds_adam_destroy.argtypes = [ctypes.c_int]
+        lib.ds_adam_step.restype = ctypes.c_int
+        lib.ds_adam_step.argtypes = [ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
+                                     ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                                     ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                                     ctypes.c_float, ctypes.c_float, ctypes.c_void_p, ctypes.c_int]
+        lib.ds_fp32_to_bf16.restype = None
+        lib.ds_fp32_to_bf16.argtypes = [ctypes.POINTER(ctypes.c_float), ctypes.c_void_p, ctypes.c_longlong]
+        lib._ds_typed = True
+    return lib
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """Fused host Adam/AdamW (reference ``DeepSpeedCPUAdam``).
+
+    Usage: construct once, then per tensor call
+    ``step(step_no, params, grads, exp_avg, exp_avg_sq, lr=, bf16_out=)``.
+    All arrays must be C-contiguous float32 of equal size; updates happen
+    in place (params and moments are mutated).
+    """
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, adamw_mode=True, n_threads=0):
+        self._lib = _lib()
+        self.opt_id = next(_ids)
+        self.defaults = dict(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay, adamw_mode=adamw_mode)
+        self.n_threads = n_threads
+        rc = self._lib.ds_adam_create(self.opt_id, float(lr), float(betas[0]), float(betas[1]), float(eps),
+                                      float(weight_decay), int(bool(adamw_mode)))
+        assert rc == 0
+
+    def step(self, step_no, params, grads, exp_avg, exp_avg_sq, lr=None, grad_scale=1.0, bf16_out=None):
+        for a in (params, grads, exp_avg, exp_avg_sq):
+            assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"], "fp32 contiguous arrays required"
+            assert a.size == params.size
+        out_ptr = None
+        if bf16_out is not None:
+            assert bf16_out.dtype == np.uint16 and bf16_out.size == params.size
+            out_ptr = bf16_out.ctypes.data_as(ctypes.c_void_p)
+        rc = self._lib.ds_adam_step(self.opt_id, int(step_no), params.size, _f32p(params), _f32p(grads),
+                                    _f32p(exp_avg), _f32p(exp_avg_sq),
+                                    float(lr) if lr is not None else -1.0, float(grad_scale), out_ptr,
+                                    int(self.n_threads))
+        if rc != 0:
+            raise RuntimeError(f"ds_adam_step failed rc={rc}")
+
+    def fp32_to_bf16(self, src: np.ndarray, dst: np.ndarray):
+        assert src.dtype == np.float32 and dst.dtype == np.uint16 and src.size == dst.size
+        self._lib.ds_fp32_to_bf16(_f32p(src), dst.ctypes.data_as(ctypes.c_void_p), src.size)
+
+    def destroy(self):
+        if getattr(self, "opt_id", None) is not None:
+            self._lib.ds_adam_destroy(self.opt_id)
+            self.opt_id = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass

@@ -1,0 +1,106 @@
+"""Async file I/O for ZeRO-Infinity NVMe tiering.
+
+Python surface of the native library in ``ops/csrc/aio/deepspeed_aio.cpp``
+(reference: ``csrc/aio/py_lib`` AsyncIOBuilder → aio_handle with
+``async_pread/async_pwrite/wait``). Buffers are numpy arrays pinned by the
+OS page cache; alignment for O_DIRECT is handled by the C++ side's fallback.
+"""
+
+import ctypes
+
+import numpy as np
+
+from ..native import build_op
+
+_FUNCS = {
+    "ds_aio_create": (ctypes.c_void_p, [ctypes.c_int, ctypes.c_longlong, ctypes.c_int]),
+    "ds_aio_submit_read": (ctypes.c_int, [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                                          ctypes.c_longlong, ctypes.c_longlong]),
+    "ds_aio_submit_write": (ctypes.c_int, [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                                           ctypes.c_longlong, ctypes.c_longlong]),
+    "ds_aio_wait": (ctypes.c_int, [ctypes.c_void_p]),
+    "ds_aio_pending": (ctypes.c_int, [ctypes.c_void_p]),
+    "ds_aio_destroy": (None, [ctypes.c_void_p]),
+    "ds_aio_sync_pread": (ctypes.c_int, [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong]),
+    "ds_aio_sync_pwrite": (ctypes.c_int, [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong]),
+}
+
+
+def _lib():
+    lib = build_op("deepspeed_aio", ["aio/deepspeed_aio.cpp"])
+    if not getattr(lib, "_ds_typed", False):
+        for fname, (restype, argtypes) in _FUNCS.items():
+            f = getattr(lib, fname)
+            f.restype = restype
+            f.argtypes = argtypes
+        lib._ds_typed = True
+    return lib
+
+
+def _buf_ptr(arr: np.ndarray):
+    assert arr.flags["C_CONTIGUOUS"], "aio buffers must be C-contiguous"
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+class AsyncIOHandle:
+    """Reference ``aio_handle``: submit reads/writes, then ``wait()``.
+
+    The caller owns buffer lifetime: every submitted numpy buffer must stay
+    alive until the next ``wait()`` returns (same contract as the reference's
+    pinned tensors).
+    """
+
+    def __init__(self, block_size=1 << 20, queue_depth=8, thread_count=None, single_submit=False,
+                 overlap_events=True, use_o_direct=False):
+        self._lib = _lib()
+        n_threads = thread_count or queue_depth
+        self._h = self._lib.ds_aio_create(int(n_threads), int(block_size), int(bool(use_o_direct)))
+        if not self._h:
+            raise RuntimeError("failed to create aio handle")
+        self._inflight_bufs = []
+
+    def async_pread(self, buffer: np.ndarray, filename: str, file_offset: int = 0):
+        rc = self._lib.ds_aio_submit_read(self._h, filename.encode(), _buf_ptr(buffer), buffer.nbytes,
+                                          int(file_offset))
+        if rc != 0:
+            raise OSError(-rc, f"aio read submit failed for {filename}")
+        self._inflight_bufs.append(buffer)
+
+    def async_pwrite(self, buffer: np.ndarray, filename: str, file_offset: int = 0):
+        rc = self._lib.ds_aio_submit_write(self._h, filename.encode(), _buf_ptr(buffer), buffer.nbytes,
+                                           int(file_offset))
+        if rc != 0:
+            raise OSError(-rc, f"aio write submit failed for {filename}")
+        self._inflight_bufs.append(buffer)
+
+    def wait(self):
+        rc = self._lib.ds_aio_wait(self._h)
+        self._inflight_bufs.clear()
+        if rc != 0:
+            raise OSError(-rc, "aio request failed")
+        return 0
+
+    def pending(self):
+        return int(self._lib.ds_aio_pending(self._h))
+
+    # synchronous convenience (reference sync_pread/sync_pwrite)
+    def sync_pread(self, buffer: np.ndarray, filename: str, file_offset: int = 0):
+        rc = self._lib.ds_aio_sync_pread(filename.encode(), _buf_ptr(buffer), buffer.nbytes, int(file_offset))
+        if rc != 0:
+            raise OSError(-rc, f"pread failed for {filename}")
+
+    def sync_pwrite(self, buffer: np.ndarray, filename: str, file_offset: int = 0):
+        rc = self._lib.ds_aio_sync_pwrite(filename.encode(), _buf_ptr(buffer), buffer.nbytes, int(file_offset))
+        if rc != 0:
+            raise OSError(-rc, f"pwrite failed for {filename}")
+
+    def close(self):
+        if self._h:
+            self._lib.ds_aio_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

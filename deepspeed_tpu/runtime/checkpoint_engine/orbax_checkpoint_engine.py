@@ -59,8 +59,16 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         arrays_path = os.path.join(path, "arrays")
         if os.path.exists(arrays_path):
             if template is not None:
+                # partial restore: the template may cover a subset of the
+                # on-disk tree (e.g. load_optimizer_states=False skips the
+                # host optimizer subtree)
                 arr_template, _ = _split_state(template)
-                arrays = self._ckptr.restore(arrays_path, arr_template)
+                restore_args = self._ocp.checkpoint_utils.construct_restore_args(arr_template)
+                with self._ocp.Checkpointer(self._ocp.PyTreeCheckpointHandler()) as ckptr:
+                    arrays = ckptr.restore(
+                        arrays_path,
+                        args=self._ocp.args.PyTreeRestore(item=arr_template, restore_args=restore_args,
+                                                          partial_restore=True))
             else:
                 arrays = self._ckptr.restore(arrays_path)
         return _merge_state(arrays, meta)

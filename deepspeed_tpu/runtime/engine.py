@@ -131,6 +131,11 @@ class DeepSpeedEngine:
 
         # --- optimizer chain ---
         self.lr_schedule_fn, self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+        # ZeRO-Offload / Infinity: optimizer states leave HBM for host RAM /
+        # NVMe; the update runs in the fused C++ host kernel (zero/offload.py)
+        offload_cfg = config.zero_config.offload_optimizer
+        self._offload_enabled = (offload_cfg is not None
+                                 and str(config.zero_config.offload_optimizer_device) != "none")
         self.optimizer = self._configure_optimizer(optimizer)
 
         # --- state init, sharded at construction (zero.Init equivalent:
@@ -138,6 +143,11 @@ class DeepSpeedEngine:
         #     partition_parameters.py:762) ---
         self._rng = jax.random.PRNGKey(seed)
         self.state = self._init_state(example_batch)
+
+        # --- host offload optimizer (after state init: needs the params) ---
+        self.host_optimizer = None
+        if self._offload_enabled:
+            self.host_optimizer = self._configure_host_offload_optimizer(offload_cfg)
 
         # --- data pipeline ---
         if training_data is not None:
@@ -194,6 +204,31 @@ class DeepSpeedEngine:
         chain.append(tx)
         return optax.chain(*chain) if len(chain) > 1 else tx
 
+    def _configure_host_offload_optimizer(self, offload_cfg):
+        """Build the ZeRO-Offload host optimizer (reference: cpu_offload forces
+        DeepSpeedCPUAdam, ``engine.py:1275``+``stage_1_and_2.py`` cpu path)."""
+        from .zero.offload import HostOffloadOptimizer
+        from .constants import ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER
+
+        params = dict(self.config.optimizer_params or {})
+        name = (self.config.optimizer_name or ADAMW_OPTIMIZER).lower()
+        if name not in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER):
+            logger.warning(f"offload_optimizer: '{name}' not supported on host; using fused CPU AdamW")
+        adamw = name == ADAMW_OPTIMIZER or params.get("adam_w_mode", True)
+        nvme = offload_cfg.nvme_path if str(offload_cfg.device) == "nvme" else None
+        if str(offload_cfg.device) == "nvme":
+            assert nvme, "offload_optimizer.device=nvme requires nvme_path"
+        return HostOffloadOptimizer(self.state["params"],
+                                    lr=params.get("lr", 1e-3),
+                                    betas=tuple(params.get("betas", (0.9, 0.999))),
+                                    eps=params.get("eps", 1e-8),
+                                    weight_decay=params.get("weight_decay", 0.0),
+                                    adamw_mode=adamw,
+                                    nvme_path=nvme,
+                                    pipeline_read=offload_cfg.pipeline_read,
+                                    pipeline_write=offload_cfg.pipeline_write,
+                                    grad_clip=self.config.gradient_clipping or 0.0)
+
     # ------------------------------------------------------------------
     # state init
     # ------------------------------------------------------------------
@@ -201,8 +236,14 @@ class DeepSpeedEngine:
         init_rng, self._rng = jax.random.split(self._rng)
         param_shapes = jax.eval_shape(lambda r: self.module.init(r, example_batch), init_rng)
         param_shardings = self.zero_policy.param_shardings(param_shapes)
-        opt_shapes = jax.eval_shape(self.optimizer.init, param_shapes)
-        opt_shardings = self.zero_policy.opt_state_shardings(opt_shapes, param_shapes)
+        if self._offload_enabled:
+            # ZeRO-Offload: moments live on host/NVMe — nothing in HBM
+            opt_init = lambda params: {}
+            opt_shardings = {}
+        else:
+            opt_init = self.optimizer.init
+            opt_shapes = jax.eval_shape(self.optimizer.init, param_shapes)
+            opt_shardings = self.zero_policy.opt_state_shardings(opt_shapes, param_shapes)
         scalar = NamedSharding(self.mesh, P())
 
         state_shardings = {
@@ -219,7 +260,7 @@ class DeepSpeedEngine:
             params = self.module.init(rng, example_batch)
             return {
                 "params": params,
-                "opt_state": self.optimizer.init(params),
+                "opt_state": opt_init(params),
                 "step": jnp.zeros([], jnp.int32),
                 "loss_scale": jnp.asarray(
                     float(self.config.loss_scale) if (self.fp16_enabled and self.config.loss_scale) else
@@ -297,32 +338,99 @@ class DeepSpeedEngine:
             "good_steps": good,
         }, finite
 
+    def _scan_microbatch_grads(self, params, batches, rng, loss_scale, gas: int):
+        """Shared accumulation core (traced): scan ``gas`` microbatches,
+        return (mean grads fp32 sharded, per-microbatch losses)."""
+        grad_specs = self.zero_policy.grad_specs(params)
+
+        def micro(carry, mb):
+            acc, rng = carry
+            rng, sub = jax.random.split(rng)
+            grads, loss = self._microbatch_grads(params, mb, sub, loss_scale)
+            acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            acc = constrain(acc, grad_specs, self.mesh)
+            return (acc, rng), loss
+
+        zeros = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        zeros = constrain(zeros, grad_specs, self.mesh)
+        if gas == 1:
+            one = jax.tree_util.tree_map(lambda x: x[0], batches)
+            (acc, _), losses = micro((zeros, rng), one)
+            losses = losses[None]
+        else:
+            (acc, _), losses = jax.lax.scan(micro, (zeros, rng), batches)
+        acc = jax.tree_util.tree_map(lambda g: g / gas, acc)
+        return acc, losses
+
+    def _accumulate_grads_fn(self, gas: int):
+        """Compiled grads-only program for the host-offload path."""
+
+        def grads_fn(params, batches, rng, loss_scale):
+            acc, losses = self._scan_microbatch_grads(params, batches, rng, loss_scale, gas)
+            return acc, jnp.mean(losses)
+
+        return jax.jit(grads_fn)
+
+    def _host_apply_update(self, grads):
+        """Shared host-offload tail: fused C++ Adam on the masters, then
+        upload of the new params into their shardings. Returns
+        (grad_norm, overflow, lr)."""
+        step_no = int(self.state["step"]) + 1
+        lr = (float(self.lr_schedule_fn(step_no - 1)) if self.lr_schedule_fn is not None else
+              (self.config.optimizer_params or {}).get("lr", 1e-3))
+        scale = float(self.state["loss_scale"])
+        new_params, grad_norm, overflow = self.host_optimizer.step(step_no, grads, lr=lr, loss_scale=scale)
+        if not overflow:
+            dtypes = jax.tree_util.tree_map(lambda p: p.dtype, self.state["params"])
+            cast = jax.tree_util.tree_map(lambda a, dt: np.asarray(a, dtype=dt), new_params, dtypes)
+            self.state["params"] = jax.device_put(cast, self._state_shardings["params"])
+            self.state["step"] = self.state["step"] + 1
+        else:
+            self.skipped_steps += 1
+        self._advance_loss_scale_host(overflow)
+        return grad_norm, overflow, lr
+
+    def _offload_train_batch(self, batch, step_rng):
+        """ZeRO-Offload step: compiled fwd+bwd on device, host Adam update."""
+        gas = self.config.gradient_accumulation_steps
+        if "offload_grads" not in self._compiled:
+            self._compiled["offload_grads"] = self._accumulate_grads_fn(gas)
+        with self.mesh:
+            batch = self._shard_batch(batch, leading=("mb", ))
+            grads, loss = self._compiled["offload_grads"](self.state["params"], batch, step_rng,
+                                                          self.state["loss_scale"])
+        grad_norm, overflow, lr = self._host_apply_update(grads)
+        return {
+            "loss": loss,
+            "grad_norm": jnp.asarray(grad_norm),
+            "overflow": jnp.asarray(overflow),
+            "lr": jnp.asarray(lr),
+        }
+
+    def _advance_loss_scale_host(self, overflow: bool):
+        """Host mirror of the dynamic loss-scale state machine."""
+        if not (self.fp16_enabled and self.dynamic_loss_scale):
+            return
+        args = self.config.dynamic_loss_scale_args
+        window, min_scale = args["scale_window"], args["min_scale"]
+        good = int(self.state["good_steps"])
+        scale = float(self.state["loss_scale"])
+        if overflow:
+            scale, good = max(scale * 0.5, min_scale), 0
+        else:
+            good += 1
+            if good >= window:
+                scale, good = scale * 2.0, 0
+        self.state["loss_scale"] = jnp.asarray(scale, jnp.float32)
+        self.state["good_steps"] = jnp.asarray(good, jnp.int32)
+
     def _build_train_step(self, gas: int):
         """Fused train step: scan over ``gas`` microbatches then update."""
         if self.pipe_world_size > 1:
             return self._build_pipeline_train_step()
 
         def train_step(state, batches, rng):
-            params = state["params"]
-            grad_specs = self.zero_policy.grad_specs(params)
-
-            def micro(carry, mb):
-                acc, rng = carry
-                rng, sub = jax.random.split(rng)
-                grads, loss = self._microbatch_grads(params, mb, sub, state["loss_scale"])
-                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                acc = constrain(acc, grad_specs, self.mesh)
-                return (acc, rng), loss
-
-            zeros = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            zeros = constrain(zeros, grad_specs, self.mesh)
-            if gas == 1:
-                one = jax.tree_util.tree_map(lambda x: x[0], batches)
-                (acc, _), losses = micro((zeros, rng), one)
-                losses = losses[None]
-            else:
-                (acc, _), losses = jax.lax.scan(micro, (zeros, rng), batches)
-            acc = jax.tree_util.tree_map(lambda g: g / gas, acc)
+            acc, losses = self._scan_microbatch_grads(state["params"], batches, rng, state["loss_scale"], gas)
             return self._finalize_step(state, acc, jnp.mean(losses))
 
         return self._jit_step(train_step)
@@ -381,19 +489,22 @@ class DeepSpeedEngine:
         else:
             batch = jax.tree_util.tree_map(lambda x: np.asarray(x).reshape(gas, -1, *np.shape(x)[1:]), batch)
 
-        if "train_step" not in self._compiled:
-            self._compiled["train_step"] = self._build_train_step(gas)
         step_rng, self._rng = jax.random.split(self._rng)
         self.tput_timer.start()
-        with self.mesh:
-            batch = self._shard_batch(batch, leading=("mb", ))
-            self.state, metrics = self._compiled["train_step"](self.state, batch, step_rng)
+        if self.host_optimizer is not None:
+            metrics = self._offload_train_batch(batch, step_rng)
+        else:
+            if "train_step" not in self._compiled:
+                self._compiled["train_step"] = self._build_train_step(gas)
+            with self.mesh:
+                batch = self._shard_batch(batch, leading=("mb", ))
+                self.state, metrics = self._compiled["train_step"](self.state, batch, step_rng)
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
         self.tput_timer.stop(global_step=True)
-        if self.fp16_enabled and bool(metrics["overflow"]):
-            self.skipped_steps += 1
+        if self.host_optimizer is None and self.fp16_enabled and bool(metrics["overflow"]):
+            self.skipped_steps += 1  # offload path counts inside _host_apply_update
         self._record_metrics(metrics)
         return metrics["loss"]
 
@@ -477,6 +588,15 @@ class DeepSpeedEngine:
         if self.micro_steps % gas != 0:
             return  # mid-accumulation micro-step, nothing to do
         assert self._grad_acc_buffer is not None, "step() called with no accumulated gradients"
+        if self.host_optimizer is not None:
+            grads = jax.tree_util.tree_map(lambda g: g / gas, self._grad_acc_buffer)
+            self._host_apply_update(grads)
+            self._grad_acc_buffer = None
+            self.global_steps += 1
+            self.global_samples += self.train_batch_size()
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            return
         if "apply" not in self._compiled:
 
             def apply_fn(state, grads):
@@ -599,6 +719,8 @@ class DeepSpeedEngine:
             "global_samples": self.global_samples,
             "skipped_steps": self.skipped_steps,
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
+            "host_optimizer": (_escape_keys(self.host_optimizer.state_dict())
+                               if self.host_optimizer is not None else None),
             "ds_config": self.config.param_dict,
             "ds_version": "0.1.0-tpu",
             **(client_state or {}),
@@ -653,6 +775,9 @@ class DeepSpeedEngine:
             "scalars": {k: _as_shape_struct(self.state[k], _shard_of(self.state[k]))
                         for k in ("step", "loss_scale", "good_steps")},
         }
+        if self.host_optimizer is not None and load_optimizer_states:
+            # state_template: shapes only — no NVMe reads just for a template
+            template["host_optimizer"] = _escape_keys(self.host_optimizer.state_template())
         loaded = self.checkpoint_engine.load(path, template=template)
         params = loaded["module"]
         state = dict(self.state)
@@ -669,9 +794,16 @@ class DeepSpeedEngine:
         self.skipped_steps = int(loaded.get("skipped_steps", 0))
         if load_lr_scheduler_states and self.lr_scheduler is not None and loaded.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(loaded["lr_scheduler"])
+        if self.host_optimizer is not None:
+            if load_optimizer_states and loaded.get("host_optimizer"):
+                self.host_optimizer.load_state_dict(_unescape_keys(loaded["host_optimizer"]))
+            else:
+                # masters must follow the loaded weights, else the next host
+                # step would resurrect the pre-load params
+                self.host_optimizer.reset_masters(self.state["params"])
         client_state = {k: v for k, v in loaded.items()
                         if k not in ("module", "optimizer", "scalars", "global_steps", "global_samples",
-                                     "skipped_steps", "lr_scheduler", "ds_config", "ds_version")}
+                                     "skipped_steps", "lr_scheduler", "host_optimizer", "ds_config", "ds_version")}
         log_dist(f"loaded checkpoint {path}", ranks=[0])
         return path, client_state
 
@@ -699,6 +831,19 @@ class DeepSpeedEngine:
     def train(self, mode=True):
         self._train_mode = bool(mode)
         return self
+
+
+def _escape_keys(tree):
+    """Param-path keys contain '/' which checkpoint layouts reserve."""
+    if isinstance(tree, dict):
+        return {k.replace("/", "::"): _escape_keys(v) for k, v in tree.items()}
+    return tree
+
+
+def _unescape_keys(tree):
+    if isinstance(tree, dict):
+        return {k.replace("::", "/"): _unescape_keys(v) for k, v in tree.items()}
+    return tree
 
 
 def _as_shape_struct(x, sharding=None):

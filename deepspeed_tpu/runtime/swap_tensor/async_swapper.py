@@ -1,0 +1,49 @@
+"""Generic async tensor swapping (reference ``runtime/swap_tensor/async_swapper.py``
+``AsyncTensorSwapper``): fire-and-forget swap-out of host tensors to files with
+a bounded in-flight window, so compute overlaps the NVMe writes.
+"""
+
+import os
+from collections import deque
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle
+from ...utils.logging import logger
+
+
+class AsyncTensorSwapper:
+    """Swap numpy tensors out to files asynchronously.
+
+    ``add_buffers([(array, path), ...])`` submits writes; buffers are kept
+    alive until their write completes. ``max_inflight`` bounds host-RAM held
+    by pending writes (the reference bounds by buffer count the same way).
+    """
+
+    def __init__(self, aio_handle: AsyncIOHandle = None, max_inflight: int = 8, timers=None):
+        self.handle = aio_handle or AsyncIOHandle()
+        self._own_handle = aio_handle is None
+        self.max_inflight = max_inflight
+        self._inflight = deque()
+        self.swap_bytes = 0
+
+    def swap_out_tensors(self, tensor_path_pairs):
+        for arr, path in tensor_path_pairs:
+            arr = np.ascontiguousarray(arr)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            if len(self._inflight) >= self.max_inflight:
+                self.synchronize()
+            self.handle.async_pwrite(arr, path)
+            self._inflight.append(arr)  # keep alive until wait()
+            self.swap_bytes += arr.nbytes
+
+    def synchronize(self):
+        """Wait for all pending writes (reference ``shutdown``/buffer flush)."""
+        if self._inflight:
+            self.handle.wait()
+            self._inflight.clear()
+
+    def shutdown(self):
+        self.synchronize()
+        if self._own_handle:
+            self.handle.close()

@@ -1,0 +1,118 @@
+"""Optimizer-state swapping for ZeRO-Infinity (reference
+``runtime/swap_tensor/optimizer_utils.py`` ``OptimizerSwapper`` +
+``partitioned_optimizer_swapper.py`` / ``pipelined_optimizer_swapper.py``).
+
+The moments of each parameter leaf live on NVMe; around the optimizer step
+the swapper streams them through host buffers with read/write overlap:
+while leaf *i* is being updated by the fused CPU Adam kernel, leaf *i+1*'s
+moments are already being read and leaf *i-1*'s are being written back
+(reference ``PipelinedOptimizerSwapper`` behavior — separate read and write
+aio queues).
+"""
+
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle
+from ...utils.logging import logger
+
+
+class OptimizerStateSwapper:
+    """NVMe-backed store of per-leaf optimizer state arrays.
+
+    State layout: one file per (leaf, state_name), fp32. The iteration
+    protocol used by the host offload optimizer:
+
+        swapper.prefetch(key)           # submit async reads
+        arrays = swapper.fetch(key)     # wait + collect
+        ... fused adam mutates arrays in place ...
+        swapper.writeback(key, arrays)  # submit async writes
+        swapper.flush()                 # end of step barrier
+    """
+
+    STATE_NAMES = ("exp_avg", "exp_avg_sq")
+
+    def __init__(self, base_dir: str, pipeline_read: bool = True, pipeline_write: bool = True,
+                 aio_threads: int = 2):
+        self.base_dir = os.path.join(base_dir, "optimizer_state")
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.read_handle = AsyncIOHandle(thread_count=aio_threads)
+        self.write_handle = AsyncIOHandle(thread_count=aio_threads)
+        self.pipeline_read = pipeline_read
+        self.pipeline_write = pipeline_write
+        self._meta: Dict[str, tuple] = {}  # key -> (shape, dtype)
+        self._read_bufs: Dict[str, Dict[str, np.ndarray]] = {}
+        self._write_keepalive: List[np.ndarray] = []
+
+    def _path(self, key: str, state_name: str) -> str:
+        safe = key.replace("/", "_").replace(".", "_")
+        return os.path.join(self.base_dir, f"{safe}.{state_name}")
+
+    def initialize(self, key: str, shape, dtype=np.float32):
+        """Create zero-initialized moments on NVMe for a leaf."""
+        self._meta[key] = (tuple(shape), np.dtype(dtype))
+        zeros = np.zeros(shape, dtype)
+        for name in self.STATE_NAMES:
+            self.write_handle.async_pwrite(zeros, self._path(key, name))
+        self._write_keepalive.append(zeros)
+
+    def has(self, key: str) -> bool:
+        return key in self._meta
+
+    def prefetch(self, key: str):
+        """Submit async reads of the leaf's moments into fresh host buffers.
+        With ``pipeline_read`` off this is a no-op and ``fetch`` reads
+        synchronously (reference gates prefetch behind PipelinedOptimizerSwapper
+        the same way)."""
+        if not self.pipeline_read:
+            return
+        shape, dtype = self._meta[key]
+        bufs = {name: np.empty(shape, dtype) for name in self.STATE_NAMES}
+        for name, buf in bufs.items():
+            self.read_handle.async_pread(buf, self._path(key, name))
+        self._read_bufs[key] = bufs
+
+    def fetch(self, key: str) -> Dict[str, np.ndarray]:
+        """Wait for the leaf's reads and return {state_name: array}."""
+        if key not in self._read_bufs:
+            shape, dtype = self._meta[key]
+            bufs = {name: np.empty(shape, dtype) for name in self.STATE_NAMES}
+            for name, buf in bufs.items():
+                self.read_handle.async_pread(buf, self._path(key, name))
+            self._read_bufs[key] = bufs
+        self.read_handle.wait()
+        return self._read_bufs.pop(key)
+
+    def writeback(self, key: str, arrays: Dict[str, np.ndarray], async_op: bool = True):
+        for name in self.STATE_NAMES:
+            arr = np.ascontiguousarray(arrays[name])
+            self.write_handle.async_pwrite(arr, self._path(key, name))
+            self._write_keepalive.append(arr)
+        if not (async_op and self.pipeline_write):
+            self.flush_writes()
+
+    def flush_writes(self):
+        if self._write_keepalive:
+            self.write_handle.wait()
+            self._write_keepalive.clear()
+
+    def flush(self):
+        self.flush_writes()
+
+    # -- bulk accessors for checkpointing ------------------------------
+    def state_dict(self) -> Dict[str, Dict[str, np.ndarray]]:
+        self.flush_writes()
+        out = {}
+        for key in self._meta:
+            self.prefetch(key)
+            out[key] = self.fetch(key)
+        return out
+
+    def load_state_dict(self, state: Dict[str, Dict[str, np.ndarray]]):
+        for key, arrays in state.items():
+            some = arrays[self.STATE_NAMES[0]]
+            self._meta[key] = (tuple(some.shape), some.dtype)
+            self.writeback(key, arrays, async_op=True)
+        self.flush_writes()
