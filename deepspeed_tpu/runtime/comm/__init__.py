@@ -1,0 +1,2 @@
+from .compressed import (pack_signs, unpack_signs, onebit_allreduce, reduce_scatter_coalesced,
+                         all_to_all_quant_reduce, onebit_chunk_len)

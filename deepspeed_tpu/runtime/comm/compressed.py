@@ -1,0 +1,109 @@
+"""Compressed gradient collectives.
+
+TPU-native analog of the reference compressed-communication backends
+(``runtime/comm/nccl.py:51`` ``NcclBackend.compressed_allreduce`` — the 1-bit
+Adam/LAMB error-feedback exchange — and ``runtime/comm/coalesced_collectives.py``
+``reduce_scatter_coalesced:73`` / ``all_to_all_quant_reduce:31`` used by
+ZeRO-3/ZeRO++). Everything here is traced code running inside
+``shard_map`` over a mesh axis; the payloads are bit-packed uint8 sign
+tensors + per-chunk fp32 scales, so the wire volume is ~n/4 bytes per
+allreduce vs 4n for fp32 — the same ~16-32x compression the reference gets
+from its CUDA pack kernels, but riding XLA collectives on ICI.
+
+Algorithm (reference 1-bit Adam, NcclBackend.compressed_allreduce):
+  worker:  c = g + err_w;  scale_w = mean|c| per destination chunk;
+           err_w' = c - scale_w*sign(c);  a2a(sign(c), scale_w)
+  server:  avg = mean_i scale_w_i * sign_i;  c_s = avg + err_s;
+           scale_s = mean|c_s|;  err_s' = c_s - scale_s*sign(c_s);
+           allgather(sign(c_s), scale_s)
+"""
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_BIT_WEIGHTS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def pack_signs(bits: jax.Array) -> jax.Array:
+    """Pack a {0,1} array whose last dim is a multiple of 8 into uint8
+    (8 signs per byte — the reference's CUDA sign-packing kernel)."""
+    *lead, n = bits.shape
+    assert n % 8 == 0, f"last dim {n} must be a multiple of 8"
+    grouped = bits.reshape(*lead, n // 8, 8).astype(jnp.uint8)
+    w = jnp.asarray(_BIT_WEIGHTS, jnp.uint8)
+    return (grouped * w).sum(axis=-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jax.Array) -> jax.Array:
+    """uint8 → ±1 fp32 array with last dim expanded 8x."""
+    *lead, nb = packed.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (packed[..., None] >> shifts) & jnp.uint8(1)
+    return (bits.reshape(*lead, nb * 8).astype(jnp.float32) * 2.0 - 1.0)
+
+
+def onebit_chunk_len(n: int, world: int) -> int:
+    """Per-device server chunk length: ceil(n/world) rounded up to 8."""
+    chunk = -(-n // world)
+    return -(-chunk // 8) * 8
+
+
+def onebit_allreduce(x: jax.Array, err_worker: jax.Array, err_server: jax.Array,
+                     axis_name: str, world: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Error-feedback 1-bit averaged allreduce of ``x`` over ``axis_name``.
+
+    Must run inside ``shard_map``. Shapes (all local):
+      x, err_worker: param shape;  err_server: (onebit_chunk_len(n, world),)
+    Returns (avg_approx with x's shape, err_worker', err_server').
+    """
+    shape = x.shape
+    n = math.prod(shape) if shape else 1
+    chunk = onebit_chunk_len(n, world)
+    total = chunk * world
+
+    flat = x.reshape(-1).astype(jnp.float32) + err_worker.reshape(-1).astype(jnp.float32)
+    flat = jnp.pad(flat, (0, total - n))
+    rows = flat.reshape(world, chunk)  # row j is destined for device j
+
+    scale_w = jnp.mean(jnp.abs(rows), axis=1)  # (world,)
+    bits_w = (rows >= 0).astype(jnp.uint8)
+    signs_w = bits_w.astype(jnp.float32) * 2.0 - 1.0
+    new_err_w = (rows - scale_w[:, None] * signs_w).reshape(-1)[:n].reshape(shape)
+
+    packed_w = pack_signs(bits_w)  # (world, chunk//8) uint8
+    recv_packed = lax.all_to_all(packed_w, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    recv_scale = lax.all_to_all(scale_w, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    recv_signs = unpack_signs(recv_packed)  # (world, chunk) ±1
+
+    server_avg = jnp.mean(recv_scale[:, None] * recv_signs, axis=0)  # (chunk,)
+    comp_s = server_avg + err_server.astype(jnp.float32)
+    scale_s = jnp.mean(jnp.abs(comp_s))  # scalar
+    bits_s = (comp_s >= 0).astype(jnp.uint8)
+    signs_s = bits_s.astype(jnp.float32) * 2.0 - 1.0
+    new_err_s = comp_s - scale_s * signs_s
+
+    packed_s = pack_signs(bits_s[None, :])[0]  # (chunk//8,)
+    all_packed = lax.all_gather(packed_s, axis_name, axis=0, tiled=False)  # (world, chunk//8)
+    all_scale = lax.all_gather(scale_s, axis_name, axis=0, tiled=False)  # (world,)
+    out_rows = all_scale[:, None] * unpack_signs(all_packed)  # (world, chunk)
+    out = out_rows.reshape(-1)[:n].reshape(shape)
+    return out, new_err_w.astype(err_worker.dtype), new_err_s.astype(err_server.dtype)
+
+
+def reduce_scatter_coalesced(tensors, axis_name: str):
+    """Reference ``reduce_scatter_coalesced:73`` — bucketed reduce-scatter of a
+    tensor list. In-jit: XLA already coalesces adjacent collectives, so this
+    is a per-tensor psum_scatter with the same call signature."""
+    return [lax.psum_scatter(t, axis_name, scatter_dimension=0, tiled=True) for t in tensors]
+
+
+def all_to_all_quant_reduce(tensors, axis_name: str, block_size: int = 256):
+    """Reference qgZ ``all_to_all_quant_reduce:31``: int8 block-quantized
+    2-hop gradient reduction (quantize → a2a → dequant-reduce)."""
+    from ...ops.pallas.quant import quantized_psum_scatter
+
+    return [quantized_psum_scatter(t, axis_name, block_size) for t in tensors]

@@ -138,6 +138,10 @@ class DeepSpeedEngine:
                                  and str(config.zero_config.offload_optimizer_device) != "none")
         self.optimizer = self._configure_optimizer(optimizer)
 
+        # 1-bit optimizers: compressed gradient exchange after freeze_step
+        # (reference runtime/fp16/onebit/* + comm/nccl.py compressed_allreduce)
+        self._onebit = self._configure_onebit()
+
         # --- state init, sharded at construction (zero.Init equivalent:
         #     params materialize directly into their shards, reference
         #     partition_parameters.py:762) ---
@@ -204,6 +208,26 @@ class DeepSpeedEngine:
         chain.append(tx)
         return optax.chain(*chain) if len(chain) > 1 else tx
 
+    def _configure_onebit(self):
+        from .constants import ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER
+
+        name = (self.config.optimizer_name or "").lower()
+        if name not in (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
+            return None
+        from .fp16.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
+
+        cls = {ONEBIT_ADAM_OPTIMIZER: OnebitAdam, ONEBIT_LAMB_OPTIMIZER: OnebitLamb,
+               ZERO_ONE_ADAM_OPTIMIZER: ZeroOneAdam}[name]
+        policy = cls.from_params(self.config.optimizer_params or {})
+        # same envelope as the reference: 1-bit composes with ZeRO<=1, pure DP
+        assert self.config.zero_optimization_stage <= 1, "1-bit optimizers require ZeRO stage <= 1"
+        assert self.mp_world_size == 1 and self.seq_world_size == 1 and self.pipe_world_size == 1, \
+            "1-bit optimizers support pure data parallelism only"
+        assert not self._offload_enabled, "1-bit optimizers are incompatible with offload_optimizer"
+        log_dist(f"1-bit optimizer '{name}': exact allreduce for {policy.freeze_step} warmup steps, "
+                 f"then error-feedback sign compression", ranks=[0])
+        return policy
+
     def _configure_host_offload_optimizer(self, offload_cfg):
         """Build the ZeRO-Offload host optimizer (reference: cpu_offload forces
         DeepSpeedCPUAdam, ``engine.py:1275``+``stage_1_and_2.py`` cpu path)."""
@@ -253,12 +277,22 @@ class DeepSpeedEngine:
             "loss_scale": scalar,
             "good_steps": scalar,
         }
+        if self._onebit is not None:
+            # per-worker error-feedback buffers, stacked over the data axis:
+            # leaf i of err_w is (dp, *param_shape); err_s is (dp, server_chunk)
+            from .comm.compressed import onebit_chunk_len
+
+            dp = self.mesh.shape[DATA_AXIS]
+            err_sharding = lambda: NamedSharding(self.mesh, P(DATA_AXIS))
+            state_shardings["onebit_err_w"] = jax.tree_util.tree_map(lambda _: err_sharding(), param_shapes)
+            state_shardings["onebit_err_s"] = jax.tree_util.tree_map(lambda _: err_sharding(), param_shapes)
+            self._onebit_dp = dp
         self._state_shardings = state_shardings
 
         @partial(jax.jit, out_shardings=state_shardings)
         def init_fn(rng):
             params = self.module.init(rng, example_batch)
-            return {
+            state = {
                 "params": params,
                 "opt_state": opt_init(params),
                 "step": jnp.zeros([], jnp.int32),
@@ -267,6 +301,16 @@ class DeepSpeedEngine:
                     (float(self.config.initial_dynamic_scale) if self.fp16_enabled else 1.0), jnp.float32),
                 "good_steps": jnp.zeros([], jnp.int32),
             }
+            if self._onebit is not None:
+                from .comm.compressed import onebit_chunk_len
+
+                dp = self._onebit_dp
+                state["onebit_err_w"] = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((dp, ) + tuple(p.shape), jnp.float32), params)
+                state["onebit_err_s"] = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((dp, onebit_chunk_len(int(np.prod(p.shape) or 1), dp)), jnp.float32),
+                    params)
+            return state
 
         with self.mesh:
             state = init_fn(init_rng)
@@ -298,11 +342,13 @@ class DeepSpeedEngine:
         grads = constrain(grads, self.zero_policy.grad_specs(params), self.mesh)
         return grads, loss
 
-    def _apply_update(self, state, grads, grad_norm_ok):
+    def _apply_update(self, state, grads, grad_norm_ok, unscaled=False):
         """Unscale, update, advance loss scale — skipping on overflow
-        (reference ``has_overflow`` stage_1_and_2.py:2002 + DynamicLossScaler)."""
+        (reference ``has_overflow`` stage_1_and_2.py:2002 + DynamicLossScaler).
+        ``unscaled=True`` when the caller already divided by the loss scale
+        (the 1-bit path compresses in unscaled units)."""
         params, opt_state = state["params"], state["opt_state"]
-        inv_scale = 1.0 / state["loss_scale"]
+        inv_scale = 1.0 if unscaled else 1.0 / state["loss_scale"]
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv_scale, grads)
 
         finite = jnp.logical_and(
@@ -424,10 +470,92 @@ class DeepSpeedEngine:
         self.state["loss_scale"] = jnp.asarray(scale, jnp.float32)
         self.state["good_steps"] = jnp.asarray(good, jnp.int32)
 
+    def _build_onebit_train_step(self, gas: int):
+        """1-bit train step: per-worker local grads via shard_map over the
+        data axis, then the error-feedback compressed allreduce (exact pmean
+        during the freeze_step warmup), then the optax update."""
+        from .comm.compressed import onebit_allreduce
+
+        dp = self._onebit_dp
+        freeze_step = self._onebit.freeze_step
+        params_treedef = jax.tree_util.tree_structure(self.state["params"])
+
+        def batch_spec(ndim):
+            return P(*([None, DATA_AXIS] + [None] * (ndim - 2)))
+
+        def local_fn(params, batches, rng, loss_scale, step, err_w, err_s):
+            # everything here is the per-device view: batches (gas, local, ...),
+            # err leaves carry a leading length-1 shard of the stacked dim
+            def micro(carry, mb):
+                acc, rng = carry
+                rng, sub = jax.random.split(rng)
+
+                def scaled_loss(p):
+                    loss, _aux = self._loss_fn(p, mb, sub)
+                    return loss * loss_scale, loss
+
+                grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
+                acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                return (acc, rng), loss
+
+            zeros = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (acc, _), losses = jax.lax.scan(micro, (zeros, rng), batches)
+            # compress in UNSCALED units: error-feedback residuals persist
+            # across steps, so they must not be denominated in a loss scale
+            # that the dynamic scaler later changes
+            acc = jax.tree_util.tree_map(lambda g: g / (gas * loss_scale), acc)
+
+            # a non-finite gradient anywhere must not poison the persistent
+            # error buffers: fall back to the exact path (whose NaN output
+            # _apply_update then rejects, leaving params AND errors untouched)
+            local_finite = jnp.all(jnp.stack([jnp.all(jnp.isfinite(g))
+                                              for g in jax.tree_util.tree_leaves(acc)]))
+            finite = jax.lax.pmin(local_finite.astype(jnp.int32), DATA_AXIS) > 0
+            use_comp = jnp.logical_and(step >= freeze_step, finite)
+            g_leaves = jax.tree_util.tree_leaves(acc)
+            ew_leaves = jax.tree_util.tree_leaves(err_w)
+            es_leaves = jax.tree_util.tree_leaves(err_s)
+            out_g, out_ew, out_es = [], [], []
+            for g, ew, es in zip(g_leaves, ew_leaves, es_leaves):
+                ew0, es0 = ew[0], es[0]
+                comp = lambda g=g, ew0=ew0, es0=es0: onebit_allreduce(g, ew0, es0, DATA_AXIS, dp)
+                exact = lambda g=g, ew0=ew0, es0=es0: (jax.lax.pmean(g, DATA_AXIS), ew0, es0)
+                o, new_ew, new_es = jax.lax.cond(use_comp, comp, exact)
+                out_g.append(o)
+                out_ew.append(new_ew[None])
+                out_es.append(new_es[None])
+            reduced = jax.tree_util.tree_unflatten(params_treedef, out_g)
+            new_err_w = jax.tree_util.tree_unflatten(params_treedef, out_ew)
+            new_err_s = jax.tree_util.tree_unflatten(params_treedef, out_es)
+            mean_loss = jax.lax.pmean(jnp.mean(losses), DATA_AXIS)
+            return reduced, new_err_w, new_err_s, mean_loss
+
+        replicated = jax.tree_util.tree_map(lambda _: P(), self.state["params"])
+        err_spec = jax.tree_util.tree_map(lambda _: P(DATA_AXIS), self.state["params"])
+        batch_specs = jax.tree_util.tree_map(batch_spec, self._last_batch_struct)
+        sharded = jax.shard_map(
+            local_fn, mesh=self.mesh,
+            in_specs=(replicated, batch_specs, P(), P(), P(), err_spec, err_spec),
+            out_specs=(replicated, err_spec, err_spec, P()),
+            check_vma=False)
+
+        def train_step(state, batches, rng):
+            reduced, new_ew, new_es, mean_loss = sharded(state["params"], batches, rng, state["loss_scale"],
+                                                         state["step"], state["onebit_err_w"],
+                                                         state["onebit_err_s"])
+            new_state, metrics = self._finalize_step(state, reduced, mean_loss, unscaled=True)
+            new_state["onebit_err_w"] = new_ew
+            new_state["onebit_err_s"] = new_es
+            return new_state, metrics
+
+        return self._jit_step(train_step)
+
     def _build_train_step(self, gas: int):
         """Fused train step: scan over ``gas`` microbatches then update."""
         if self.pipe_world_size > 1:
             return self._build_pipeline_train_step()
+        if self._onebit is not None:
+            return self._build_onebit_train_step(gas)
 
         def train_step(state, batches, rng):
             acc, losses = self._scan_microbatch_grads(state["params"], batches, rng, state["loss_scale"], gas)
@@ -452,9 +580,9 @@ class DeepSpeedEngine:
 
         return self._jit_step(train_step)
 
-    def _finalize_step(self, state, grads, mean_loss):
+    def _finalize_step(self, state, grads, mean_loss, unscaled=False):
         """Shared tail: apply update + build the step metrics dict."""
-        new_state, finite = self._apply_update(state, grads, jnp.array(True))
+        new_state, finite = self._apply_update(state, grads, jnp.array(True), unscaled=unscaled)
         metrics = {
             "loss": mean_loss,
             "grad_norm": optax.global_norm(grads),
@@ -495,6 +623,7 @@ class DeepSpeedEngine:
             metrics = self._offload_train_batch(batch, step_rng)
         else:
             if "train_step" not in self._compiled:
+                self._last_batch_struct = jax.tree_util.tree_map(lambda x: np.ndim(x), batch)
                 self._compiled["train_step"] = self._build_train_step(gas)
             with self.mesh:
                 batch = self._shard_batch(batch, leading=("mb", ))
@@ -539,6 +668,9 @@ class DeepSpeedEngine:
         assert self.pipe_world_size <= 1, (
             "forward/backward/step are not supported with pipeline parallelism; use train_batch() "
             "(same contract as the reference PipelineEngine)")
+        assert self._onebit is None, (
+            "1-bit optimizers require the fused train_batch() path (the compressed exchange lives "
+            "inside the compiled step)")
         fwd_rng, self._rng = jax.random.split(self._rng)
         if not self._train_mode:  # eval: loss only, no grads
             if "loss" not in self._compiled:
@@ -707,7 +839,14 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def _ckpt_state(self, client_state=None):
         leaves, treedef = jax.tree_util.tree_flatten(self.state["opt_state"])
+        onebit = None
+        if self._onebit is not None:
+            onebit = {
+                "err_w": {str(i): l for i, l in enumerate(jax.tree_util.tree_leaves(self.state["onebit_err_w"]))},
+                "err_s": {str(i): l for i, l in enumerate(jax.tree_util.tree_leaves(self.state["onebit_err_s"]))},
+            }
         return {
+            "onebit": onebit,
             "module": self.state["params"],
             "optimizer": {str(i): l for i, l in enumerate(leaves)},
             "scalars": {
@@ -778,6 +917,12 @@ class DeepSpeedEngine:
         if self.host_optimizer is not None and load_optimizer_states:
             # state_template: shapes only — no NVMe reads just for a template
             template["host_optimizer"] = _escape_keys(self.host_optimizer.state_template())
+        if self._onebit is not None and load_optimizer_states:
+            template["onebit"] = {
+                kind: {str(i): _as_shape_struct(l, _shard_of(l))
+                       for i, l in enumerate(jax.tree_util.tree_leaves(self.state[state_key]))}
+                for kind, state_key in (("err_w", "onebit_err_w"), ("err_s", "onebit_err_s"))
+            }
         loaded = self.checkpoint_engine.load(path, template=template)
         params = loaded["module"]
         state = dict(self.state)
@@ -788,6 +933,12 @@ class DeepSpeedEngine:
         for k in ("step", "loss_scale", "good_steps"):
             if "scalars" in loaded and k in loaded["scalars"]:
                 state[k] = loaded["scalars"][k]
+        if self._onebit is not None and load_optimizer_states and _fully_restored(loaded.get("onebit")):
+            for kind, state_key in (("err_w", "onebit_err_w"), ("err_s", "onebit_err_s")):
+                tdef = jax.tree_util.tree_structure(state[state_key])
+                n = tdef.num_leaves
+                state[state_key] = jax.tree_util.tree_unflatten(
+                    tdef, [loaded["onebit"][kind][str(i)] for i in range(n)])
         self.state = state
         self.global_steps = int(loaded.get("global_steps", 0))
         self.global_samples = int(loaded.get("global_samples", 0))
@@ -795,7 +946,7 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self.lr_scheduler is not None and loaded.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(loaded["lr_scheduler"])
         if self.host_optimizer is not None:
-            if load_optimizer_states and loaded.get("host_optimizer"):
+            if load_optimizer_states and _fully_restored(loaded.get("host_optimizer")):
                 self.host_optimizer.load_state_dict(_unescape_keys(loaded["host_optimizer"]))
             else:
                 # masters must follow the loaded weights, else the next host
@@ -803,7 +954,8 @@ class DeepSpeedEngine:
                 self.host_optimizer.reset_masters(self.state["params"])
         client_state = {k: v for k, v in loaded.items()
                         if k not in ("module", "optimizer", "scalars", "global_steps", "global_samples",
-                                     "skipped_steps", "lr_scheduler", "host_optimizer", "ds_config", "ds_version")}
+                                     "skipped_steps", "lr_scheduler", "host_optimizer", "onebit", "ds_config",
+                                     "ds_version")}
         log_dist(f"loaded checkpoint {path}", ranks=[0])
         return path, client_state
 
@@ -831,6 +983,17 @@ class DeepSpeedEngine:
     def train(self, mode=True):
         self._train_mode = bool(mode)
         return self
+
+
+def _fully_restored(tree):
+    """True when a restored checkpoint subtree contains real arrays — a
+    partial restore leaves ShapeDtypeStruct placeholders for subtrees that
+    were absent on disk (e.g. loading a non-offload checkpoint into an
+    offload-enabled engine)."""
+    if not tree:
+        return False
+    leaves = jax.tree_util.tree_leaves(tree)
+    return bool(leaves) and not any(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
 
 
 def _escape_keys(tree):

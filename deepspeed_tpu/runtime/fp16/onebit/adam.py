@@ -1,0 +1,40 @@
+"""1-bit Adam (reference ``runtime/fp16/onebit/adam.py`` ``OnebitAdam``).
+
+Two phases, same as the reference: a warmup of ``freeze_step`` steps with
+exact (fp32) gradient averaging, then the compression stage where the
+cross-data-axis gradient exchange switches to the error-feedback 1-bit
+collective (``runtime/comm/compressed.py``) while Adam's variance term keeps
+running on the compressed estimates.
+
+On TPU this class is a *policy object* consumed by the engine: the compressed
+exchange happens inside the jitted train step (``engine._build_onebit_train_step``)
+and the parameter update itself is the optax adam chain — the reference splits
+the same responsibilities between its torch optimizer subclass and the NCCL
+compressed backend.
+"""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class OnebitAdam:
+    freeze_step: int = 100
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    cuda_aware: bool = False       # accepted for config parity; no-op on TPU
+    comm_backend_name: str = "xla"  # reference default 'nccl'
+
+    #: optax optimizer the engine pairs with the compressed exchange
+    base_optimizer = "adam"
+
+    @classmethod
+    def from_params(cls, params: dict):
+        return cls(freeze_step=params.get("freeze_step", 100),
+                   lr=params.get("lr", 1e-3),
+                   betas=tuple(params.get("betas", (0.9, 0.999))),
+                   eps=params.get("eps", 1e-8),
+                   weight_decay=params.get("weight_decay", 0.0),
+                   cuda_aware=params.get("cuda_aware", False),
+                   comm_backend_name=params.get("comm_backend_name", "xla"))

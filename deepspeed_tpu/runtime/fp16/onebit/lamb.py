@@ -1,0 +1,28 @@
+"""1-bit LAMB (reference ``runtime/fp16/onebit/lamb.py``): compressed gradient
+exchange + LAMB's per-layer trust-ratio update. The engine composes the 1-bit
+collective with ``optax.lamb`` the way the reference composes its compressed
+backend with FusedLamb."""
+
+from dataclasses import dataclass
+
+from .adam import OnebitAdam
+
+
+@dataclass
+class OnebitLamb(OnebitAdam):
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+    coeff_beta: float = 0.9
+    factor_max: float = 4.0
+    factor_min: float = 0.5
+    factor_threshold: float = 0.1
+
+    base_optimizer = "lamb"
+
+    @classmethod
+    def from_params(cls, params: dict):
+        base = OnebitAdam.from_params(params)
+        return cls(**base.__dict__,
+                   max_coeff=params.get("max_coeff", 10.0),
+                   min_coeff=params.get("min_coeff", 0.01),
+                   coeff_beta=params.get("coeff_beta", 0.9))

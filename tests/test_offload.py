@@ -266,6 +266,32 @@ def test_engine_offload_checkpoint_roundtrip(tmp_path):
     assert np.isfinite(loss)
 
 
+def test_offload_engine_loads_non_offload_checkpoint(tmp_path):
+    """Loading a checkpoint saved WITHOUT offload into an offload engine must
+    not crash and must refresh the masters from the loaded weights."""
+    base = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+        "tpu": {"mesh": {"data": 8}},
+    }
+    plain = dict(base, zero_optimization={"stage": 1})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=plain)
+    engine.train_batch(_batch())
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    trained = jax.device_get(engine.state["params"])
+
+    off = dict(base, zero_optimization={"stage": 1, "offload_optimizer": {"device": "cpu"}})
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=off)
+    engine2.load_checkpoint(str(tmp_path / "ck"))  # no host_optimizer on disk
+    rebuilt = engine2.host_optimizer.rebuild_params()
+    for (_, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(rebuilt),
+                              jax.tree_util.tree_leaves_with_path(trained)):
+        np.testing.assert_allclose(a, np.asarray(b, np.float32), rtol=1e-6)
+    assert np.isfinite(float(engine2.train_batch(_batch())))
+
+
 def test_engine_offload_load_module_only_refreshes_masters(tmp_path):
     """Without optimizer-state load, the host masters must still follow the
     loaded weights — otherwise the first step resurrects the init params."""

@@ -1,0 +1,235 @@
+"""1-bit optimizer tests — mirrors the reference tests/unit/onebit/: sign
+packing, error-feedback compressed allreduce properties, and end-to-end
+1-bit Adam training with the warmup→compression transition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.runtime.comm.compressed import (pack_signs, unpack_signs, onebit_allreduce,
+                                                   onebit_chunk_len, reduce_scatter_coalesced,
+                                                   all_to_all_quant_reduce)
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    bits = (rng.random((4, 64)) > 0.5).astype(np.uint8)
+    packed = pack_signs(jnp.asarray(bits))
+    assert packed.shape == (4, 8) and packed.dtype == jnp.uint8
+    signs = unpack_signs(packed)
+    np.testing.assert_array_equal(np.asarray(signs), bits.astype(np.float32) * 2 - 1)
+
+
+def test_onebit_chunk_len():
+    assert onebit_chunk_len(100, 8) == 16  # ceil(100/8)=13 → 16
+    assert onebit_chunk_len(64, 8) == 8
+    assert onebit_chunk_len(1, 8) == 8
+
+
+def _data_mesh(dp=8):
+    devs = np.asarray(jax.devices()[:dp]).reshape(dp)
+    return Mesh(devs, axis_names=("data", ))
+
+
+def test_onebit_allreduce_first_step_is_scaled_sign():
+    """With zero errors, output ≈ scale * sign(mean-ish) and the errors become
+    nonzero (feedback captured)."""
+    dp = 8
+    mesh = _data_mesh(dp)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((dp, 40)).astype(np.float32)  # per-device rows
+    chunk = onebit_chunk_len(40, dp)
+    err_w = np.zeros((dp, 40), np.float32)
+    err_s = np.zeros((dp, chunk), np.float32)
+
+    def f(xs, ew, es):
+        out, new_ew, new_es = onebit_allreduce(xs[0], ew[0], es[0], "data", dp)
+        return out[None], new_ew[None], new_es[None]
+
+    out, new_ew, new_es = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+                                            out_specs=(P("data"), P("data"), P("data")),
+                                            check_vma=False))(x, err_w, err_s)
+    out = np.asarray(out)
+    # all devices receive the same reduced tensor
+    for i in range(1, dp):
+        np.testing.assert_allclose(out[i], out[0], rtol=1e-6)
+    # sign of the output should broadly agree with the sign of the true mean
+    true_mean = x.mean(axis=0)
+    agreement = np.mean(np.sign(out[0]) == np.sign(true_mean))
+    assert agreement > 0.7, f"sign agreement {agreement}"
+    assert np.abs(np.asarray(new_ew)).max() > 0  # error feedback captured
+
+
+def test_onebit_allreduce_error_feedback_converges():
+    """Repeatedly reducing the SAME tensor with error feedback must converge
+    to the true mean (the defining property of EF-SGD compression)."""
+    dp = 8
+    mesh = _data_mesh(dp)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((dp, 64)).astype(np.float32)
+    chunk = onebit_chunk_len(64, dp)
+    true_mean = x.mean(axis=0)
+
+    def f(xs, ew, es):
+        out, new_ew, new_es = onebit_allreduce(xs[0], ew[0], es[0], "data", dp)
+        return out[None], new_ew[None], new_es[None]
+
+    step = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+                             out_specs=(P("data"), P("data"), P("data")), check_vma=False))
+    ew = np.zeros((dp, 64), np.float32)
+    es = np.zeros((dp, chunk), np.float32)
+    acc = np.zeros(64, np.float64)
+    n_iters = 50
+    for i in range(n_iters):
+        out, ew, es = step(x, ew, es)
+        acc += np.asarray(out)[0]
+    # time-averaged compressed estimate ≈ true mean (EF property)
+    np.testing.assert_allclose(acc / n_iters, true_mean, atol=0.15)
+
+
+def test_reduce_scatter_and_quant_reduce():
+    dp = 8
+    mesh = _data_mesh(dp)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((dp, 64)).astype(np.float32)
+
+    def f(xs):
+        rs = reduce_scatter_coalesced([xs[0]], "data")[0]
+        # block_size must align with the per-device chunk (64/8 = 8)
+        qr = all_to_all_quant_reduce([xs[0]], "data", block_size=8)[0]
+        return rs[None], qr[None]
+
+    rs, qr = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(P("data"), ),
+                               out_specs=(P("data"), P("data")), check_vma=False))(x)
+    true_sum = x.sum(axis=0)
+    # reduce_scatter: device i holds chunk i of the sum
+    np.testing.assert_allclose(np.asarray(rs).reshape(-1), true_sum, rtol=1e-5)
+    # quantized reduce: approximate sum, tight at int8 blockwise precision
+    np.testing.assert_allclose(np.asarray(qr).reshape(-1), true_sum, atol=0.5)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end engine
+# ---------------------------------------------------------------------------
+def _tiny_model():
+    return TransformerLM(TransformerConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                                           intermediate_size=64, max_seq_len=32, dtype=jnp.float32,
+                                           attention_impl="reference"))
+
+
+def _batch(bsz=16, seq=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 128, size=(bsz, seq), dtype=np.int32)}
+
+
+@pytest.mark.parametrize("opt_name", ["OneBitAdam", "OneBitLamb", "ZeroOneAdam"])
+def test_engine_onebit_trains(opt_name):
+    params = {"lr": 5e-3, "freeze_step": 3}
+    if opt_name == "ZeroOneAdam":
+        params["var_freeze_step"] = 3
+    config = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": opt_name, "params": params},
+        "zero_optimization": {"stage": 1},
+        "tpu": {"mesh": {"data": 8}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=config)
+    assert engine._onebit is not None and engine._onebit.freeze_step == 3
+    # 3 warmup (exact) steps + 5 compressed steps: loss must keep decreasing
+    losses = [float(engine.train_batch(_batch(seed=i))) for i in range(8)]
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+    # after compression begins the worker error buffers must be nonzero
+    err_leaves = jax.tree_util.tree_leaves(engine.state["onebit_err_w"])
+    assert any(float(jnp.abs(l).max()) > 0 for l in err_leaves)
+
+
+def test_engine_onebit_checkpoint_roundtrip(tmp_path):
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam", "params": {"lr": 5e-3, "freeze_step": 1}},
+        "zero_optimization": {"stage": 0},
+        "tpu": {"mesh": {"data": 8}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=config)
+    for i in range(3):
+        engine.train_batch(_batch(8, seed=i))
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    err_before = jax.device_get(engine.state["onebit_err_w"])
+
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=config)
+    engine2.load_checkpoint(str(tmp_path / "ck"))
+    err_after = jax.device_get(engine2.state["onebit_err_w"])
+    for (_, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(err_before),
+                              jax.tree_util.tree_leaves_with_path(err_after)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    loss = float(engine2.train_batch(_batch(8, seed=9)))
+    assert np.isfinite(loss)
+
+
+def test_onebit_nan_does_not_poison_error_buffers():
+    """A non-finite gradient must leave the persistent error buffers clean;
+    the subsequent step with clean data must still train (finding from the
+    EF-buffer poisoning review)."""
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam", "params": {"lr": 5e-3, "freeze_step": 0}},
+        "zero_optimization": {"stage": 0},
+        "tpu": {"mesh": {"data": 8}},
+    }
+
+    class PoisonableModel:
+        def __init__(self):
+            self.inner = _tiny_model()
+
+        def init(self, rng, example=None):
+            return self.inner.init(rng, example)
+
+        def loss(self, params, batch, rng=None):
+            loss = self.inner.loss(params, batch, rng)
+            if isinstance(loss, tuple):
+                loss = loss[0]
+            # poison flag rides in the batch to stay jit-compatible
+            return loss * jnp.where(jnp.any(batch["poison"]), jnp.nan, 1.0)
+
+    model = PoisonableModel()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+
+    def batch(poison, seed=0):
+        b = _batch(8, seed=seed)
+        b["poison"] = np.full((8, 1), poison, np.float32)
+        return b
+
+    engine.train_batch(batch(0.0, seed=0))  # healthy compressed step
+    err0 = [np.asarray(l).copy() for l in jax.tree_util.tree_leaves(engine.state["onebit_err_w"])]
+    engine.train_batch(batch(1.0, seed=1))  # poisoned step
+    err1 = [np.asarray(l) for l in jax.tree_util.tree_leaves(engine.state["onebit_err_w"])]
+    for a, b in zip(err0, err1):
+        assert np.isfinite(b).all(), "NaN leaked into error buffers"
+        np.testing.assert_array_equal(a, b)  # untouched by the bad step
+    # recovery: clean step trains and produces finite loss
+    loss = float(engine.train_batch(batch(0.0, seed=2)))
+    assert np.isfinite(loss)
+    params_finite = all(np.isfinite(np.asarray(l)).all()
+                        for l in jax.tree_util.tree_leaves(jax.device_get(engine.state["params"])))
+    assert params_finite
+
+
+def test_onebit_rejects_tensor_parallel():
+    config = {
+        "train_batch_size": 4,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-3}},
+        "tpu": {"mesh": {"data": 4, "model": 2}},
+    }
+    with pytest.raises(AssertionError, match="pure data parallelism"):
+        deepspeed_tpu.initialize(model=_tiny_model(), config=config)
