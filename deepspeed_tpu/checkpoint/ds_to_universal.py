@@ -1,0 +1,116 @@
+"""Engine checkpoint → universal checkpoint conversion.
+
+Reference ``deepspeed/checkpoint/ds_to_universal.py`` (335 LoC): flattened
+ZeRO shards are stitched into one folder per parameter holding ``fp32.pt``
+plus optimizer moments, so a job with a different DP/TP/PP topology can
+re-partition on load. The TPU layout needs no stitching (tensorstore restores
+full arrays), so conversion = consolidate to fp32 + extract the Adam moments
+from the optax chain state into the same per-parameter layout:
+
+    <out>/zero/<param_path>/fp32.npy
+    <out>/zero/<param_path>/exp_avg.npy        (when Adam state exists)
+    <out>/zero/<param_path>/exp_avg_sq.npy
+    <out>/universal_meta.pkl                   (step/loss-scale/version)
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+from ..utils.logging import logger
+from .zero_to_fp32 import _resolve_tag, _restore_arrays
+
+UNIVERSAL_LAYOUT_VERSION = 1
+
+
+def _flat_paths(tree):
+    import jax
+    from ..runtime.zero.partition import path_str
+
+    return [(path_str(kp), leaf) for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]]
+
+
+def _extract_adam_moments(opt_leaves_dict, params_tree):
+    """Find the (mu, nu) trees of a ScaleByAdamState inside the serialized
+    optax chain leaves. The engine checkpoints opt_state as numbered flat
+    leaves; an adam/adamw chain stores [count, mu..., nu...] where mu/nu each
+    mirror the params tree — match by leaf count and shapes."""
+    import jax
+
+    param_leaves = jax.tree_util.tree_leaves(params_tree)
+    n = len(param_leaves)
+    leaves = [opt_leaves_dict[str(i)] for i in range(len(opt_leaves_dict))]
+    shapes = [np.shape(l) for l in param_leaves]
+    # scan for two consecutive runs of leaves whose shapes match the params
+    for start in range(len(leaves) - 2 * n + 1):
+        run1 = leaves[start:start + n]
+        run2 = leaves[start + n:start + 2 * n]
+        if all(np.shape(a) == s for a, s in zip(run1, shapes)) and \
+           all(np.shape(a) == s for a, s in zip(run2, shapes)):
+            return run1, run2
+    return None, None
+
+
+def ds_to_universal(checkpoint_dir, output_dir, tag=None):
+    """Convert; returns the number of parameters written (reference main)."""
+    import jax
+
+    path = _resolve_tag(checkpoint_dir, tag)
+    tree = _restore_arrays(path)
+    module = tree["module"]
+    zero_dir = os.path.join(output_dir, "zero")
+    os.makedirs(zero_dir, exist_ok=True)
+
+    flat = _flat_paths(module)
+    mu_leaves = nu_leaves = None
+    if "optimizer" in tree and tree["optimizer"]:
+        mu_leaves, nu_leaves = _extract_adam_moments(tree["optimizer"], module)
+        if mu_leaves is None:
+            logger.warning("optimizer state present but not adam-shaped; universal ckpt will carry weights only")
+
+    for i, (key, leaf) in enumerate(flat):
+        pdir = os.path.join(zero_dir, key.replace("/", "."))
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"), np.asarray(jax.device_get(leaf), np.float32))
+        if mu_leaves is not None:
+            np.save(os.path.join(pdir, "exp_avg.npy"), np.asarray(jax.device_get(mu_leaves[i]), np.float32))
+            np.save(os.path.join(pdir, "exp_avg_sq.npy"), np.asarray(jax.device_get(nu_leaves[i]), np.float32))
+
+    meta = {
+        "universal_layout_version": UNIVERSAL_LAYOUT_VERSION,
+        "param_paths": [k for k, _ in flat],
+        "has_optimizer": mu_leaves is not None,
+    }
+    scalars = tree.get("scalars", {})
+    for k in ("step", "loss_scale", "good_steps"):
+        if k in scalars:
+            meta[k] = np.asarray(jax.device_get(scalars[k])).item()
+    # carry non-array sidecar meta (global_steps etc.) from the source ckpt
+    src_meta = os.path.join(path, "meta.pkl")
+    if os.path.exists(src_meta):
+        with open(src_meta, "rb") as f:
+            side = pickle.load(f)
+        for k in ("global_steps", "global_samples", "skipped_steps", "lr_scheduler", "ds_version"):
+            if k in side:
+                meta[k] = side[k]
+    with open(os.path.join(output_dir, "universal_meta.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+    logger.info(f"universal checkpoint: {len(flat)} params -> {output_dir} "
+                f"(optimizer={'yes' if mu_leaves is not None else 'no'})")
+    return len(flat)
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser(description="Convert an engine checkpoint to universal layout")
+    p.add_argument("--input_folder", required=True)
+    p.add_argument("--output_folder", required=True)
+    p.add_argument("--tag", default=None)
+    args = p.parse_args()
+    ds_to_universal(args.input_folder, args.output_folder, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()

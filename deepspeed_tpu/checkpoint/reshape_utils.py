@@ -1,0 +1,59 @@
+"""Tensor-parallel checkpoint resharding.
+
+Reference ``runtime/state_dict_factory.py`` (434 LoC ``SDLoaderFactory`` —
+MP-degree resharding of inference checkpoints with qkv split/merge) +
+``deepspeed/checkpoint/reshape_meg_2d.py``. On TPU a running engine reshards
+through NamedShardings, so these utilities serve the *offline* path: take a
+state dict saved at TP degree N and produce degree M (merge shards → split).
+"""
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def merge_tp_param(shards: Sequence[np.ndarray], axis: int) -> np.ndarray:
+    """Concatenate TP shards of one parameter (reference merge path:
+    qkv/mlp columns along their sharded axis)."""
+    return np.concatenate([np.asarray(s) for s in shards], axis=axis)
+
+
+def split_tp_param(full: np.ndarray, degree: int, axis: int) -> List[np.ndarray]:
+    """Evenly split one parameter for a TP degree (reference split path)."""
+    assert full.shape[axis] % degree == 0, \
+        f"dim {axis} of shape {full.shape} not divisible by tp degree {degree}"
+    return [np.ascontiguousarray(s) for s in np.split(full, degree, axis=axis)]
+
+
+def reshard_state_dict(shard_dicts: Sequence[Dict[str, np.ndarray]],
+                       tp_axis_map: Dict[str, int],
+                       target_degree: int) -> List[Dict[str, np.ndarray]]:
+    """Reshard a list of per-rank state dicts (source TP degree = len(list))
+    to ``target_degree`` ranks.
+
+    ``tp_axis_map``: {param_path: axis} for params sharded over TP; params
+    absent from the map are treated as replicated (checked identical across
+    shards, then copied to every target rank).
+    """
+    src_degree = len(shard_dicts)
+    keys = list(shard_dicts[0].keys())
+    out = [dict() for _ in range(target_degree)]
+    for key in keys:
+        parts = [sd[key] for sd in shard_dicts]
+        if key in tp_axis_map:
+            axis = tp_axis_map[key]
+            full = merge_tp_param(parts, axis)
+            splits = split_tp_param(full, target_degree, axis)
+            for r in range(target_degree):
+                out[r][key] = splits[r]
+        else:
+            base = np.asarray(parts[0])
+            for p in parts[1:]:
+                if not np.array_equal(base, np.asarray(p)):
+                    logger.warning(f"replicated param {key} differs across source ranks; using rank0")
+                    break
+            for r in range(target_degree):
+                out[r][key] = base
+    return out

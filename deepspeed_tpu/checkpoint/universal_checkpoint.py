@@ -1,0 +1,125 @@
+"""Universal checkpoint loading.
+
+Reference ``deepspeed/checkpoint/universal_checkpoint.py``
+(``load_hp_checkpoint_state:117``): each rank loads its slice of the
+per-parameter fp32 weights + moments from the universal layout, whatever the
+new DP/TP/PP topology. On TPU the "slice for this rank" is expressed by
+device_put into the engine's NamedShardings — XLA distributes the full host
+array to exactly the shards each device owns.
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def read_universal_checkpoint(universal_dir):
+    """Load the universal layout into ({path: {fp32, exp_avg?, exp_avg_sq?}}, meta)."""
+    meta_path = os.path.join(universal_dir, "universal_meta.pkl")
+    with open(meta_path, "rb") as f:
+        meta = pickle.load(f)
+    out = {}
+    zero_dir = os.path.join(universal_dir, "zero")
+    for key in meta["param_paths"]:
+        pdir = os.path.join(zero_dir, key.replace("/", "."))
+        entry = {"fp32": np.load(os.path.join(pdir, "fp32.npy"))}
+        for name in ("exp_avg", "exp_avg_sq"):
+            p = os.path.join(pdir, f"{name}.npy")
+            if os.path.exists(p):
+                entry[name] = np.load(p)
+        out[key] = entry
+    return out, meta
+
+
+def load_hp_checkpoint_state(param_path, universal_dir):
+    """Reference function of the same name: the hp (high-precision) states of
+    one parameter."""
+    sd, _ = read_universal_checkpoint(universal_dir)
+    return sd[param_path]
+
+
+def load_universal_checkpoint(engine, universal_dir, load_optimizer_states=True):
+    """Restore an engine from a universal checkpoint under any topology.
+
+    Weights are device_put into the engine's current shardings; Adam moments
+    are written back into the optax chain state when the layouts line up
+    (reference reshards the flat shards; XLA resharding does it here).
+    """
+    import jax
+
+    from ..runtime.zero.partition import path_str
+
+    sd, meta = read_universal_checkpoint(universal_dir)
+
+    def pick(kp, leaf):
+        key = path_str(kp)
+        if key not in sd:
+            logger.warning(f"universal checkpoint missing {key}; keeping current value")
+            return leaf
+        return np.asarray(sd[key]["fp32"], dtype=leaf.dtype).reshape(leaf.shape)
+
+    host_params = jax.tree_util.tree_map_with_path(pick, jax.device_get(engine.state["params"]))
+    engine.state["params"] = jax.device_put(host_params, engine._state_shardings["params"])
+
+    if load_optimizer_states and meta.get("has_optimizer") and engine.state["opt_state"]:
+        flat = jax.tree_util.tree_flatten_with_path(host_params)[0]
+        keys = [path_str(kp) for kp, _ in flat]
+        mu = [np.asarray(sd[k]["exp_avg"], np.float32) for k in keys if k in sd and "exp_avg" in sd[k]]
+        nu = [np.asarray(sd[k]["exp_avg_sq"], np.float32) for k in keys if k in sd and "exp_avg_sq" in sd[k]]
+        if len(mu) == len(keys):
+            engine.state["opt_state"] = _overlay_adam_moments(engine, mu, nu)
+        else:
+            logger.warning("universal checkpoint moments incomplete; optimizer state not restored")
+
+    if engine.host_optimizer is not None:
+        engine.host_optimizer.reset_masters(engine.state["params"])
+        if load_optimizer_states and meta.get("has_optimizer"):
+            hsd = engine.host_optimizer.state_dict()
+            for k in engine.host_optimizer.keys:
+                if k in sd and "exp_avg" in sd[k]:
+                    hsd["exp_avg"][k] = sd[k]["exp_avg"].reshape(-1)
+                    hsd["exp_avg_sq"][k] = sd[k]["exp_avg_sq"].reshape(-1)
+                if k in sd:
+                    hsd["masters"][k] = sd[k]["fp32"].reshape(-1)
+            engine.host_optimizer.load_state_dict(hsd)
+
+    for k in ("step", "good_steps"):
+        if k in meta:
+            import jax.numpy as jnp
+
+            engine.state[k] = jnp.asarray(meta[k], engine.state[k].dtype)
+    if "loss_scale" in meta:
+        import jax.numpy as jnp
+
+        engine.state["loss_scale"] = jnp.asarray(meta["loss_scale"], jnp.float32)
+    engine.global_steps = int(meta.get("global_steps", engine.global_steps))
+    logger.info(f"loaded universal checkpoint from {universal_dir} "
+                f"(step={meta.get('step')}, optimizer={meta.get('has_optimizer')})")
+    return meta
+
+
+def _overlay_adam_moments(engine, mu_leaves, nu_leaves):
+    """Write mu/nu leaf lists back into the optax chain state at the position
+    where adam's ScaleByAdamState lives (matched by shape-run, the inverse of
+    ds_to_universal._extract_adam_moments)."""
+    import jax
+
+    opt_state = jax.device_get(engine.state["opt_state"])
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    param_shapes = [np.shape(l) for l in jax.tree_util.tree_leaves(jax.device_get(engine.state["params"]))]
+    n = len(param_shapes)
+    for start in range(len(leaves) - 2 * n + 1):
+        if all(np.shape(a) == s for a, s in zip(leaves[start:start + n], param_shapes)) and \
+           all(np.shape(a) == s for a, s in zip(leaves[start + n:start + 2 * n], param_shapes)):
+            for i in range(n):
+                leaves[start + i] = mu_leaves[i].reshape(param_shapes[i])
+                leaves[start + n + i] = nu_leaves[i].reshape(param_shapes[i])
+            break
+    else:
+        logger.warning("could not locate adam moments in optimizer state; not restored")
+        return engine.state["opt_state"]
+    new_state = jax.tree_util.tree_unflatten(treedef, leaves)
+    return jax.device_put(new_state, engine._state_shardings["opt_state"])

@@ -1,0 +1,104 @@
+"""Offline reconstruction of full fp32 weights from a sharded checkpoint.
+
+Reference ``deepspeed/utils/zero_to_fp32.py`` (592 LoC,
+``convert_zero_checkpoint_to_fp32_state_dict``): the reference must stitch
+``bf16_zero_pp_rank_*`` flat shards back into parameter tensors; on TPU the
+checkpoint is a tensorstore layout that restores to full arrays directly —
+this module provides the same offline CLI/API surface (no engine, no mesh
+required) over that layout.
+
+Usage (same as the reference script dropped into checkpoint dirs):
+    python -m deepspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <output_file>
+"""
+
+import argparse
+import os
+import pickle
+
+import numpy as np
+
+from ..utils.logging import logger
+
+LATEST_FILE = "latest"
+
+
+def _resolve_tag(checkpoint_dir, tag):
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, LATEST_FILE)
+        if os.path.isfile(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            raise ValueError(f"no 'latest' file in {checkpoint_dir}; pass tag explicitly")
+    path = os.path.join(checkpoint_dir, str(tag))
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint path {path} does not exist")
+    return path
+
+
+def _restore_arrays(path):
+    import orbax.checkpoint as ocp
+
+    arrays_path = os.path.join(path, "arrays")
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(arrays_path)
+    return tree
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None, exclude_frozen_parameters=False):
+    """Full fp32 params as a flat {path: np.ndarray} dict (reference function
+    of the same name)."""
+    import jax
+
+    path = _resolve_tag(checkpoint_dir, tag)
+    tree = _restore_arrays(path)
+    module = tree.get("module", tree)
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(module)[0]:
+        from ..runtime.zero.partition import path_str
+
+        flat[path_str(kp)] = np.asarray(jax.device_get(leaf), dtype=np.float32)
+    return flat
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file, tag=None,
+                                               exclude_frozen_parameters=False):
+    """Write the consolidated fp32 state dict to ``output_file`` (pickle)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag, exclude_frozen_parameters)
+    os.makedirs(os.path.dirname(os.path.abspath(output_file)), exist_ok=True)
+    with open(output_file, "wb") as f:
+        pickle.dump(sd, f)
+    total = sum(v.size for v in sd.values())
+    logger.info(f"wrote {len(sd)} tensors ({total/1e6:.2f}M params) to {output_file}")
+    return sd
+
+
+def load_state_dict_from_zero_checkpoint(model_params, checkpoint_dir, tag=None):
+    """Overlay checkpoint weights onto a param pytree (reference
+    ``load_state_dict_from_zero_checkpoint`` updates a torch module)."""
+    import jax
+    from ..runtime.zero.partition import path_str
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+
+    def replace(kp, leaf):
+        key = path_str(kp)
+        if key in sd:
+            return np.asarray(sd[key], dtype=np.asarray(leaf).dtype).reshape(np.shape(leaf))
+        logger.warning(f"checkpoint missing param {key}; keeping existing value")
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(replace, model_params)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Reconstruct full fp32 weights from a checkpoint")
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_file")
+    parser.add_argument("-t", "--tag", default=None)
+    args = parser.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()

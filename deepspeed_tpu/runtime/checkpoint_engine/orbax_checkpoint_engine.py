@@ -39,6 +39,11 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         path = os.path.abspath(path)
         if arrays:
             self._ckptr.save(os.path.join(path, "arrays"), arrays, force=True)
+            if not self._async and hasattr(self._ckptr, "wait_until_finished"):
+                # StandardCheckpointer finalizes in a background thread since
+                # orbax 0.11 — a synchronous save contract must block here,
+                # else an immediate offline read sees arrays.orbax-checkpoint-tmp
+                self._ckptr.wait_until_finished()
         if jax.process_index() == 0:
             os.makedirs(path, exist_ok=True)
             with open(os.path.join(path, "meta.pkl"), "wb") as f:

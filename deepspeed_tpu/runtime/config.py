@@ -234,6 +234,10 @@ class DeepSpeedConfig:
         comms_dict = pd.get(COMMS_LOGGER, {})
         self.comms_config = CommsConfig(comms_logger_enabled=bool(comms_dict.get("enabled", False)),
                                         comms_logger=CommsLoggerConfig(**comms_dict))
+        from .data_pipeline.config import DataEfficiencyConfig, CurriculumLearningConfig
+
+        self.data_efficiency_config = DataEfficiencyConfig(**pd.get(DATA_EFFICIENCY, {}))
+        self.curriculum_learning_config = CurriculumLearningConfig(**pd.get(CURRICULUM_LEARNING_LEGACY, {}))
         ckpt_dict = pd.get(CHECKPOINT, {})
         self.checkpoint_config = CheckpointConfig(**ckpt_dict)
         self.checkpoint_tag_validation_enabled = self.checkpoint_config.tag_validation != "Ignore"

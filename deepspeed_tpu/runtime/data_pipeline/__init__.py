@@ -1,0 +1,5 @@
+from .config import (DataEfficiencyConfig, CurriculumLearningConfig, RandomLTDConfig,
+                     get_data_efficiency_config)
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import DeepSpeedDataSampler
+from .data_routing import random_ltd

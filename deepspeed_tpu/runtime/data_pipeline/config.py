@@ -1,0 +1,71 @@
+"""Data-efficiency configuration (reference ``runtime/data_pipeline/config.py``
+/ ``constants.py``): the ``data_efficiency`` block with its two arms —
+``data_sampling`` (curriculum learning) and ``data_routing`` (random-LTD) —
+plus the legacy top-level ``curriculum_learning`` block.
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from pydantic import Field
+
+from ..config_utils import DeepSpeedConfigModel
+
+# schedule types (reference data_pipeline/constants.py)
+CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR = "fixed_linear"
+CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT = "fixed_root"
+CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE = "fixed_discrete"
+CURRICULUM_LEARNING_SCHEDULE_CUSTOM = "custom"
+
+
+class CurriculumLearningConfig(DeepSpeedConfigModel):
+    """Legacy ``curriculum_learning`` block (reference
+    ``curriculum_scheduler.py`` consumes exactly these keys)."""
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 1
+    max_difficulty: int = 10**9
+    schedule_type: str = CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+    # reference data_efficiency schema nests per-metric configs here; that
+    # multi-metric clustered-index form is not supported — reject loudly
+    # rather than silently dropping it (see CurriculumScheduler.__init__)
+    curriculum_metrics: Optional[Dict[str, Any]] = None
+
+
+class RandomLTDConfig(DeepSpeedConfigModel):
+    """``data_routing.random_ltd`` block (reference
+    ``data_pipeline/config.py`` random-LTD keys, flattened to the used set)."""
+    enabled: bool = False
+    total_layer_num: int = 0
+    random_ltd_layer_num: int = 0
+    random_ltd_layer_id: List[int] = Field(default_factory=list)
+    model_mask_name: Optional[str] = None
+    model_type: str = "decoder"
+    hidden_state_order: str = "batch_seq_dim"
+    random_ltd_schedule: Dict[str, Any] = Field(default_factory=dict)  # {min_value, max_value, schedule_type, schedule_config}
+
+
+class DataSamplingConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    # parsed for reference-config compatibility; the jax data path has no
+    # worker processes and epochs are driven by the caller's loop
+    num_epochs: int = 1000
+    num_workers: int = 0
+    curriculum_learning: CurriculumLearningConfig = Field(default_factory=CurriculumLearningConfig)
+
+
+class DataRoutingConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    random_ltd: RandomLTDConfig = Field(default_factory=RandomLTDConfig)
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    """``data_efficiency`` block (reference DeepSpeedDataEfficiencyConfig)."""
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: DataSamplingConfig = Field(default_factory=DataSamplingConfig)
+    data_routing: DataRoutingConfig = Field(default_factory=DataRoutingConfig)
+
+
+def get_data_efficiency_config(param_dict: dict) -> DataEfficiencyConfig:
+    return DataEfficiencyConfig(**param_dict.get("data_efficiency", {}))

@@ -1,0 +1,113 @@
+"""Curriculum learning scheduler (reference
+``runtime/data_pipeline/curriculum_scheduler.py`` ``CurriculumScheduler``,
+158 LoC): maps the global step to a "difficulty" (typically sequence length)
+via fixed_linear / fixed_root / fixed_discrete / custom schedules.
+
+TPU note: when the difficulty drives sequence length, every new value means a
+new compiled program shape — ``difficulty_step`` should be a multiple large
+enough (e.g. 64) that the schedule visits few distinct lengths; the engine
+additionally rounds to that step so XLA compiles once per bucket.
+"""
+
+import math
+
+from .config import (CURRICULUM_LEARNING_SCHEDULE_CUSTOM, CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE,
+                     CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR, CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT,
+                     CurriculumLearningConfig)
+from ...utils.logging import logger
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config):
+        if isinstance(config, dict):
+            config = CurriculumLearningConfig(**config)
+        if getattr(config, "curriculum_metrics", None):
+            raise NotImplementedError(
+                "the multi-metric 'curriculum_metrics' schema (clustered difficulty index) is not "
+                "supported; express the curriculum with schedule_type/schedule_config and pass the "
+                "per-sample metric to DeepSpeedDataSampler(difficulty_metric=...)")
+        self.config = config
+        self.state = {
+            "current_difficulty": config.min_difficulty,
+            "min_difficulty": config.min_difficulty,
+            "max_difficulty": config.max_difficulty,
+            "schedule_type": config.schedule_type,
+            "last_update_step": 0,
+        }
+        sc = dict(config.schedule_config)
+        st = config.schedule_type
+        if st in (CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR, CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT):
+            assert "total_curriculum_step" in sc, f"{st} schedule requires total_curriculum_step"
+            sc.setdefault("difficulty_step", 1)
+            if st == CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT:
+                sc.setdefault("root_degree", 2)
+        elif st == CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE:
+            assert "difficulty" in sc and "max_step" in sc, "fixed_discrete requires difficulty + max_step lists"
+            assert len(sc["difficulty"]) == len(sc["max_step"]) + 1, \
+                "len(difficulty) must be len(max_step)+1 (last difficulty is open-ended)"
+        elif st == CURRICULUM_LEARNING_SCHEDULE_CUSTOM:
+            assert callable(sc.get("difficulty_fn")), "custom schedule requires difficulty_fn(global_steps)"
+        else:
+            raise ValueError(f"unknown curriculum schedule_type '{st}'")
+        self.schedule_config = sc
+
+    def get_current_difficulty(self):
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty):
+        self.state["current_difficulty"] = difficulty
+
+    def get_state(self):
+        return dict(self.state)
+
+    def set_state(self, state):
+        self.state = dict(state)
+
+    # -- schedules -----------------------------------------------------
+    def __fixed_linear(self, global_steps):
+        sc = self.schedule_config
+        frac = min(1.0, global_steps / sc["total_curriculum_step"])
+        diff = self.state["min_difficulty"] + frac * (self.state["max_difficulty"] - self.state["min_difficulty"])
+        step = sc["difficulty_step"]
+        diff = int(diff / step) * step
+        return max(self.state["min_difficulty"], min(self.state["max_difficulty"], diff))
+
+    def __fixed_root(self, global_steps):
+        sc = self.schedule_config
+        frac = min(1.0, global_steps / sc["total_curriculum_step"])
+        frac = frac**(1.0 / sc["root_degree"])
+        diff = self.state["min_difficulty"] + frac * (self.state["max_difficulty"] - self.state["min_difficulty"])
+        step = sc["difficulty_step"]
+        diff = int(diff / step) * step
+        return max(self.state["min_difficulty"], min(self.state["max_difficulty"], diff))
+
+    def __fixed_discrete(self, global_steps):
+        sc = self.schedule_config
+        for diff, max_step in zip(sc["difficulty"], sc["max_step"]):
+            if global_steps <= max_step:
+                return diff
+        return sc["difficulty"][-1]
+
+    def update_difficulty(self, global_steps):
+        st = self.config.schedule_type
+        if st == CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR:
+            diff = self.__fixed_linear(global_steps)
+        elif st == CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT:
+            diff = self.__fixed_root(global_steps)
+        elif st == CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE:
+            diff = self.__fixed_discrete(global_steps)
+        else:
+            diff = self.schedule_config["difficulty_fn"](global_steps)
+        if diff != self.state["current_difficulty"]:
+            logger.info(f"curriculum difficulty -> {diff} at step {global_steps}")
+        self.state["current_difficulty"] = diff
+        self.state["last_update_step"] = global_steps
+        return diff
+
+    # checkpoint API (reference state_dict/load_state_dict)
+    def state_dict(self):
+        return self.get_state()
+
+    def load_state_dict(self, state):
+        self.set_state(state)

@@ -1,0 +1,3 @@
+from . import random_ltd
+from .random_ltd import (RandomLTDScheduler, token_gather, token_scatter, random_token_drop,
+                         apply_random_ltd)

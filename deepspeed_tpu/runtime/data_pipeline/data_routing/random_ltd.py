@@ -1,0 +1,94 @@
+"""Random layer-token-drop (random-LTD).
+
+Reference: ``runtime/data_pipeline/data_routing/basic_layer.py``
+(``RandomLayerTokenDrop``) + the CUDA kernels in ``csrc/random_ltd/``
+(``gather_scatter.cu``, ``token_sort.cu``): during training, middle layers
+process a random subset of tokens; the dropped tokens skip the layer and are
+scattered back afterwards — compute drops quadratically in kept length for
+attention while accuracy is preserved by the schedule that anneals kept
+length up to the full sequence.
+
+TPU-native: gather/scatter are ``jnp.take_along_axis`` / ``.at[].set`` (XLA
+lowers both to efficient dynamic-slice/dus on sorted indices — the reference's
+token_sort kernel exists to keep kept tokens in causal order, which we get by
+sorting the sampled indices). All shapes are static per (kept_len) bucket:
+the scheduler quantizes kept length so XLA compiles one program per bucket.
+"""
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..curriculum_scheduler import CurriculumScheduler
+from ..config import CurriculumLearningConfig
+
+
+def token_gather(x: jax.Array, indices: jax.Array) -> jax.Array:
+    """Gather kept tokens: x [B, S, H], indices [B, K] (sorted) → [B, K, H].
+    (reference csrc/random_ltd/gather_scatter.cu::gather_tokens)"""
+    return jnp.take_along_axis(x, indices[..., None], axis=1)
+
+
+def token_scatter(full: jax.Array, kept: jax.Array, indices: jax.Array) -> jax.Array:
+    """Scatter processed tokens back over the (unprocessed) full tensor:
+    full [B, S, H], kept [B, K, H], indices [B, K] → [B, S, H].
+    (reference scatter_tokens kernel)"""
+    B = full.shape[0]
+    batch_idx = jnp.arange(B)[:, None]
+    return full.at[batch_idx, indices].set(kept)
+
+
+def random_token_drop(rng: jax.Array, batch: int, seq_len: int, keep_len: int) -> jax.Array:
+    """Sample ``keep_len`` token indices per row, sorted ascending so causal
+    masks remain valid (the role of the reference token_sort.cu kernel)."""
+    noise = jax.random.uniform(rng, (batch, seq_len))
+    keep = jnp.argsort(noise, axis=1)[:, :keep_len]
+    return jnp.sort(keep, axis=1)
+
+
+def apply_random_ltd(layer_fn, x: jax.Array, rng: jax.Array, keep_len: int):
+    """Run ``layer_fn`` on a random ``keep_len``-token subset and scatter the
+    outputs back (identity for dropped tokens) — the RandomLayerTokenDrop
+    forward. ``keep_len`` must be static (bucketed by the scheduler)."""
+    B, S = x.shape[0], x.shape[1]
+    if keep_len >= S:
+        return layer_fn(x)
+    idx = random_token_drop(rng, B, S, keep_len)
+    kept = token_gather(x, idx)
+    processed = layer_fn(kept)
+    return token_scatter(x, processed, idx)
+
+
+class RandomLTDScheduler:
+    """Schedule of the kept-token count (reference
+    ``data_pipeline/data_routing/scheduler.py``): anneals from min_value to
+    max_value (the full sequence) with the same schedule machinery as
+    curriculum learning. Values are quantized to ``difficulty_step`` so the
+    jitted layer compiles once per bucket."""
+
+    def __init__(self, random_ltd_config):
+        rl = random_ltd_config
+        sched = dict(rl.random_ltd_schedule) if hasattr(rl, "random_ltd_schedule") else dict(rl)
+        self.scheduler = CurriculumScheduler(
+            CurriculumLearningConfig(enabled=True,
+                                     curriculum_type="seqlen",
+                                     min_difficulty=sched.get("min_value", 128),
+                                     max_difficulty=sched.get("max_value", 2048),
+                                     schedule_type=sched.get("schedule_type", "fixed_linear"),
+                                     schedule_config=sched.get("schedule_config",
+                                                               {"total_curriculum_step": 1000,
+                                                                "difficulty_step": 64})))
+        self.config = rl
+
+    def get_current_seq(self) -> int:
+        return int(self.scheduler.get_current_difficulty())
+
+    def update_seq(self, global_steps: int) -> int:
+        return int(self.scheduler.update_difficulty(global_steps))
+
+    def state_dict(self):
+        return self.scheduler.state_dict()
+
+    def load_state_dict(self, state):
+        self.scheduler.load_state_dict(state)

@@ -1,0 +1,90 @@
+"""Curriculum-aware distributed data sampler (reference
+``runtime/data_pipeline/data_sampling/data_sampler.py``
+``DeepSpeedDataSampler``): draws each global batch from the subset of samples
+whose difficulty metric is within the current curriculum difficulty,
+partitioned across data-parallel ranks.
+
+The reference clusters samples by metric value into an on-disk index; here
+the metric is an in-memory array (or callable evaluated once), which covers
+the same training behavior for datasets that fit an index in RAM — the
+multi-TB offline-indexed variant belongs to a data-services layer, not the
+framework core.
+"""
+
+import math
+from typing import Callable, Optional, Sequence, Union
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+from ...utils.logging import logger
+
+
+class DeepSpeedDataSampler:
+
+    def __init__(self,
+                 dataset_len: int,
+                 batch_size: int,
+                 difficulty_metric: Optional[Union[Sequence, Callable]] = None,
+                 curriculum_scheduler: Optional[CurriculumScheduler] = None,
+                 data_parallel_rank: int = 0,
+                 data_parallel_world_size: int = 1,
+                 shuffle: bool = True,
+                 seed: int = 1234):
+        self.dataset_len = dataset_len
+        self.batch_size = batch_size
+        self.rank = data_parallel_rank
+        self.world = data_parallel_world_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.global_steps = 0
+        self.curriculum_scheduler = curriculum_scheduler
+
+        if difficulty_metric is None:
+            self.metric = None
+        elif callable(difficulty_metric):
+            self.metric = np.asarray([difficulty_metric(i) for i in range(dataset_len)])
+        else:
+            self.metric = np.asarray(difficulty_metric)
+            assert len(self.metric) == dataset_len
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def _eligible(self):
+        if self.metric is None or self.curriculum_scheduler is None:
+            return np.arange(self.dataset_len)
+        diff = self.curriculum_scheduler.get_current_difficulty()
+        idx = np.nonzero(self.metric <= diff)[0]
+        if len(idx) < self.batch_size * self.world:
+            # too few easy samples early in the curriculum: take the easiest
+            # batch-worth instead of starving (reference pads the cluster)
+            idx = np.argsort(self.metric)[:self.batch_size * self.world]
+        return idx
+
+    def __iter__(self):
+        g = np.random.default_rng(self.seed + self.epoch)
+        while True:
+            if self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.update_difficulty(self.global_steps)
+            pool = self._eligible()
+            if self.shuffle:
+                chosen = g.choice(pool, size=self.batch_size * self.world, replace=len(pool) < self.batch_size * self.world)
+            else:
+                start = (self.global_steps * self.batch_size * self.world) % max(1, len(pool))
+                rolled = np.roll(pool, -start)
+                chosen = rolled[:self.batch_size * self.world]
+            self.global_steps += 1
+            yield chosen[self.rank::self.world][:self.batch_size]
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "global_steps": self.global_steps,
+                "curriculum": (self.curriculum_scheduler.state_dict()
+                               if self.curriculum_scheduler is not None else None)}
+
+    def load_state_dict(self, state):
+        self.epoch = state["epoch"]
+        self.global_steps = state["global_steps"]
+        if state.get("curriculum") and self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.load_state_dict(state["curriculum"])

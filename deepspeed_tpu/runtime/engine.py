@@ -157,6 +157,22 @@ class DeepSpeedEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
 
+        # --- data efficiency: curriculum learning + random-LTD (reference
+        #     engine.py:1848-1854 curriculum/random-LTD updates) ---
+        self.curriculum_scheduler = None
+        cl_cfg = config.curriculum_learning_config
+        de_cl = config.data_efficiency_config.data_sampling.curriculum_learning
+        if cl_cfg.enabled or (config.data_efficiency_config.enabled and de_cl.enabled):
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(cl_cfg if cl_cfg.enabled else de_cl)
+        self.random_ltd_scheduler = None
+        rl_cfg = config.data_efficiency_config.data_routing
+        if config.data_efficiency_config.enabled and rl_cfg.enabled and rl_cfg.random_ltd.enabled:
+            from .data_pipeline.data_routing.random_ltd import RandomLTDScheduler
+
+            self.random_ltd_scheduler = RandomLTDScheduler(rl_cfg.random_ltd)
+
         # --- aux subsystems ---
         self.monitor = MonitorMaster(config.monitor_config)
         self.engine_timers = EngineTimers(enable_micro_timers=config.wall_clock_breakdown,
@@ -617,6 +633,10 @@ class DeepSpeedEngine:
         else:
             batch = jax.tree_util.tree_map(lambda x: np.asarray(x).reshape(gas, -1, *np.shape(x)[1:]), batch)
 
+        if self.curriculum_scheduler is not None:
+            batch = self._apply_curriculum(batch)
+        if self.random_ltd_scheduler is not None:
+            self.random_ltd_scheduler.update_seq(self.global_steps)
         step_rng, self._rng = jax.random.split(self._rng)
         self.tput_timer.start()
         if self.host_optimizer is not None:
@@ -636,6 +656,26 @@ class DeepSpeedEngine:
             self.skipped_steps += 1  # offload path counts inside _host_apply_update
         self._record_metrics(metrics)
         return metrics["loss"]
+
+    def _apply_curriculum(self, batch, seq_axis=2):
+        """seqlen curriculum: truncate the sequence dim of (gas, bsz, seq…)
+        leaves to the current difficulty (reference passes curriculum_seqlen
+        into the model, engine.py:1848; truncation is the model-agnostic TPU
+        equivalent — each difficulty bucket compiles once). ``seq_axis``: 2
+        on the fused path ((gas, bsz, seq)), 1 on the eager microbatch path."""
+        diff = int(self.curriculum_scheduler.update_difficulty(self.global_steps))
+        if self.curriculum_scheduler.config.curriculum_type != "seqlen":
+            return batch
+        # sequence dim must stay divisible by the seq-parallel axis
+        if self.seq_world_size > 1:
+            diff = max(self.seq_world_size, diff - diff % self.seq_world_size)
+
+        def trunc(x):
+            if np.ndim(x) > seq_axis and np.shape(x)[seq_axis] > diff:
+                return x[(slice(None), ) * seq_axis + (slice(0, diff), )]
+            return x
+
+        return jax.tree_util.tree_map(trunc, batch)
 
     def _shard_batch(self, batch, leading=()):
         """Place host batch onto the mesh: batch dim over data axes, sequence
@@ -671,6 +711,10 @@ class DeepSpeedEngine:
         assert self._onebit is None, (
             "1-bit optimizers require the fused train_batch() path (the compressed exchange lives "
             "inside the compiled step)")
+        if self.curriculum_scheduler is not None and self._train_mode:
+            batch = self._apply_curriculum(batch, seq_axis=1)
+        if self.random_ltd_scheduler is not None and self._train_mode:
+            self.random_ltd_scheduler.update_seq(self.global_steps)
         fwd_rng, self._rng = jax.random.split(self._rng)
         if not self._train_mode:  # eval: loss only, no grads
             if "loss" not in self._compiled:
@@ -858,6 +902,10 @@ class DeepSpeedEngine:
             "global_samples": self.global_samples,
             "skipped_steps": self.skipped_steps,
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
+            "curriculum_scheduler": (self.curriculum_scheduler.state_dict()
+                                     if self.curriculum_scheduler is not None else None),
+            "random_ltd_scheduler": (self.random_ltd_scheduler.state_dict()
+                                     if self.random_ltd_scheduler is not None else None),
             "host_optimizer": (_escape_keys(self.host_optimizer.state_dict())
                                if self.host_optimizer is not None else None),
             "ds_config": self.config.param_dict,
@@ -945,6 +993,10 @@ class DeepSpeedEngine:
         self.skipped_steps = int(loaded.get("skipped_steps", 0))
         if load_lr_scheduler_states and self.lr_scheduler is not None and loaded.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(loaded["lr_scheduler"])
+        if self.curriculum_scheduler is not None and loaded.get("curriculum_scheduler"):
+            self.curriculum_scheduler.load_state_dict(loaded["curriculum_scheduler"])
+        if self.random_ltd_scheduler is not None and loaded.get("random_ltd_scheduler"):
+            self.random_ltd_scheduler.load_state_dict(loaded["random_ltd_scheduler"])
         if self.host_optimizer is not None:
             if load_optimizer_states and _fully_restored(loaded.get("host_optimizer")):
                 self.host_optimizer.load_state_dict(_unescape_keys(loaded["host_optimizer"]))
@@ -954,7 +1006,8 @@ class DeepSpeedEngine:
                 self.host_optimizer.reset_masters(self.state["params"])
         client_state = {k: v for k, v in loaded.items()
                         if k not in ("module", "optimizer", "scalars", "global_steps", "global_samples",
-                                     "skipped_steps", "lr_scheduler", "host_optimizer", "onebit", "ds_config",
+                                     "skipped_steps", "lr_scheduler", "curriculum_scheduler",
+                                     "random_ltd_scheduler", "host_optimizer", "onebit", "ds_config",
                                      "ds_version")}
         log_dist(f"loaded checkpoint {path}", ranks=[0])
         return path, client_state

@@ -1,0 +1,179 @@
+"""Data pipeline tests — curriculum scheduler schedules, curriculum sampler,
+random-LTD gather/scatter numerics, and engine seqlen-curriculum training
+(mirrors the reference tests/unit/runtime/test_data_efficiency.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler, DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.config import CurriculumLearningConfig
+from deepspeed_tpu.runtime.data_pipeline.data_routing.random_ltd import (
+    RandomLTDScheduler, apply_random_ltd, random_token_drop, token_gather, token_scatter)
+
+
+# ---------------------------------------------------------------------------
+# curriculum scheduler
+# ---------------------------------------------------------------------------
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({"enabled": True, "min_difficulty": 8, "max_difficulty": 64,
+                             "schedule_type": "fixed_linear",
+                             "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    assert s.update_difficulty(0) == 8
+    assert s.update_difficulty(50) == 8 + (64 - 8) // 2 // 8 * 8  # halfway, stepped by 8
+    assert s.update_difficulty(100) == 64
+    assert s.update_difficulty(10**6) == 64  # clamped
+
+
+def test_fixed_root_schedule_monotone():
+    s = CurriculumScheduler({"enabled": True, "min_difficulty": 8, "max_difficulty": 512,
+                             "schedule_type": "fixed_root",
+                             "schedule_config": {"total_curriculum_step": 1000, "root_degree": 2,
+                                                 "difficulty_step": 8}})
+    vals = [s.update_difficulty(t) for t in range(0, 1100, 100)]
+    assert vals[0] == 8 and vals[-1] == 512
+    assert all(a <= b for a, b in zip(vals, vals[1:]))
+    # root schedule front-loads difficulty vs linear
+    assert s.update_difficulty(250) > 8 + (512 - 8) // 4
+
+
+def test_fixed_discrete_schedule():
+    s = CurriculumScheduler({"enabled": True, "min_difficulty": 2, "max_difficulty": 100,
+                             "schedule_type": "fixed_discrete",
+                             "schedule_config": {"difficulty": [10, 20, 100], "max_step": [5, 10]}})
+    assert s.update_difficulty(3) == 10
+    assert s.update_difficulty(7) == 20
+    assert s.update_difficulty(50) == 100
+
+
+def test_custom_schedule_and_state_roundtrip():
+    s = CurriculumScheduler({"enabled": True, "schedule_type": "custom",
+                             "schedule_config": {"difficulty_fn": lambda t: 5 + t}})
+    assert s.update_difficulty(10) == 15
+    st = s.state_dict()
+    s2 = CurriculumScheduler({"enabled": True, "schedule_type": "custom",
+                              "schedule_config": {"difficulty_fn": lambda t: 0}})
+    s2.load_state_dict(st)
+    assert s2.get_current_difficulty() == 15
+
+
+def test_bad_schedule_rejected():
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"enabled": True, "schedule_type": "bogus"})
+    with pytest.raises(AssertionError):
+        CurriculumScheduler({"enabled": True, "schedule_type": "fixed_linear"})  # missing total step
+    # the reference multi-metric schema must fail loudly, not silently no-op
+    with pytest.raises(NotImplementedError, match="curriculum_metrics"):
+        CurriculumScheduler({"enabled": True, "curriculum_metrics": {"seqlen": {}}})
+
+
+# ---------------------------------------------------------------------------
+# curriculum data sampler
+# ---------------------------------------------------------------------------
+def test_sampler_respects_difficulty():
+    metric = np.arange(100)  # sample i has difficulty i
+    sched = CurriculumScheduler({"enabled": True, "min_difficulty": 10, "max_difficulty": 100,
+                                 "schedule_type": "fixed_linear",
+                                 "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 10}})
+    sampler = DeepSpeedDataSampler(dataset_len=100, batch_size=4, difficulty_metric=metric,
+                                   curriculum_scheduler=sched, data_parallel_rank=0,
+                                   data_parallel_world_size=2)
+    it = iter(sampler)
+    first = next(it)
+    assert len(first) == 4
+    assert all(metric[i] <= 10 for i in first)  # early: only easy samples
+    for _ in range(20):
+        last = next(it)
+    assert max(metric[i] for i in last) > 10  # late: harder samples admitted
+
+
+def test_sampler_partitions_ranks():
+    sampler0 = DeepSpeedDataSampler(100, 4, data_parallel_rank=0, data_parallel_world_size=2,
+                                    shuffle=False)
+    sampler1 = DeepSpeedDataSampler(100, 4, data_parallel_rank=1, data_parallel_world_size=2,
+                                    shuffle=False)
+    b0, b1 = next(iter(sampler0)), next(iter(sampler1))
+    assert set(b0).isdisjoint(set(b1))
+
+
+# ---------------------------------------------------------------------------
+# random-LTD
+# ---------------------------------------------------------------------------
+def test_token_gather_scatter_roundtrip():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 16, 8)).astype(np.float32))
+    idx = random_token_drop(jax.random.PRNGKey(0), 2, 16, 6)
+    assert idx.shape == (2, 6)
+    assert bool(jnp.all(idx[:, 1:] >= idx[:, :-1]))  # sorted, causal-safe
+    kept = token_gather(x, idx)
+    back = token_scatter(x, kept, idx)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))  # identity scatter
+
+
+def test_apply_random_ltd_semantics():
+    """Processed tokens get layer_fn applied; dropped tokens pass through."""
+    x = jnp.ones((2, 8, 4))
+
+    out = apply_random_ltd(lambda t: t * 2.0, x, jax.random.PRNGKey(1), keep_len=3)
+    flat = np.asarray(out)
+    n_doubled = int((flat[:, :, 0] == 2.0).sum())
+    n_kept = int((flat[:, :, 0] == 1.0).sum())
+    assert n_doubled == 2 * 3 and n_kept == 2 * 5
+    # keep_len >= seq: full pass-through of layer_fn
+    full = apply_random_ltd(lambda t: t * 2.0, x, jax.random.PRNGKey(1), keep_len=8)
+    np.testing.assert_allclose(np.asarray(full), 2.0 * np.asarray(x))
+
+
+def test_random_ltd_scheduler_buckets():
+    from deepspeed_tpu.runtime.data_pipeline.config import RandomLTDConfig
+
+    sched = RandomLTDScheduler(RandomLTDConfig(
+        enabled=True,
+        random_ltd_schedule={"min_value": 64, "max_value": 512, "schedule_type": "fixed_linear",
+                             "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 64}}))
+    vals = {sched.update_seq(t) for t in range(0, 110, 10)}
+    assert vals <= {64, 128, 192, 256, 320, 384, 448, 512}  # quantized buckets
+    assert sched.update_seq(1000) == 512
+
+
+# ---------------------------------------------------------------------------
+# engine integration: seqlen curriculum
+# ---------------------------------------------------------------------------
+def test_engine_curriculum_seqlen_trains():
+    model = TransformerLM(TransformerConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                                            intermediate_size=64, max_seq_len=64, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+        "zero_optimization": {"stage": 1},
+        "curriculum_learning": {
+            "enabled": True,
+            "curriculum_type": "seqlen",
+            "min_difficulty": 16,
+            "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 16},
+        },
+        "tpu": {"mesh": {"data": 8}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    assert engine.curriculum_scheduler is not None
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, size=(8, 64), dtype=np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    # schedule reached max difficulty
+    assert engine.curriculum_scheduler.get_current_difficulty() == 64
+    assert losses[-1] < losses[0]
+
+    # the eager 3-call path must honor the curriculum too
+    engine.forward(batch)
+    engine.backward()
+    engine.step()
+    assert engine.curriculum_scheduler.get_current_difficulty() == 64
