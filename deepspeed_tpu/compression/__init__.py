@@ -1,0 +1,4 @@
+from .compress import init_compression, redundancy_clean, apply_compression
+from .config import get_compression_config, DeepSpeedCompressionConfig
+from .scheduler import CompressionScheduler
+from . import basic_layer

@@ -1,0 +1,123 @@
+"""Compression primitives.
+
+Reference ``deepspeed/compression/basic_layer.py`` (840 LoC) implements
+LinearLayer_Compress with in-module quantizers and pruning masks. Functional
+TPU redesign: each technique is a pure array transform — straight-through
+quantizers for QAT inside the jitted loss, and mask builders for pruning —
+applied to the param tree by ``compress.py``.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# quantizers (reference SymQuantizer / AsymQuantizer / TernaryQuantizer /
+# BinaryQuantizer in compression/utils.py)
+# ---------------------------------------------------------------------------
+def sym_quantize(x, bits: int = 8, groups: int = 1):
+    """Symmetric uniform fake-quantization (quantize-dequantize) with
+    per-group absmax scaling. Straight-through: use inside the loss with
+    ``ste`` for QAT."""
+    q_range = 2**(bits - 1) - 1
+    orig = x.shape
+    g = x.reshape(groups, -1)
+    scale = jnp.max(jnp.abs(g), axis=-1, keepdims=True) / q_range
+    scale = jnp.maximum(scale, 1e-10)
+    q = jnp.clip(jnp.round(g / scale), -q_range - 1, q_range)
+    return (q * scale).reshape(orig)
+
+
+def asym_quantize(x, bits: int = 8, groups: int = 1):
+    """Asymmetric (min/max) fake-quantization."""
+    levels = 2**bits - 1
+    orig = x.shape
+    g = x.reshape(groups, -1)
+    mn = jnp.min(g, axis=-1, keepdims=True)
+    mx = jnp.max(g, axis=-1, keepdims=True)
+    scale = jnp.maximum((mx - mn) / levels, 1e-10)
+    q = jnp.round((g - mn) / scale)
+    return (q * scale + mn).reshape(orig)
+
+
+def ternary_quantize(x, groups: int = 1):
+    """TernaryQuantizer: {-a, 0, +a} with a = mean|x| over the live set."""
+    orig = x.shape
+    g = x.reshape(groups, -1)
+    thres = 0.7 * jnp.mean(jnp.abs(g), axis=-1, keepdims=True)
+    mask = (jnp.abs(g) > thres).astype(g.dtype)
+    alpha = jnp.sum(jnp.abs(g) * mask, axis=-1, keepdims=True) / jnp.maximum(mask.sum(-1, keepdims=True), 1)
+    return (alpha * jnp.sign(g) * mask).reshape(orig)
+
+
+def binary_quantize(x, groups: int = 1):
+    """BinaryQuantizer: ±mean|x|."""
+    orig = x.shape
+    g = x.reshape(groups, -1)
+    alpha = jnp.mean(jnp.abs(g), axis=-1, keepdims=True)
+    return (alpha * jnp.sign(g)).reshape(orig)
+
+
+def ste(fake_quant_fn, x, *args, **kwargs):
+    """Straight-through estimator: forward quantized, backward identity
+    (reference autograd.Function backward pass-through)."""
+    return x + jax.lax.stop_gradient(fake_quant_fn(x, *args, **kwargs) - x)
+
+
+QUANTIZERS = {"symmetric": sym_quantize, "asymmetric": asym_quantize}
+
+
+def quantize_weight(x, bits: int = 8, groups: int = 1, quantization_type: str = "symmetric"):
+    if bits == 1:
+        return binary_quantize(x, groups)
+    if bits == 2:
+        return ternary_quantize(x, groups)
+    return QUANTIZERS[quantization_type](x, bits, groups)
+
+
+# ---------------------------------------------------------------------------
+# pruning masks (reference LinearLayer_Compress sparse/row/head/channel)
+# ---------------------------------------------------------------------------
+def sparse_pruning_mask(w, dense_ratio: float, method: str = "l1"):
+    """Unstructured mask keeping the top ``dense_ratio`` fraction by |w|
+    (method 'l1') or a random subset ('topk' uses |w| too; 'random' random)."""
+    k = max(1, int(round(w.size * dense_ratio)))
+    flat = jnp.abs(w).reshape(-1)
+    if method == "random":
+        scores = jax.random.uniform(jax.random.PRNGKey(0), flat.shape)
+    else:
+        scores = flat
+    thresh = jnp.sort(scores)[-k]
+    return (scores >= thresh).reshape(w.shape).astype(w.dtype)
+
+
+def row_pruning_mask(w, dense_ratio: float):
+    """Structured mask over output rows by row L1 norm (reference row
+    pruning; rows = axis 0)."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+    k = max(1, int(round(w.shape[0] * dense_ratio)))
+    thresh = jnp.sort(norms)[-k]
+    mask = (norms >= thresh).astype(w.dtype)
+    return mask.reshape((-1, ) + (1, ) * (w.ndim - 1))
+
+
+def channel_pruning_mask(w, dense_ratio: float):
+    """Structured mask over input channels (axis -1)."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    k = max(1, int(round(w.shape[-1] * dense_ratio)))
+    thresh = jnp.sort(norms)[-k]
+    mask = (norms >= thresh).astype(w.dtype)
+    return mask.reshape((1, ) * (w.ndim - 1) + (-1, ))
+
+
+def head_pruning_mask(w, dense_ratio: float, num_heads: int):
+    """Mask over attention heads: w is [hidden, num_heads*head_dim] (an
+    output projection's input, reference head pruning on attn outputs)."""
+    h = w.reshape(w.shape[0], num_heads, -1)
+    norms = jnp.sum(jnp.abs(h), axis=(0, 2))
+    k = max(1, int(round(num_heads * dense_ratio)))
+    thresh = jnp.sort(norms)[-k]
+    mask = (norms >= thresh).astype(w.dtype)
+    return jnp.repeat(mask, w.shape[1] // num_heads).reshape(1, -1)

@@ -1,0 +1,125 @@
+"""Compression application (reference ``deepspeed/compression/compress.py``:
+``init_compression`` rewrites nn modules into compressed variants;
+``redundancy_clean`` permanently applies masks/quantization at export).
+
+Functional TPU form: the "rewrite" is a transform over the param tree —
+``init_compression`` builds a ``CompressionScheduler`` describing which param
+paths get which technique; ``apply_compression(params, step)`` produces the
+compressed view (used in the loss for QAT / mask-training), and
+``redundancy_clean`` bakes the final masks/quantization into the stored
+params for export."""
+
+import fnmatch
+import re
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from . import basic_layer
+from .config import DeepSpeedCompressionConfig, get_compression_config
+from .scheduler import CompressionScheduler
+from ..utils.logging import logger
+
+
+def _match(path: str, patterns) -> bool:
+    for pat in patterns:
+        if fnmatch.fnmatch(path, pat):
+            return True
+        try:
+            if re.search(pat, path):
+                return True
+        except re.error:
+            pass  # pattern was glob-only (e.g. leading '*'), already tried
+    return False
+
+
+def _technique_plan(config: DeepSpeedCompressionConfig):
+    """[(technique_name, group_name, params, modules_patterns, offset)]"""
+    plan = []
+    for tech_name in ("weight_quantization", "sparse_pruning", "row_pruning", "head_pruning",
+                      "channel_pruning"):
+        tech = getattr(config, tech_name)
+        if not tech.enabled:
+            continue
+        offset = tech.schedule_offset
+        shared = tech.shared_parameters
+        for gname, group in tech.different_groups.items():
+            plan.append((tech_name, gname, {**shared, **group.params}, group.modules, offset))
+    if config.activation_quantization.enabled:
+        # activation quantization operates on forward intermediates, not
+        # weights — the model must call basic_layer.ste(asym_quantize, x, …)
+        # at its activation sites (reference rewrites the module forward);
+        # record it on the scheduler so models can query the config, and be
+        # loud that a weight-tree transform alone cannot honor it
+        logger.warning("activation_quantization enabled: apply it at model activation sites via "
+                       "compression.basic_layer (ste + asym/sym_quantize); it is not a weight transform")
+    return plan
+
+
+def _apply_one(tech_name, params_cfg, w):
+    if tech_name == "weight_quantization":
+        bits = int(params_cfg.get("target_bits", 8))
+        groups = int(params_cfg.get("quantization_groups", 1))
+        qtype = params_cfg.get("quantization_type", "symmetric")
+        return basic_layer.ste(basic_layer.quantize_weight, w, bits, groups, qtype) \
+            if params_cfg.get("quantize_weight_in_forward", True) else \
+            basic_layer.quantize_weight(w, bits, groups, qtype)
+    dense = float(params_cfg.get("dense_ratio", 0.5))
+    if tech_name == "sparse_pruning":
+        mask = basic_layer.sparse_pruning_mask(w, dense, params_cfg.get("method", "l1"))
+    elif tech_name == "row_pruning":
+        mask = basic_layer.row_pruning_mask(w, dense)
+    elif tech_name == "channel_pruning":
+        mask = basic_layer.channel_pruning_mask(w, dense)
+    elif tech_name == "head_pruning":
+        mask = basic_layer.head_pruning_mask(w, dense, int(params_cfg.get("num_heads", 1)))
+    else:
+        return w
+    return w * jax.lax.stop_gradient(mask)
+
+
+def init_compression(params, deepspeed_config, teacher_model=None, mpu=None):
+    """Build the compression scheduler for a param tree (reference
+    ``init_compression`` returns the rewritten model; here: (params,
+    scheduler) — params unchanged until apply/clean)."""
+    cfg = deepspeed_config if isinstance(deepspeed_config, DeepSpeedCompressionConfig) else \
+        get_compression_config(deepspeed_config if isinstance(deepspeed_config, dict) else {})
+    plan = _technique_plan(cfg)
+    n_matched = 0
+    from ..runtime.zero.partition import path_str
+
+    matched: Dict[str, list] = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        path = path_str(kp)
+        for tech_name, gname, pcfg, patterns, offset in plan:
+            if jnp.ndim(leaf) >= 2 and _match(path, patterns):
+                matched.setdefault(path, []).append((tech_name, pcfg, offset))
+                n_matched += 1
+    logger.info(f"init_compression: {len(plan)} technique groups, {n_matched} param matches")
+    scheduler = CompressionScheduler(matched)
+    scheduler.activation_quantization = cfg.activation_quantization  # model-side technique
+    return scheduler
+
+
+def apply_compression(params, scheduler: CompressionScheduler, step: int = 10**9):
+    """Compressed view of the params for techniques past their schedule
+    offset (QAT/mask-training forward)."""
+    from ..runtime.zero.partition import path_str
+
+    def transform(kp, leaf):
+        path = path_str(kp)
+        for tech_name, pcfg, offset in scheduler.matched.get(path, []):
+            if step >= offset:
+                leaf = _apply_one(tech_name, pcfg, leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(transform, params)
+
+
+def redundancy_clean(params, deepspeed_config, scheduler: CompressionScheduler = None):
+    """Bake compression into the stored params for export (reference
+    ``redundancy_clean`` folds masks/quantization into the state dict)."""
+    if scheduler is None:
+        scheduler = init_compression(params, deepspeed_config)
+    return apply_compression(params, scheduler)

@@ -1,0 +1,52 @@
+"""Compression configuration (reference ``deepspeed/compression/config.py`` +
+``constants.py``): the ``compression_training`` block with per-technique
+groups, each carrying ``shared_parameters`` and named ``different_groups``
+with ``modules`` patterns."""
+
+from typing import Any, Dict, List, Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class TechniqueGroup(DeepSpeedConfigModel):
+    """One entry of ``different_groups`` (reference group schema)."""
+    params: Dict[str, Any] = Field(default_factory=dict)
+    modules: List[str] = Field(default_factory=lambda: ["*"])
+    related_modules: Optional[List[str]] = None
+
+
+class TechniqueConfig(DeepSpeedConfigModel):
+    """A technique block: weight_quantization / sparse_pruning / …"""
+    shared_parameters: Dict[str, Any] = Field(default_factory=dict)
+    different_groups: Dict[str, TechniqueGroup] = Field(default_factory=dict)
+
+    @property
+    def enabled(self):
+        return bool(self.shared_parameters.get("enabled", False))
+
+    @property
+    def schedule_offset(self):
+        return int(self.shared_parameters.get("schedule_offset", 0))
+
+
+class LayerReductionConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    keep_number_layer: Optional[int] = None
+    teacher_layer: List[int] = Field(default_factory=list)
+    other_module_name: List[str] = Field(default_factory=list)
+
+
+class DeepSpeedCompressionConfig(DeepSpeedConfigModel):
+    layer_reduction: LayerReductionConfig = Field(default_factory=LayerReductionConfig)
+    weight_quantization: TechniqueConfig = Field(default_factory=TechniqueConfig)
+    activation_quantization: TechniqueConfig = Field(default_factory=TechniqueConfig)
+    sparse_pruning: TechniqueConfig = Field(default_factory=TechniqueConfig)
+    row_pruning: TechniqueConfig = Field(default_factory=TechniqueConfig)
+    head_pruning: TechniqueConfig = Field(default_factory=TechniqueConfig)
+    channel_pruning: TechniqueConfig = Field(default_factory=TechniqueConfig)
+
+
+def get_compression_config(param_dict: dict) -> DeepSpeedCompressionConfig:
+    return DeepSpeedCompressionConfig(**param_dict.get("compression_training", {}))

@@ -1,0 +1,24 @@
+"""Compression scheduler (reference ``deepspeed/compression/scheduler.py``:
+tracks which technique applies to which module from which step)."""
+
+from typing import Dict, List, Tuple
+
+
+class CompressionScheduler:
+
+    def __init__(self, matched: Dict[str, List[Tuple[str, dict, int]]]):
+        #: {param_path: [(technique, params, schedule_offset_step), ...]}
+        self.matched = matched
+
+    def active_techniques(self, step: int):
+        out = {}
+        for path, entries in self.matched.items():
+            live = [(t, p) for t, p, offset in entries if step >= offset]
+            if live:
+                out[path] = live
+        return out
+
+    def check_sparse_pruning_before_backward(self, step: int):
+        """Reference hook name; mask freshness is handled functionally in
+        apply_compression so this is a no-op kept for API parity."""
+        return self.active_techniques(step)

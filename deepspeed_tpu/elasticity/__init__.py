@@ -1,0 +1,4 @@
+from .elasticity import (compute_elastic_config, get_compatible_gpus_v01, get_compatible_gpus_v02,
+                         elasticity_enabled, ensure_immutable_elastic_config, ElasticityError,
+                         ElasticityConfigError, ElasticityIncompatibleWorldSize)
+from .elastic_agent import ElasticAgent

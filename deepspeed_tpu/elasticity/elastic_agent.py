@@ -1,0 +1,71 @@
+"""Elastic training agent.
+
+Reference ``elasticity/elastic_agent.py:28`` ``DSElasticAgent`` extends
+torch-elastic's ``LocalElasticAgent``: on worker failure within
+``max_restarts`` it re-rendezvous and restarts workers, letting the batch
+math re-resolve for the surviving world size.
+
+TPU analog: slice membership is fixed per jax.distributed init, so elasticity
+means *restart the step loop on a re-initialized mesh* — the agent wraps the
+user's train function, detects device/process loss (RuntimeError from a dead
+ICI peer), recomputes the elastic batch config for the new chip count, and
+re-invokes with checkpoint resume. The checkpoint-based resume is exactly the
+recovery path the reference uses, minus torch-elastic's rendezvous store
+(jax.distributed re-init plays that role)."""
+
+import time
+from typing import Callable, Optional
+
+from .elasticity import compute_elastic_config, ElasticityIncompatibleWorldSize
+from ..utils.logging import logger
+
+
+class ElasticAgent:
+
+    def __init__(self, ds_config: dict, max_restarts: int = 3, restart_delay_s: float = 5.0):
+        self.ds_config = ds_config
+        self.max_restarts = max_restarts
+        self.restart_delay_s = restart_delay_s
+        self.restart_count = 0
+
+    def resolve_batch_config(self, world_size: int):
+        """New (train_batch, micro_batch) for the current chip count. dp is
+        the number of model replicas (world / mp / pp) — the v0.2 micro batch
+        is chosen for that dp, so gas must use it too."""
+        batch, _valid, micro = compute_elastic_config(self.ds_config, world_size=world_size,
+                                                      return_microbatch=True)
+        ec = self.ds_config.get("elasticity", {})
+        mp = int(ec.get("model_parallel_size", 1)) * int(ec.get("pipe_parallel_size", 1))
+        dp = max(1, world_size // mp)
+        gas = batch // (micro * dp)
+        assert micro * gas * dp == batch, \
+            f"inconsistent elastic config: {micro}*{gas}*{dp} != {batch}"
+        return {"train_batch_size": batch, "train_micro_batch_size_per_gpu": micro,
+                "gradient_accumulation_steps": gas}
+
+    def run(self, train_fn: Callable[[dict], None], world_size_fn: Optional[Callable[[], int]] = None):
+        """Invoke ``train_fn(batch_config)`` with elastic restarts (reference
+        ``_invoke_run:118`` polling loop collapsed to exception-driven
+        restarts — XLA surfaces peer loss as a RuntimeError)."""
+        if world_size_fn is None:
+            import jax
+
+            world_size_fn = lambda: len(jax.devices())
+        while True:
+            world = world_size_fn()
+            try:
+                cfg = self.resolve_batch_config(world)
+            except ElasticityIncompatibleWorldSize as e:
+                raise RuntimeError(f"no elastic config for world size {world}: {e}")
+            logger.info(f"elastic agent: starting with world={world} config={cfg} "
+                        f"(restart {self.restart_count}/{self.max_restarts})")
+            try:
+                return train_fn(cfg)
+            except RuntimeError as e:
+                self.restart_count += 1
+                if self.restart_count > self.max_restarts:
+                    logger.error(f"elastic agent: exceeded {self.max_restarts} restarts; giving up")
+                    raise
+                logger.warning(f"elastic agent: worker failure ({e}); re-resolving in "
+                               f"{self.restart_delay_s}s")
+                time.sleep(self.restart_delay_s)

@@ -1,0 +1,145 @@
+"""Elastic batch-size math (reference ``deepspeed/elasticity/elasticity.py``:
+``_get_compatible_gpus_v01:83`` / ``_get_compatible_gpus_v02:126`` /
+``compute_elastic_config:233``).
+
+Given a max global batch, the set of allowed micro-batch sizes and a chip
+range, enumerate the (global batch, chip-count) combinations that keep
+batch = micro * gas * chips exact — so a job restarted on a different slice
+size picks a new valid batch without changing the effective math. v0.2 adds
+the model-parallel-aware variant: chips are consumed in groups of
+mp_size * pp_size (the TPU analog: devices per model replica)."""
+
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityError(Exception):
+    """Base error (reference same name)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int], min_valid_gpus: int,
+                   max_valid_gpus: int) -> List[int]:
+    """All chip counts that evenly tile batch_size with some micro batch
+    (reference ``_get_valid_gpus``)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb != 0:
+            continue
+        max_gpus = batch_size // mb
+        for i in range(1, max_gpus + 1):
+            if max_gpus % i == 0 and min_valid_gpus <= i <= max_valid_gpus:
+                valid.add(i)
+    return sorted(valid)
+
+
+def get_compatible_gpus_v01(micro_batches: List[int],
+                            max_acceptable_batch_size: int,
+                            min_gpus: int = 1,
+                            max_gpus: int = 10000,
+                            prefer_larger: bool = True) -> Tuple[int, List[int]]:
+    """v0.1 (reference :83): pick the batch size <= max with the most valid
+    chip counts (ties broken toward larger/smaller batch per prefer_larger)."""
+    if not micro_batches:
+        raise ElasticityConfigError("micro_batches must be non-empty")
+    # candidates are micro * 2^k ladders (reference :98-104) — power-of-two
+    # scaling keeps the valid chip sets aligned with slice sizes
+    candidates = set()
+    for mb in micro_batches:
+        b = mb
+        while b <= max_acceptable_batch_size:
+            candidates.add(b)
+            b *= 2
+    candidate_batch_sizes = sorted(candidates)
+    best_batch, best_gpus = None, []
+    for batch in (reversed(candidate_batch_sizes) if prefer_larger else candidate_batch_sizes):
+        gpus = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        if len(gpus) > len(best_gpus):
+            best_batch, best_gpus = batch, gpus
+    if best_batch is None:
+        raise ElasticityConfigError(
+            f"no valid batch <= {max_acceptable_batch_size} for micro batches {micro_batches}")
+    return best_batch, best_gpus
+
+
+def get_compatible_gpus_v02(micro_batches: List[int],
+                            max_acceptable_batch_size: int,
+                            current_num_gpus: int,
+                            min_gpus: int = 1,
+                            max_gpus: int = 10000,
+                            prefer_larger: bool = True,
+                            num_gpus_per_node: int = 1,
+                            model_parallel_size: int = 1) -> Tuple[int, List[int], int]:
+    """v0.2 (reference :126): chips are consumed in model-replica groups of
+    ``model_parallel_size``; returns (batch, valid dp counts, micro batch)."""
+    if current_num_gpus % model_parallel_size != 0:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {current_num_gpus} not divisible by model parallel size {model_parallel_size}")
+    dp_size = current_num_gpus // model_parallel_size
+    batch, valid_dp = get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
+                                              max(1, min_gpus // model_parallel_size),
+                                              max(1, max_gpus // model_parallel_size), prefer_larger)
+    if dp_size not in valid_dp:
+        raise ElasticityIncompatibleWorldSize(
+            f"dp size {dp_size} (world {current_num_gpus} / mp {model_parallel_size}) not in valid set {valid_dp}")
+    mbs = _micro_batch_for(batch, dp_size, micro_batches, prefer_larger)
+    return batch, valid_dp, mbs
+
+
+def _micro_batch_for(batch, dp_size, micro_batches, prefer_larger):
+    options = [mb for mb in micro_batches if batch % (mb * dp_size) == 0]
+    if not options:
+        raise ElasticityIncompatibleWorldSize(f"no micro batch fits batch={batch} dp={dp_size}")
+    return max(options) if prefer_larger else min(options)
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict, requested):
+    """Reference guard: the scheduler-time elastic config must match the
+    runtime one, else restarts silently change batch math."""
+    if runtime_elastic_config_dict != requested:
+        raise ElasticityConfigError("elastic config changed between scheduling and runtime")
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = "0", world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Reference ``compute_elastic_config:233``: resolve the final
+    (batch, valid chip counts[, micro batch]) from a user config dict."""
+    ec = dict(ds_config.get("elasticity", {}))
+    if not ec.get("enabled", False):
+        raise ElasticityConfigError("elasticity not enabled in config")
+    version = float(ec.get("version", LATEST_ELASTICITY_VERSION))
+    micro_batches = list(ec.get("micro_batch_sizes", [2, 4, 6]))
+    max_batch = int(ec.get("max_train_batch_size", 2000))
+    min_gpus, max_gpus = int(ec.get("min_gpus", 1)), int(ec.get("max_gpus", 10000))
+    prefer_larger = bool(ec.get("prefer_larger_batch_size", True))
+
+    if version >= 0.2 and world_size > 0:
+        mp = int(ec.get("model_parallel_size", 1)) * int(ec.get("pipe_parallel_size", 1))
+        batch, valid_dp, mbs = get_compatible_gpus_v02(micro_batches, max_batch, world_size,
+                                                       min_gpus, max_gpus, prefer_larger,
+                                                       model_parallel_size=mp)
+        logger.info(f"elasticity v{version}: batch={batch} valid_dp={valid_dp} micro={mbs}")
+        return (batch, valid_dp, mbs) if return_microbatch else (batch, valid_dp)
+
+    batch, valid = get_compatible_gpus_v01(micro_batches, max_batch, min_gpus, max_gpus, prefer_larger)
+    if world_size > 0 and world_size not in valid:
+        raise ElasticityIncompatibleWorldSize(f"world size {world_size} not in valid set {valid}")
+    if return_microbatch:
+        mbs = _micro_batch_for(batch, world_size or valid[-1], micro_batches, prefer_larger)
+        return batch, valid, mbs
+    return batch, valid

@@ -1,0 +1,84 @@
+"""Environment / op-compatibility report (reference ``deepspeed/env_report.py``
+— the ``ds_report`` CLI: versions + a matrix of which native ops are
+installed/compatible)."""
+
+import importlib
+import shutil
+import subprocess
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+NO = f"{RED}[NO]{END}"
+
+
+def op_report():
+    """Rows of (op name, available) for every registered op builder."""
+    from .ops import op_registry
+
+    rows = []
+    for name, builder in sorted(op_registry.items()):
+        rows.append((name, builder.is_compatible()))
+    # native toolchain entries (the reference reports nvcc/torch cuda here)
+    from .ops.native import is_available as native_ok
+
+    rows.append(("native toolchain (g++)", native_ok()))
+    return rows
+
+
+def version_report():
+    rows = []
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            m = importlib.import_module(mod)
+            rows.append((mod, getattr(m, "__version__", "?")))
+        except ImportError:
+            rows.append((mod, None))
+    return rows
+
+
+def device_report():
+    import jax
+
+    try:
+        devs = jax.devices()
+        return {
+            "platform": devs[0].platform if devs else "none",
+            "device_count": len(devs),
+            "process_count": jax.process_count(),
+            "devices": [str(d) for d in devs[:8]],
+        }
+    except Exception as e:  # no backend available
+        return {"platform": f"unavailable ({e})", "device_count": 0, "process_count": 0, "devices": []}
+
+
+def main(hide_operator_status=False, hide_errors_and_warnings=False):
+    import deepspeed_tpu
+
+    print("-" * 64)
+    print("DeepSpeed-TPU C++/Pallas op report")
+    print("-" * 64)
+    if not hide_operator_status:
+        for name, ok in op_report():
+            print(f"{name:.<40} {OKAY if ok else NO}")
+    print("-" * 64)
+    print("DeepSpeed-TPU general environment info:")
+    for mod, ver in version_report():
+        print(f"{mod:.<40} {ver if ver else NO}")
+    print(f"{'deepspeed_tpu':.<40} {deepspeed_tpu.__version__}")
+    dev = device_report()
+    print(f"{'platform':.<40} {dev['platform']}")
+    print(f"{'device_count':.<40} {dev['device_count']}")
+    print(f"{'process_count':.<40} {dev['process_count']}")
+    print("-" * 64)
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

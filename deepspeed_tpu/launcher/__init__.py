@@ -1,0 +1,2 @@
+from .runner import fetch_hostfile, parse_inclusion_exclusion, parse_resource_filter, encode_world_info
+from .multinode_runner import MultiNodeRunner, SSHRunner, OpenMPIRunner

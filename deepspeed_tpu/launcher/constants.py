@@ -1,0 +1,16 @@
+"""Launcher constants (reference ``deepspeed/launcher/constants.py``)."""
+
+PDSH_LAUNCHER = "pdsh"
+SSH_LAUNCHER = "ssh"
+OPENMPI_LAUNCHER = "openmpi"
+SLURM_LAUNCHER = "slurm"
+
+DEFAULT_MASTER_PORT = 29500
+DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed default service port
+
+# env vars exported to every worker process (the TPU analog of the
+# RANK/LOCAL_RANK/WORLD_SIZE/MASTER_* set the reference exports, launch.py)
+ENV_COORDINATOR_ADDRESS = "DS_TPU_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "DS_TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "DS_TPU_PROCESS_ID"
+ENV_WORLD_INFO = "DS_TPU_WORLD_INFO"

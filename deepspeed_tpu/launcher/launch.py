@@ -1,0 +1,89 @@
+"""Per-node launch (reference ``deepspeed/launcher/launch.py``).
+
+The reference forks one process per local device and sets
+RANK/LOCAL_RANK/WORLD_SIZE. TPU inverts this: ONE process per host drives all
+local chips, and jax.distributed wires hosts together — so this module builds
+the env (coordinator address, process count, process id) and execs the user
+script once. Slot filters narrow chip visibility via TPU_VISIBLE_CHIPS.
+"""
+
+import os
+import shlex
+import subprocess
+import sys
+
+from .constants import (ENV_COORDINATOR_ADDRESS, ENV_NUM_PROCESSES, ENV_PROCESS_ID, ENV_WORLD_INFO)
+from .runner import decode_world_info
+
+
+def build_worker_env(world_info_b64, master_addr, master_port, process_id):
+    """Env for one host's worker process."""
+    world = decode_world_info(world_info_b64)
+    hosts = list(world)
+    env = {
+        ENV_WORLD_INFO: world_info_b64,
+        ENV_COORDINATOR_ADDRESS: f"{master_addr}:{master_port}",
+        ENV_NUM_PROCESSES: str(len(hosts)),
+        ENV_PROCESS_ID: str(process_id),
+    }
+    host = hosts[process_id]
+    slots = world[host]
+    # world_info carries only the ACTIVE slots, so the host's full chip count
+    # is unknown here — always export the visibility filter; a full list is
+    # harmless and a prefix selection ([0,1] of 4 chips) must still narrow
+    if slots:
+        env["TPU_VISIBLE_CHIPS"] = ",".join(str(s) for s in slots)
+    return env
+
+
+def build_local_cmd(args, world_info_b64, master_addr):
+    """Single-node command + env (runner main single-node path)."""
+    env = build_worker_env(world_info_b64, master_addr, args.master_port, process_id=0)
+    cmd = [sys.executable, args.user_script, *args.user_args]
+    return cmd, env
+
+
+def maybe_init_distributed():
+    """Called by user scripts (or deepspeed_tpu.initialize) to join the pod:
+    reads the launcher env and calls jax.distributed.initialize when the
+    launcher provided multi-host coordinates."""
+    addr = os.environ.get(ENV_COORDINATOR_ADDRESS)
+    n = int(os.environ.get(ENV_NUM_PROCESSES, "1"))
+    pid = int(os.environ.get(ENV_PROCESS_ID, "0"))
+    if addr and n > 1:
+        import jax
+
+        jax.distributed.initialize(coordinator_address=addr, num_processes=n, process_id=pid)
+        return True
+    return False
+
+
+def main():
+    """Exec the user script with the worker env (invoked on each node by the
+    multinode runner: ``python -m deepspeed_tpu.launcher.launch --world_info=…
+    --node_rank=… -- script.py args``)."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--world_info", required=True)
+    p.add_argument("--node_rank", type=int, required=True)
+    p.add_argument("--master_addr", required=True)
+    p.add_argument("--master_port", type=int, required=True)
+    p.add_argument("script_and_args", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+
+    rest = args.script_and_args
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if args.node_rank < 0:  # OpenMPI runner: rank comes from the MPI env
+        args.node_rank = int(os.environ.get("OMPI_COMM_WORLD_RANK", "0"))
+    env = dict(os.environ)
+    env.update(build_worker_env(args.world_info, args.master_addr, args.master_port, args.node_rank))
+    cmd = [sys.executable, *rest]
+    print(f"[launch] node {args.node_rank}: {' '.join(map(shlex.quote, cmd))}", flush=True)
+    result = subprocess.run(cmd, env=env)
+    sys.exit(result.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,179 @@
+"""Launcher entry (reference ``deepspeed/launcher/runner.py:392`` main).
+
+``dstpu [--hostfile F] [--include/--exclude SPEC] [--num_nodes N]
+       [--master_addr A] [--master_port P] script.py args...``
+
+Semantics track the reference: the hostfile lists ``hostname slots=N`` lines
+(slots = TPU chips on that host); ``--include``/``--exclude`` filter hosts and
+slot indices with the reference's ``host:slot@host2:slot`` syntax; a
+multinode runner fans the per-node launch command out over ssh (the PDSH-
+runner analog — TPU pods are provisioned with ssh access between workers).
+Single-host runs exec ``launch.py`` directly.
+
+TPU-specific: one *process per host* drives all local chips (JAX single-
+controller), so WORLD_SIZE counts hosts, and per-host chip visibility is
+narrowed with TPU_VISIBLE_CHIPS when a slot filter is present.
+"""
+
+import argparse
+import base64
+import collections
+import json
+import os
+import shlex
+import subprocess
+import sys
+
+from .constants import (DEFAULT_COORDINATOR_PORT, ENV_WORLD_INFO, SSH_LAUNCHER, OPENMPI_LAUNCHER)
+from ..utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(description="deepspeed_tpu launcher")
+    parser.add_argument("-H", "--hostfile", type=str, default="/job/hostfile",
+                        help="hostfile of 'hostname slots=N' lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="inclusion filter, e.g. 'worker-0@worker-1:0,2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="exclusion filter, e.g. 'worker-1:0'")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", dest="num_gpus", type=int, default=-1)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--master_port", type=int, default=DEFAULT_COORDINATOR_PORT)
+    parser.add_argument("--launcher", type=str, default=SSH_LAUNCHER, choices=[SSH_LAUNCHER, OPENMPI_LAUNCHER])
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse ``hostname slots=N`` lines (reference ``fetch_hostfile``).
+    Returns OrderedDict {hostname: slot_count} or None if missing."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resource_pool = collections.OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                key, slot_count = slots.split("=")
+                if key != "slots":
+                    raise ValueError
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(f"Hostfile contains a bad entry: {line!r}")
+            if hostname in resource_pool:
+                raise ValueError(f"Hostfile contains multiple entries for {hostname}")
+            resource_pool[hostname] = slot_count
+    if not resource_pool:
+        raise ValueError(f"Hostfile '{hostfile_path}' is empty or formatted incorrectly")
+    return resource_pool
+
+
+def _parse_hosts_string(spec):
+    """'host1:0,2@host2' → {host1: [0,2], host2: []} ([] = all slots)."""
+    mapping = {}
+    for term in filter(None, spec.split("@")):
+        if ":" in term:
+            host, slots = term.split(":")
+            mapping[host] = [int(s) for s in slots.split(",")]
+        else:
+            mapping[term] = []
+    return mapping
+
+
+def parse_resource_filter(resource_pool, include_str="", exclude_str=""):
+    """Apply include/exclude filters (reference ``parse_resource_filter``):
+    returns {hostname: [slot indices]}. Only one of include/exclude may be
+    non-empty, matching the reference's contract."""
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive")
+    pool = {host: list(range(n)) for host, n in resource_pool.items()}
+    if include_str:
+        included = _parse_hosts_string(include_str)
+        out = {}
+        for host, slots in included.items():
+            if host not in pool:
+                raise ValueError(f"include host {host} not in resource pool")
+            use = slots or pool[host]
+            bad = [s for s in use if s not in pool[host]]
+            if bad:
+                raise ValueError(f"include slots {bad} not available on {host}")
+            out[host] = sorted(use)
+        return out
+    if exclude_str:
+        excluded = _parse_hosts_string(exclude_str)
+        for host, slots in excluded.items():
+            if host not in pool:
+                raise ValueError(f"exclude host {host} not in resource pool")
+            bad = [s for s in slots if s not in pool[host]]
+            if bad:
+                raise ValueError(f"exclude slots {bad} not available on {host}")
+        out = {}
+        for host, slots in pool.items():
+            if host in excluded:
+                drop = excluded[host] or slots
+                keep = [s for s in slots if s not in drop]
+                if keep:
+                    out[host] = keep
+            else:
+                out[host] = slots
+        return out
+    return pool
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    """Reference wrapper of the same name."""
+    return parse_resource_filter(dict(resource_pool), include_str=inclusion, exclude_str=exclusion)
+
+
+def encode_world_info(active_resources):
+    """base64 json of {host: [slots]} (reference ``encode_world_info``)."""
+    return base64.urlsafe_b64encode(json.dumps(active_resources).encode()).decode()
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if resource_pool is None:
+        # single-node: run launch.py locally over all visible chips
+        resource_pool = {"localhost": args.num_gpus if args.num_gpus > 0 else 0}
+
+    active = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = dict(list(active.items())[:args.num_nodes])
+    if not active:
+        raise RuntimeError("no resources left after include/exclude filters")
+
+    multi_node = len(active) > 1 or args.force_multi
+    master_addr = args.master_addr or next(iter(active))
+    world_info = encode_world_info(active)
+
+    if not multi_node:
+        from .launch import build_local_cmd
+
+        cmd, env = build_local_cmd(args, world_info, master_addr)
+        logger.info(f"launching: {' '.join(map(shlex.quote, cmd))}")
+        os.environ.update(env)
+        result = subprocess.run(cmd)
+        sys.exit(result.returncode)
+
+    from .multinode_runner import OpenMPIRunner, SSHRunner
+
+    runner_cls = {SSH_LAUNCHER: SSHRunner, OPENMPI_LAUNCHER: OpenMPIRunner}[args.launcher]
+    runner = runner_cls(args, world_info, master_addr, args.master_port)
+    sys.exit(runner.launch(active))
+
+
+if __name__ == "__main__":
+    main()

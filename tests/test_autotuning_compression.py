@@ -1,0 +1,160 @@
+"""Autotuner + compression tests (mirrors reference tests/unit/autotuning/
+and tests/unit/compression/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+
+# ---------------------------------------------------------------------------
+# autotuner
+# ---------------------------------------------------------------------------
+def _model_factory():
+    return TransformerLM(TransformerConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                                           intermediate_size=64, max_seq_len=32, dtype=jnp.float32,
+                                           attention_impl="reference"))
+
+
+def _batch_factory(global_batch):
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, 128, size=(global_batch, 32), dtype=np.int32)}
+
+
+def _base_config():
+    return {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "tpu": {"mesh": {"data": 8}},
+    }
+
+
+def test_autotuner_model_mode():
+    from deepspeed_tpu.autotuning import Autotuner
+
+    tuner = Autotuner(_model_factory, _base_config(), _batch_factory)
+    best = tuner.tune(zero_stages=(0, 1), micro_batches=(1, 2), mode="model")
+    assert best.fits
+    # prefers the largest fitting micro batch, then the lowest stage
+    assert best.config["train_micro_batch_size_per_gpu"] == 2
+    assert best.config["zero_optimization"]["stage"] == 0
+    assert len(tuner.results) == 4
+    assert all(r.peak_bytes is None or r.peak_bytes > 0 for r in tuner.results)
+
+
+def test_autotuner_memory_budget_filters():
+    from deepspeed_tpu.autotuning import Autotuner
+
+    tuner = Autotuner(_model_factory, _base_config(), _batch_factory, hbm_budget_bytes=1)
+    with pytest.raises(RuntimeError, match="no config that fits"):
+        tuner.tune(zero_stages=(0, ), micro_batches=(1, ), mode="model")
+
+
+def test_autotuner_measure_mode():
+    from deepspeed_tpu.autotuning import Autotuner
+
+    tuner = Autotuner(_model_factory, _base_config(), _batch_factory)
+    best = tuner.tune(zero_stages=(1, ), micro_batches=(1, ), mode="measure", num_steps=2)
+    assert best.measured_tokens_per_s and best.measured_tokens_per_s > 0
+
+
+# ---------------------------------------------------------------------------
+# compression primitives
+# ---------------------------------------------------------------------------
+def test_sym_quantize_levels():
+    from deepspeed_tpu.compression.basic_layer import sym_quantize
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 64)).astype(np.float32))
+    q = sym_quantize(w, bits=4, groups=4)
+    # 4-bit symmetric: at most 16 distinct levels per group
+    for g in np.split(np.asarray(q).reshape(4, -1), 4):
+        assert len(np.unique(g)) <= 16
+    # dequantized values stay close for 8-bit
+    q8 = sym_quantize(w, bits=8, groups=1)
+    assert float(jnp.abs(q8 - w).max()) < 0.05
+
+
+def test_ste_gradient_passthrough():
+    from deepspeed_tpu.compression.basic_layer import ste, sym_quantize
+
+    w = jnp.linspace(-1, 1, 32)
+    g = jax.grad(lambda x: jnp.sum(ste(sym_quantize, x, 4, 1)**2))(w)
+    # straight-through: gradient is 2*q but flows through unquantized path
+    assert np.isfinite(np.asarray(g)).all() and float(jnp.abs(g).sum()) > 0
+
+
+def test_pruning_masks():
+    from deepspeed_tpu.compression.basic_layer import (channel_pruning_mask, row_pruning_mask,
+                                                       sparse_pruning_mask)
+
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((16, 8)).astype(np.float32))
+    m = sparse_pruning_mask(w, 0.25)
+    assert 0.2 <= float(m.mean()) <= 0.3
+    # kept entries are the largest |w|
+    kept = np.abs(np.asarray(w))[np.asarray(m) > 0]
+    dropped = np.abs(np.asarray(w))[np.asarray(m) == 0]
+    assert kept.min() >= dropped.max() - 1e-6
+
+    rm = row_pruning_mask(w, 0.5)
+    assert rm.shape == (16, 1) and int(np.asarray(rm).sum()) == 8
+    cm = channel_pruning_mask(w, 0.5)
+    assert cm.shape == (1, 8) and int(np.asarray(cm).sum()) == 4
+
+
+def test_binary_ternary():
+    from deepspeed_tpu.compression.basic_layer import binary_quantize, ternary_quantize
+
+    w = jnp.asarray(np.random.default_rng(2).standard_normal(256).astype(np.float32))
+    b = np.asarray(binary_quantize(w))
+    assert len(np.unique(b)) == 2
+    t = np.asarray(ternary_quantize(w))
+    assert len(np.unique(t)) <= 3 and 0.0 in np.unique(t)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end compression flow
+# ---------------------------------------------------------------------------
+def test_init_apply_redundancy_clean():
+    from deepspeed_tpu.compression import apply_compression, init_compression, redundancy_clean
+
+    rng = np.random.default_rng(3)
+    params = {
+        "layer1": {"kernel": jnp.asarray(rng.standard_normal((32, 32)).astype(np.float32)),
+                   "bias": jnp.zeros(32)},
+        "layer2": {"kernel": jnp.asarray(rng.standard_normal((32, 32)).astype(np.float32))},
+    }
+    config = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5, "quantization_type": "symmetric"},
+            "different_groups": {"wq1": {"params": {"target_bits": 4, "quantization_groups": 2},
+                                         "modules": ["layer1.*"]}},
+        },
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0, "method": "l1"},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.5}, "modules": ["layer2.*"]}},
+        },
+    }}
+    sched = init_compression(params, config)
+    assert "layer1/kernel" in sched.matched and "layer2/kernel" in sched.matched
+    # leading-* glob patterns must not crash the regex fallback
+    from deepspeed_tpu.compression.compress import _match
+
+    assert _match("layers/0/query/kernel", ["*query*"])
+    assert not _match("layers/0/mlp/kernel", ["*query*"])
+    assert "layer1/bias" not in sched.matched  # rank-1 params untouched
+
+    # before the quantization offset only pruning is active
+    early = apply_compression(params, sched, step=0)
+    assert np.array_equal(np.asarray(early["layer1"]["kernel"]), np.asarray(params["layer1"]["kernel"]))
+    assert float((np.asarray(early["layer2"]["kernel"]) == 0).mean()) >= 0.45
+
+    late = apply_compression(params, sched, step=10)
+    assert not np.array_equal(np.asarray(late["layer1"]["kernel"]), np.asarray(params["layer1"]["kernel"]))
+
+    cleaned = redundancy_clean(params, config)
+    assert float((np.asarray(cleaned["layer2"]["kernel"]) == 0).mean()) >= 0.45

@@ -1,0 +1,182 @@
+"""Launcher + elasticity tests — hostfile parsing, include/exclude filters,
+runner command construction, elastic batch math (mirrors the reference
+tests/unit/launcher/ and tests/unit/elasticity/)."""
+
+import base64
+import json
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (decode_world_info, encode_world_info, fetch_hostfile,
+                                           parse_args, parse_inclusion_exclusion)
+from deepspeed_tpu.launcher.multinode_runner import OpenMPIRunner, SSHRunner
+from deepspeed_tpu.launcher import launch
+from deepspeed_tpu.elasticity import (ElasticityConfigError, ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config, get_compatible_gpus_v01)
+from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+
+
+# ---------------------------------------------------------------------------
+# hostfile + filters
+# ---------------------------------------------------------------------------
+def test_fetch_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("# comment\nworker-0 slots=4\nworker-1 slots=8\n\n")
+    pool = fetch_hostfile(str(hf))
+    assert pool == {"worker-0": 4, "worker-1": 8}
+    assert fetch_hostfile(str(tmp_path / "missing")) is None
+
+
+def test_fetch_hostfile_bad_entries(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=4\nworker-0 slots=2\n")
+    with pytest.raises(ValueError, match="multiple entries"):
+        fetch_hostfile(str(hf))
+    hf.write_text("worker-0 gpus=4\n")
+    with pytest.raises(ValueError, match="bad entry"):
+        fetch_hostfile(str(hf))
+
+
+def test_include_exclude_filters():
+    pool = {"w0": 4, "w1": 4, "w2": 2}
+    # include whole host + specific slots
+    act = parse_inclusion_exclusion(pool, "w0@w1:0,2", "")
+    assert act == {"w0": [0, 1, 2, 3], "w1": [0, 2]}
+    # exclude one host entirely + one slot elsewhere
+    act = parse_inclusion_exclusion(pool, "", "w2@w0:1")
+    assert act == {"w0": [0, 2, 3], "w1": [0, 1, 2, 3]}
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, "w0", "w1")  # mutually exclusive
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, "nope", "")
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, "", "w-typo")  # bad exclude host
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, "", "w2:5")  # bad exclude slot
+
+
+def test_world_info_roundtrip():
+    active = {"w0": [0, 1], "w1": [0]}
+    assert decode_world_info(encode_world_info(active)) == active
+
+
+# ---------------------------------------------------------------------------
+# runner command construction
+# ---------------------------------------------------------------------------
+def _args(extra=()):
+    return parse_args(["--master_port", "1234", *extra, "train.py", "--lr", "0.1"])
+
+
+def test_ssh_runner_cmds():
+    args = _args()
+    wi = encode_world_info({"w0": [0, 1], "w1": [0, 1]})
+    runner = SSHRunner(args, wi, master_addr="w0", master_port=1234)
+    cmds = runner.get_cmd({"w0": [0, 1], "w1": [0, 1]})
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and cmds[0][-2] == "w0"
+    assert "--node_rank=0" in cmds[0][-1] and "--node_rank=1" in cmds[1][-1]
+    assert "train.py" in cmds[0][-1] and "--lr 0.1" in cmds[0][-1]
+
+
+def test_openmpi_runner_cmd():
+    args = _args()
+    wi = encode_world_info({"w0": [0], "w1": [0], "w2": [0]})
+    runner = OpenMPIRunner(args, wi, master_addr="w0", master_port=1234)
+    (cmd, ) = runner.get_cmd({"w0": [0], "w1": [0], "w2": [0]})
+    assert cmd[:3] == ["mpirun", "-np", "3"]
+    assert "w0:1,w1:1,w2:1" in cmd
+    assert "train.py" in cmd
+
+
+def test_build_worker_env_slot_filter():
+    wi = encode_world_info({"w0": [0, 2], "w1": [0, 1]})
+    env = launch.build_worker_env(wi, "w0", 9999, process_id=0)
+    assert env["DS_TPU_COORDINATOR_ADDRESS"] == "w0:9999"
+    assert env["DS_TPU_NUM_PROCESSES"] == "2"
+    assert env["TPU_VISIBLE_CHIPS"] == "0,2"  # gapped selection
+    env1 = launch.build_worker_env(wi, "w0", 9999, process_id=1)
+    # prefix selections must narrow too (total chip count is unknown here)
+    assert env1["TPU_VISIBLE_CHIPS"] == "0,1"
+
+
+# ---------------------------------------------------------------------------
+# elasticity math (mirrors reference tests/unit/elasticity)
+# ---------------------------------------------------------------------------
+def test_get_compatible_gpus_v01():
+    batch, gpus = get_compatible_gpus_v01([2, 4, 6], max_acceptable_batch_size=2000,
+                                          min_gpus=1, max_gpus=10000)
+    # every valid chip count evenly tiles the chosen batch with some micro size
+    for g in gpus:
+        assert any(batch % (mb * g) == 0 for mb in [2, 4, 6])
+    assert batch <= 2000 and len(gpus) > 0
+
+
+def test_compute_elastic_config_v02():
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 1024,
+                                "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 512,
+                                "version": 0.2}}
+    batch, valid_dp, micro = compute_elastic_config(ds_config, world_size=8, return_microbatch=True)
+    assert 8 in valid_dp
+    assert batch % (micro * 8) == 0
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config, world_size=7, return_microbatch=True)
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+def test_elastic_agent_restarts():
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 128,
+                                "micro_batch_sizes": [2, 4], "version": 0.2}}
+    agent = ElasticAgent(ds_config, max_restarts=2, restart_delay_s=0.0)
+    worlds = iter([8, 8, 4])  # lose half the slice after two failures
+    calls = []
+
+    def train_fn(cfg):
+        calls.append(cfg)
+        if len(calls) < 3:
+            raise RuntimeError("peer lost")
+        return "done"
+
+    assert agent.run(train_fn, world_size_fn=lambda: next(worlds)) == "done"
+    assert len(calls) == 3
+    assert calls[0]["train_batch_size"] % (calls[0]["train_micro_batch_size_per_gpu"] * 8) == 0
+    assert calls[2]["train_batch_size"] % (calls[2]["train_micro_batch_size_per_gpu"] * 4) == 0
+
+
+def test_elastic_agent_gives_up():
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 128,
+                                "micro_batch_sizes": [2], "version": 0.2}}
+    agent = ElasticAgent(ds_config, max_restarts=1, restart_delay_s=0.0)
+
+    def always_fail(cfg):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        agent.run(always_fail, world_size_fn=lambda: 4)
+    assert agent.restart_count == 2  # initial + 1 restart, then give up
+
+
+# ---------------------------------------------------------------------------
+# env report + CLI smoke
+# ---------------------------------------------------------------------------
+def test_env_report_smoke(capsys):
+    from deepspeed_tpu import env_report
+
+    env_report.main()
+    out = capsys.readouterr().out
+    assert "op report" in out and "jax" in out
+
+
+def test_dstpu_single_node_launch(tmp_path):
+    """End-to-end: dstpu runner executes a script locally with launcher env."""
+    script = tmp_path / "probe.py"
+    script.write_text("import os\nprint('WI=' + os.environ.get('DS_TPU_WORLD_INFO', 'missing'))\n")
+    from deepspeed_tpu.launcher import runner
+
+    out = subprocess.run([sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+                          "--hostfile", str(tmp_path / "nope"), str(script)],
+                         capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    assert "WI=" in out.stdout and "missing" not in out.stdout
