@@ -58,14 +58,21 @@ def initialize(args=None,
     if callable(model) and not hasattr(model, "init"):
         model = _FunctionalModel(model, model_parameters)
 
-    engine = DeepSpeedEngine(model=model,
-                             config=ds_config,
-                             optimizer=optimizer,
-                             lr_scheduler=lr_scheduler,
-                             mesh=mesh,
-                             example_batch=example_batch,
-                             training_data=training_data,
-                             collate_fn=collate_fn)
+    # engine-class dispatch (reference deepspeed/__init__.py:156-196:
+    # DeepSpeedEngine / PipelineEngine / DeepSpeedHybridEngine)
+    engine_cls = DeepSpeedEngine
+    if ds_config.hybrid_engine_config.enabled:
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine_cls = DeepSpeedHybridEngine
+    engine = engine_cls(model=model,
+                        config=ds_config,
+                        optimizer=optimizer,
+                        lr_scheduler=lr_scheduler,
+                        mesh=mesh,
+                        example_batch=example_batch,
+                        training_data=training_data,
+                        collate_fn=collate_fn)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

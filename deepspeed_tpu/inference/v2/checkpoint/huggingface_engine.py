@@ -1,0 +1,219 @@
+"""HuggingFace checkpoint loading for inference.
+
+Reference ``inference/v2/checkpoint/huggingface_engine.py`` (the FastGen
+checkpoint engine iterating HF weights into the layer containers) +
+``engine_factory.build_hf_engine``. Here the containers are the stacked
+param pytree of ``models.transformer``: per-family name maps stack the
+per-layer HF tensors into [L, ...] arrays, transposing torch Linear weights
+([out, in]) into our [in, out] einsum layout. Supported families mirror the
+reference inventory (llama_v2, mistral, opt) plus gpt2.
+"""
+
+import json
+import os
+import re
+from typing import Dict, Iterator, Tuple
+
+import numpy as np
+
+from ....utils.logging import logger
+
+
+class HuggingFaceCheckpointEngine:
+    """Iterate (name, np.ndarray) weights from an HF model dir or hub name
+    (reference class of the same name: ``parameters()`` iterator)."""
+
+    def __init__(self, model_name_or_path: str, auth_token: str = None):
+        self.model_name_or_path = model_name_or_path
+        self._sd = None
+
+    def _load(self):
+        if self._sd is not None:
+            return self._sd
+        path = self.model_name_or_path
+        sd = {}
+        if os.path.isdir(path):
+            safes = [f for f in os.listdir(path) if f.endswith(".safetensors")]
+            bins = [f for f in os.listdir(path) if f.endswith(".bin")]
+            if safes:
+                from safetensors import safe_open
+
+                for f in sorted(safes):
+                    with safe_open(os.path.join(path, f), framework="np") as fh:
+                        for k in fh.keys():
+                            sd[k] = fh.get_tensor(k)
+            elif bins:
+                import torch
+
+                for f in sorted(bins):
+                    part = torch.load(os.path.join(path, f), map_location="cpu", weights_only=True)
+                    for k, v in part.items():
+                        sd[k] = v.float().numpy()
+            else:
+                raise FileNotFoundError(f"no .safetensors/.bin weights in {path}")
+        else:  # hub name → go through transformers
+            from transformers import AutoModelForCausalLM
+
+            model = AutoModelForCausalLM.from_pretrained(path)
+            sd = {k: v.detach().float().numpy() for k, v in model.state_dict().items()}
+        self._sd = sd
+        return sd
+
+    def parameters(self) -> Iterator[Tuple[str, np.ndarray]]:
+        yield from self._load().items()
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        return dict(self._load())
+
+    def model_config(self):
+        path = self.model_name_or_path
+        cfg_file = os.path.join(path, "config.json") if os.path.isdir(path) else None
+        if cfg_file and os.path.isfile(cfg_file):
+            with open(cfg_file) as f:
+                return json.load(f)
+        from transformers import AutoConfig
+
+        return AutoConfig.from_pretrained(path).to_dict()
+
+
+# ---------------------------------------------------------------------------
+# config mapping
+# ---------------------------------------------------------------------------
+def transformer_config_from_hf(hf_cfg: dict):
+    """HF config.json → TransformerConfig (the per-family policy lookup,
+    reference ``engine_factory.py`` model_type dispatch)."""
+    from ....models.transformer import TransformerConfig
+
+    mt = hf_cfg.get("model_type", "llama")
+    if mt in ("llama", "mistral"):
+        return TransformerConfig(
+            vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["hidden_size"],
+            num_layers=hf_cfg["num_hidden_layers"], num_heads=hf_cfg["num_attention_heads"],
+            num_kv_heads=hf_cfg.get("num_key_value_heads", hf_cfg["num_attention_heads"]),
+            intermediate_size=hf_cfg["intermediate_size"],
+            max_seq_len=hf_cfg.get("max_position_embeddings", 2048),
+            norm="rmsnorm", positions="rotary", mlp="swiglu", use_bias=False,
+            tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", False)),
+            rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+            norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5))), mt
+    if mt == "gpt2":
+        return TransformerConfig(
+            vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["n_embd"],
+            num_layers=hf_cfg["n_layer"], num_heads=hf_cfg["n_head"],
+            intermediate_size=4 * hf_cfg["n_embd"], max_seq_len=hf_cfg.get("n_positions", 1024),
+            norm="layernorm", positions="learned", mlp="gelu", use_bias=True,
+            tie_embeddings=True, norm_eps=float(hf_cfg.get("layer_norm_epsilon", 1e-5))), mt
+    if mt == "opt":
+        return TransformerConfig(
+            vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["hidden_size"],
+            num_layers=hf_cfg["num_hidden_layers"], num_heads=hf_cfg["num_attention_heads"],
+            intermediate_size=hf_cfg["ffn_dim"], max_seq_len=hf_cfg.get("max_position_embeddings", 2048),
+            norm="layernorm", positions="learned", mlp="relu", use_bias=True,
+            tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", True)), norm_eps=1e-5), mt
+    raise ValueError(f"unsupported model_type {mt!r}; supported: llama, mistral, gpt2, opt")
+
+
+# ---------------------------------------------------------------------------
+# weight conversion
+# ---------------------------------------------------------------------------
+def _stack(sd, fmt, L, transpose=False):
+    ws = [np.asarray(sd[fmt.format(i=i)], np.float32) for i in range(L)]
+    if transpose:
+        ws = [w.T for w in ws]
+    return np.stack(ws)
+
+
+def convert_hf_state_dict(sd: Dict[str, np.ndarray], cfg, model_type: str):
+    """HF state dict → stacked param pytree (numpy, fp32)."""
+    L = cfg.num_layers
+    if model_type in ("llama", "mistral"):
+        p = {
+            "embed": {"embedding": np.asarray(sd["model.embed_tokens.weight"], np.float32)},
+            "blocks": {
+                "ln1_scale": _stack(sd, "model.layers.{i}.input_layernorm.weight", L),
+                "wq": _stack(sd, "model.layers.{i}.self_attn.q_proj.weight", L, transpose=True),
+                "wk": _stack(sd, "model.layers.{i}.self_attn.k_proj.weight", L, transpose=True),
+                "wv": _stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, transpose=True),
+                "wo": _stack(sd, "model.layers.{i}.self_attn.o_proj.weight", L, transpose=True),
+                "ln2_scale": _stack(sd, "model.layers.{i}.post_attention_layernorm.weight", L),
+                "w_gate": _stack(sd, "model.layers.{i}.mlp.gate_proj.weight", L, transpose=True),
+                "w_up": _stack(sd, "model.layers.{i}.mlp.up_proj.weight", L, transpose=True),
+                "w_down": _stack(sd, "model.layers.{i}.mlp.down_proj.weight", L, transpose=True),
+            },
+            "final_norm": {"scale": np.asarray(sd["model.norm.weight"], np.float32)},
+        }
+        if not cfg.tie_embeddings:
+            p["lm_head"] = {"kernel": np.asarray(sd["lm_head.weight"], np.float32).T}
+        return p
+    if model_type == "gpt2":
+        H = cfg.hidden_size
+        # Conv1D stores [in, out] — NO transpose; c_attn fuses qkv on out dim
+        c_attn = _stack(sd, "transformer.h.{i}.attn.c_attn.weight", L)
+        b_attn = _stack(sd, "transformer.h.{i}.attn.c_attn.bias", L)
+        p = {
+            "embed": {"embedding": np.asarray(sd["transformer.wte.weight"], np.float32)},
+            "pos_embed": {"embedding": np.asarray(sd["transformer.wpe.weight"], np.float32)},
+            "blocks": {
+                "ln1_scale": _stack(sd, "transformer.h.{i}.ln_1.weight", L),
+                "ln1_bias": _stack(sd, "transformer.h.{i}.ln_1.bias", L),
+                "wq": c_attn[:, :, :H], "wk": c_attn[:, :, H:2 * H], "wv": c_attn[:, :, 2 * H:],
+                "bq": b_attn[:, :H], "bk": b_attn[:, H:2 * H], "bv": b_attn[:, 2 * H:],
+                "wo": _stack(sd, "transformer.h.{i}.attn.c_proj.weight", L),
+                "bo": _stack(sd, "transformer.h.{i}.attn.c_proj.bias", L),
+                "ln2_scale": _stack(sd, "transformer.h.{i}.ln_2.weight", L),
+                "ln2_bias": _stack(sd, "transformer.h.{i}.ln_2.bias", L),
+                "w_up": _stack(sd, "transformer.h.{i}.mlp.c_fc.weight", L),
+                "b_up": _stack(sd, "transformer.h.{i}.mlp.c_fc.bias", L),
+                "w_down": _stack(sd, "transformer.h.{i}.mlp.c_proj.weight", L),
+                "b_down": _stack(sd, "transformer.h.{i}.mlp.c_proj.bias", L),
+            },
+            "final_norm": {"scale": np.asarray(sd["transformer.ln_f.weight"], np.float32),
+                           "bias": np.asarray(sd["transformer.ln_f.bias"], np.float32)},
+        }
+        return p
+    if model_type == "opt":
+        base = "model.decoder.layers.{i}."
+        p = {
+            "embed": {"embedding": np.asarray(sd["model.decoder.embed_tokens.weight"], np.float32)},
+            # OPT's learned positions carry a +2 offset (rows 0-1 unused for
+            # dense position_ids starting at 0)
+            "pos_embed": {"embedding": np.asarray(sd["model.decoder.embed_positions.weight"], np.float32)[2:]},
+            "blocks": {
+                "ln1_scale": _stack(sd, base + "self_attn_layer_norm.weight", L),
+                "ln1_bias": _stack(sd, base + "self_attn_layer_norm.bias", L),
+                "wq": _stack(sd, base + "self_attn.q_proj.weight", L, transpose=True),
+                "wk": _stack(sd, base + "self_attn.k_proj.weight", L, transpose=True),
+                "wv": _stack(sd, base + "self_attn.v_proj.weight", L, transpose=True),
+                "bq": _stack(sd, base + "self_attn.q_proj.bias", L),
+                "bk": _stack(sd, base + "self_attn.k_proj.bias", L),
+                "bv": _stack(sd, base + "self_attn.v_proj.bias", L),
+                "wo": _stack(sd, base + "self_attn.out_proj.weight", L, transpose=True),
+                "bo": _stack(sd, base + "self_attn.out_proj.bias", L),
+                "ln2_scale": _stack(sd, base + "final_layer_norm.weight", L),
+                "ln2_bias": _stack(sd, base + "final_layer_norm.bias", L),
+                "w_up": _stack(sd, base + "fc1.weight", L, transpose=True),
+                "b_up": _stack(sd, base + "fc1.bias", L),
+                "w_down": _stack(sd, base + "fc2.weight", L, transpose=True),
+                "b_down": _stack(sd, base + "fc2.bias", L),
+            },
+            "final_norm": {"scale": np.asarray(sd["model.decoder.final_layer_norm.weight"], np.float32),
+                           "bias": np.asarray(sd["model.decoder.final_layer_norm.bias"], np.float32)},
+        }
+        return p
+    raise ValueError(f"unsupported model_type {model_type!r}")
+
+
+def build_hf_engine(model_name_or_path: str, engine_config=None, dtype=None):
+    """HF checkpoint → ready InferenceEngineV2 (reference
+    ``engine_factory.build_hf_engine``)."""
+    from ....models.transformer import TransformerLM
+    from ..engine_v2 import InferenceEngineV2
+
+    ckpt = HuggingFaceCheckpointEngine(model_name_or_path)
+    cfg, model_type = transformer_config_from_hf(ckpt.model_config())
+    if dtype is not None:
+        cfg.dtype = dtype
+    params = convert_hf_state_dict(ckpt.state_dict(), cfg, model_type)
+    logger.info(f"built {model_type} inference model from {model_name_or_path} "
+                f"({cfg.num_layers}L/{cfg.hidden_size}H)")
+    return InferenceEngineV2(TransformerLM(cfg), engine_config, params=params)

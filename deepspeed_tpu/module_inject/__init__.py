@@ -1,0 +1,3 @@
+from .auto_tp import AutoTP, ReplaceWithTensorSlicing
+from .replace_module import replace_transformer_layer, revert_transformer_layer
+from .policies import TransformerPolicy, LlamaPolicy, GPTPolicy, OPTPolicy, BertPolicy, POLICY_REGISTRY

@@ -1,0 +1,102 @@
+"""Injection policies (reference ``deepspeed/module_inject/containers/`` —
+20 per-model policy classes telling the injector which weights are attention
+qkv/output and MLP in/out so they can be TP-sharded and kernel-fused).
+
+TPU form: a policy is a table of (param-path regex → TP PartitionSpec over
+the ``model`` axis). Column-parallel (output-dim sharded) for QKV and MLP-in,
+row-parallel (input-dim sharded) for attention-out and MLP-down — the same
+Megatron split the reference encodes per container.
+"""
+
+import re
+from typing import Dict, List, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import MODEL_AXIS
+from ..runtime.zero.partition import PartitionRules
+
+COL = P(None, MODEL_AXIS)   # shard output features
+ROW = P(MODEL_AXIS, None)   # shard input features
+COL3 = P(None, None, MODEL_AXIS)  # stacked-layer [L, in, out]
+ROW3 = P(None, MODEL_AXIS, None)
+
+
+class TransformerPolicy:
+    """Base policy (reference ``DSPolicy``/``TransformerPolicy``)."""
+
+    #: patterns matched against 'a/b/c' param paths
+    column_patterns: List[str] = [
+        r"(^|/)(wq|wk|wv|q_proj|k_proj|v_proj|query|key|value|w_gate|w_up|gate_proj|up_proj"
+        r"|fc1|wi|moe_wi|moe_wg)(/|$)",
+    ]
+    row_patterns: List[str] = [
+        r"(^|/)(wo|o_proj|dense|out_proj|w_down|down_proj|fc2|moe_wo)(/|$)",
+    ]
+
+    # params whose FIRST dim is the stacked layer dim (scan-stacked models)
+    stacked_layer_prefixes: List[str] = [r"^blocks/"]
+
+    @classmethod
+    def _is_stacked(cls, path: str) -> bool:
+        return any(re.search(p, path) for p in cls.stacked_layer_prefixes)
+
+    @classmethod
+    def spec_for(cls, path: str, ndim: int):
+        stacked = cls._is_stacked(path)
+        for pat in cls.column_patterns:
+            if re.search(pat, path):
+                return (COL3 if stacked and ndim == 3 else COL) if ndim >= 2 else None
+        for pat in cls.row_patterns:
+            if re.search(pat, path):
+                return (ROW3 if stacked and ndim == 3 else ROW) if ndim >= 2 else None
+        return None
+
+    @classmethod
+    def partition_rules(cls) -> PartitionRules:
+        rules: List[Tuple[str, P]] = []
+        for pat in cls.column_patterns:
+            rules.append((pat, COL3))
+        for pat in cls.row_patterns:
+            rules.append((pat, ROW3))
+        return PartitionRules(rules)
+
+
+class LlamaPolicy(TransformerPolicy):
+    """llama/llama2 (reference containers/llama.py, llama2.py)."""
+
+
+class MistralPolicy(LlamaPolicy):
+    """mistral shares llama's layout (reference v2 mistral containers)."""
+
+
+class GPTPolicy(TransformerPolicy):
+    """gpt2/gpt-neo/gpt-j (reference containers/gpt2.py et al.): fused
+    c_attn is column-sharded, c_proj row-sharded."""
+    column_patterns = TransformerPolicy.column_patterns + [r"(^|/)c_attn(/|$)", r"(^|/)c_fc(/|$)"]
+    row_patterns = TransformerPolicy.row_patterns + [r"(^|/)c_proj(/|$)"]
+
+
+class OPTPolicy(TransformerPolicy):
+    """opt (reference containers/opt.py)."""
+
+
+class BertPolicy(TransformerPolicy):
+    """bert/roberta (reference containers/bert.py): self-attention q/k/v
+    column, attention output + ffn output row."""
+    column_patterns = TransformerPolicy.column_patterns + [r"intermediate/kernel"]
+    row_patterns = TransformerPolicy.row_patterns + [r"output/kernel"]
+
+
+POLICY_REGISTRY: Dict[str, type] = {
+    "llama": LlamaPolicy,
+    "llama2": LlamaPolicy,
+    "mistral": MistralPolicy,
+    "gpt2": GPTPolicy,
+    "gpt": GPTPolicy,
+    "gptj": GPTPolicy,
+    "gpt_neox": GPTPolicy,
+    "opt": OPTPolicy,
+    "bert": BertPolicy,
+    "roberta": BertPolicy,
+}

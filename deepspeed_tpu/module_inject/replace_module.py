@@ -1,0 +1,56 @@
+"""Transformer-layer replacement (reference
+``deepspeed/module_inject/replace_module.py:182`` ``replace_transformer_layer``
+— swaps HF blocks for fused ``DeepSpeedTransformerInference`` modules or
+TP-shards generic linears via AutoTP).
+
+On TPU "kernel injection" decomposes into two orthogonal moves:
+  1. the compute path: flip the model config's ``attention_impl`` to the
+     Pallas flash/paged kernels (the analog of the fused CUDA inference ops);
+  2. the layout: TP shardings from the policy applied to the params.
+Both are non-destructive (return new config/params) — reverting is the
+identity, where the reference needs ``revert_transformer_layer`` surgery.
+"""
+
+from typing import Optional
+
+from .auto_tp import AutoTP
+from .policies import POLICY_REGISTRY
+from ..utils.logging import logger
+
+
+def replace_transformer_layer(orig_layer_impl=None,
+                              model=None,
+                              checkpoint_dict=None,
+                              config=None,
+                              model_config=None,
+                              params=None,
+                              mesh=None,
+                              policy=None,
+                              model_type: Optional[str] = None):
+    """TP-shard + kernel-inject a model (reference signature adapted).
+
+    Returns (model, params): model with flash/paged attention enabled and
+    params annotated with the policy's TP shardings when a mesh is given.
+    """
+    model = model if model is not None else orig_layer_impl
+    mc = model_config or getattr(model, "config", None)
+    if mc is not None and getattr(mc, "attention_impl", None) == "reference":
+        # use the fused kernels where sizes allow; 'auto' falls back per-shape
+        mc.attention_impl = "auto"
+        logger.info("kernel injection: attention_impl -> auto (Pallas flash/paged where applicable)")
+    auto_tp = AutoTP(policy=policy, model_type=model_type or getattr(mc, "model_type", None))
+    if params is not None and mesh is not None and mesh.shape.get("model", 1) > 1:
+        params = auto_tp.shard(params, mesh)
+        logger.info(f"AutoTP: params sharded over model axis (size {mesh.shape['model']})")
+    return model, params
+
+
+def revert_transformer_layer(orig_layer_impl=None, model=None, config=None):
+    """Reference ``revert_transformer_layer``: module surgery undo. The TPU
+    injection is non-destructive, so revert only restores the reference
+    attention impl."""
+    model = model if model is not None else orig_layer_impl
+    mc = getattr(model, "config", None)
+    if mc is not None:
+        mc.attention_impl = "reference"
+    return model

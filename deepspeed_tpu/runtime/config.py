@@ -148,6 +148,18 @@ class TPUConfig(DeepSpeedConfigModel):
         return MeshConfig(**known)
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """``hybrid_engine`` block (reference ``runtime/hybrid_engine.py`` config:
+    enable_hybrid_engine, inference_tp_size, release_inference_cache,
+    pin_parameters, tp_gather_partition_size)."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
 class ElasticityConfig(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -246,6 +258,7 @@ class DeepSpeedConfig:
         self.use_node_local_storage = self.checkpoint_config.use_node_local_storage
         self.elasticity_enabled = bool(pd.get(ELASTICITY, {}).get("enabled", False))
         self.elasticity_config = ElasticityConfig(**pd.get(ELASTICITY, {}))
+        self.hybrid_engine_config = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.pipeline_config = PipelineConfig(**pd.get(PIPELINE, {})) if isinstance(pd.get(PIPELINE, {}),
                                                                                     dict) else PipelineConfig()
         self.tpu_config = TPUConfig(**pd.get(TPU, {}))

@@ -1,0 +1,83 @@
+"""HF checkpoint → inference engine parity tests: tiny random HF models of
+each supported family are saved to disk, loaded through the v2 checkpoint
+engine, and their logits compared against the HF torch forward (the analog of
+reference tests/unit/inference/test_inference.py's model sweep)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _save_tiny(tmp_path, kind):
+    if kind == "llama":
+        cfg = transformers.LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                                       num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                                       max_position_embeddings=128, tie_word_embeddings=False)
+        model = transformers.LlamaForCausalLM(cfg)
+    elif kind == "mistral":
+        cfg = transformers.MistralConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                                         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                                         max_position_embeddings=128, tie_word_embeddings=False)
+        model = transformers.MistralForCausalLM(cfg)
+    elif kind == "gpt2":
+        cfg = transformers.GPT2Config(vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=128)
+        model = transformers.GPT2LMHeadModel(cfg)
+    elif kind == "opt":
+        cfg = transformers.OPTConfig(vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+                                     num_attention_heads=4, max_position_embeddings=128,
+                                     word_embed_proj_dim=64, do_layer_norm_before=True)
+        model = transformers.OPTForCausalLM(cfg)
+    model = model.eval()
+    d = tmp_path / kind
+    model.save_pretrained(str(d))
+    return model, str(d)
+
+
+@pytest.mark.parametrize("kind", ["llama", "mistral", "gpt2", "opt"])
+def test_hf_parity(tmp_path, kind):
+    from deepspeed_tpu.inference.v2.checkpoint import build_hf_engine
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+
+    hf_model, path = _save_tiny(tmp_path, kind)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, size=(1, 12), dtype=np.int64)
+
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(ids)).logits[:, -1, :].float().numpy()
+
+    engine = build_hf_engine(path, dtype=jnp.float32)
+    logits = np.asarray(engine.put([7], [ids[0].astype(np.int32)]))
+    # ragged engine returns [n_seqs, vocab] last-token logits
+    assert logits.shape[-1] == 128
+    np.testing.assert_allclose(logits[0], ref[0], rtol=2e-3, atol=2e-3)
+
+
+def test_hf_engine_decode_continues(tmp_path):
+    """After prefill, single-token decode steps must keep matching HF."""
+    from deepspeed_tpu.inference.v2.checkpoint import build_hf_engine
+
+    hf_model, path = _save_tiny(tmp_path, "llama")
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 128, size=10, dtype=np.int64)
+
+    engine = build_hf_engine(path, dtype=jnp.float32)
+    logits = np.asarray(engine.put([1], [prompt.astype(np.int32)]))
+    toks = list(prompt)
+    for _ in range(3):
+        nxt = int(np.argmax(logits[0]))
+        toks.append(nxt)
+        logits = np.asarray(engine.put([1], [np.asarray([nxt], np.int32)]))
+
+    with torch.no_grad():
+        full = hf_model(torch.from_numpy(np.asarray(toks)[None])).logits[:, -1, :].float().numpy()
+    np.testing.assert_allclose(logits[0], full[0], rtol=2e-3, atol=2e-3)
+
+
+def test_unsupported_model_type_rejected():
+    from deepspeed_tpu.inference.v2.checkpoint.huggingface_engine import transformer_config_from_hf
+
+    with pytest.raises(ValueError, match="unsupported model_type"):
+        transformer_config_from_hf({"model_type": "mamba"})

@@ -1,0 +1,149 @@
+"""module_inject / AutoTP + hybrid engine tests (mirrors the reference
+tests/unit/model_parallelism/ + tests/unit/hybrid_engine/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.module_inject import AutoTP, ReplaceWithTensorSlicing, replace_transformer_layer
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.mesh import MODEL_AXIS, MeshConfig
+
+
+# ---------------------------------------------------------------------------
+# AutoTP policy inference
+# ---------------------------------------------------------------------------
+def test_auto_tp_specs_on_model_tree():
+    model = TransformerLM(TransformerConfig(vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+                                            intermediate_size=32, max_seq_len=16, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
+    specs = AutoTP(model_type="llama").tree_specs(params)
+    # qkv/mlp-in column-shard, attn-out/mlp-down row-shard (stacked [L, in, out])
+    assert specs["blocks"]["wq"] == P(None, None, MODEL_AXIS)
+    assert specs["blocks"]["w_up"] == P(None, None, MODEL_AXIS)
+    assert specs["blocks"]["wo"] == P(None, MODEL_AXIS, None)
+    assert specs["blocks"]["w_down"] == P(None, MODEL_AXIS, None)
+    # norms/embeddings replicated
+    assert specs["blocks"]["ln1_scale"] == P(None, None)
+    assert specs["embed"]["embedding"] == P(None, None)
+
+
+def test_auto_tp_sharded_forward_matches_unsharded():
+    groups.reset()
+    mesh = groups.initialize_mesh(MeshConfig(data=2, model=4))
+    model = TransformerLM(TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                                            intermediate_size=64, max_seq_len=16, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
+    ids = np.random.default_rng(0).integers(0, 64, size=(2, 16), dtype=np.int32)
+
+    from deepspeed_tpu.models.transformer import forward
+
+    base = np.asarray(jax.jit(lambda p, i: forward(model.config, p, i))(params, ids))
+    sharded_params = AutoTP(model_type="llama").shard(params, mesh)
+    with mesh:
+        tp = np.asarray(jax.jit(lambda p, i: forward(model.config, p, i))(sharded_params, ids))
+    np.testing.assert_allclose(base, tp, rtol=2e-5, atol=2e-5)
+    groups.reset()
+
+
+# ---------------------------------------------------------------------------
+# ReplaceWithTensorSlicing numeric helpers
+# ---------------------------------------------------------------------------
+def test_tensor_slicing_copy():
+    mp = ReplaceWithTensorSlicing(mp_size=4)
+    w = np.arange(32 * 16, dtype=np.float32).reshape(32, 16)
+    col = mp.copy((32, 4), w, rank=1)  # column split
+    np.testing.assert_array_equal(col, w[:, 4:8])
+    row = mp.copy((8, 16), w, rank=2)  # row split
+    np.testing.assert_array_equal(row, w[16:24])
+    same = mp.copy((32, 16), w, rank=0)  # replicated passthrough
+    np.testing.assert_array_equal(same, w)
+    with pytest.raises(ValueError):
+        mp.copy((32, 5), w)
+
+
+def test_qkv_copy_slices_each_projection():
+    mp = ReplaceWithTensorSlicing(mp_size=2)
+    h = 8
+    # fused qkv: [h, 3h]; q/k/v each [h, h]
+    q = np.full((h, h), 1.0, np.float32)
+    k = np.full((h, h), 2.0, np.float32)
+    v = np.full((h, h), 3.0, np.float32)
+    fused = np.concatenate([q, k, v], axis=1)
+    rank0 = mp.qkv_copy((h, 3 * h // 2), fused, rank=0)
+    # each of q,k,v contributes its own half — NOT a contiguous slice
+    assert rank0.shape == (h, 3 * h // 2)
+    np.testing.assert_array_equal(rank0[:, :4], np.full((h, 4), 1.0))
+    np.testing.assert_array_equal(rank0[:, 4:8], np.full((h, 4), 2.0))
+    np.testing.assert_array_equal(rank0[:, 8:], np.full((h, 4), 3.0))
+
+
+def test_replace_transformer_layer_flips_kernels():
+    model = TransformerLM(TransformerConfig(vocab_size=64, hidden_size=16, num_layers=1, num_heads=2,
+                                            intermediate_size=32, max_seq_len=16,
+                                            attention_impl="reference"))
+    model, _ = replace_transformer_layer(model=model, model_type="llama")
+    assert model.config.attention_impl == "auto"
+    from deepspeed_tpu.module_inject import revert_transformer_layer
+
+    revert_transformer_layer(model=model)
+    assert model.config.attention_impl == "reference"
+
+
+# ---------------------------------------------------------------------------
+# hybrid engine (RLHF flip)
+# ---------------------------------------------------------------------------
+def test_hybrid_engine_train_generate_interleave():
+    groups.reset()
+    model = TransformerLM(TransformerConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                                            intermediate_size=64, max_seq_len=64, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 5e-2}},
+        "zero_optimization": {"stage": 1},
+        "hybrid_engine": {"enabled": True},
+        "tpu": {"mesh": {"data": 8}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+    assert isinstance(engine, DeepSpeedHybridEngine)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, size=(8, 32), dtype=np.int32)}
+    prompt = rng.integers(0, 128, size=(2, 8), dtype=np.int32)
+
+    out1 = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    assert out1.shape == (2, 12)
+    assert engine._train_mode  # flipped back to training
+
+    engine.train_batch(batch)
+    engine.train_batch(batch)
+    out2 = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    # big LR: two steps must change the greedy rollout params (outputs differ
+    # with overwhelming probability)
+    assert engine._inference_params_step == 2
+    assert len(engine.generate_latency()) == 2
+    groups.reset()
+
+
+def test_lora_fuse_unfuse_roundtrip():
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((16, 16)).astype(np.float32)
+    a = rng.standard_normal((16, 4)).astype(np.float32)
+    b = rng.standard_normal((4, 16)).astype(np.float32)
+    fused = DeepSpeedHybridEngine.fuse_lora_weight(w, a, b, scaling=0.5)
+    assert not np.allclose(fused, w)
+    back = DeepSpeedHybridEngine.unfuse_lora_weight(fused, a, b, scaling=0.5)
+    np.testing.assert_allclose(back, w, rtol=1e-5, atol=1e-5)
