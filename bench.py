@@ -3,15 +3,20 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Metric: decoder-LM training throughput (tokens/sec/chip) in bf16 with the
-fused train step. ``vs_baseline`` reports achieved MFU relative to the
-reference's published 54%-of-peak Ulysses number
+fused train step on a Llama-2-architecture model (rmsnorm/rotary/swiglu —
+the BASELINE.md target workload) at the largest configuration that fits one
+v5e chip's HBM with ZeRO-3 + Adam. ``vs_baseline`` reports achieved MFU
+relative to the reference's published 54%-of-peak Ulysses number
 (`blogs/deepspeed-ulysses/README.md:81-83` — the only hardware-normalized
 efficiency figure the reference publishes), i.e. vs_baseline = MFU / 0.54.
+
+Attention runs the Pallas flash kernel (fwd+bwd); the remat policy saves the
+attention context (`save_only_these_names(attn_out)`) so the backward never
+recomputes the flash kernel; gradient accumulation amortizes the
+HBM-bandwidth-bound Adam step over 16 microbatches.
 """
 
 import json
-import os
-import sys
 import time
 
 
@@ -24,27 +29,30 @@ def main():
     import deepspeed_tpu
     from deepspeed_tpu.models import TransformerConfig, TransformerLM
 
-    # ~124M-param GPT-2-small-shaped llama-style model, seq 1024 — big enough
-    # to saturate the MXU on one chip, small enough to fit v5e HBM with Adam.
     if on_tpu:
-        cfg = TransformerConfig(vocab_size=32000, hidden_size=768, num_layers=12, num_heads=12,
-                                intermediate_size=3072, max_seq_len=1024, norm="rmsnorm", positions="rotary",
-                                mlp="swiglu", dtype=jnp.bfloat16, attention_impl="reference", remat=True)
-        micro, seq, steps, warmup = 8, 1024, 10, 3
+        # 748M-param Llama-arch model: h=2048 x 12 layers, seq 2048 — the
+        # largest clean shape that fits v5e HBM (16G) with fp32 Adam states
+        # and an f32 grad accumulator.
+        cfg = TransformerConfig(vocab_size=32000, hidden_size=2048, num_layers=12,
+                                num_heads=16, num_kv_heads=16, intermediate_size=5632,
+                                max_seq_len=2048, norm="rmsnorm", positions="rotary",
+                                mlp="swiglu", dtype=jnp.bfloat16, attention_impl="flash",
+                                remat=True, remat_policy="save_only_these_names(attn_out)")
+        micro, gas, seq, steps, warmup = 2, 16, 2048, 8, 3
     else:  # CI / CPU smoke mode
         cfg = TransformerConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
                                 intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
                                 attention_impl="reference")
-        micro, seq, steps, warmup = 2, 256, 3, 1
+        micro, gas, seq, steps, warmup = 2, 1, 256, 3, 1
 
     model = TransformerLM(cfg)
     n_chips = len(jax.devices())
     config = {
-        "train_batch_size": micro * n_chips,
+        "train_batch_size": micro * gas * n_chips,
         "train_micro_batch_size_per_gpu": micro,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.0}},
-        "zero_optimization": {"stage": 1 if n_chips > 1 else 0},
+        "zero_optimization": {"stage": 3 if on_tpu else 0},
         "bf16": {"enabled": bool(on_tpu)},
         "steps_per_print": 10**9,
         "tpu": {"mesh": {"data": n_chips}},
@@ -71,8 +79,8 @@ def main():
     tok_per_sec_per_chip = tokens / dt / n_chips
 
     n_params = model.num_params()
-    # fwd+bwd ≈ 6 FLOPs/param/token + attention term
-    attn_flops_per_token = 12 * cfg.num_layers * cfg.hidden_size * seq  # 2*2*3 * L * H * S
+    # fwd+bwd ≈ 6 FLOPs/param/token + attention term (PaLM MFU convention)
+    attn_flops_per_token = 12 * cfg.num_layers * cfg.hidden_size * seq
     flops_per_token = 6 * n_params + attn_flops_per_token
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
     mfu = tok_per_sec_per_chip * flops_per_token / peak

@@ -64,15 +64,35 @@ def ds_to_universal(checkpoint_dir, output_dir, tag=None):
 
     flat = _flat_paths(module)
     mu_leaves = nu_leaves = None
+    masters = {}
     if "optimizer" in tree and tree["optimizer"]:
         mu_leaves, nu_leaves = _extract_adam_moments(tree["optimizer"], module)
         if mu_leaves is None:
             logger.warning("optimizer state present but not adam-shaped; universal ckpt will carry weights only")
+    if mu_leaves is None and tree.get("host_optimizer"):
+        # ZeRO-Offload: the device-side optimizer state is empty; the Adam
+        # moments (and fp32 masters) live in the host_optimizer subtree
+        # (engine.py save_checkpoint), keyed by '::'-escaped param paths.
+        host = tree["host_optimizer"]
+        try:
+            mu_leaves, nu_leaves, masters = [], [], {}
+            for key, leaf in flat:
+                ek = key.replace("/", "::")
+                shape = np.shape(leaf)
+                mu_leaves.append(np.asarray(host["exp_avg"][ek], np.float32).reshape(shape))
+                nu_leaves.append(np.asarray(host["exp_avg_sq"][ek], np.float32).reshape(shape))
+                masters[key] = np.asarray(host["masters"][ek], np.float32).reshape(shape)
+            logger.info("using host_optimizer (ZeRO-Offload) state for universal checkpoint")
+        except KeyError as e:
+            logger.warning(f"host_optimizer subtree incomplete ({e}); universal ckpt will carry weights only")
+            mu_leaves = nu_leaves = None
+            masters = {}
 
     for i, (key, leaf) in enumerate(flat):
         pdir = os.path.join(zero_dir, key.replace("/", "."))
         os.makedirs(pdir, exist_ok=True)
-        np.save(os.path.join(pdir, "fp32.npy"), np.asarray(jax.device_get(leaf), np.float32))
+        fp32 = masters[key] if key in masters else np.asarray(jax.device_get(leaf), np.float32)
+        np.save(os.path.join(pdir, "fp32.npy"), fp32)
         if mu_leaves is not None:
             np.save(os.path.join(pdir, "exp_avg.npy"), np.asarray(jax.device_get(mu_leaves[i]), np.float32))
             np.save(os.path.join(pdir, "exp_avg_sq.npy"), np.asarray(jax.device_get(nu_leaves[i]), np.float32))

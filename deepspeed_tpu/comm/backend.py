@@ -57,9 +57,13 @@ class XlaBackend(Backend):
     def _maybe_init_jax_distributed(self, init_method, rank, world_size):
         import jax
 
-        coord = os.environ.get("DSTPU_COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS")
-        n_proc = int(os.environ.get("DSTPU_NUM_PROCESSES", os.environ.get("WORLD_SIZE", world_size)) or -1)
-        proc_id = int(os.environ.get("DSTPU_PROCESS_ID", os.environ.get("RANK", rank)) or -1)
+        from ..launcher.constants import (ENV_COORDINATOR_ADDRESS, ENV_NUM_PROCESSES,
+                                          ENV_PROCESS_ID)
+
+        coord = (os.environ.get(ENV_COORDINATOR_ADDRESS)
+                 or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+        n_proc = int(os.environ.get(ENV_NUM_PROCESSES, os.environ.get("WORLD_SIZE", world_size)) or -1)
+        proc_id = int(os.environ.get(ENV_PROCESS_ID, os.environ.get("RANK", rank)) or -1)
         if coord is not None and n_proc > 1:
             try:
                 jax.distributed.initialize(coordinator_address=coord, num_processes=n_proc, process_id=proc_id)

@@ -108,8 +108,10 @@ def mpi_discovery(distributed_port=29500, verbose=True):
     os.environ.setdefault("RANK", str(rank))
     os.environ.setdefault("WORLD_SIZE", str(world_size))
     os.environ.setdefault("LOCAL_RANK", str(local_rank))
-    if "MASTER_ADDR" in os.environ and "DSTPU_COORDINATOR_ADDRESS" not in os.environ:
-        os.environ["DSTPU_COORDINATOR_ADDRESS"] = f"{os.environ['MASTER_ADDR']}:{distributed_port}"
+    from ..launcher.constants import ENV_COORDINATOR_ADDRESS
+
+    if "MASTER_ADDR" in os.environ and ENV_COORDINATOR_ADDRESS not in os.environ:
+        os.environ[ENV_COORDINATOR_ADDRESS] = f"{os.environ['MASTER_ADDR']}:{distributed_port}"
     if verbose:
         logger.info(f"mpi_discovery: rank={rank} world_size={world_size} local_rank={local_rank}")
 

@@ -293,6 +293,12 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True)
     else:
         ctx = _attention(cfg, q, k, v)
     ctx = ctx.reshape(B, S, nq * d)
+    # named for remat_policy="save_only_these_names(attn_out)": saving the
+    # attention context keeps the flash kernel out of the backward recompute
+    # while everything else (cheap elementwise + refusable matmuls) remats
+    from jax.ad_checkpoint import checkpoint_name
+
+    ctx = checkpoint_name(ctx, "attn_out")
     attn_out = jnp.einsum("bsd,dh->bsh", ctx, layer["wo"].astype(dt))
     if cfg.use_bias:
         attn_out = attn_out + layer["bo"].astype(dt)
@@ -377,6 +383,20 @@ def _activation_constraint(cfg: TransformerConfig, x, enabled=True):
         return x
 
 
+def _remat_policy(name: str):
+    """Resolve a remat policy name. Supports every ``jax.checkpoint_policies``
+    attribute plus ``"save_only_these_names(a,b,...)"`` for checkpoint_name-
+    tagged values (e.g. ``attn_out``)."""
+    if name.startswith("save_only_these_names(") and name.endswith(")"):
+        names = [n.strip() for n in name[len("save_only_these_names("):-1].split(",") if n.strip()]
+        return jax.checkpoint_policies.save_only_these_names(*names)
+    policy = getattr(jax.checkpoint_policies, name, None)
+    if policy is None:
+        raise ValueError(f"unknown remat_policy {name!r}: expected an attribute of "
+                         f"jax.checkpoint_policies or 'save_only_these_names(a,b,...)'")
+    return policy
+
+
 def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array, rng=None):
     """Token ids [B, S] → (logits [B, S, V], moe_aux_loss)."""
     dt = cfg.dtype
@@ -391,8 +411,8 @@ def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: 
 
     block_fn = partial(_block, cfg)
     if cfg.remat:
-        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
-        block_fn = jax.checkpoint(block_fn, policy=policy, static_argnums=())
+        block_fn = jax.checkpoint(block_fn, policy=_remat_policy(cfg.remat_policy),
+                                  static_argnums=())
 
     use_layer_keys = cfg.moe_num_experts > 0 and rng is not None
     layer_keys = jax.random.split(rng, cfg.num_layers) if use_layer_keys else None

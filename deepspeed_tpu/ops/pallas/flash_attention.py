@@ -1,22 +1,40 @@
-"""Flash attention for TPU.
+"""Flash attention for TPU — forward AND backward Pallas kernels.
 
 Replaces the reference's fused attention kernels (training:
-``csrc/transformer/*.cu`` softmax/transform; inference context:
-``csrc/transformer/inference/csrc/softmax.cu``) with a Pallas blocked
+``csrc/transformer/softmax_kernels.cu`` / ``general_kernels.cu``; inference
+context: ``csrc/transformer/inference/csrc/softmax.cu``) with a Pallas blocked
 flash-attention. The public entry ``flash_attention(q, k, v, causal=...)``
 takes [B, S, n_heads, head_dim] (GQA allowed: n_kv may divide n_q) and is
 numerically validated against ``models.transformer.reference_attention``
-(mirroring the reference's tests/unit/ops kernel-vs-torch strategy).
+(mirroring the reference's tests/unit/ops kernel-vs-torch strategy) — in both
+forward and ``jax.grad``.
 
-The Pallas kernel path requires a real TPU; elsewhere (CPU tests) we fall back
-to the jnp reference implementation, which XLA fuses reasonably well.
+Backward follows the flash-attention recurrences: the forward saves the
+per-row log-sum-exp ``lse = m + log(l)``; the backward recomputes
+``p = exp(s - lse)`` blockwise, with the two-pass split:
+
+  * dk/dv pass — grid over k-blocks, inner loop over q-blocks:
+      dv += p^T dO;   ds = p * (dO v^T - delta);   dk += ds^T q * scale
+  * dq pass — grid over q-blocks, inner loop over k-blocks:
+      dq += ds k * scale
+  where ``delta = rowsum(dO * O)``.
+
+Fallback policy: on non-TPU backends, or for shapes the kernel does not
+support (S not a multiple of 128), we use the jnp reference implementation —
+XLA fuses it reasonably. On TPU with supported shapes a kernel failure is
+LOUD: it raises unless ``DS_TPU_ALLOW_ATTN_FALLBACK=1`` is set, so training
+can never silently drop to O(S^2) unfused attention again (the round-1 perf
+failure mode).
 """
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
+
+_NEG_INF = -1e30
 
 
 def _use_pallas():
@@ -26,16 +44,36 @@ def _use_pallas():
         return False
 
 
+def _shapes_supported(q, block_q, block_k):
+    B, S, nq, d = q.shape
+    bq, bk = min(block_q, S), min(block_k, S)
+    return (S % bq == 0 and S % bk == 0 and S % 128 == 0 and d >= 32)
+
+
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512):
-    """q: [B, S, nq, d]; k/v: [B, S, nkv, d] with nq % nkv == 0."""
-    if _use_pallas():
+    """q: [B, S, nq, d]; k/v: [B, S, nkv, d] with nq % nkv == 0.
+
+    Differentiable: both forward and backward run as Pallas kernels on TPU.
+    """
+    if _use_pallas() and not _shapes_supported(q, block_q, block_k):
+        from ...utils.logging import warning_once
+
+        warning_once(f"flash attention: unsupported shape {q.shape} (S must be a "
+                     f"multiple of 128, head_dim >= 32) — using O(S^2) reference attention")
+    if _use_pallas() and _shapes_supported(q, block_q, block_k):
         try:
             return _pallas_flash(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
         except Exception as e:
+            if os.environ.get("DS_TPU_ALLOW_ATTN_FALLBACK") != "1":
+                raise RuntimeError(
+                    "Pallas flash attention failed on a supported shape "
+                    f"({type(e).__name__}: {e}). Set DS_TPU_ALLOW_ATTN_FALLBACK=1 "
+                    "to permit the O(S^2) reference-attention fallback."
+                ) from e
             from ...utils.logging import warning_once
 
-            warning_once(f"pallas flash attention unavailable ({type(e).__name__}: {e}); "
-                         f"falling back to reference attention — expect O(S^2) memory and lower throughput")
+            warning_once(f"pallas flash attention failed ({type(e).__name__}); "
+                         f"falling back to reference attention — expect O(S^2) memory")
     from ...models.transformer import reference_attention
 
     return reference_attention(q, k, v, causal=causal)
@@ -43,14 +81,38 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: i
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
 def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=False):
+    return _flash_core(causal, min(block_q, q.shape[1]), min(block_k, q.shape[1]),
+                       interpret, q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_core(causal, block_q, block_k, interpret, q, k, v):
+    out, _ = _flash_fwd_impl(causal, block_q, block_k, interpret, q, k, v)
+    return out
+
+
+def _flash_core_fwd(causal, block_q, block_k, interpret, q, k, v):
+    out, lse = _flash_fwd_impl(causal, block_q, block_k, interpret, q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(causal, block_q, block_k, interpret, res, dout):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, out, lse, dout)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _flash_fwd_impl(causal, block_q, block_k, interpret, q, k, v):
+    """Returns (out [B,S,nq,d], lse [B,nq,S] float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, S, nq, d = q.shape
     nkv = k.shape[2]
     group = nq // nkv
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
     assert S % block_q == 0 and S % block_k == 0
     scale = 1.0 / math.sqrt(d)
 
@@ -61,13 +123,18 @@ def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=Fals
 
     grid = (B, nq, S // block_q)
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+    # TPU requires the last two block dims to be (8k, 128k)-aligned; stats get
+    # a broadcast 128-lane trailing dim (same layout as jax's own TPU flash
+    # kernel), sliced back to [B, nq, S] for the saved residual.
+    LANES = 128
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
         # block refs carry the singleton (batch, head) dims: [1, 1, bq|S, d]
         qi = pl.program_id(2)
         n_kblocks = S // block_k
 
         acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
         def body(kj, _):
@@ -78,7 +145,7 @@ def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=Fals
             if causal:
                 q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
                 k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, -1e30)
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
             m_prev = m_ref[:]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)
@@ -91,7 +158,9 @@ def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=Fals
         # ceil-div: the k block containing the last visible key must run
         n_iters = ((qi + 1) * block_q + block_k - 1) // block_k if causal else n_kblocks
         jax.lax.fori_loop(0, n_iters, body, 0)
-        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(m_ref[:] + jnp.log(l_safe), (block_q, LANES))
 
     def q_index(b, h, i):
         return (b, h, i, 0)
@@ -99,7 +168,7 @@ def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=Fals
     def kv_index(b, h, i):
         return (b, h // group, 0, 0)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -107,8 +176,14 @@ def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=Fals
             pl.BlockSpec((1, 1, S, d), kv_index),
             pl.BlockSpec((1, 1, S, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), q_index),
-        out_shape=jax.ShapeDtypeStruct((B, nq, S, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_index),
+            pl.BlockSpec((1, 1, block_q, LANES), q_index),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, nq, S, d), q.dtype),
+            jax.ShapeDtypeStruct((B, nq, S, LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -116,4 +191,168 @@ def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=Fals
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
+
+
+def _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, out, lse, dout):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, nq, d = q.shape
+    nkv = k.shape[2]
+    group = nq // nkv
+    scale = 1.0 / math.sqrt(d)
+    n_qblocks = S // block_q
+    n_kblocks = S // block_k
+
+    LANES = 128
+
+    qt = q.transpose(0, 2, 1, 3)          # [B, nq, S, d]
+    kt = k.transpose(0, 2, 1, 3)          # [B, nkv, S, d]
+    vt = v.transpose(0, 2, 1, 3)
+    ot = out.transpose(0, 2, 1, 3)        # [B, nq, S, d]
+    dot = dout.transpose(0, 2, 1, 3)      # [B, nq, S, d]
+    # lane-broadcast the saved [B, nq, S] stats back to the TPU-aligned layout
+    lse_b = jnp.broadcast_to(lse[..., None], (B, nq, S, LANES))
+
+    # Both passes use the canonical Mosaic revisit-accumulate idiom: the block
+    # loop is the innermost *grid* dimension (TPU grids execute sequentially),
+    # the output block spec ignores it, and a VMEM scratch accumulates across
+    # revisits — initialized on the first visit, flushed on the last. Causal
+    # skipping is done with pl.when on statically-shaped programs (dynamic
+    # fori_loop trip counts inside the kernel miscompile on some Mosaic
+    # versions — observed as NaNs in the final grid programs in bf16).
+
+    def _shared_block_math(qb, ob, dob, lseb, kb, vb, qi, kj):
+        """Recompute p and ds for one (q-block, k-block) tile."""
+        deltab = jnp.sum(dob * ob, axis=-1, keepdims=True)               # [bq, 1]
+        s = scale * jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lseb)                                            # [bq, bk]
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)      # [bq, bk]
+        ds = p * (dp - deltab)
+        return p, ds
+
+    # ---- pass 1: dk/dv (per q-head; grouped-sum outside for GQA) ----
+    # grid: q-blocks innermost; dk/dv blocks revisited across qi.
+    def dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc):
+        kj = pl.program_id(2)
+        qi = pl.program_id(3)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        # causal: q blocks strictly before this k block contribute nothing
+        visible = (qi + 1) * block_q > kj * block_k if causal else True
+
+        @pl.when(visible)
+        def _compute():
+            kb = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+            vb = v_ref[0, 0].astype(jnp.float32)
+            qb = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
+            ob = o_ref[0, 0].astype(jnp.float32)
+            dob = do_ref[0, 0].astype(jnp.float32)
+            lseb = lse_ref[0, 0, :, :1]           # [bq, 1]
+            p, ds = _shared_block_math(qb, ob, dob, lseb, kb, vb, qi, kj)
+            dv_acc[:] += jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
+            dk_acc[:] += scale * jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
+
+        @pl.when(qi == n_qblocks - 1)
+        def _flush():
+            dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+    def kv_index4(b, h, j, i):
+        return (b, h // group, j, 0)
+
+    def q_index4(b, h, j, i):
+        return (b, h, i, 0)
+
+    dk_g, dv_g = pl.pallas_call(
+        dkdv_kernel,
+        grid=(B, nq, n_kblocks, n_qblocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_index4),       # q
+            pl.BlockSpec((1, 1, block_k, d), kv_index4),      # k
+            pl.BlockSpec((1, 1, block_k, d), kv_index4),      # v
+            pl.BlockSpec((1, 1, block_q, d), q_index4),       # out
+            pl.BlockSpec((1, 1, block_q, d), q_index4),       # dout
+            pl.BlockSpec((1, 1, block_q, LANES), q_index4),   # lse
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, nq, S, d), jnp.float32),
+            jax.ShapeDtypeStruct((B, nq, S, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot, lse_b)
+
+    # ---- pass 2: dq — k-blocks innermost; dq block revisited across kj ----
+    def dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc):
+        qi = pl.program_id(2)
+        kj = pl.program_id(3)
+
+        @pl.when(kj == 0)
+        def _init():
+            dq_acc[:] = jnp.zeros_like(dq_acc)
+
+        visible = (qi + 1) * block_q > kj * block_k if causal else True
+
+        @pl.when(visible)
+        def _compute():
+            qb = q_ref[0, 0].astype(jnp.float32)     # [bq, d]
+            ob = o_ref[0, 0].astype(jnp.float32)
+            dob = do_ref[0, 0].astype(jnp.float32)
+            lseb = lse_ref[0, 0, :, :1]              # [bq, 1]
+            kb = k_ref[0, 0].astype(jnp.float32)     # [bk, d]
+            vb = v_ref[0, 0].astype(jnp.float32)
+            _, ds = _shared_block_math(qb, ob, dob, lseb, kb, vb, qi, kj)
+            dq_acc[:] += scale * jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+        @pl.when(kj == n_kblocks - 1)
+        def _flush():
+            dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+    def q_index_dq(b, h, i, j):
+        return (b, h, i, 0)
+
+    def kv_index_dq(b, h, i, j):
+        return (b, h // group, j, 0)
+
+    dq_t = pl.pallas_call(
+        dq_kernel,
+        grid=(B, nq, n_qblocks, n_kblocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_index_dq),
+            pl.BlockSpec((1, 1, block_k, d), kv_index_dq),
+            pl.BlockSpec((1, 1, block_k, d), kv_index_dq),
+            pl.BlockSpec((1, 1, block_q, d), q_index_dq),
+            pl.BlockSpec((1, 1, block_q, d), q_index_dq),
+            pl.BlockSpec((1, 1, block_q, LANES), q_index_dq),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), q_index_dq),
+        out_shape=jax.ShapeDtypeStruct((B, nq, S, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot, lse_b)
+
+    dq = dq_t.transpose(0, 2, 1, 3).astype(q.dtype)
+    if group > 1:
+        dk_g = dk_g.reshape(B, nkv, group, S, d).sum(axis=2)
+        dv_g = dv_g.reshape(B, nkv, group, S, d).sum(axis=2)
+    dk = dk_g.transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv_g.transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv

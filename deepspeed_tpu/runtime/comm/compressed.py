@@ -96,14 +96,20 @@ def onebit_allreduce(x: jax.Array, err_worker: jax.Array, err_server: jax.Array,
 
 def reduce_scatter_coalesced(tensors, axis_name: str):
     """Reference ``reduce_scatter_coalesced:73`` — bucketed reduce-scatter of a
-    tensor list. In-jit: XLA already coalesces adjacent collectives, so this
-    is a per-tensor psum_scatter with the same call signature."""
-    return [lax.psum_scatter(t, axis_name, scatter_dimension=0, tiled=True) for t in tensors]
+    tensor list, returning the MEAN over the axis (the reference pre-divides
+    by world size, ``coalesced_collectives.py:116``). In-jit: XLA already
+    coalesces adjacent collectives, so this is a per-tensor psum_scatter."""
+    world = lax.psum(1, axis_name)
+    return [lax.psum_scatter(t / world, axis_name, scatter_dimension=0, tiled=True)
+            for t in tensors]
 
 
 def all_to_all_quant_reduce(tensors, axis_name: str, block_size: int = 256):
     """Reference qgZ ``all_to_all_quant_reduce:31``: int8 block-quantized
-    2-hop gradient reduction (quantize → a2a → dequant-reduce)."""
+    2-hop gradient reduction (quantize → a2a → dequant-reduce), returning the
+    MEAN over the axis (the reference divides by num_nodes after its
+    quantized_reduction hop)."""
     from ...ops.pallas.quant import quantized_psum_scatter
 
-    return [quantized_psum_scatter(t, axis_name, block_size) for t in tensors]
+    world = lax.psum(1, axis_name)
+    return [quantized_psum_scatter(t, axis_name, block_size) / world for t in tensors]

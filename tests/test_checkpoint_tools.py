@@ -133,3 +133,31 @@ def test_reshard_state_dict():
     np.testing.assert_allclose(dst[1]["ln"], norm)
     with pytest.raises(AssertionError):
         split_tp_param(full_qkv, 5, axis=1)  # indivisible
+
+
+def test_universal_from_offload_checkpoint(tmp_path):
+    """Regression: with offload_optimizer the device opt tree is empty — the
+    conversion must pull Adam moments + fp32 masters from host_optimizer
+    instead of silently emitting a weights-only universal checkpoint."""
+    from deepspeed_tpu.checkpoint import ds_to_universal, read_universal_checkpoint
+
+    config = dict(_config(stage=1))
+    config["zero_optimization"] = {"stage": 1, "offload_optimizer": {"device": "cpu"}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=config)
+    for i in range(2):
+        engine.train_batch(_batch(seed=i))
+    engine.save_checkpoint(str(tmp_path / "ck"))
+
+    uni = tmp_path / "uni"
+    n = ds_to_universal(str(tmp_path / "ck"), str(uni))
+    assert n > 0
+    tree, meta = read_universal_checkpoint(str(uni))
+    assert meta["has_optimizer"], "offload checkpoint must carry optimizer moments"
+    # moments must match the live host optimizer state
+    key0 = engine.host_optimizer.keys[0]
+    got = tree[key0]["exp_avg"].reshape(-1)
+    np.testing.assert_allclose(got, np.asarray(engine.host_optimizer.moments[key0]["exp_avg"]).reshape(-1),
+                               rtol=1e-6)
+    # fp32 weights come from the masters
+    np.testing.assert_allclose(tree[key0]["fp32"].reshape(-1),
+                               engine.host_optimizer.masters[key0].reshape(-1), rtol=1e-6)

@@ -180,3 +180,27 @@ def test_dstpu_single_node_launch(tmp_path):
                          capture_output=True, text=True, cwd="/root/repo")
     assert out.returncode == 0, out.stderr
     assert "WI=" in out.stdout and "missing" not in out.stdout
+
+
+def test_launcher_env_reaches_backend_distributed_init(monkeypatch):
+    """Regression: the env names the launcher exports must be the ones
+    XlaBackend reads — a mismatch silently left every host in its own
+    single-process world."""
+    from deepspeed_tpu.comm.backend import XlaBackend
+
+    wi = encode_world_info({"w0": [0], "w1": [0]})
+    env = launch.build_worker_env(wi, "w0", 9999, process_id=1)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+
+    calls = {}
+
+    def fake_init(coordinator_address=None, num_processes=None, process_id=None):
+        calls.update(addr=coordinator_address, n=num_processes, pid=process_id)
+        raise RuntimeError("stop before real init")  # backend logs + continues
+
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    XlaBackend()
+    assert calls == {"addr": "w0:9999", "n": 2, "pid": 1}

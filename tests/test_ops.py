@@ -78,3 +78,42 @@ def test_fused_adam_matches_optax():
         p2 = optax.apply_updates(p2, u2)
     for k in params:
         np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("nkv", [4, 2])
+def test_flash_attention_backward_matches_reference(causal, nkv):
+    B, S, nq, d = 1, 256, 4, 32
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(7), 4)
+    q = jax.random.normal(k1, (B, S, nq, d), jnp.float32)
+    k = jax.random.normal(k2, (B, S, nkv, d), jnp.float32)
+    v = jax.random.normal(k3, (B, S, nkv, d), jnp.float32)
+    w = jax.random.normal(k4, (B, S, nq, d), jnp.float32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) * w)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(_pallas_flash(q, k, v, causal=causal, block_q=64,
+                                     block_k=128, interpret=True) * w)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_attention_backward_blockq_smaller_than_blockk():
+    B, S, n, d = 1, 256, 2, 16
+    q = jax.random.normal(jax.random.PRNGKey(11), (B, S, n, d), jnp.float32)
+
+    def loss(fn):
+        def f(x):
+            return jnp.sum(fn(x, x, x) ** 2)
+        return f
+
+    ref = jax.grad(loss(lambda a, b, c: reference_attention(a, b, c, causal=True)))(q)
+    fl = jax.grad(loss(lambda a, b, c: _pallas_flash(a, b, c, causal=True, block_q=32,
+                                                     block_k=128, interpret=True)))(q)
+    np.testing.assert_allclose(np.asarray(fl), np.asarray(ref), rtol=5e-3, atol=5e-3)
