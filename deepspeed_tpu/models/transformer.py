@@ -32,7 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
+from ..parallel.mesh import BATCH_AXES, DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
 from ..runtime.zero.partition import PartitionRules
 
 
@@ -366,7 +366,7 @@ def _moe_mlp(cfg: TransformerConfig, layer, h, rng=None, constrain=True):
     expert_out = jnp.einsum("becf,efm->becm", hmid, layer["moe_wo"].astype(dt))
     if constrain:
         try:
-            expert_out = lax.with_sharding_constraint(expert_out, P(DATA_AXIS, None, None, None))
+            expert_out = lax.with_sharding_constraint(expert_out, P(BATCH_AXES, None, None, None))
         except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
             pass
     out = jnp.einsum("bsec,becm->bsm", combine.astype(dt), expert_out)
@@ -378,7 +378,7 @@ def _activation_constraint(cfg: TransformerConfig, x, enabled=True):
     if not enabled:
         return x
     try:
-        return lax.with_sharding_constraint(x, P(DATA_AXIS, SEQ_AXIS if cfg.sequence_parallel else None, None))
+        return lax.with_sharding_constraint(x, P(BATCH_AXES, SEQ_AXIS if cfg.sequence_parallel else None, None))
     except (ValueError, jax.errors.JaxRuntimeError, RuntimeError, NameError):
         return x
 

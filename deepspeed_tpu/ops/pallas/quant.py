@@ -60,6 +60,77 @@ def quantized_all_gather(x, axis_name: str, block_size: int = 256):
     return dequantize_blockwise(q_full, s_full, block_size)
 
 
+def quantized_allreduce_mean(x, axis_name, block_size: int = 256):
+    """qgZ-style 2-hop quantized gradient allreduce returning the MEAN over
+    ``axis_name`` (reference ``all_to_all_quant_reduce`` followed by the
+    allgather its callers perform): int8 reduce-scatter + int8 all-gather —
+    ~4x less wire traffic than an fp32 ring allreduce. In-jit (shard_map).
+
+    ``axis_name`` may be a tuple of mesh axes (reduces over their product).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    axes = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name, )
+    world = lax.psum(1, axes)
+    shape, n = x.shape, x.size
+    # pad the flat vector so each device owns an equal, block-aligned chunk
+    chunk = -(-n // world)
+    chunk = -(-chunk // block_size) * block_size
+    flat = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, chunk * world - n))
+    rows = flat.reshape(world, chunk)
+
+    part = rows
+    for a in axes:  # hop per axis: a2a quantized partial reduction
+        part = quantized_psum_scatter(part.reshape(world, chunk), a, block_size) \
+            if False else part  # placeholder — replaced below
+    # single fused implementation over the (possibly multi-axis) group:
+    q, s = quantize_blockwise(rows, block_size)
+    q_sh = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
+    s_sh = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
+    deq = dequantize_blockwise(q_sh, s_sh, block_size)          # (world, chunk)
+    local_sum = jnp.sum(deq, axis=0) / world                    # (chunk,) mean
+    q2, s2 = quantize_blockwise(local_sum[None], block_size)
+    q_full = lax.all_gather(q2[:, 0] if q2.ndim == 3 else q2[0], axes, axis=0, tiled=False)
+    s_full = lax.all_gather(s2[0], axes, axis=0, tiled=False)
+    out = dequantize_blockwise(q_full, s_full, block_size)      # (world, chunk)
+    return out.reshape(-1)[:n].reshape(shape).astype(x.dtype)
+
+
+def spec_for_scales(spec, ndim: int):
+    """PartitionSpec for blockwise-quant scales (last dim replaced by
+    n_blocks): keep all entries except the last dim's, which must be None —
+    returns None if the last dim was sharded (blocks would straddle shards)."""
+    from jax.sharding import PartitionSpec as P
+
+    entries = list(spec) + [None] * (ndim - len(spec))
+    entries = entries[:ndim]
+    if ndim and entries[-1] is not None:
+        return None
+    return P(*entries)
+
+
+def quantized_reshard(x, target_spec, mesh, block_size: int = 256):
+    """ZeRO++ qwZ: move ``x`` to a less-sharded layout with int8 on the wire
+    (reference quantized all-gather handles, ``partition_parameters.py:1139``):
+    quantize shard-locally, re-shard the int8 payload + scales via sharding
+    constraints (XLA lowers to an int8 all-gather), dequantize locally.
+    Falls back to a plain reshard when the last dim is sharded (block
+    boundaries would straddle shards). In-jit (GSPMD, not shard_map).
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import NamedSharding
+
+    s_spec = spec_for_scales(target_spec, x.ndim)
+    if x.ndim == 0 or s_spec is None:
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, target_spec))
+    q, s = quantize_blockwise(x, block_size)
+    q = lax.with_sharding_constraint(q, NamedSharding(mesh, target_spec))
+    s = lax.with_sharding_constraint(s, NamedSharding(mesh, s_spec))
+    return dequantize_blockwise(q, s, block_size).astype(x.dtype)
+
+
 def quantized_psum_scatter(x, axis_name: str, block_size: int = 256):
     """ZeRO++ qgZ-style reduced-precision gradient reduce-scatter (reference
     ``all_to_all_quant_reduce`` coalesced_collectives.py:31): quantize, a2a,

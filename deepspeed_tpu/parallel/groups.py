@@ -13,7 +13,8 @@ or simply annotates shardings and lets XLA insert the collective.
 
 from typing import Optional, Tuple
 
-from .mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, MeshConfig, build_mesh, mesh_axis_size)
+from .mesh import (DATA_AXIS, DATA_REPL_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, MeshConfig,
+                   build_mesh, mesh_axis_size)
 from ..utils.logging import log_dist
 
 _WORLD_MESH = None
@@ -68,8 +69,9 @@ def reset():
 # ---- group accessors: each returns the mesh axis name(s) of that dimension ----
 
 def get_data_parallel_group() -> Tuple[str, ...]:
-    """ZeRO/DP sharding axes. When sequence parallelism is on, ZeRO shards over
-    (data, seq) — the reference's ``seq_data_parallel_group`` (engine.py:1546)."""
+    """ZeRO/DP *sharding* axes (the MiCS shard group: excludes ``data_repl``).
+    When sequence parallelism is on, ZeRO shards over (data, seq) — the
+    reference's ``seq_data_parallel_group`` (engine.py:1546)."""
     if mesh_axis_size(get_mesh(), SEQ_AXIS) > 1:
         return (DATA_AXIS, SEQ_AXIS)
     return (DATA_AXIS, )
@@ -77,6 +79,26 @@ def get_data_parallel_group() -> Tuple[str, ...]:
 
 def get_pure_data_parallel_group() -> Tuple[str, ...]:
     return (DATA_AXIS, )
+
+
+def get_batch_axes() -> Tuple[str, ...]:
+    """Axes the BATCH dimension shards over — the full data-parallel extent,
+    including MiCS replica groups (``data_repl`` is size 1 without MiCS)."""
+    return (DATA_REPL_AXIS, DATA_AXIS)
+
+
+def get_mics_replica_group() -> Tuple[str, ...]:
+    """MiCS inter-shard-group replication axis (reference mics.py sub-groups:
+    states replicated across these ranks, sharded within the shard group)."""
+    return (DATA_REPL_AXIS, )
+
+
+def get_batch_world_size() -> int:
+    m = get_mesh()
+    out = 1
+    for a in get_batch_axes():
+        out *= mesh_axis_size(m, a)
+    return out
 
 
 def get_model_parallel_group() -> Tuple[str, ...]:

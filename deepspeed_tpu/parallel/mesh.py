@@ -25,16 +25,26 @@ import jax
 from jax.sharding import Mesh, PartitionSpec, NamedSharding
 
 DATA_AXIS = "data"
+DATA_REPL_AXIS = "data_repl"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 
+# The batch dimension spans BOTH data axes. ``data_repl`` is 1 except under
+# MiCS (reference ``runtime/zero/mics.py``): with ``mics_shard_size=s`` the
+# data dimension splits into (dp/s) x s, ZeRO states shard over the inner
+# ``data`` axis only (replicated across ``data_repl``), and XLA's gradient
+# psum over both axes lowers to the reference's hierarchical allgather/
+# reduce — intra-shard-group traffic on nearest ICI neighbors.
+BATCH_AXES = (DATA_REPL_AXIS, DATA_AXIS)
+
 # Canonical axis order: pipe-major so pipeline stages land on contiguous
 # device blocks (ICI neighbors), then data, then seq, then model innermost so
 # TP rides the fastest ICI links — mirroring the reference's default
 # "pipe-data-model" topology order (pipe/topology.py:244) with seq added.
-AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+# data_repl sits outside data so a MiCS shard group is an ICI-contiguous block.
+AXIS_ORDER = (PIPE_AXIS, DATA_REPL_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 @dataclass
@@ -42,6 +52,7 @@ class MeshConfig:
     """Axis sizes for the global device mesh (TPU section of the JSON config)."""
 
     data: int = -1
+    data_repl: int = 1  # MiCS replica groups: data_repl = dp / mics_shard_size
     model: int = 1
     pipe: int = 1
     seq: int = 1
@@ -49,7 +60,8 @@ class MeshConfig:
     axis_order: Sequence[str] = field(default_factory=lambda: list(AXIS_ORDER))
 
     def resolve(self, n_devices: int) -> dict:
-        sizes = {PIPE_AXIS: self.pipe, DATA_AXIS: self.data, SEQ_AXIS: self.seq, MODEL_AXIS: self.model}
+        sizes = {PIPE_AXIS: self.pipe, DATA_REPL_AXIS: self.data_repl, DATA_AXIS: self.data,
+                 SEQ_AXIS: self.seq, MODEL_AXIS: self.model}
         unknown = [k for k, v in sizes.items() if v == -1]
         if len(unknown) > 1:
             raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
@@ -84,7 +96,7 @@ def build_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
 
 def single_device_mesh(device=None) -> Mesh:
     device = device or jax.devices()[0]
-    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), axis_names=AXIS_ORDER)
+    return Mesh(np.asarray([device]).reshape((1, ) * len(AXIS_ORDER)), axis_names=AXIS_ORDER)
 
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:

@@ -39,7 +39,7 @@ from ..accelerator import get_accelerator
 from ..comm import comm as dist
 from ..monitor.monitor import MonitorMaster
 from ..parallel import groups
-from ..parallel.mesh import DATA_AXIS, SEQ_AXIS, MeshConfig, build_mesh
+from ..parallel.mesh import BATCH_AXES, DATA_AXIS, DATA_REPL_AXIS, SEQ_AXIS, MeshConfig, build_mesh
 from ..utils.logging import logger, log_dist
 from ..utils.timer import (SynchronizedWallClockTimer, NoopTimer, ThroughputTimer, FORWARD_GLOBAL_TIMER,
                            BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER)
@@ -88,12 +88,29 @@ class DeepSpeedEngine:
             dist.init_distributed(dist_backend=get_accelerator().communication_backend_name())
 
         # --- mesh: single source of truth for all parallel dims ---
+        mics = config.zero_config.mics_shard_size
         if mesh is not None:
             self.mesh = groups.set_mesh(mesh, ep_size=getattr(config.tpu_config, "expert", 1))
         elif groups.is_initialized():
             self.mesh = groups.get_mesh()
         else:
-            self.mesh = groups.initialize_mesh(config.tpu_config.mesh_config())
+            mc = config.tpu_config.mesh_config()
+            if mics and mics > 0:
+                # MiCS (reference runtime/zero/mics.py): split the data axis
+                # into (replica, shard) so ZeRO states shard over only
+                # mics_shard_size devices and replicate across the rest
+                import jax as _jax
+
+                sizes = mc.resolve(len(_jax.devices()))
+                dp = sizes[DATA_AXIS] * sizes.get(DATA_REPL_AXIS, 1)
+                if dp % mics != 0:
+                    raise ValueError(f"mics_shard_size={mics} must divide the data-parallel size {dp}")
+                mc.data, mc.data_repl = mics, dp // mics
+            self.mesh = groups.initialize_mesh(mc)
+        if mics and mics > 0 and self.mesh.shape.get(DATA_AXIS, 1) != mics:
+            raise ValueError(f"mics_shard_size={mics} requires the mesh 'data' axis to equal it "
+                             f"(got {dict(self.mesh.shape)}); with an externally-built mesh, size the "
+                             f"'data'/'data_repl' axes accordingly")
         config.mesh = self.mesh
 
         # ZeRO shards over (data, seq) when SP is on, but the *batch* triad is
@@ -104,7 +121,8 @@ class DeepSpeedEngine:
         self.mp_world_size = groups.get_model_parallel_world_size()
         self.seq_world_size = groups.get_sequence_parallel_world_size()
         self.pipe_world_size = groups.get_pipe_parallel_world_size()
-        self.batch_dp_world_size = self.mesh.shape.get(DATA_AXIS, 1)
+        self.batch_dp_world_size = (self.mesh.shape.get(DATA_AXIS, 1)
+                                    * self.mesh.shape.get(DATA_REPL_AXIS, 1))
         config.resolve_batch_config(self.batch_dp_world_size)
         if self.pipe_world_size > 1:
             # same constraint as the reference: PP composes with ZeRO<=1
@@ -230,6 +248,8 @@ class DeepSpeedEngine:
         name = (self.config.optimizer_name or "").lower()
         if name not in (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
             return None
+        assert not self.config.zero_config.mics_shard_size or self.config.zero_config.mics_shard_size <= 0, \
+            "1-bit optimizers compose with plain DP, not MiCS (their compressed exchange runs over the data axis only)"
         from .fp16.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
 
         cls = {ONEBIT_ADAM_OPTIMIZER: OnebitAdam, ONEBIT_LAMB_OPTIMIZER: OnebitLamb,
@@ -685,7 +705,7 @@ class DeepSpeedEngine:
             nlead = len(leading)
             spec = [None] * x.ndim
             if x.ndim > nlead:
-                spec[nlead] = DATA_AXIS
+                spec[nlead] = BATCH_AXES  # (data_repl, data) — full DP extent
             if self.seq_world_size > 1 and x.ndim > nlead + 1:
                 spec[nlead + 1] = SEQ_AXIS
             s = NamedSharding(self.mesh, P(*spec))
@@ -848,16 +868,22 @@ class DeepSpeedEngine:
             mesh_devs = self.mesh.devices  # ndarray indexed by axis order
             axis_names = list(self.mesh.axis_names)
             data_dim = axis_names.index(DATA_AXIS)
+            repl_dim = axis_names.index(DATA_REPL_AXIS) if DATA_REPL_AXIS in axis_names else None
             import numpy as _np
 
             proc = jax.process_index()
             coords = set()
             it = _np.nditer(_np.empty(mesh_devs.shape), flags=["multi_index"])
+            data_size = mesh_devs.shape[data_dim]
             for _ in it:
                 d = mesh_devs[it.multi_index]
                 if d.process_index == proc:
-                    coords.add(it.multi_index[data_dim])
-            dp_size = mesh_devs.shape[data_dim]
+                    # flat coord over (data_repl, data): batch shards span both
+                    c = it.multi_index[data_dim]
+                    if repl_dim is not None:
+                        c += it.multi_index[repl_dim] * data_size
+                    coords.add(c)
+            dp_size = data_size * (mesh_devs.shape[repl_dim] if repl_dim is not None else 1)
             coords = sorted(coords)
             n_owned = len(coords)
             if n_owned == 0 or dp_size % n_owned != 0:

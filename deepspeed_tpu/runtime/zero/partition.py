@@ -24,8 +24,9 @@ Tensor-parallel rules compose: each param first receives its TP spec (over the
 ``model`` axis), then ZeRO shards the largest remaining divisible dimension
 over the data axes, matching how the reference composes mpu TP with ZeRO
 (``engine.py:1546``). MiCS (reference ``runtime/zero/mics.py``) maps to
-sharding over a sub-axis of data (not yet implemented — see
-``ZeroShardingPolicy.__init__``).
+sharding over the inner ``data`` axis of a (data_repl, data) split — states
+sharded within a shard group of ``mics_shard_size`` devices, replicated
+across groups (see ``ZeroShardingPolicy.__init__`` and ``parallel/mesh.py``).
 """
 
 import re
@@ -168,17 +169,43 @@ class ZeroShardingPolicy:
                  stage: int = 0,
                  tp_rules: Optional[PartitionRules] = None,
                  data_axes: Optional[Sequence[str]] = None,
-                 mics_shard_size: int = -1):
+                 mics_shard_size: int = -1,
+                 hpz_partition_size: int = 0):
         self.mesh = mesh
         self.stage = stage
         self.tp_rules = tp_rules or PartitionRules()
         self.data_axes = tuple(data_axes) if data_axes is not None else groups.get_data_parallel_group()
         self.data_axes = tuple(a for a in self.data_axes if mesh.shape.get(a, 1) >= 1)
         self.mics_shard_size = mics_shard_size
+        self.hpz_partition_size = hpz_partition_size
+        if hpz_partition_size and hpz_partition_size > 1:
+            # ZeRO++ hpZ (reference groups.py:505 + partition_parameters.py
+            # ds_secondary_tensor): primary states shard over the FULL dp
+            # extent (data_repl x data); the forward consumes a secondary
+            # copy sharded over only the inner ``data`` axis (== the hpZ
+            # group), so per-layer weight gathers stay within the group.
+            from ...parallel.mesh import DATA_AXIS, DATA_REPL_AXIS
+
+            got = mesh.shape.get(DATA_AXIS, 1)
+            if got != hpz_partition_size:
+                raise ValueError(f"hpZ: mesh '{DATA_AXIS}' axis is {got} but zero_hpz_partition_size="
+                                 f"{hpz_partition_size}; the engine must split the data axis first")
+            self.secondary_axes = self.data_axes
+            self.data_axes = (DATA_REPL_AXIS, ) + tuple(self.data_axes)
         if mics_shard_size > 0:
-            logger.warning(f"MiCS (mics_shard_size={mics_shard_size}) is not implemented yet; "
-                           f"falling back to full data-axis sharding (plain ZeRO-{stage}). "
-                           f"Sub-group sharding requires a split data axis — planned.")
+            # MiCS (reference runtime/zero/mics.py): the engine splits the
+            # data dimension into (data_repl, data) mesh axes with
+            # |data| == mics_shard_size. This policy's data_axes exclude
+            # data_repl, so states shard over the small shard group and
+            # replicate across replica groups; the batch spans both axes, so
+            # XLA's gradient reduction covers the full DP extent
+            # (hierarchical: reduce within shard group rides nearest ICI).
+            from ...parallel.mesh import DATA_AXIS
+
+            got = mesh.shape.get(DATA_AXIS, 1)
+            if got != mics_shard_size:
+                raise ValueError(f"MiCS: mesh '{DATA_AXIS}' axis is {got} but mics_shard_size="
+                                 f"{mics_shard_size}; the engine must split the data axis first")
 
     # -- specs --------------------------------------------------------
     def tp_spec_tree(self, params):
@@ -195,6 +222,14 @@ class ZeroShardingPolicy:
         if self.stage >= 3:
             return self._sharded_spec_tree(params)
         return self.tp_spec_tree(params)
+
+    def secondary_param_specs(self, params):
+        """hpZ secondary copy: sharded over the intra-group axes only (so the
+        forward's per-layer all-gathers stay inside the hpZ group)."""
+        assert self.hpz_partition_size and self.hpz_partition_size > 1
+        tp = self.tp_spec_tree(params)
+        return jax.tree_util.tree_map(
+            lambda x, s: add_data_axes(s, np.shape(x), self.mesh, self.secondary_axes), params, tp)
 
     def grad_specs(self, params):
         if self.stage >= 2:

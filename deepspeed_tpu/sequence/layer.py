@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import SEQ_AXIS, DATA_AXIS
+from ..parallel.mesh import SEQ_AXIS, BATCH_AXES
 
 
 def single_all_to_all(x, scatter_idx: int, gather_idx: int, axis_name: str = SEQ_AXIS):
@@ -75,14 +75,14 @@ class DistributedAttention:
         return _SeqAllToAll.apply(self.spg, ctx, self.gather_idx, self.scatter_idx)
 
 
-def ulysses_qkv_constraint(x, mesh=None, batch_axes=(DATA_AXIS, ), seq_axis=SEQ_AXIS):
+def ulysses_qkv_constraint(x, mesh=None, batch_axes=BATCH_AXES, seq_axis=SEQ_AXIS):
     """GSPMD head-sharding constraint for q/k/v [B, S, n, d]: puts the seq
     mesh axis on the head dim, triggering XLA's all-to-all."""
     spec = P(tuple(batch_axes), None, seq_axis, None)
     return lax.with_sharding_constraint(x, spec if mesh is None else jax.NamedSharding(mesh, spec))
 
 
-def ulysses_output_constraint(x, mesh=None, batch_axes=(DATA_AXIS, ), seq_axis=SEQ_AXIS):
+def ulysses_output_constraint(x, mesh=None, batch_axes=BATCH_AXES, seq_axis=SEQ_AXIS):
     """GSPMD constraint restoring sequence sharding on attention output."""
     spec = P(tuple(batch_axes), seq_axis, None, None)
     return lax.with_sharding_constraint(x, spec if mesh is None else jax.NamedSharding(mesh, spec))
@@ -93,7 +93,7 @@ def ulysses_attention_gspmd(attn_fn: Callable,
                             key,
                             value,
                             *args,
-                            batch_axes=(DATA_AXIS, ),
+                            batch_axes=BATCH_AXES,
                             seq_axis: str = SEQ_AXIS,
                             **kwargs):
     """GSPMD-form Ulysses: sharding constraints around ``attn_fn``.

@@ -38,7 +38,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from ..parallel.mesh import SEQ_AXIS, DATA_AXIS, MODEL_AXIS
+from ..parallel.mesh import SEQ_AXIS, BATCH_AXES, MODEL_AXIS
 
 
 def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True, axis_size: Optional[int] = None,
@@ -128,7 +128,7 @@ class RingAttention:
 
 
 def ring_attention_gspmd(q, k, v, mesh, causal: bool = True, seq_axis: str = SEQ_AXIS,
-                         batch_axes=(DATA_AXIS, ), model_axis: str = MODEL_AXIS):
+                         batch_axes=BATCH_AXES, model_axis: str = MODEL_AXIS):
     """Ring attention on *global* arrays sharded over ``mesh``.
 
     q/k/v: [B, S, n, d] with B sharded over ``batch_axes``, S over
