@@ -68,6 +68,12 @@ class TransformerConfig:
     moe_min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
     moe_noisy_gate_policy: Optional[str] = None
+    # ZeRO++ qwZ (reference partition_parameters.py:1139 quantized all-gather
+    # handles): when set (by the engine, from zero_quantized_weights), the
+    # per-layer stage-3 weight gathers inside the scan body travel as int8
+    # payload + per-block scales instead of fp32 — 4x less ICI traffic —
+    # with a straight-through gradient to the fp32 masters.
+    quantized_weights: bool = False
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -251,10 +257,54 @@ def _attention(cfg: TransformerConfig, q, k, v):
     return reference_attention(q, k, v, causal=True)
 
 
+def _qwz_target_specs(cfg: TransformerConfig, layer):
+    """ZeRO++ qwZ: the per-layer compute layout each big weight is gathered
+    into — derived from ``partition_rules`` (dropping the stacked layer dim,
+    which the per-layer slice no longer has), so the two never drift. MoE
+    expert weights (data axis in their TP spec = expert parallelism, not a
+    ZeRO gather) and 1-D vectors are skipped."""
+    rules = partition_rules(cfg)
+    out = {}
+    for k, v in layer.items():
+        if np.ndim(v) < 2:
+            continue
+        full = rules.spec_for(f"blocks/{k}", np.ndim(v) + 1)
+        entries = list(full)[1:]  # drop the stacked-L/pipe dim
+        flat = [a for e in entries if e is not None
+                for a in (e if isinstance(e, (tuple, list)) else (e, ))]
+        if DATA_AXIS in flat:
+            continue
+        out[k] = P(*entries)
+    return out
+
+
+def _qwz_layer_view(cfg: TransformerConfig, layer):
+    """Route the stage-3 per-layer weight gathers through int8
+    (ops/pallas/quant.quantized_gather_ste)."""
+    from ..parallel import groups
+    from ..ops.pallas.quant import quantized_gather_ste
+    from ..utils.logging import logger
+
+    if not groups.is_initialized():
+        return layer
+    mesh = groups.get_mesh()
+    out = dict(layer)
+    for k, spec in _qwz_target_specs(cfg, layer).items():
+        try:
+            out[k] = quantized_gather_ste(out[k], spec, mesh)
+        except (ValueError, jax.errors.JaxRuntimeError, RuntimeError) as e:
+            # e.g. manual mesh axes inside shard_map: keep the plain view,
+            # but say so — a silent fp32 fallback would defeat the flag
+            logger.warning(f"qwZ: falling back to unquantized gather for blocks/{k}: {e}")
+    return out
+
+
 def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True):
     """One transformer block; ``layer`` holds this layer's slice of the
     stacked arrays. Returns (x, moe_aux_loss). ``constrain=False`` disables
     GSPMD sharding constraints (for use inside shard_map pipeline stages)."""
+    if cfg.quantized_weights and constrain:
+        layer = _qwz_layer_view(cfg, layer)
     dt = cfg.dtype
     B, S, H = x.shape
     nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim

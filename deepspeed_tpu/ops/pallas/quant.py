@@ -3,27 +3,43 @@
 TPU equivalent of the reference quantization suite
 (``csrc/quantization/{quantize,dequantize,quant_reduce,...}.cu``, 2,289 LoC,
 exposed via ``QuantizerBuilder``) which powers ZeRO++'s quantized-weight
-all-gather (qwZ) and quantized-gradient all-to-all reduce (qgZ,
+all-gather (qwZ, ``runtime/zero/partition_parameters.py:1139``) and
+quantized-gradient all-to-all reduce (qgZ,
 ``runtime/comm/coalesced_collectives.py:31``). Here quant/dequant are
 jnp-level (XLA fuses the scale/round chain into surrounding ops); the
 symmetric int8 blockwise format matches the reference's group-wise scheme.
+
+Two families of entry points:
+
+  * GSPMD (in-jit, sharding-constraint based): ``quantized_reshard`` and its
+    straight-through-gradient wrapper ``quantized_gather_ste`` — the weight
+    all-gather travels as int8 payload + per-block fp32 scales.
+  * shard_map (manual collective axes): ``quantized_all_gather_dim`` /
+    ``quantized_psum_scatter_dim`` — the hpZ/qgZ building blocks the engine
+    uses inside its ``shard_map`` over the ``data_repl`` axis, plus the
+    flat-vector ``quantized_psum_scatter`` / ``quantized_allreduce_mean``.
 """
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
-def quantize_blockwise(x: jax.Array, block_size: int = 256, dtype=jnp.int8) -> Tuple[jax.Array, jax.Array]:
-    """Symmetric per-block int8 quantization of the last axis.
+def quantize_blockwise(x: jax.Array, block_size: int = 256, dtype=jnp.int8,
+                       axis: int = -1) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-block int8 quantization along ``axis``.
 
-    Returns (q, scales) with q: same shape as x in int8, scales:
-    x.shape[:-1] + [n_blocks] in fp32.
+    Returns (q, scales): q has x's shape in int8; scales replace the ``axis``
+    dim with n_blocks, in fp32.
     """
-    orig_shape = x.shape
-    n = orig_shape[-1]
+    axis = axis % max(x.ndim, 1)
+    moved = axis != x.ndim - 1
+    if moved:
+        x = jnp.moveaxis(x, axis, -1)
+    n = x.shape[-1]
     pad = (-n) % block_size
     if pad:
         x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
@@ -34,10 +50,20 @@ def quantize_blockwise(x: jax.Array, block_size: int = 256, dtype=jnp.int8) -> T
     q = q.reshape(*x.shape[:-1], -1)
     if pad:
         q = q[..., :n]
-    return q, scale[..., 0]
+    s = scale[..., 0]
+    if moved:
+        q = jnp.moveaxis(q, -1, axis)
+        s = jnp.moveaxis(s, -1, axis)
+    return q, s
 
 
-def dequantize_blockwise(q: jax.Array, scales: jax.Array, block_size: int = 256) -> jax.Array:
+def dequantize_blockwise(q: jax.Array, scales: jax.Array, block_size: int = 256,
+                         axis: int = -1) -> jax.Array:
+    axis = axis % max(q.ndim, 1)
+    moved = axis != q.ndim - 1
+    if moved:
+        q = jnp.moveaxis(q, axis, -1)
+        scales = jnp.moveaxis(scales, axis, -1)
     n = q.shape[-1]
     pad = (-n) % block_size
     if pad:
@@ -47,17 +73,77 @@ def dequantize_blockwise(q: jax.Array, scales: jax.Array, block_size: int = 256)
     x = x.reshape(*q.shape[:-1], -1)
     if pad:
         x = x[..., :n]
+    if moved:
+        x = jnp.moveaxis(x, -1, axis)
     return x
 
 
+# ---------------------------------------------------------------------------
+# shard_map collectives (manual mesh axes)
+# ---------------------------------------------------------------------------
+
+def _quant_axis_for(shape, avoid_dim: int) -> Optional[int]:
+    """Pick the quantization axis: the largest dim other than ``avoid_dim``
+    (blocks must not straddle the concat/split dim of the collective).
+    None when the array has no other dim worth blocking (gather plain)."""
+    cands = [i for i in range(len(shape)) if i != avoid_dim and shape[i] > 1]
+    if not cands:
+        return None
+    return max(cands, key=lambda i: shape[i])
+
+
+def quantized_all_gather_dim(x, axis_name, dim: int, block_size: int = 256):
+    """ZeRO++ qwZ hop (reference ``partition_parameters.py:1139`` quantized
+    all-gather handles): all-gather int8 payload + fp32 block scales along
+    ``dim`` over the manual mesh axis ``axis_name``, dequantize locally —
+    4x less wire traffic than fp32. For use inside ``shard_map``."""
+    qaxis = _quant_axis_for(x.shape, dim % max(x.ndim, 1))
+    if qaxis is None:
+        return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+    q, s = quantize_blockwise(x, block_size, axis=qaxis)
+    qf = lax.all_gather(q, axis_name, axis=dim, tiled=True)
+    sf = lax.all_gather(s, axis_name, axis=dim, tiled=True)
+    return dequantize_blockwise(qf, sf, block_size, axis=qaxis).astype(x.dtype)
+
+
+def quantized_psum_scatter_dim(x, axis_name, dim: int, block_size: int = 256):
+    """ZeRO++ qgZ hop (reference ``all_to_all_quant_reduce``
+    coalesced_collectives.py:31): quantize, all-to-all int8 along ``dim``,
+    dequantize, local sum — returns the group SUM scattered along ``dim``
+    (psum_scatter semantics, tiled). For use inside ``shard_map``."""
+    dim = dim % max(x.ndim, 1)
+    world = lax.psum(1, axis_name)
+    qaxis = _quant_axis_for(x.shape, dim)
+    if qaxis is None:
+        return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+    q, s = quantize_blockwise(x, block_size, axis=qaxis)
+    q2 = lax.all_to_all(q, axis_name, split_axis=dim, concat_axis=dim, tiled=True)
+    s2 = lax.all_to_all(s, axis_name, split_axis=dim, concat_axis=dim, tiled=True)
+    deq = dequantize_blockwise(q2, s2, block_size, axis=qaxis)
+    # the received ``world`` chunks to be summed are tiled along ``dim``
+    moved = jnp.moveaxis(deq, dim, 0)
+    moved = moved.reshape(world, moved.shape[0] // world, *moved.shape[1:])
+    out = jnp.moveaxis(moved.sum(axis=0), 0, dim)
+    return out.astype(x.dtype)
+
+
 def quantized_all_gather(x, axis_name: str, block_size: int = 256):
-    """ZeRO++ qwZ: all-gather int8 + local dequant — 4x less ICI traffic than
-    fp32 all-gather (reference ``partition_parameters.py:1139`` quantized
-    handles). In-jit only."""
+    """Flat-vector qwZ: all-gather int8 + local dequant along dim 0 — 4x less
+    ICI traffic than fp32 all-gather. In-jit (shard_map) only."""
+    return quantized_all_gather_dim(x, axis_name, 0, block_size)
+
+
+def quantized_psum_scatter(x, axis_name: str, block_size: int = 256):
+    """qgZ reduced-precision gradient reduce-scatter over dim 0 (reference
+    ``all_to_all_quant_reduce`` coalesced_collectives.py:31): quantize, a2a,
+    local dequant+reduce. In-jit (shard_map) only."""
+    n_dev = lax.psum(1, axis_name)
     q, s = quantize_blockwise(x, block_size)
-    q_full = jax.lax.all_gather(q, axis_name, axis=0, tiled=True)
-    s_full = jax.lax.all_gather(s, axis_name, axis=0, tiled=True)
-    return dequantize_blockwise(q_full, s_full, block_size)
+    q_sh = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    s_sh = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    deq = dequantize_blockwise(q_sh, s_sh, block_size)
+    parts = jnp.split(deq, n_dev, axis=0)
+    return functools.reduce(jnp.add, parts)
 
 
 def quantized_allreduce_mean(x, axis_name, block_size: int = 256):
@@ -68,9 +154,6 @@ def quantized_allreduce_mean(x, axis_name, block_size: int = 256):
 
     ``axis_name`` may be a tuple of mesh axes (reduces over their product).
     """
-    import jax.numpy as jnp
-    from jax import lax
-
     axes = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name, )
     world = lax.psum(1, axes)
     shape, n = x.shape, x.size
@@ -80,67 +163,81 @@ def quantized_allreduce_mean(x, axis_name, block_size: int = 256):
     flat = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, chunk * world - n))
     rows = flat.reshape(world, chunk)
 
-    part = rows
-    for a in axes:  # hop per axis: a2a quantized partial reduction
-        part = quantized_psum_scatter(part.reshape(world, chunk), a, block_size) \
-            if False else part  # placeholder — replaced below
-    # single fused implementation over the (possibly multi-axis) group:
     q, s = quantize_blockwise(rows, block_size)
     q_sh = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
     s_sh = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
     deq = dequantize_blockwise(q_sh, s_sh, block_size)          # (world, chunk)
     local_sum = jnp.sum(deq, axis=0) / world                    # (chunk,) mean
     q2, s2 = quantize_blockwise(local_sum[None], block_size)
-    q_full = lax.all_gather(q2[:, 0] if q2.ndim == 3 else q2[0], axes, axis=0, tiled=False)
+    q_full = lax.all_gather(q2[0], axes, axis=0, tiled=False)
     s_full = lax.all_gather(s2[0], axes, axis=0, tiled=False)
     out = dequantize_blockwise(q_full, s_full, block_size)      # (world, chunk)
     return out.reshape(-1)[:n].reshape(shape).astype(x.dtype)
 
 
-def spec_for_scales(spec, ndim: int):
-    """PartitionSpec for blockwise-quant scales (last dim replaced by
-    n_blocks): keep all entries except the last dim's, which must be None —
-    returns None if the last dim was sharded (blocks would straddle shards)."""
+# ---------------------------------------------------------------------------
+# GSPMD resharding (in-jit sharding constraints; XLA lowers to int8 gathers)
+# ---------------------------------------------------------------------------
+
+def spec_for_scales(spec, ndim: int, axis: int):
+    """PartitionSpec for blockwise-quant scales: identical to the payload's
+    spec except the quantized ``axis`` (whose size became n_blocks) must be
+    unsharded — returns None if that dim was sharded in ``spec`` (blocks
+    would straddle shards)."""
     from jax.sharding import PartitionSpec as P
 
     entries = list(spec) + [None] * (ndim - len(spec))
     entries = entries[:ndim]
-    if ndim and entries[-1] is not None:
+    if ndim and entries[axis] is not None:
         return None
     return P(*entries)
 
 
-def quantized_reshard(x, target_spec, mesh, block_size: int = 256):
+def quantized_reshard(x, target_spec, mesh, block_size: int = 256, axis: Optional[int] = None):
     """ZeRO++ qwZ: move ``x`` to a less-sharded layout with int8 on the wire
     (reference quantized all-gather handles, ``partition_parameters.py:1139``):
-    quantize shard-locally, re-shard the int8 payload + scales via sharding
-    constraints (XLA lowers to an int8 all-gather), dequantize locally.
-    Falls back to a plain reshard when the last dim is sharded (block
-    boundaries would straddle shards). In-jit (GSPMD, not shard_map).
+    quantize shard-locally along a dim the target leaves unsharded, re-shard
+    the int8 payload + scales via sharding constraints (XLA lowers to an int8
+    all-gather), dequantize locally. Falls back to a plain reshard when every
+    dim is sharded in the target (block boundaries would straddle shards).
+    In-jit (GSPMD, not shard_map).
     """
-    import jax
-    from jax import lax
-    from jax.sharding import NamedSharding
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    s_spec = spec_for_scales(target_spec, x.ndim)
-    if x.ndim == 0 or s_spec is None:
+    if x.ndim == 0:
         return lax.with_sharding_constraint(x, NamedSharding(mesh, target_spec))
-    q, s = quantize_blockwise(x, block_size)
-    q = lax.with_sharding_constraint(q, NamedSharding(mesh, target_spec))
+    entries = list(target_spec) + [None] * (x.ndim - len(target_spec))
+    entries = entries[:x.ndim]
+    if axis is None:
+        open_dims = [i for i in range(x.ndim) if entries[i] is None and x.shape[i] > 1]
+        axis = max(open_dims, key=lambda i: x.shape[i]) if open_dims else None
+    if axis is None:
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*entries)))
+    s_spec = spec_for_scales(P(*entries), x.ndim, axis)
+    if s_spec is None:
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*entries)))
+    q, s = quantize_blockwise(x, block_size, axis=axis)
+    q = lax.with_sharding_constraint(q, NamedSharding(mesh, P(*entries)))
     s = lax.with_sharding_constraint(s, NamedSharding(mesh, s_spec))
-    return dequantize_blockwise(q, s, block_size).astype(x.dtype)
+    return dequantize_blockwise(q, s, block_size, axis=axis).astype(x.dtype)
 
 
-def quantized_psum_scatter(x, axis_name: str, block_size: int = 256):
-    """ZeRO++ qgZ-style reduced-precision gradient reduce-scatter (reference
-    ``all_to_all_quant_reduce`` coalesced_collectives.py:31): quantize, a2a,
-    local dequant+reduce. In-jit only."""
-    n_dev = jax.lax.psum(1, axis_name)
-    q, s = quantize_blockwise(x, block_size)
-    # all-to-all: each device receives its shard from every peer
-    q_sh = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
-    s_sh = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=True)
-    deq = dequantize_blockwise(q_sh, s_sh, block_size)
-    # sum the n_dev received contributions (concatenated along axis 0)
-    parts = jnp.split(deq, n_dev, axis=0)
-    return functools.reduce(jnp.add, parts)
+def quantized_gather_ste(x, target_spec, mesh, block_size: int = 256):
+    """``quantized_reshard`` with a straight-through gradient: the forward
+    gathers int8 on the wire; the backward passes the cotangent through
+    unchanged (XLA re-shards/reduces it to ``x``'s layout at the join) —
+    matching the reference's qwZ semantics where gradients are computed at
+    the dequantized weights and applied to the fp32 masters."""
+
+    @jax.custom_vjp
+    def f(y):
+        return quantized_reshard(y, target_spec, mesh, block_size)
+
+    def fwd(y):
+        return quantized_reshard(y, target_spec, mesh, block_size), None
+
+    def bwd(_, g):
+        return (g, )
+
+    f.defvjp(fwd, bwd)
+    return f(x)

@@ -89,28 +89,65 @@ class DeepSpeedEngine:
 
         # --- mesh: single source of truth for all parallel dims ---
         mics = config.zero_config.mics_shard_size
+        # ZeRO++ flags (reference engine.py:858 consumption of
+        # zero_quantized_weights / zero_quantized_gradients, groups.py:505 hpZ)
+        zcfg = config.zero_config
+        hpz = zcfg.zero_hpz_partition_size or 0
+        self._qwz = bool(zcfg.zero_quantized_weights)
+        self._qgz = bool(zcfg.zero_quantized_gradients)
+        self._hpz = hpz if hpz > 1 else 0
+        if self._qwz or self._qgz or self._hpz:
+            if config.zero_optimization_stage != 3:
+                raise ValueError("ZeRO++ (zero_quantized_weights / zero_quantized_gradients / "
+                                 "zero_hpz_partition_size) requires zero stage 3, got "
+                                 f"stage {config.zero_optimization_stage}")
+            if mics and mics > 0:
+                raise ValueError("ZeRO++ and MiCS both split the data axis; enable one or the other")
+        if self._qgz and not self._hpz:
+            raise ValueError(
+                "zero_quantized_gradients on TPU rides the hpZ two-level reduction (intra-group "
+                "reduce is compiler-scheduled fp32 over nearest ICI, the inter-group hop is int8): "
+                "set zero_hpz_partition_size > 1 as well")
+        if (self._qwz or self._qgz or self._hpz) and config.zero_config.offload_optimizer is not None \
+                and str(config.zero_config.offload_optimizer_device) != "none":
+            raise ValueError("ZeRO++ does not compose with offload_optimizer yet")
+        # MiCS and hpZ both split the data axis into (data_repl, data); they
+        # differ in where the optimizer states live (MiCS: inner axis only;
+        # hpZ: full extent, with a per-step secondary gather)
+        inner_split = mics if (mics and mics > 0) else self._hpz
         if mesh is not None:
             self.mesh = groups.set_mesh(mesh, ep_size=getattr(config.tpu_config, "expert", 1))
         elif groups.is_initialized():
             self.mesh = groups.get_mesh()
         else:
             mc = config.tpu_config.mesh_config()
-            if mics and mics > 0:
-                # MiCS (reference runtime/zero/mics.py): split the data axis
-                # into (replica, shard) so ZeRO states shard over only
-                # mics_shard_size devices and replicate across the rest
+            if inner_split:
+                # MiCS (reference runtime/zero/mics.py) / ZeRO++ hpZ (reference
+                # groups.py:505): split the data axis into (replica, shard)
                 import jax as _jax
 
                 sizes = mc.resolve(len(_jax.devices()))
                 dp = sizes[DATA_AXIS] * sizes.get(DATA_REPL_AXIS, 1)
-                if dp % mics != 0:
-                    raise ValueError(f"mics_shard_size={mics} must divide the data-parallel size {dp}")
-                mc.data, mc.data_repl = mics, dp // mics
+                if dp % inner_split != 0:
+                    which = "mics_shard_size" if mics and mics > 0 else "zero_hpz_partition_size"
+                    raise ValueError(f"{which}={inner_split} must divide the data-parallel size {dp}")
+                mc.data, mc.data_repl = inner_split, dp // inner_split
             self.mesh = groups.initialize_mesh(mc)
-        if mics and mics > 0 and self.mesh.shape.get(DATA_AXIS, 1) != mics:
-            raise ValueError(f"mics_shard_size={mics} requires the mesh 'data' axis to equal it "
+        if inner_split and self.mesh.shape.get(DATA_AXIS, 1) != inner_split:
+            which = "mics_shard_size" if mics and mics > 0 else "zero_hpz_partition_size"
+            raise ValueError(f"{which}={inner_split} requires the mesh 'data' axis to equal it "
                              f"(got {dict(self.mesh.shape)}); with an externally-built mesh, size the "
                              f"'data'/'data_repl' axes accordingly")
+        self._hpz_degraded = False
+        if self._hpz and self.mesh.shape.get(DATA_REPL_AXIS, 1) <= 1:
+            logger.warning(f"zero_hpz_partition_size={hpz} covers the whole data-parallel extent: "
+                           "hpZ has no secondary hop and degrades to plain ZeRO-3 (choose a "
+                           "partition size smaller than the data-parallel size)"
+                           + ("; zero_quantized_gradients is a no-op too (there is no inter-group "
+                              "hop to quantize)" if self._qgz else ""))
+            self._hpz = 0
+            self._qgz = False
+            self._hpz_degraded = True
         config.mesh = self.mesh
 
         # ZeRO shards over (data, seq) when SP is on, but the *batch* triad is
@@ -144,8 +181,36 @@ class DeepSpeedEngine:
         rules = model.partition_rules() if hasattr(model, "partition_rules") else PartitionRules()
         mics = config.zero_config.mics_shard_size
         self.zero_policy = ZeroShardingPolicy(self.mesh, stage=config.zero_optimization_stage, tp_rules=rules,
-                                              mics_shard_size=mics)
+                                              mics_shard_size=mics, hpz_partition_size=self._hpz)
         self.zero_enabled = config.zero_enabled
+        # qwZ without hpZ: the per-layer stage-3 weight gathers themselves
+        # go int8 — this needs the model to route its weight views through
+        # quantized_gather_ste (reference quantizes inside the all-gather
+        # handle, partition_parameters.py:1139; here the model's forward
+        # is where the gathers live, so the hook is a model config flag).
+        # The flag is SYNCED (set or cleared) so a model object reused across
+        # engines does not leak one engine's qwZ mode into the next.
+        wants_model_qwz = self._qwz and not self._hpz
+        mcfg = getattr(self.module, "config", None)
+        if mcfg is not None and hasattr(mcfg, "quantized_weights"):
+            mcfg.quantized_weights = wants_model_qwz
+        elif wants_model_qwz:
+            hint = ("zero_hpz_partition_size was set but covers the whole data-parallel extent "
+                    "(degraded to plain ZeRO-3); choose a partition size smaller than the "
+                    "data-parallel size" if self._hpz_degraded else
+                    "either use such a model or also set zero_hpz_partition_size to quantize "
+                    "the inter-group secondary gather instead")
+            raise ValueError(
+                "zero_quantized_weights without an effective zero_hpz_partition_size needs a "
+                "model that supports quantized weight gathers (a config.quantized_weights flag, "
+                f"like models.transformer.TransformerLM); {hint}")
+        if wants_model_qwz:
+            log_dist("ZeRO++ qwZ: per-layer weight gathers quantized to int8 (model-level)", ranks=[0])
+        if self._hpz:
+            log_dist(f"ZeRO++ hpZ: secondary weight shard over the {self.mesh.shape[DATA_AXIS]}-wide "
+                     f"'data' group, {self.mesh.shape.get(DATA_REPL_AXIS, 1)} groups"
+                     + ("; qwZ int8 secondary gather" if self._qwz else "")
+                     + ("; qgZ int8 inter-group gradient reduce" if self._qgz else ""), ranks=[0])
 
         # --- optimizer chain ---
         self.lr_schedule_fn, self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
@@ -592,10 +657,113 @@ class DeepSpeedEngine:
             return self._build_pipeline_train_step()
         if self._onebit is not None:
             return self._build_onebit_train_step(gas)
+        if self._hpz:
+            return self._build_hpz_train_step(gas)
 
         def train_step(state, batches, rng):
             acc, losses = self._scan_microbatch_grads(state["params"], batches, rng, state["loss_scale"], gas)
             return self._finalize_step(state, acc, jnp.mean(losses))
+
+        return self._jit_step(train_step)
+
+    def _build_hpz_train_step(self, gas: int):
+        """ZeRO++ hpZ/qwZ/qgZ train step (reference hpZ groups ``groups.py:505``,
+        qwZ ``partition_parameters.py:1139``, qgZ ``coalesced_collectives.py:31``).
+
+        A ``shard_map`` manual over the ``data_repl`` axis (everything else
+        stays GSPMD-auto) makes the hierarchy explicit:
+
+          1. gather each primary param shard over ``data_repl`` once per step
+             — the hpZ *secondary copy*, int8 on the wire when qwZ — leaving
+             it stage-3 sharded over the inner ``data`` axis, so every
+             per-layer gather inside the forward/backward stays within the
+             hpZ group (nearest ICI);
+          2. run the microbatch scan against the secondary copy (intra-group
+             collectives compiler-inserted, fp32/bf16);
+          3. reduce the accumulated grads back to the primary layout with a
+             ``psum_scatter`` over ``data_repl`` — the qgZ int8 all-to-all
+             when enabled (intra-group reduction already happened in fp32 via
+             GSPMD: the reference's 2-level scheme).
+        """
+        from ..ops.pallas.quant import quantized_all_gather_dim, quantized_psum_scatter_dim
+
+        policy = self.zero_policy
+        params = self.state["params"]
+        primary_specs = policy.param_specs(params)
+        n_repl = self.mesh.shape.get(DATA_REPL_AXIS, 1)
+        qwz, qgz = self._qwz, self._qgz
+        is_spec = lambda x: isinstance(x, P)
+
+        def repl_dim(spec):
+            # -1 == replicated over data_repl (None would vanish as a pytree leaf)
+            for i, e in enumerate(spec):
+                axes = e if isinstance(e, (tuple, list)) else ((e, ) if e is not None else ())
+                if DATA_REPL_AXIS in axes:
+                    return i
+            return -1
+
+        dims = jax.tree_util.tree_map(repl_dim, primary_specs, is_leaf=is_spec)
+
+        def manual_spec(x, d):
+            if d < 0:
+                return P()
+            return P(*[DATA_REPL_AXIS if i == d else None for i in range(np.ndim(x))])
+
+        param_manual = jax.tree_util.tree_map(manual_spec, params, dims)
+        batch_manual = jax.tree_util.tree_map(
+            lambda nd: P(*([None, DATA_REPL_AXIS] + [None] * (max(nd - 2, 0)))), self._last_batch_struct)
+
+        def local_fn(p_shard, batches, rng, loss_scale):
+            def gather(x, d):
+                if d < 0:
+                    return x
+                if qwz:
+                    return quantized_all_gather_dim(x, DATA_REPL_AXIS, d)
+                return jax.lax.all_gather(x, DATA_REPL_AXIS, axis=d, tiled=True)
+
+            secondary = jax.tree_util.tree_map(gather, p_shard, dims)
+
+            def micro(carry, mb):
+                acc, rng = carry
+                rng, sub = jax.random.split(rng)
+
+                def scaled(p):
+                    loss, _aux = self._loss_fn(p, mb, sub)
+                    return loss * loss_scale, loss
+
+                grads, loss = jax.grad(scaled, has_aux=True)(secondary)
+                acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                return (acc, rng), loss
+
+            zeros = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), secondary)
+            if gas == 1:
+                one = jax.tree_util.tree_map(lambda x: x[0], batches)
+                (acc, _), losses = micro((zeros, rng), one)
+                losses = losses[None]
+            else:
+                (acc, _), losses = jax.lax.scan(micro, (zeros, rng), batches)
+            acc = jax.tree_util.tree_map(lambda g: g / gas, acc)
+
+            def reduce_(g, d):
+                if d < 0:
+                    return jax.lax.pmean(g, DATA_REPL_AXIS)
+                if qgz:
+                    return quantized_psum_scatter_dim(g, DATA_REPL_AXIS, d) / n_repl
+                return jax.lax.psum_scatter(g, DATA_REPL_AXIS, scatter_dimension=d, tiled=True) / n_repl
+
+            grads = jax.tree_util.tree_map(reduce_, acc, dims)
+            mean_loss = jax.lax.pmean(jnp.mean(losses), DATA_REPL_AXIS)
+            return grads, mean_loss
+
+        sharded = jax.shard_map(local_fn, mesh=self.mesh,
+                                in_specs=(param_manual, batch_manual, P(), P()),
+                                out_specs=(param_manual, P()),
+                                axis_names=frozenset({DATA_REPL_AXIS}),
+                                check_vma=False)
+
+        def train_step(state, batches, rng):
+            grads, mean_loss = sharded(state["params"], batches, rng, state["loss_scale"])
+            return self._finalize_step(state, grads, mean_loss)
 
         return self._jit_step(train_step)
 
