@@ -100,11 +100,20 @@ class InferenceEngineV2:
         return SchedulingResult.Success
 
     # ------------------------------------------------------------------
-    def put(self, batch_uids: List[int], batch_tokens: List[np.ndarray], do_checks: bool = True) -> np.ndarray:
+    def put(self, batch_uids: List[int], batch_tokens: List[np.ndarray], do_checks: bool = True,
+            sample: Optional[str] = None, block: bool = True) -> np.ndarray:
         """Run one ragged forward (reference ``put:107``). ``batch_tokens[i]``
         are the new tokens of sequence ``batch_uids[i]`` (whole prompt for
         prefill, one token for decode). Returns last-token logits
-        [len(batch_uids), vocab]."""
+        [len(batch_uids), vocab] — or, with ``sample='greedy'``, the argmax
+        token ids [len(batch_uids)] sampled ON DEVICE, so only a few bytes
+        travel back to the host per step (the serving loop's steady-state
+        transfer instead of the full vocab row per sequence).
+
+        ``block=False`` returns the device array without a host fetch, so a
+        scheduler that doesn't need the values (e.g. speculative admission,
+        or a benchmark on a high-latency relay) can pipeline several steps
+        into the device queue."""
         batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
         if do_checks:
             result = self.can_schedule(batch_uids, [t.size for t in batch_tokens])
@@ -121,16 +130,17 @@ class InferenceEngineV2:
             descs.append(seq)
         rb = self.batch.finalize()
 
-        fn = self._get_compiled(rb.token_ids.shape[0], rb.block_tables.shape[0])
+        fn = self._get_compiled(rb.token_ids.shape[0], rb.block_tables.shape[0], sample)
         kv = self.state_manager.kv_cache
-        logits, k_pool, v_pool = fn(self.params, jnp.asarray(rb.token_ids), jnp.asarray(rb.token_seq_idx),
-                                    jnp.asarray(rb.token_pos), jnp.asarray(rb.token_valid),
-                                    jnp.asarray(rb.block_tables), jnp.asarray(rb.last_token_idx),
-                                    kv.k_pool, kv.v_pool)
+        # ONE descriptor upload per forward (reference single pinned-buffer
+        # upload; each separate array would be its own RPC on a tunnel)
+        out, k_pool, v_pool = fn(self.params, jnp.asarray(rb.packed()), kv.k_pool, kv.v_pool)
         kv.update(k_pool, v_pool)
         for seq in descs:
             seq.post_forward()
-        return np.asarray(logits)[:rb.n_seqs]
+        if not block:
+            return out[:rb.n_seqs]
+        return np.asarray(out)[:rb.n_seqs]
 
     # ------------------------------------------------------------------
     def query(self, uid: Optional[int] = None):
@@ -146,15 +156,26 @@ class InferenceEngineV2:
         return self.state_manager.free_blocks
 
     # ------------------------------------------------------------------
-    def _get_compiled(self, t_bucket: int, s_bucket: int):
-        key = (t_bucket, s_bucket)
+    def _get_compiled(self, t_bucket: int, s_bucket: int, sample: Optional[str] = None):
+        key = (t_bucket, s_bucket, sample)
         if key not in self._compiled:
+            from .ragged.ragged_wrapper import unpack_descriptors
+
             cfg, bs, use_pallas = self.model_config, self.config.kv_block_size, self._use_pallas
+            max_blocks = self._max_blocks_per_seq
+            if sample not in (None, "greedy"):
+                raise ValueError(f"unsupported sample mode {sample!r}: None | 'greedy'")
 
-            def fwd(params, token_ids, seq_idx, pos, valid, tables, last_idx, k_pool, v_pool):
-                return ragged_forward(cfg, bs, params, token_ids, seq_idx, pos, valid, tables,
-                                      last_idx, k_pool, v_pool, use_pallas=use_pallas)
+            def fwd(params, packed, k_pool, v_pool):
+                token_ids, seq_idx, pos, valid, tables, last_idx = unpack_descriptors(
+                    packed, t_bucket, s_bucket, max_blocks)
+                logits, k_pool, v_pool = ragged_forward(cfg, bs, params, token_ids, seq_idx, pos, valid,
+                                                        tables, last_idx, k_pool, v_pool,
+                                                        use_pallas=use_pallas)
+                out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
+                return out, k_pool, v_pool
 
-            self._compiled[key] = jax.jit(fwd, donate_argnums=(7, 8))
-            log_dist(f"compiled ragged forward bucket tokens={t_bucket} seqs={s_bucket}", ranks=[0])
+            self._compiled[key] = jax.jit(fwd, donate_argnums=(2, 3))
+            log_dist(f"compiled ragged forward bucket tokens={t_bucket} seqs={s_bucket} "
+                     f"sample={sample}", ranks=[0])
         return self._compiled[key]

@@ -40,6 +40,32 @@ class RaggedBatch:
     def max_context_bucket(self) -> int:
         return self.block_tables.shape[1]
 
+    def packed(self) -> np.ndarray:
+        """All descriptor arrays as ONE int32 vector — a single host→device
+        transfer per forward (the analog of the reference's single pinned-
+        buffer upload, ``ragged_wrapper.py finalize()``; on a tunneled
+        runtime each array upload is an RPC, so one packed transfer matters).
+        Layout: [T ids][T seq_idx][T pos][T valid][S*max_blocks tables][S last_idx].
+        """
+        return np.concatenate([
+            self.token_ids, self.token_seq_idx, self.token_pos,
+            self.token_valid.astype(np.int32), self.block_tables.reshape(-1),
+            self.last_token_idx,
+        ]).astype(np.int32)
+
+
+def unpack_descriptors(packed, t_bucket: int, s_bucket: int, max_blocks: int):
+    """In-jit inverse of ``RaggedBatch.packed()`` (shapes are static per
+    bucket). Returns (token_ids, seq_idx, pos, valid, block_tables, last_idx)."""
+    T, S = t_bucket, s_bucket
+    token_ids = packed[0:T]
+    seq_idx = packed[T:2 * T]
+    pos = packed[2 * T:3 * T]
+    valid = packed[3 * T:4 * T].astype(bool)
+    tables = packed[4 * T:4 * T + S * max_blocks].reshape(S, max_blocks)
+    last_idx = packed[4 * T + S * max_blocks:4 * T + S * max_blocks + S]
+    return token_ids, seq_idx, pos, valid, tables, last_idx
+
 
 class RaggedBatchWrapper:
 

@@ -573,25 +573,64 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
     return logits.astype(jnp.float32), new_cache
 
 
+def _ce_aux(batch, input_ids):
+    """Normalize a batch into the CE aux dict consumed by ``_ce_loss``."""
+    aux = {}
+    if isinstance(batch, dict) and "labels" in batch:
+        aux["labels"] = batch["labels"]
+    else:
+        aux["shift_ids"] = input_ids
+    if isinstance(batch, dict) and "loss_mask" in batch:
+        aux["loss_mask"] = batch["loss_mask"]
+    return aux
+
+
+def _ce_loss(logits, aux, use_onehot=False):
+    """Next-token cross entropy. ``aux``: {'labels'} or {'shift_ids'} plus
+    optional 'loss_mask'. ``use_onehot`` contracts against a one-hot instead
+    of gathering: the gather op makes XLA's SPMD partitioner CHECK-fail when
+    the vocab dim is sharded over an auto axis inside a manual-subset
+    shard_map (the 1F1B pipeline); the einsum partitions cleanly (the vocab
+    sum lowers to a psum over 'model')."""
+    if "labels" in aux:
+        shift_logits, labels = logits, aux["labels"]
+    else:
+        shift_logits, labels = logits[..., :-1, :], aux["shift_ids"][..., 1:]
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    if use_onehot:
+        onehot = (labels[..., None] == jnp.arange(logp.shape[-1])).astype(logp.dtype)
+        token_ll = jnp.einsum("...v,...v->...", logp, onehot)
+    else:
+        token_ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if "loss_mask" in aux:
+        mask = aux["loss_mask"][..., :token_ll.shape[-1]].astype(jnp.float32)
+        return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return -token_ll.mean()
+
+
+def _stage_scan_fn(cfg: TransformerConfig):
+    """One pipeline stage: scan this stage's contiguous layer slice (shared
+    by the GPipe and 1F1B executors so the schedules cannot diverge)."""
+
+    def stage_fn(blocks_local, xb, sin, cos):
+        def body(carry, layer):
+            y, _aux = _block(cfg, carry, layer, sin, cos, None, constrain=False)
+            return y, None
+
+        y, _ = lax.scan(body, xb, blocks_local)
+        return y
+
+    return stage_fn
+
+
 def loss_fn(cfg: TransformerConfig, params, batch, rng=None):
     """Next-token cross entropy (+ MoE aux loss). ``batch``: dict with
     'input_ids' [B, S] and optional 'labels' (defaults to shifted input) and
     'loss_mask'."""
     input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
     logits, moe_aux = forward_with_aux(cfg, params, input_ids, rng)
-    if isinstance(batch, dict) and "labels" in batch:
-        labels = batch["labels"]
-        shift_logits, shift_labels = logits, labels
-    else:
-        shift_logits = logits[:, :-1]
-        shift_labels = input_ids[:, 1:]
-    logp = jax.nn.log_softmax(shift_logits, axis=-1)
-    token_ll = jnp.take_along_axis(logp, shift_labels[..., None], axis=-1)[..., 0]
     aux = cfg.moe_aux_loss_coef * moe_aux if cfg.moe_num_experts > 0 else 0.0
-    if isinstance(batch, dict) and "loss_mask" in batch:
-        mask = batch["loss_mask"][:, :token_ll.shape[1]].astype(jnp.float32)
-        return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0) + aux
-    return -token_ll.mean() + aux
+    return _ce_loss(logits, _ce_aux(batch, input_ids)) + aux
 
 
 def pipeline_loss_fn(cfg: TransformerConfig, params, batches, rng=None, *, mesh, num_stages: int):
@@ -618,15 +657,7 @@ def pipeline_loss_fn(cfg: TransformerConfig, params, batches, rng=None, *, mesh,
     sin, cos = rope_table(cfg, jnp.arange(S)) if cfg.positions == "rotary" else (
         jnp.zeros((S, 1)), jnp.zeros((S, 1)))
 
-    def stage_fn(blocks_local, xb, sin, cos):
-        def body(carry, layer):
-            y, _aux = _block(cfg, carry, layer, sin, cos, None, constrain=False)
-            return y, None
-
-        y, _ = lax.scan(body, xb, blocks_local)
-        return y
-
-    outs = pipeline_apply(stage_fn, params["blocks"], x, sin, cos, mesh=mesh, num_stages=num_stages,
+    outs = pipeline_apply(_stage_scan_fn(cfg), params["blocks"], x, sin, cos, mesh=mesh, num_stages=num_stages,
                           remat=True)  # [M, B, S, H]
     h = _norm(outs, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     if cfg.tie_embeddings:
@@ -650,6 +681,75 @@ def pipeline_loss_fn(cfg: TransformerConfig, params, batches, rng=None, *, mesh,
     return -token_ll.mean()
 
 
+def pipeline_loss_fn_1f1b(cfg: TransformerConfig, params, batches, rng=None, *, mesh, num_stages: int):
+    """1F1B pipelined loss over microbatches [M, b, S] (runtime/pipe/spmd.py
+    ``pipeline_1f1b`` — the reference ``TrainSchedule`` schedule.py:189
+    compiled into one program).
+
+    Because 1F1B interleaves backward work into the forward loop, gradients
+    are produced by the pipeline itself; a ``custom_vjp`` hands them to
+    ``jax.grad`` so the engine's ``jax.grad(scaled_loss)`` contract is
+    unchanged. The embedding runs outside the pipeline (GSPMD) and its VJP is
+    chained through the pipeline's d(injected activations); the loss head
+    (final norm + LM head + CE) runs inside at the last stage, per tick.
+    """
+    from ..runtime.pipe.spmd import pipeline_1f1b
+
+    ids = batches["input_ids"] if isinstance(batches, dict) else batches
+    M, B, S = ids.shape
+    dt = cfg.dtype
+    assert cfg.num_layers % num_stages == 0, (
+        f"num_layers {cfg.num_layers} must divide evenly into {num_stages} pipeline stages")
+    assert cfg.moe_num_experts == 0, "MoE+pipeline composition not supported yet"
+
+    sin, cos = rope_table(cfg, jnp.arange(S)) if cfg.positions == "rotary" else (
+        jnp.zeros((S, 1)), jnp.zeros((S, 1)))
+
+    head_keys = ["final_norm"] + (["embed"] if cfg.tie_embeddings else ["lm_head"])
+    aux = _ce_aux(batches, ids)
+
+    def head_fn(hp, y, aux_mb):
+        h = _norm(y, hp["final_norm"]["scale"], hp["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsh,vh->bsv", h, hp["embed"]["embedding"].astype(dt))
+        else:
+            logits = jnp.einsum("bsh,hv->bsv", h, hp["lm_head"]["kernel"].astype(dt))
+        return _ce_loss(logits.astype(jnp.float32), aux_mb, use_onehot=True)
+
+    def embed_fn(p):
+        x = p["embed"]["embedding"].astype(dt)[ids]
+        if cfg.positions == "learned":
+            x = x + p["pos_embed"]["embedding"].astype(dt)[:S][None, None]
+        return x
+
+    def _loss_and_grads(params):
+        xs, embed_vjp = jax.vjp(embed_fn, params)
+        head_params = {k: params[k] for k in head_keys}
+        loss, g_blocks, g_head, d_xs = pipeline_1f1b(
+            _stage_scan_fn(cfg), head_fn, params["blocks"], head_params, xs, aux, sin, cos,
+            mesh=mesh, num_stages=num_stages)
+        (grads, ) = embed_vjp(d_xs)  # full-tree cotangent (embedding only)
+        grads = dict(grads)
+        grads["blocks"] = g_blocks
+        for k in head_keys:  # tied embeddings: head grads add to embed grads
+            grads[k] = jax.tree_util.tree_map(jnp.add, grads[k], g_head[k])
+        return loss, grads
+
+    @jax.custom_vjp
+    def run(params):
+        return _loss_and_grads(params)[0]
+
+    def run_fwd(params):
+        loss, grads = _loss_and_grads(params)
+        return loss, grads
+
+    def run_bwd(grads, g):
+        return (jax.tree_util.tree_map(lambda x: x * g, grads), )
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(params)
+
+
 class TransformerLM:
     """Model object consumed by ``deepspeed_tpu.initialize``: bundles config,
     init, loss and TP partition rules (the engine's model protocol)."""
@@ -666,7 +766,9 @@ class TransformerLM:
     def loss(self, params, batch, rng=None):
         return loss_fn(self.config, params, batch, rng)
 
-    def pipeline_loss(self, params, batches, rng=None, *, mesh, num_stages):
+    def pipeline_loss(self, params, batches, rng=None, *, mesh, num_stages, schedule="1f1b"):
+        if schedule == "1f1b":
+            return pipeline_loss_fn_1f1b(self.config, params, batches, rng, mesh=mesh, num_stages=num_stages)
         return pipeline_loss_fn(self.config, params, batches, rng, mesh=mesh, num_stages=num_stages)
 
     def partition_rules(self):

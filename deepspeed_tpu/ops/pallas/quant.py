@@ -136,14 +136,10 @@ def quantized_all_gather(x, axis_name: str, block_size: int = 256):
 def quantized_psum_scatter(x, axis_name: str, block_size: int = 256):
     """qgZ reduced-precision gradient reduce-scatter over dim 0 (reference
     ``all_to_all_quant_reduce`` coalesced_collectives.py:31): quantize, a2a,
-    local dequant+reduce. In-jit (shard_map) only."""
-    n_dev = lax.psum(1, axis_name)
-    q, s = quantize_blockwise(x, block_size)
-    q_sh = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
-    s_sh = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=True)
-    deq = dequantize_blockwise(q_sh, s_sh, block_size)
-    parts = jnp.split(deq, n_dev, axis=0)
-    return functools.reduce(jnp.add, parts)
+    local dequant+reduce. 1-D inputs fall back to the exact psum_scatter
+    (blocks along the split dim would straddle the all_to_all chunks).
+    In-jit (shard_map) only."""
+    return quantized_psum_scatter_dim(x, axis_name, 0, block_size)
 
 
 def quantized_allreduce_mean(x, axis_name, block_size: int = 256):

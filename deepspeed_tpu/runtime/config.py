@@ -12,7 +12,7 @@ donation — the knobs that replace CUDA streams/buckets.
 import os
 import json
 import copy
-from typing import Optional, List, Union, Any
+from typing import Literal, Optional, List, Union, Any
 
 from pydantic import Field
 
@@ -130,6 +130,9 @@ class PipelineConfig(DeepSpeedConfigModel):
     activation_checkpoint_interval: int = 0
     pipe_partitioned: bool = True
     grad_partitioned: bool = True
+    # '1f1b' (reference TrainSchedule schedule.py:189 — bounded live
+    # activations, composes with TP) | 'gpipe' (fill-drain via jax.grad)
+    schedule: Literal["1f1b", "gpipe"] = "1f1b"
 
 
 class TPUConfig(DeepSpeedConfigModel):

@@ -163,12 +163,28 @@ class DeepSpeedEngine:
         config.resolve_batch_config(self.batch_dp_world_size)
         if self.pipe_world_size > 1:
             # same constraint as the reference: PP composes with ZeRO<=1
-            # (PipelineEngine asserts zero stage < 2); and the SPMD pipeline
-            # v1 handles pipe x data only
+            # (PipelineEngine asserts zero stage < 2)
             assert config.zero_optimization_stage <= 1, "pipeline parallelism requires ZeRO stage <= 1"
             assert hasattr(model, "pipeline_loss"), "model must provide pipeline_loss for pipeline parallelism"
             assert self.seq_world_size == 1, "pipeline + sequence parallel composition not supported yet"
-            assert self.mp_world_size == 1, "pipeline + tensor parallel composition not supported yet"
+            self._pipe_schedule = getattr(config.pipeline_config, "schedule", "1f1b")
+            import inspect
+
+            try:
+                model_takes_schedule = "schedule" in inspect.signature(model.pipeline_loss).parameters
+            except (TypeError, ValueError):
+                model_takes_schedule = False
+            self._model_takes_schedule = model_takes_schedule
+            # 1F1B's shard_map is manual over 'pipe' only, so TP/DP compose by
+            # GSPMD propagation (reference PipeModelDataParallelTopology,
+            # pipe/topology.py:244); the GPipe runner is fully-manual and
+            # remains pipe x data only. A model that does not accept the
+            # schedule kwarg runs its own (legacy, GPipe-era) pipeline and
+            # gets no TP allowance.
+            if self._pipe_schedule != "1f1b" or not model_takes_schedule:
+                assert self.mp_world_size == 1, \
+                    "pipeline + tensor parallel needs the 1f1b schedule (pipeline.schedule='1f1b') " \
+                    "and a model whose pipeline_loss accepts the schedule kwarg"
 
         # --- precision policy ---
         self.compute_dtype = (jnp.bfloat16 if config.bfloat16_enabled else
@@ -680,10 +696,11 @@ class DeepSpeedEngine:
              hpZ group (nearest ICI);
           2. run the microbatch scan against the secondary copy (intra-group
              collectives compiler-inserted, fp32/bf16);
-          3. reduce the accumulated grads back to the primary layout with a
-             ``psum_scatter`` over ``data_repl`` — the qgZ int8 all-to-all
-             when enabled (intra-group reduction already happened in fp32 via
-             GSPMD: the reference's 2-level scheme).
+          3. after EACH microbatch, reduce its grads back to the primary
+             layout with a ``psum_scatter`` over ``data_repl`` — the qgZ
+             int8 all-to-all when enabled (intra-group reduction already
+             happened in fp32 via GSPMD: the reference's 2-level scheme) —
+             so the fp32 accumulator stays at primary-shard size.
         """
         from ..ops.pallas.quant import quantized_all_gather_dim, quantized_psum_scatter_dim
 
@@ -723,7 +740,18 @@ class DeepSpeedEngine:
 
             secondary = jax.tree_util.tree_map(gather, p_shard, dims)
 
+            def reduce_(g, d):
+                if d < 0:
+                    return jax.lax.pmean(g, DATA_REPL_AXIS)
+                if qgz:
+                    return quantized_psum_scatter_dim(g, DATA_REPL_AXIS, d) / n_repl
+                return jax.lax.psum_scatter(g, DATA_REPL_AXIS, scatter_dimension=d, tiled=True) / n_repl
+
             def micro(carry, mb):
+                # the accumulator lives in the PRIMARY (scattered) layout:
+                # each microbatch's grads reduce over data_repl immediately,
+                # so peak HBM never holds a full fp32 gradient copy per hpZ
+                # group (reference reduces per IPG bucket the same way)
                 acc, rng = carry
                 rng, sub = jax.random.split(rng)
 
@@ -732,26 +760,19 @@ class DeepSpeedEngine:
                     return loss * loss_scale, loss
 
                 grads, loss = jax.grad(scaled, has_aux=True)(secondary)
-                acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                grads = jax.tree_util.tree_map(
+                    lambda g, d: reduce_(g.astype(jnp.float32), d), grads, dims)
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                 return (acc, rng), loss
 
-            zeros = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), secondary)
+            zeros = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), p_shard)
             if gas == 1:
                 one = jax.tree_util.tree_map(lambda x: x[0], batches)
                 (acc, _), losses = micro((zeros, rng), one)
                 losses = losses[None]
             else:
                 (acc, _), losses = jax.lax.scan(micro, (zeros, rng), batches)
-            acc = jax.tree_util.tree_map(lambda g: g / gas, acc)
-
-            def reduce_(g, d):
-                if d < 0:
-                    return jax.lax.pmean(g, DATA_REPL_AXIS)
-                if qgz:
-                    return quantized_psum_scatter_dim(g, DATA_REPL_AXIS, d) / n_repl
-                return jax.lax.psum_scatter(g, DATA_REPL_AXIS, scatter_dimension=d, tiled=True) / n_repl
-
-            grads = jax.tree_util.tree_map(reduce_, acc, dims)
+            grads = jax.tree_util.tree_map(lambda g: g / gas, acc)
             mean_loss = jax.lax.pmean(jnp.mean(losses), DATA_REPL_AXIS)
             return grads, mean_loss
 
@@ -773,10 +794,13 @@ class DeepSpeedEngine:
         pipe/engine.py:348); one jitted program runs the whole 1F1B-equivalent
         fill/drain loop forward AND backward."""
 
+        kwargs = {"mesh": self.mesh, "num_stages": self.pipe_world_size}
+        if self._model_takes_schedule:
+            kwargs["schedule"] = self._pipe_schedule
+
         def train_step(state, batches, rng):
             def scaled(p):
-                loss = self.module.pipeline_loss(p, batches, rng, mesh=self.mesh,
-                                                 num_stages=self.pipe_world_size)
+                loss = self.module.pipeline_loss(p, batches, rng, **kwargs)
                 return loss * state["loss_scale"], loss
 
             grads, loss = jax.grad(scaled, has_aux=True)(state["params"])

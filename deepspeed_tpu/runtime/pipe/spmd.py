@@ -110,3 +110,144 @@ def pipeline_apply(stage_fn: Callable,
                              in_specs=(param_specs, x_spec) + const_specs,
                              out_specs=x_spec)
     return shard_fn(stage_params, microbatches, *consts)
+
+
+def pipeline_1f1b(stage_fn: Callable,
+                  head_fn: Callable,
+                  stage_params,
+                  head_params,
+                  microbatches,
+                  head_aux,
+                  *consts,
+                  mesh,
+                  num_stages: int,
+                  pipe_axis: str = PIPE_AXIS):
+    """Compiled 1F1B pipeline with hand-rolled per-tick VJPs.
+
+    The reference's steady-state 1F1B (``runtime/pipe/schedule.py:189``
+    ``TrainSchedule``) alternates one forward with one backward per stage,
+    bounding live activations to ~num_stages microbatches instead of M (the
+    GPipe fill-drain property of ``pipeline_apply`` + ``jax.grad``). Here the
+    interleaving is explicit because autodiff through a scan cannot reorder
+    backward work into the forward loop:
+
+      tick t, stage s:  FORWARD  microbatch  mf = t - s            (masked)
+                        BACKWARD microbatch  mb = t - (2S-2-s)     (masked)
+
+    — the same tick math as ``TrainSchedule._step_to_micro_batch`` folded
+    into the paired-tick form (at the last stage mf == mb: forward, loss
+    head, and backward of a microbatch happen in one tick, the "1F1B pivot").
+    Each backward recomputes its stage forward from a ring buffer of saved
+    stage INPUTS (size min(2S-1, M): the live span of stage 0) — per-stage
+    rematerialization, the reference's activation-checkpointing-between-
+    stages configuration. Communication is two ``ppermute``s per tick
+    (activations down, gradients up) — the SendActivation/RecvGrad pairs of
+    the reference schedule as single collective-permutes.
+
+    The shard_map is manual over the ``pipe`` axis ONLY: data/model/seq stay
+    GSPMD-auto inside, so PP composes with TP/DP by sharding propagation
+    (reference ``pipe/topology.py:244`` PipeModelDataParallelTopology).
+
+    ``stage_fn(stage_params_local, x, *consts) -> y`` applies one stage's
+    contiguous layer slice. ``head_fn(head_params, y, aux_mb) -> scalar`` is
+    the per-microbatch loss head (executed at the last stage).
+    Returns ``(mean_loss, stage_grads, head_grads, d_microbatches)`` where
+    ``stage_grads`` stays sharded over ``pipe`` (each stage owns its slice)
+    and ``d_microbatches`` is the cotangent of the injected activations (for
+    the caller to chain into the embedding's VJP).
+    """
+    tree = jax.tree_util.tree_map
+    M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    S = num_stages
+    n_ticks = M + 2 * S - 2
+    n_buf = min(2 * S - 1, M)
+    param_specs = tree(lambda x: P(pipe_axis), stage_params)
+
+    def pipelined(params_local, head_params, xs, head_aux, *consts):
+        stage = lax.axis_index(pipe_axis)
+        last = S - 1
+
+        x0 = tree(lambda x: jnp.zeros_like(x[0]), xs)
+        buf0 = tree(lambda x: jnp.zeros((n_buf, ) + x.shape[1:], x.dtype), xs)
+        gp0 = tree(lambda p: jnp.zeros(p.shape, jnp.float32), params_local)
+        gh0 = tree(lambda p: jnp.zeros(p.shape, jnp.float32), head_params)
+        dxs0 = tree(jnp.zeros_like, xs)
+
+        def tick(carry, t):
+            fwd_recv, bwd_recv, buf, g_params, g_head, d_xs, loss_acc = carry
+            mf = t - stage
+            mb = t - (2 * last - stage)
+            valid_f = jnp.logical_and(mf >= 0, mf < M)
+            valid_b = jnp.logical_and(mb >= 0, mb < M)
+
+            # ---- forward: ingest at stage 0, else use received activation
+            idx_f = jnp.clip(mf, 0, M - 1)
+            inject = tree(lambda x: x[idx_f], xs)
+            x_in = tree(lambda i, r: jnp.where(stage == 0, i, r), inject, fwd_recv)
+            y = stage_fn(params_local, x_in, *consts)
+            slot_f = idx_f % n_buf
+            buf = tree(lambda b, v: b.at[slot_f].set(jnp.where(valid_f, v, b[slot_f])), buf, x_in)
+
+            # ---- loss head (last stage only, where mf == mb; a lax.cond
+            # keeps the other stages from burning the [b,S,V] head FLOPs —
+            # all devices of a pipe stage agree on the predicate, and the
+            # head's auto-axis psum groups never span pipe stages)
+            aux_mb = tree(lambda a: a[idx_f], head_aux)
+
+            def head_branch(ops):
+                hp, yy, am = ops
+                loss_mb, head_vjp = jax.vjp(lambda h, y2: head_fn(h, y2, am), hp, yy)
+                # total loss is the MEAN over microbatches: seed 1/M so every
+                # grad downstream of the head carries the normalization
+                dhp, dy = head_vjp(jnp.full_like(loss_mb, 1.0 / M))
+                return loss_mb.astype(jnp.float32), dhp, dy
+
+            def skip_branch(ops):
+                hp, yy, _ = ops
+                return jnp.zeros([], jnp.float32), tree(jnp.zeros_like, hp), tree(jnp.zeros_like, yy)
+
+            loss_mb, dhp, dy = lax.cond(jnp.logical_and(valid_f, stage == last),
+                                        head_branch, skip_branch, (head_params, y, aux_mb))
+            loss_acc = loss_acc + loss_mb
+            g_head = tree(lambda a, g: a + g.astype(jnp.float32), g_head, dhp)
+
+            # ---- backward: recompute this stage's VJP from the saved input
+            idx_b = jnp.clip(mb, 0, M - 1)
+            x_b = tree(lambda b: b[idx_b % n_buf], buf)
+            g_in = tree(lambda d, r: jnp.where(stage == last, d, r), dy, bwd_recv)
+            _, stage_vjp = jax.vjp(lambda pl, xx: stage_fn(pl, xx, *consts), params_local, x_b)
+            dparams, dx = stage_vjp(g_in)
+            use_b = valid_b.astype(jnp.float32)
+            g_params = tree(lambda a, g: a + g.astype(jnp.float32) * use_b, g_params, dparams)
+            d_xs = tree(
+                lambda D, d: D.at[idx_b].set(
+                    jnp.where(jnp.logical_and(valid_b, stage == 0), d.astype(D.dtype), D[idx_b])),
+                d_xs, dx)
+
+            # ---- rotate: activations downstream, gradients upstream
+            down = [(i, (i + 1) % S) for i in range(S)]
+            up = [(i, (i - 1) % S) for i in range(S)]
+            fwd_recv = tree(lambda v: lax.ppermute(v, pipe_axis, down), y)
+            bwd_recv = tree(lambda v: lax.ppermute(v, pipe_axis, up), dx)
+            return (fwd_recv, bwd_recv, buf, g_params, g_head, d_xs, loss_acc), None
+
+        carry0 = (x0, x0, buf0, gp0, gh0, dxs0, jnp.zeros([], jnp.float32))
+        (fwd_recv, bwd_recv, buf, g_params, g_head, d_xs, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(n_ticks))
+
+        # loss / head grads accumulated only at the last stage, d_xs only at
+        # stage 0 (zeros elsewhere): psum over pipe replicates them
+        loss = lax.psum(loss_acc, pipe_axis) / M
+        g_head = tree(lambda g: lax.psum(g, pipe_axis), g_head)
+        d_xs = tree(lambda d: lax.psum(jnp.where(stage == 0, d, jnp.zeros_like(d)), pipe_axis), d_xs)
+        return loss, g_params, g_head, d_xs
+
+    rep = lambda t_: jax.tree_util.tree_map(lambda _: P(), t_)
+    shard_fn = jax.shard_map(
+        pipelined, mesh=mesh,
+        in_specs=(param_specs, rep(head_params), rep(microbatches), rep(head_aux))
+        + tuple(rep(c) for c in consts),
+        out_specs=(P(), param_specs, rep(head_params), rep(microbatches)),
+        axis_names=frozenset({pipe_axis}),
+        check_vma=False)
+    return shard_fn(stage_params, head_params, microbatches, head_aux, *consts)
