@@ -60,7 +60,7 @@ class InferenceEngineV2:
             self._use_pallas = jax.default_backend() == "tpu"
         else:
             self._use_pallas = ic.use_pallas_kernels == "always"
-        self._compiled: Dict[Tuple[int, int], object] = {}
+        self._compiled: Dict[Tuple[int, int, Optional[str]], object] = {}
         log_dist(
             f"InferenceEngineV2 ready: blocks={ic.num_kv_blocks}x{bs} "
             f"kv={self.state_manager.kv_cache.memory_bytes()/2**20:.0f}MiB "

@@ -60,6 +60,9 @@ def _adam_leaf(scalars, g, p, m, v, interpret=False):
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), spec, spec, spec, spec],
         out_specs=(spec, spec, spec),
         out_shape=tuple(jax.ShapeDtypeStruct((rows, _LANES), jnp.float32) for _ in range(3)),
+        # update in place: outputs alias the p/m/v inputs (the engine donates
+        # the state pytree, so no second copy of params/moments ever exists)
+        input_output_aliases={2: 0, 3: 1, 4: 2},
         interpret=interpret,
     )(scalars, view(g), view(p), view(m), view(v))
     return tuple(o.reshape(shape) for o in out)

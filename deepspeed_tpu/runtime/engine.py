@@ -241,6 +241,10 @@ class DeepSpeedEngine:
         # (reference runtime/fp16/onebit/* + comm/nccl.py compressed_allreduce)
         self._onebit = self._configure_onebit()
 
+        # Pallas fused Adam(W): single-pass update kernel with overflow gate
+        # and clip folded in (reference csrc/adam/multi_tensor_adam.cu)
+        self._pallas_adam = self._configure_pallas_adam(optimizer, example_batch)
+
         # --- state init, sharded at construction (zero.Init equivalent:
         #     params materialize directly into their shards, reference
         #     partition_parameters.py:762) ---
@@ -344,6 +348,50 @@ class DeepSpeedEngine:
         log_dist(f"1-bit optimizer '{name}': exact allreduce for {policy.freeze_step} warmup steps, "
                  f"then error-feedback sign compression", ranks=[0])
         return policy
+
+    def _configure_pallas_adam(self, client_optimizer, example_batch):
+        """Engage the Pallas fused Adam(W) step when the config maps to plain
+        Adam/AdamW on fp32 masters: one HBM pass over (grad, param, m, v)
+        with the overflow gate, loss un-scaling, and global-norm clipping
+        folded in as scalars — the optax chain costs extra full passes for
+        the finite-check and the overflow where-selects. Returns the kernel
+        hyperparams dict or None; on engage, swaps ``self.optimizer`` for the
+        FusedAdamState-structured transformation (same math, used only for
+        state init)."""
+        from .constants import ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER
+
+        mode = getattr(self.config.tpu_config, "pallas_fused_adam", "auto")
+        if (mode == "never" or client_optimizer is not None or self._offload_enabled
+                or self._onebit is not None):
+            return None
+        name = (self.config.optimizer_name or ADAMW_OPTIMIZER).lower()
+        if name not in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER):
+            return None
+        params = dict(self.config.optimizer_params or {})
+        adam_w = name == ADAMW_OPTIMIZER or params.get("adam_w_mode", True)
+        wd = params.get("weight_decay", 0.0)
+        if not adam_w and wd:
+            return None  # plain-Adam weight decay (grad += wd*p) not fused
+        if mode == "auto":
+            # measured (v5e, 748M params): XLA already fuses the optax update
+            # chain to ~1.5x the HBM roofline; the explicit kernel is not
+            # faster there, so 'auto' currently resolves to off
+            return None
+        try:  # fp32 masters only: the kernel reads/writes f32 state
+            shapes = jax.eval_shape(lambda r: self.module.init(r, example_batch), jax.random.PRNGKey(0))
+            if any(l.dtype != jnp.float32 for l in jax.tree_util.tree_leaves(shapes)):
+                return None
+        except Exception:
+            return None
+        from ..ops.adam.fused_adam import fused_adam
+
+        betas = tuple(params.get("betas", (0.9, 0.999)))
+        lr = self.lr_schedule_fn if self.lr_schedule_fn is not None else params.get("lr", 1e-3)
+        self.optimizer = fused_adam(lr=lr, b1=betas[0], b2=betas[1], eps=params.get("eps", 1e-8),
+                                    weight_decay=wd, adam_w_mode=True)
+        log_dist("Pallas fused Adam step engaged (single-pass update, gated)", ranks=[0])
+        return {"b1": betas[0], "b2": betas[1], "eps": params.get("eps", 1e-8), "wd": wd,
+                "lr": params.get("lr", 1e-3)}
 
     def _configure_host_offload_optimizer(self, offload_cfg):
         """Build the ZeRO-Offload host optimizer (reference: cpu_offload forces
@@ -459,18 +507,36 @@ class DeepSpeedEngine:
         grads = constrain(grads, self.zero_policy.grad_specs(params), self.mesh)
         return grads, loss
 
+    def _advance_loss_scale(self, state, finite):
+        """Dynamic loss scale state machine (reference DynamicLossScaler)."""
+        if self.fp16_enabled and self.dynamic_loss_scale:
+            args = self.config.dynamic_loss_scale_args
+            window, min_scale = args["scale_window"], args["min_scale"]
+            good = jnp.where(finite, state["good_steps"] + 1, 0)
+            scale = jnp.where(finite,
+                              jnp.where(good >= window, state["loss_scale"] * 2.0, state["loss_scale"]),
+                              jnp.maximum(state["loss_scale"] * 0.5, min_scale))
+            good = jnp.where(good >= window, 0, good)
+            return scale, good
+        return state["loss_scale"], state["good_steps"]
+
     def _apply_update(self, state, grads, grad_norm_ok, unscaled=False):
         """Unscale, update, advance loss scale — skipping on overflow
         (reference ``has_overflow`` stage_1_and_2.py:2002 + DynamicLossScaler).
         ``unscaled=True`` when the caller already divided by the loss scale
         (the 1-bit path compresses in unscaled units)."""
+        if self._pallas_adam is not None:
+            return self._apply_update_pallas(state, grads, grad_norm_ok, unscaled)
         params, opt_state = state["params"], state["opt_state"]
         inv_scale = 1.0 if unscaled else 1.0 / state["loss_scale"]
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv_scale, grads)
 
-        finite = jnp.logical_and(
-            grad_norm_ok,
-            jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)])))
+        # overflow detection rides the gradient global-norm (any NaN/inf makes
+        # it non-finite; an inf norm from huge-but-finite grads is a
+        # conservative skip, matching the reference's CheckOverflow) — the
+        # norm is computed for metrics/clipping anyway, so this saves a
+        # dedicated full read pass over the gradients
+        finite = jnp.logical_and(grad_norm_ok, jnp.isfinite(optax.global_norm(grads)))
 
         updates, new_opt_state = self.optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
@@ -481,21 +547,43 @@ class DeepSpeedEngine:
         params = jax.tree_util.tree_map(sel, new_params, params)
         opt_state = jax.tree_util.tree_map(sel, new_opt_state, opt_state)
 
-        # dynamic loss scale state machine
-        if self.fp16_enabled and self.dynamic_loss_scale:
-            args = self.config.dynamic_loss_scale_args
-            window, min_scale = args["scale_window"], args["min_scale"]
-            good = jnp.where(finite, state["good_steps"] + 1, 0)
-            scale = jnp.where(finite,
-                              jnp.where(good >= window, state["loss_scale"] * 2.0, state["loss_scale"]),
-                              jnp.maximum(state["loss_scale"] * 0.5, min_scale))
-            good = jnp.where(good >= window, 0, good)
-        else:
-            scale, good = state["loss_scale"], state["good_steps"]
-
+        scale, good = self._advance_loss_scale(state, finite)
         return {
             "params": params,
             "opt_state": opt_state,
+            "step": state["step"] + finite.astype(jnp.int32),
+            "loss_scale": scale,
+            "good_steps": good,
+        }, finite
+
+    def _apply_update_pallas(self, state, grads, grad_norm_ok, unscaled=False):
+        """Single-pass gated AdamW (ops/pallas/fused_adam.py): overflow
+        detection rides the gradient global-norm (NaN/inf anywhere makes the
+        norm non-finite — the reference's ``has_overflow`` semantics without
+        a dedicated pass), clipping and loss un-scaling fold into one scalar
+        gradient factor, and the overflow skip is the kernel's gate rather
+        than a post-hoc where-select over params AND optimizer state."""
+        from ..ops.adam.fused_adam import FusedAdamState
+        from ..ops.pallas.fused_adam import fused_adam_apply
+
+        pa = self._pallas_adam
+        inv_scale = jnp.asarray(1.0 if unscaled else 1.0 / state["loss_scale"], jnp.float32)
+        gnorm = optax.global_norm(grads).astype(jnp.float32) * inv_scale
+        finite = jnp.logical_and(grad_norm_ok, jnp.isfinite(gnorm))
+        clip = float(self.config.gradient_clipping or 0.0)
+        coef = jnp.minimum(1.0, clip / (gnorm + 1e-6)) if clip > 0 else jnp.asarray(1.0, jnp.float32)
+        opt = state["opt_state"]
+        count = opt.step
+        lr_t = (self.lr_schedule_fn(count) if self.lr_schedule_fn is not None else pa["lr"])
+        new_p, new_m, new_v = fused_adam_apply(
+            state["params"], opt.mu, opt.nu, grads,
+            lr_t=lr_t, b1=pa["b1"], b2=pa["b2"], eps=pa["eps"], weight_decay=pa["wd"],
+            step=count + 1, grad_scale=inv_scale * coef, gate=finite.astype(jnp.float32),
+            interpret=jax.default_backend() != "tpu")
+        scale, good = self._advance_loss_scale(state, finite)
+        return {
+            "params": new_p,
+            "opt_state": FusedAdamState(step=count + finite.astype(count.dtype), mu=new_m, nu=new_v),
             "step": state["step"] + finite.astype(jnp.int32),
             "loss_scale": scale,
             "good_steps": good,
