@@ -77,9 +77,11 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
         from ....ops.pallas.paged_attention import paged_attention, paged_attention_reference
 
         if use_pallas:
-            ctx = paged_attention(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos, block_size)
+            ctx = paged_attention(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos, block_size,
+                                  window=cfg.sliding_window)
         else:
-            ctx = paged_attention_reference(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos, block_size)
+            ctx = paged_attention_reference(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos,
+                                            block_size, window=cfg.sliding_window)
 
         attn_out = jnp.einsum("td,dh->th", ctx.reshape(T, nq * d), blk["wo"].astype(dt))
         if cfg.use_bias:

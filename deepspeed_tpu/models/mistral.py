@@ -2,8 +2,9 @@
 
 Analog of the reference ``inference/v2/model_implementations/mistral/``
 (policy+containers): Llama-shaped (RMSNorm + rotary + SwiGLU) with GQA(8 kv
-heads) and a 32k-ish context window. The reference's sliding-window attention
-is approximated by the full-context paged path for now (window masking TODO).
+heads), a 32k context, and sliding-window attention (window=4096 for 7B) —
+wired through the training flash kernel, the v1 KV-cache path, and the v2
+paged kernel via ``TransformerConfig.sliding_window``.
 """
 
 from .transformer import TransformerConfig, TransformerLM
@@ -12,9 +13,9 @@ from .transformer import TransformerConfig, TransformerLM
 def mistral_config(size: str = "7b", **overrides) -> TransformerConfig:
     presets = {
         "tiny": dict(vocab_size=32000, hidden_size=256, num_layers=4, num_heads=8, num_kv_heads=2,
-                     intermediate_size=896, max_seq_len=2048),
+                     intermediate_size=896, max_seq_len=2048, sliding_window=256),
         "7b": dict(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8,
-                   intermediate_size=14336, max_seq_len=32768),
+                   intermediate_size=14336, max_seq_len=32768, sliding_window=4096),
     }
     base = dict(presets[size], norm="rmsnorm", positions="rotary", mlp="swiglu", use_bias=False,
                 tie_embeddings=False, rope_theta=10000.0, norm_eps=1e-5)

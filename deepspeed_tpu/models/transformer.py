@@ -56,6 +56,10 @@ class TransformerConfig:
     remat: bool = False
     remat_policy: str = "nothing_saveable"
     attention_impl: str = "auto"  # 'auto' | 'reference' | 'flash'
+    # sliding-window attention (Mistral): query i sees keys in (i-window, i];
+    # None = full causal context. Applies to training (flash/reference),
+    # the v1 KV-cache path, and the v2 paged path.
+    sliding_window: Optional[int] = None
     sequence_parallel: bool = False  # Ulysses/ring sharding over the seq axis
     sequence_parallel_impl: str = "ulysses"  # 'ulysses' (a2a) | 'ring' (ppermute)
     dropout: float = 0.0
@@ -210,9 +214,11 @@ def apply_rope(x, sin, cos):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def reference_attention(q, k, v, causal=True, segment_ids=None):
+def reference_attention(q, k, v, causal=True, segment_ids=None, window=None):
     """jnp einsum attention — the numerics baseline every Pallas kernel is
-    tested against (mirrors reference tests/unit/ops strategy)."""
+    tested against (mirrors reference tests/unit/ops strategy). ``window``:
+    sliding-window attention (Mistral) — query at position i sees keys in
+    (i - window, i]."""
     B, S, nq, d = q.shape
     nkv = k.shape[2]
     group = nq // nkv
@@ -223,6 +229,8 @@ def reference_attention(q, k, v, causal=True, segment_ids=None):
     scores = jnp.einsum("bskgd,btkd->bkgst", qf, kf)
     if causal:
         mask = jnp.tril(jnp.ones((S, S), bool))
+        if window is not None:
+            mask = jnp.logical_and(mask, ~jnp.tril(jnp.ones((S, S), bool), k=-int(window)))
         scores = jnp.where(mask[None, None, None], scores, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
@@ -253,8 +261,8 @@ def _attention(cfg: TransformerConfig, q, k, v):
     if impl == "flash":
         from ..ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
-    return reference_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True, window=cfg.sliding_window)
+    return reference_attention(q, k, v, causal=True, window=cfg.sliding_window)
 
 
 def _qwz_target_specs(cfg: TransformerConfig, layer):
@@ -326,6 +334,10 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True)
 
     if cfg.sequence_parallel:
         if cfg.sequence_parallel_impl == "ring":
+            if cfg.sliding_window is not None:
+                raise NotImplementedError(
+                    "sliding_window + ring attention is not supported yet; use "
+                    "sequence_parallel_impl='ulysses' (its local attention honors the window)")
             from ..parallel import groups
             from ..parallel.mesh import mesh_axis_size
             from ..sequence.ring import ring_attention_gspmd
@@ -509,6 +521,8 @@ def _cached_attention(cfg, q, ck, cv, q_pos0, cache_len_total):
     k_pos = jnp.arange(Smax)[None, None, None, None, :]
     q_pos = (q_pos0 + jnp.arange(T))[None, None, None, :, None]
     mask = (k_pos <= q_pos) & (k_pos < cache_len_total)
+    if cfg.sliding_window is not None:
+        mask = mask & (q_pos - k_pos < cfg.sliding_window)
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     ctx = jnp.einsum("bkgts,bskd->btkgd", probs, cv.astype(jnp.float32))

@@ -50,11 +50,18 @@ def _shapes_supported(q, block_q, block_k):
     return (S % bq == 0 and S % bk == 0 and S % 128 == 0 and d >= 32)
 
 
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512):
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512,
+                    window=None):
     """q: [B, S, nq, d]; k/v: [B, S, nkv, d] with nq % nkv == 0.
 
     Differentiable: both forward and backward run as Pallas kernels on TPU.
+    ``window``: sliding-window attention (Mistral reference
+    ``inference/v2/model_implementations/mistral/``) — query i attends keys
+    in (i - window, i]; requires ``causal=True``.
     """
+    if window is not None:
+        assert causal, "sliding window requires causal attention"
+        window = int(window)
     if _use_pallas() and not _shapes_supported(q, block_q, block_k):
         from ...utils.logging import warning_once
 
@@ -62,7 +69,8 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: i
                      f"multiple of 128, head_dim >= 32) — using O(S^2) reference attention")
     if _use_pallas() and _shapes_supported(q, block_q, block_k):
         try:
-            return _pallas_flash(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+            return _pallas_flash(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                                 window=window)
         except Exception as e:
             if os.environ.get("DS_TPU_ALLOW_ATTN_FALLBACK") != "1":
                 raise RuntimeError(
@@ -76,36 +84,36 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: i
                          f"falling back to reference attention — expect O(S^2) memory")
     from ...models.transformer import reference_attention
 
-    return reference_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal, window=window)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
-def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=False):
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window"))
+def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=False, window=None):
     return _flash_core(causal, min(block_q, q.shape[1]), min(block_k, q.shape[1]),
-                       interpret, q, k, v)
+                       interpret, window, q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _flash_core(causal, block_q, block_k, interpret, q, k, v):
-    out, _ = _flash_fwd_impl(causal, block_q, block_k, interpret, q, k, v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash_core(causal, block_q, block_k, interpret, window, q, k, v):
+    out, _ = _flash_fwd_impl(causal, block_q, block_k, interpret, window, q, k, v)
     return out
 
 
-def _flash_core_fwd(causal, block_q, block_k, interpret, q, k, v):
-    out, lse = _flash_fwd_impl(causal, block_q, block_k, interpret, q, k, v)
+def _flash_core_fwd(causal, block_q, block_k, interpret, window, q, k, v):
+    out, lse = _flash_fwd_impl(causal, block_q, block_k, interpret, window, q, k, v)
     return out, (q, k, v, out, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, interpret, res, dout):
+def _flash_core_bwd(causal, block_q, block_k, interpret, window, res, dout):
     q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, out, lse, dout)
+    dq, dk, dv = _flash_bwd_impl(causal, block_q, block_k, interpret, window, q, k, v, out, lse, dout)
     return dq, dk, dv
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def _flash_fwd_impl(causal, block_q, block_k, interpret, q, k, v):
+def _flash_fwd_impl(causal, block_q, block_k, interpret, window, q, k, v):
     """Returns (out [B,S,nq,d], lse [B,nq,S] float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -145,7 +153,10 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, q, k, v):
             if causal:
                 q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
                 k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+                visible = q_pos >= k_pos
+                if window is not None:
+                    visible = jnp.logical_and(visible, q_pos - k_pos < window)
+                s = jnp.where(visible, s, _NEG_INF)
             m_prev = m_ref[:]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)
@@ -155,9 +166,12 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, q, k, v):
             m_ref[:] = m_new
             return 0
 
-        # ceil-div: the k block containing the last visible key must run
+        # ceil-div: the k block containing the last visible key must run;
+        # with a sliding window, k blocks entirely below (q_pos - window]
+        # are skipped (same dynamic-bound style as the upper limit)
         n_iters = ((qi + 1) * block_q + block_k - 1) // block_k if causal else n_kblocks
-        jax.lax.fori_loop(0, n_iters, body, 0)
+        lo = jnp.maximum(0, (qi * block_q - (window - 1)) // block_k) if (causal and window is not None) else 0
+        jax.lax.fori_loop(lo, n_iters, body, 0)
         l_safe = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
         lse_ref[0, 0] = jnp.broadcast_to(m_ref[:] + jnp.log(l_safe), (block_q, LANES))
@@ -194,7 +208,7 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, q, k, v):
     return out.transpose(0, 2, 1, 3), lse[..., 0]
 
 
-def _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, out, lse, dout):
+def _flash_bwd_impl(causal, block_q, block_k, interpret, window, q, k, v, out, lse, dout):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -230,7 +244,10 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, out, lse, dout
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            vis = q_pos >= k_pos
+            if window is not None:
+                vis = jnp.logical_and(vis, q_pos - k_pos < window)
+            s = jnp.where(vis, s, _NEG_INF)
         p = jnp.exp(s - lseb)                                            # [bq, bk]
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)      # [bq, bk]
         ds = p * (dp - deltab)
@@ -248,8 +265,13 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, out, lse, dout
             dk_acc[:] = jnp.zeros_like(dk_acc)
             dv_acc[:] = jnp.zeros_like(dv_acc)
 
-        # causal: q blocks strictly before this k block contribute nothing
+        # causal: q blocks strictly before this k block contribute nothing;
+        # sliding window: q blocks entirely beyond kj's window contribute
+        # nothing either
         visible = (qi + 1) * block_q > kj * block_k if causal else True
+        if causal and window is not None:
+            visible = jnp.logical_and(
+                visible, qi * block_q - ((kj + 1) * block_k - 1) < window)
 
         @pl.when(visible)
         def _compute():
@@ -310,6 +332,9 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, q, k, v, out, lse, dout
             dq_acc[:] = jnp.zeros_like(dq_acc)
 
         visible = (qi + 1) * block_q > kj * block_k if causal else True
+        if causal and window is not None:
+            visible = jnp.logical_and(
+                visible, qi * block_q - ((kj + 1) * block_k - 1) < window)
 
         @pl.when(visible)
         def _compute():

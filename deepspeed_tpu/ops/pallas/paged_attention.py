@@ -26,26 +26,33 @@ import jax
 import jax.numpy as jnp
 
 
-def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int):
+def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, window=None):
     """q: [T, nq, d]; k_pool/v_pool: [pool_len, nkv, d] (one layer,
     pool_len = num_blocks*block_size, may include one trailing scratch slot);
     block_tables: [S, max_blocks]; seq_idx/pos: [T].
+    ``window``: sliding-window attention (Mistral) — token at position p
+    attends cached positions in (p - window, p].
     Returns [T, nq, d]."""
     T, nq, d = q.shape
     nkv = k_pool.shape[1]
+    if window is not None:
+        window = int(window)
     if jax.default_backend() != "tpu" or nq < 8 or d % 128 != 0:
-        return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size)
+        return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
+                                         window=window)
     try:
         return _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx.astype(jnp.int32), pos.astype(jnp.int32),
-                             block_size=block_size)
+                             block_size=block_size, window=window)
     except Exception as e:  # pragma: no cover — kernel bring-up safety net
         from ...utils.logging import warning_once
 
         warning_once(f"pallas paged attention unavailable ({type(e).__name__}: {e}); using gather fallback")
-        return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size)
+        return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
+                                         window=window)
 
 
-def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int):
+def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int,
+                              window=None):
     """Gather-based oracle: materializes each sequence's context."""
     T, nq, d = q.shape
     nkv = k_pool.shape[1]
@@ -59,14 +66,17 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, blo
     qr = (q.astype(jnp.float32) / math.sqrt(d)).reshape(T, nkv, g, d)
     s = jnp.einsum("tngd,tcnd->tngc", qr, ctxk[seq_idx])
     causal = jnp.arange(C, dtype=jnp.int32)[None, :] <= pos[:, None]
+    if window is not None:
+        causal = causal & (pos[:, None] - jnp.arange(C, dtype=jnp.int32)[None, :] < window)
     s = jnp.where(causal[:, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("tngc,tcnd->tngd", p, ctxv[seq_idx])
     return out.reshape(T, nq, d).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
-def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret", "window"))
+def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, interpret: bool = False,
+                  window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -99,7 +109,10 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
             m_ref[:] = jnp.full_like(m_ref, -1e30)
             l_ref[:] = jnp.zeros_like(l_ref)
 
-        @pl.when(j * block_size <= my_pos)
+        in_window = (j * block_size <= my_pos) if window is None else jnp.logical_and(
+            j * block_size <= my_pos, (j + 1) * block_size - 1 > my_pos - window)
+
+        @pl.when(in_window)
         def _compute():
             qb = q_ref[0].astype(jnp.float32) * scale  # [nq, d]
             kb = k_ref[0].astype(jnp.float32)  # [bs, nkv, d]
@@ -111,7 +124,10 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
                 s_heads.append(jax.lax.dot(qb[n * g:(n + 1) * g], kb[:, n, :].T))  # [g, bs]
             s = jnp.concatenate(s_heads, axis=0)  # [nq, bs]
             kpos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, (nq, block_size), 1)
-            s = jnp.where(kpos <= my_pos, s, -1e30)
+            vis = kpos <= my_pos
+            if window is not None:
+                vis = jnp.logical_and(vis, my_pos - kpos < window)
+            s = jnp.where(vis, s, -1e30)
             m_prev = m_ref[:]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)  # [nq, bs]

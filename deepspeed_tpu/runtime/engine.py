@@ -416,7 +416,8 @@ class DeepSpeedEngine:
                                     nvme_path=nvme,
                                     pipeline_read=offload_cfg.pipeline_read,
                                     pipeline_write=offload_cfg.pipeline_write,
-                                    grad_clip=self.config.gradient_clipping or 0.0)
+                                    grad_clip=self.config.gradient_clipping or 0.0,
+                                    block_shardings=self.zero_policy.grad_shardings(self.state["params"]))
 
     # ------------------------------------------------------------------
     # state init
@@ -614,27 +615,37 @@ class DeepSpeedEngine:
         return acc, losses
 
     def _accumulate_grads_fn(self, gas: int):
-        """Compiled grads-only program for the host-offload path."""
+        """Compiled grads-only program for the host-offload path. Also
+        returns the (scaled) global gradient norm — a GSPMD reduction, exact
+        across hosts, where a host-side norm in multi-host shard mode would
+        only see this process's shards."""
 
         def grads_fn(params, batches, rng, loss_scale):
             acc, losses = self._scan_microbatch_grads(params, batches, rng, loss_scale, gas)
-            return acc, jnp.mean(losses)
+            return acc, jnp.mean(losses), optax.global_norm(acc)
 
         return jax.jit(grads_fn)
 
-    def _host_apply_update(self, grads):
+    def _host_apply_update(self, grads, scaled_gnorm=None):
         """Shared host-offload tail: fused C++ Adam on the masters, then
         upload of the new params into their shardings. Returns
-        (grad_norm, overflow, lr)."""
+        (grad_norm, overflow, lr). ``scaled_gnorm``: device-computed global
+        norm of the (loss-scaled) grads — required in multi-host shard mode."""
         step_no = int(self.state["step"]) + 1
         lr = (float(self.lr_schedule_fn(step_no - 1)) if self.lr_schedule_fn is not None else
               (self.config.optimizer_params or {}).get("lr", 1e-3))
         scale = float(self.state["loss_scale"])
-        new_params, grad_norm, overflow = self.host_optimizer.step(step_no, grads, lr=lr, loss_scale=scale)
+        gnorm = None if scaled_gnorm is None else float(scaled_gnorm) / scale
+        new_params, grad_norm, overflow = self.host_optimizer.step(step_no, grads, lr=lr, loss_scale=scale,
+                                                                   grad_norm=gnorm)
         if not overflow:
             dtypes = jax.tree_util.tree_map(lambda p: p.dtype, self.state["params"])
-            cast = jax.tree_util.tree_map(lambda a, dt: np.asarray(a, dtype=dt), new_params, dtypes)
-            self.state["params"] = jax.device_put(cast, self._state_shardings["params"])
+            if self.host_optimizer.shard_mode:
+                self.state["params"] = self.host_optimizer.rebuild_device_params(
+                    self._state_shardings["params"], dtypes)
+            else:
+                cast = jax.tree_util.tree_map(lambda a, dt: np.asarray(a, dtype=dt), new_params, dtypes)
+                self.state["params"] = jax.device_put(cast, self._state_shardings["params"])
             self.state["step"] = self.state["step"] + 1
         else:
             self.skipped_steps += 1
@@ -648,9 +659,9 @@ class DeepSpeedEngine:
             self._compiled["offload_grads"] = self._accumulate_grads_fn(gas)
         with self.mesh:
             batch = self._shard_batch(batch, leading=("mb", ))
-            grads, loss = self._compiled["offload_grads"](self.state["params"], batch, step_rng,
-                                                          self.state["loss_scale"])
-        grad_norm, overflow, lr = self._host_apply_update(grads)
+            grads, loss, gnorm = self._compiled["offload_grads"](self.state["params"], batch, step_rng,
+                                                                 self.state["loss_scale"])
+        grad_norm, overflow, lr = self._host_apply_update(grads, scaled_gnorm=gnorm)
         return {
             "loss": loss,
             "grad_norm": jnp.asarray(grad_norm),
@@ -1066,7 +1077,11 @@ class DeepSpeedEngine:
         assert self._grad_acc_buffer is not None, "step() called with no accumulated gradients"
         if self.host_optimizer is not None:
             grads = jax.tree_util.tree_map(lambda g: g / gas, self._grad_acc_buffer)
-            self._host_apply_update(grads)
+            if "gnorm" not in self._compiled:
+                self._compiled["gnorm"] = jax.jit(optax.global_norm)
+            with self.mesh:
+                gnorm = self._compiled["gnorm"](grads)  # device-side: exact across hosts
+            self._host_apply_update(grads, scaled_gnorm=gnorm)
             self._grad_acc_buffer = None
             self.global_steps += 1
             self.global_samples += self.train_batch_size()

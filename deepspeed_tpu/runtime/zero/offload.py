@@ -5,19 +5,23 @@ TPU-native analog of the reference's CPU-offload optimizer path
 csrc/adam/cpu_adam.cpp; NVMe tier via ``runtime/swap_tensor/*`` — SURVEY.md
 §2.2 "ZeRO-Offload / Infinity"). Division of labor on a TPU-VM:
 
-  * device (jit): forward + backward → gradients (bf16/fp32, sharded)
+  * device (jit): forward + backward → gradients (bf16/fp32, sharded) and
+    the global gradient norm (a GSPMD reduction — exact across all hosts)
   * host: fp32 master params + Adam moments in RAM — or moments on NVMe —
     updated by the fused multithreaded C++ kernel (``ops/csrc/adam``)
   * device upload: new masters placed back into the params' shardings
 
-This removes the optimizer states (8 bytes/param) and the master copies
-(4 bytes/param) from HBM, the same memory win as the reference, while the
-hot fwd/bwd path stays fully compiled. With NVMe, moments stream through
-host buffers with read/write overlap (``OptimizerStateSwapper``), the
-pipelined pattern of the reference's ``PipelinedOptimizerSwapper``.
+Multi-host (reference per-rank swappers
+``runtime/swap_tensor/partitioned_param_swapper.py:36``): each host keeps
+masters/moments ONLY for the shard blocks its addressable devices own
+(``shard_mode``), updates them from its local gradient shards, and re-assembles
+the global param arrays with ``make_array_from_single_device_arrays`` — no
+cross-host traffic beyond the device-side norm reduction. The same block
+machinery runs single-process over a virtual multi-device mesh, which is how
+the path is tested without a pod.
 """
 
-from typing import Callable, Dict, Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -34,8 +38,21 @@ def _leaf_paths(tree):
     return [(path_str(kp), leaf) for kp, leaf in flat]
 
 
+def _unique_shards(arr):
+    """Addressable shards of a jax array, one per distinct index (replicas
+    within the process are dropped). Returns [(block_key, index, np_data)],
+    deterministically ordered."""
+    seen = {}
+    for s in arr.addressable_shards:
+        key = str(s.index)
+        if key not in seen:
+            seen[key] = (s.index, np.asarray(s.data))
+    return [(k, idx, data) for k, (idx, data) in sorted(seen.items())]
+
+
 class HostOffloadOptimizer:
-    """fp32 masters + Adam moments on host; fused C++ update per leaf."""
+    """fp32 masters + Adam moments on host; fused C++ update per leaf (or per
+    addressable shard block in ``shard_mode``)."""
 
     def __init__(self,
                  init_params,
@@ -47,32 +64,60 @@ class HostOffloadOptimizer:
                  nvme_path: Optional[str] = None,
                  pipeline_read: bool = True,
                  pipeline_write: bool = True,
-                 grad_clip: float = 0.0):
+                 grad_clip: float = 0.0,
+                 shard_mode: Optional[bool] = None,
+                 block_shardings=None):
         from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
 
-        if jax.process_count() > 1:
-            # multi-host offload needs per-host shard fetch (each host updating
-            # only its addressable gradient shards) — not implemented yet; the
-            # single-host path below would crash on non-addressable arrays
-            raise NotImplementedError("offload_optimizer is single-host only for now")
         self.opt = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay, adamw_mode=adamw_mode)
         self.base_lr = lr
         self.grad_clip = grad_clip
         self.treedef = jax.tree_util.tree_structure(init_params)
+        # shard mode: hold only this host's addressable shard blocks
+        # (mandatory on a pod, where device_get of a global array would
+        # fail); DS_TPU_OFFLOAD_SHARD_MODE=1 forces it single-process so the
+        # pod path is exercised on a virtual multi-device mesh
+        if shard_mode is None:
+            import os
 
-        host = jax.device_get(init_params)
+            shard_mode = jax.process_count() > 1 or os.environ.get("DS_TPU_OFFLOAD_SHARD_MODE") == "1"
+        self.shard_mode = bool(shard_mode)
+        # block layout: masters follow the GRADIENT sharding (each host owns
+        # exactly the blocks whose grads it receives — the reference's
+        # per-rank optimizer partitions); the upload reshards to the param
+        # layout on device (the reference's allgather of updated partitions)
+        self._block_shardings = block_shardings
+        if self.shard_mode and block_shardings is not None:
+            init_params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s), init_params, block_shardings)
+
         self.keys = []
         self.masters: Dict[str, np.ndarray] = {}
         self.shapes: Dict[str, tuple] = {}
-        for key, leaf in _leaf_paths(host):
-            # always COPY: masters are mutated in place by the C++ kernel and
-            # must never alias caller arrays (on the CPU backend jnp.asarray
-            # zero-copies aligned numpy buffers, so an alias here would let
-            # the optimizer silently rewrite live jax arrays)
-            arr = np.array(leaf, dtype=np.float32, copy=True).reshape(-1)
-            self.keys.append(key)
-            self.masters[key] = arr
-            self.shapes[key] = np.shape(leaf)
+        self._blocks: Dict[str, Dict[str, str]] = {}  # path -> {str(index): key}
+        self._leaf_shapes: Dict[str, tuple] = {}
+        if self.shard_mode:
+            for path, leaf in _leaf_paths(init_params):
+                self._leaf_shapes[path] = tuple(np.shape(leaf))
+                self._blocks[path] = {}
+                for bk, _idx, data in _unique_shards(leaf):
+                    key = f"{path}::{bk}"
+                    self.keys.append(key)
+                    # COPY: the C++ kernel mutates masters in place
+                    self.masters[key] = np.array(data, dtype=np.float32, copy=True).reshape(-1)
+                    self.shapes[key] = np.shape(data)
+                    self._blocks[path][bk] = key
+        else:
+            host = jax.device_get(init_params)
+            for key, leaf in _leaf_paths(host):
+                # always COPY: masters are mutated in place by the C++ kernel
+                # and must never alias caller arrays (on the CPU backend
+                # jnp.asarray zero-copies aligned numpy buffers, so an alias
+                # would let the optimizer silently rewrite live jax arrays)
+                arr = np.array(leaf, dtype=np.float32, copy=True).reshape(-1)
+                self.keys.append(key)
+                self.masters[key] = arr
+                self.shapes[key] = np.shape(leaf)
 
         self.swapper = None
         self.moments: Dict[str, Dict[str, np.ndarray]] = {}
@@ -82,7 +127,7 @@ class HostOffloadOptimizer:
             for key in self.keys:
                 self.swapper.initialize(key, self.masters[key].shape)
             self.swapper.flush_writes()
-            logger.info(f"ZeRO-Infinity: {len(self.keys)} optimizer-state leaves on NVMe at {nvme_path}")
+            logger.info(f"ZeRO-Infinity: {len(self.keys)} optimizer-state blocks on NVMe at {nvme_path}")
         else:
             for key in self.keys:
                 self.moments[key] = {
@@ -91,6 +136,17 @@ class HostOffloadOptimizer:
                 }
 
     # ------------------------------------------------------------------
+    def _grad_blocks(self, grads_tree) -> Dict[str, np.ndarray]:
+        """Flat fp32 gradient block per master key."""
+        if self.shard_mode:
+            out = {}
+            for path, leaf in _leaf_paths(grads_tree):
+                for bk, _idx, data in _unique_shards(leaf):
+                    out[f"{path}::{bk}"] = np.asarray(data, dtype=np.float32).reshape(-1)
+            return out
+        host = jax.device_get(grads_tree)
+        return {key: np.asarray(leaf, dtype=np.float32).reshape(-1) for key, leaf in _leaf_paths(host)}
+
     def _global_grad_norm(self, grads: Dict[str, np.ndarray], inv_scale: float) -> float:
         sq = 0.0
         for g in grads.values():
@@ -98,21 +154,29 @@ class HostOffloadOptimizer:
             sq += float(np.dot(g64.ravel(), g64.ravel()))
         return float(np.sqrt(sq)) * inv_scale
 
-    def step(self, step_no: int, grads_tree, lr: Optional[float] = None, loss_scale: float = 1.0):
+    def step(self, step_no: int, grads_tree, lr: Optional[float] = None, loss_scale: float = 1.0,
+             grad_norm: Optional[float] = None):
         """Apply one Adam step on the host.
 
         ``grads_tree``: pytree matching params (device or host arrays).
-        Returns (new_params_tree_host, grad_norm, overflow: bool).
-        Overflow (non-finite grads) skips the update, reference
+        ``grad_norm``: UNSCALED global gradient norm, ideally computed on
+        device inside the compiled grads program (exact across hosts; in
+        shard_mode a host-side norm would only see local shards).
+        Returns (new_params_tree_host_or_None, grad_norm, overflow: bool) —
+        the params tree is None in shard_mode (use ``rebuild_device_params``).
+        Overflow (non-finite norm) skips the update, reference
         ``has_overflow`` semantics.
         """
-        host_grads = jax.device_get(grads_tree)
-        grads = {key: np.asarray(leaf, dtype=np.float32).reshape(-1) for key, leaf in _leaf_paths(host_grads)}
+        grads = self._grad_blocks(grads_tree)
 
         inv_scale = 1.0 / float(loss_scale)
-        norm = self._global_grad_norm(grads, inv_scale)
+        if grad_norm is None:
+            assert not self.shard_mode, "shard_mode needs the device-computed global grad norm"
+            norm = self._global_grad_norm(grads, inv_scale)
+        else:
+            norm = float(grad_norm)
         if not np.isfinite(norm):
-            return self.rebuild_params(), norm, True
+            return (None if self.shard_mode else self.rebuild_params()), norm, True
         scale = inv_scale
         if self.grad_clip and norm > self.grad_clip:
             scale *= self.grad_clip / (norm + 1e-6)
@@ -133,18 +197,61 @@ class HostOffloadOptimizer:
                 m = self.moments[key]
                 self.opt.step(step_no, self.masters[key], grads[key], m["exp_avg"], m["exp_avg_sq"],
                               lr=lr, grad_scale=scale)
-        return self.rebuild_params(), norm, False
+        return (None if self.shard_mode else self.rebuild_params()), norm, False
 
     def rebuild_params(self):
         """Masters → pytree of correctly-shaped fp32 arrays (host). Copies,
-        so later in-place master updates can't reach arrays handed out."""
+        so later in-place master updates can't reach arrays handed out.
+        Whole-leaf mode only."""
+        assert not self.shard_mode, "shard_mode: use rebuild_device_params(shardings, dtypes)"
         leaves = [self.masters[key].reshape(self.shapes[key]).copy() for key in self.keys]
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def rebuild_device_params(self, shardings, dtypes):
+        """Masters → global device arrays in the given shardings (reference
+        per-rank upload: each host contributes only the shard blocks it
+        owns). Works in both modes; in whole-leaf mode it is a plain
+        device_put per leaf."""
+        is_sh = lambda x: hasattr(x, "addressable_devices_indices_map")
+        sh_leaves = jax.tree_util.tree_leaves(shardings, is_leaf=is_sh)
+        dt_leaves = jax.tree_util.tree_leaves(dtypes)
+        bsh_leaves = (jax.tree_util.tree_leaves(self._block_shardings, is_leaf=is_sh)
+                      if (self.shard_mode and self._block_shardings is not None) else None)
+        paths = [p for p, _ in _leaf_paths(jax.tree_util.tree_unflatten(
+            self.treedef, list(range(self.treedef.num_leaves))))]
+        out_leaves = []
+        for path, sharding, dtype in zip(paths, sh_leaves, dt_leaves):
+            if not self.shard_mode:
+                arr = self.masters[path].reshape(self.shapes[path]).astype(dtype)
+                out_leaves.append(jax.device_put(arr, sharding))
+                continue
+            shape = self._leaf_shapes[path]
+            block_sharding = bsh_leaves[len(out_leaves)] if bsh_leaves is not None else sharding
+            index_map = block_sharding.addressable_devices_indices_map(shape)
+            bufs = []
+            for dev, idx in index_map.items():
+                key = self._blocks[path].get(str(idx))
+                assert key is not None, f"no master block for {path} index {idx}"
+                block = self.masters[key].reshape(self.shapes[key]).astype(dtype)
+                bufs.append(jax.device_put(block, dev))
+            arr = jax.make_array_from_single_device_arrays(shape, block_sharding, bufs)
+            if block_sharding is not sharding:
+                # device-side reshard to the param layout (cross-host over
+                # ICI/DCN — the reference's updated-partition allgather)
+                arr = jax.device_put(arr, sharding)
+            out_leaves.append(arr)
+        return jax.tree_util.tree_unflatten(self.treedef, out_leaves)
 
     def reset_masters(self, params_tree):
         """Overwrite the fp32 masters from a params pytree (used after a
         checkpoint load that replaced the device params: masters must follow,
         or the next step would resurrect the pre-load weights)."""
+        if self.shard_mode:
+            for path, leaf in _leaf_paths(params_tree):
+                for bk, _idx, data in _unique_shards(leaf):
+                    np.copyto(self.masters[f"{path}::{bk}"],
+                              np.asarray(data, dtype=np.float32).reshape(-1))
+            return
         host = jax.device_get(params_tree)
         for key, leaf in _leaf_paths(host):
             np.copyto(self.masters[key], np.asarray(leaf, dtype=np.float32).reshape(-1))

@@ -1,0 +1,8 @@
+"""deepspeed_tpu.utils — logging, timers, comms logging, and the
+tensor_fragment debug surface (reference ``deepspeed/utils/__init__.py``
+re-exports)."""
+
+from .logging import logger, log_dist, warning_once
+from .tensor_fragment import (safe_get_full_fp32_param, safe_get_full_optimizer_state,
+                              safe_get_local_fp32_param, safe_get_local_optimizer_state,
+                              safe_set_full_fp32_param, safe_set_full_optimizer_state)

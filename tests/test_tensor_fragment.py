@@ -1,0 +1,98 @@
+"""tensor_fragment debug APIs under ZeRO-1/3 + MiCS (reference
+``deepspeed/utils/tensor_fragment.py`` — safe_get/set_full_fp32_param,
+safe_get/set_full_optimizer_state, local variants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.utils import (safe_get_full_fp32_param, safe_get_full_optimizer_state,
+                                 safe_get_local_fp32_param, safe_get_local_optimizer_state,
+                                 safe_set_full_fp32_param, safe_set_full_optimizer_state)
+
+from conftest import tiny_batch
+
+
+def _engine(stage=3, mics=None):
+    groups.reset()
+    m = TransformerLM(TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                                        max_seq_len=64, intermediate_size=128,
+                                        attention_impl="reference", dtype=jnp.float32))
+    zero = {"stage": stage}
+    if mics:
+        zero["mics_shard_size"] = mics
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": zero,
+        "tpu": {"mesh": {"data": 8}},
+        "steps_per_print": 100,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+    return engine
+
+
+@pytest.mark.parametrize("stage,mics", [(1, None), (3, None), (3, 4)])
+def test_get_set_full_param(stage, mics, eight_devices):
+    engine = _engine(stage, mics)
+    engine.train_batch(tiny_batch(16, 32))
+
+    wq = safe_get_full_fp32_param(engine, "blocks/wq")
+    assert wq.shape == tuple(engine.state["params"]["blocks"]["wq"].shape)
+    assert np.isfinite(wq).all()
+
+    # set must round-trip through the sharded layout exactly
+    new = np.full_like(wq, 0.125)
+    safe_set_full_fp32_param(engine, "blocks/wq", new)
+    back = safe_get_full_fp32_param(engine, "blocks/wq")
+    np.testing.assert_array_equal(back, new)
+    # and training still runs on the mutated weights
+    assert np.isfinite(float(engine.train_batch(tiny_batch(16, 32, seed=1))))
+
+
+@pytest.mark.parametrize("stage,mics", [(1, None), (3, None), (3, 4)])
+def test_get_set_optimizer_state(stage, mics, eight_devices):
+    engine = _engine(stage, mics)
+    engine.train_batch(tiny_batch(16, 32))
+
+    m = safe_get_full_optimizer_state(engine, "blocks/wq", "exp_avg")
+    v = safe_get_full_optimizer_state(engine, "blocks/wq", "exp_avg_sq")
+    assert m is not None and v is not None
+    assert m.shape == v.shape == tuple(engine.state["params"]["blocks"]["wq"].shape)
+    assert np.abs(m).max() > 0, "after one step Adam's mu must be nonzero"
+    assert (v >= 0).all()
+
+    safe_set_full_optimizer_state(engine, "blocks/wq", "exp_avg", np.zeros_like(m))
+    np.testing.assert_array_equal(
+        safe_get_full_optimizer_state(engine, "blocks/wq", "exp_avg"), 0.0)
+    # the sibling state is untouched
+    np.testing.assert_allclose(
+        safe_get_full_optimizer_state(engine, "blocks/wq", "exp_avg_sq"), v, rtol=1e-6)
+
+
+def test_local_views(eight_devices):
+    engine = _engine(3)
+    full = safe_get_full_fp32_param(engine, "blocks/wq")
+    local = safe_get_local_fp32_param(engine, "blocks/wq")
+    # single process owns all 8 shards: local view covers the full tensor
+    assert local.size == full.size
+    m_local = safe_get_local_optimizer_state(engine, "blocks/wq", "exp_avg")
+    assert m_local is not None and m_local.size == full.size
+
+
+def test_unknown_state_key_raises(eight_devices):
+    engine = _engine(1)
+    with pytest.raises(ValueError, match="unknown optimizer state"):
+        safe_get_full_optimizer_state(engine, "blocks/wq", "momentum_buffer")
+
+
+def test_shape_mismatch_raises(eight_devices):
+    engine = _engine(1)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        safe_set_full_fp32_param(engine, "blocks/wq", np.zeros((3, 3)))
