@@ -110,7 +110,64 @@ def transformer_config_from_hf(hf_cfg: dict):
             intermediate_size=hf_cfg["ffn_dim"], max_seq_len=hf_cfg.get("max_position_embeddings", 2048),
             norm="layernorm", positions="learned", mlp="relu", use_bias=True,
             tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", True)), norm_eps=1e-5), mt
-    raise ValueError(f"unsupported model_type {mt!r}; supported: llama, mistral, gpt2, opt")
+    if mt == "bloom":
+        H = hf_cfg.get("hidden_size", hf_cfg.get("n_embed"))
+        return TransformerConfig(
+            vocab_size=hf_cfg["vocab_size"], hidden_size=H,
+            num_layers=hf_cfg.get("num_hidden_layers", hf_cfg.get("n_layer")),
+            num_heads=hf_cfg.get("num_attention_heads", hf_cfg.get("n_head")),
+            intermediate_size=4 * H, max_seq_len=2048,
+            norm="layernorm", positions="alibi", mlp="gelu", use_bias=True,
+            tie_embeddings=True, embed_layernorm=True,
+            norm_eps=float(hf_cfg.get("layer_norm_epsilon", 1e-5))), mt
+    if mt == "gptj":
+        return TransformerConfig(
+            vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["n_embd"],
+            num_layers=hf_cfg["n_layer"], num_heads=hf_cfg["n_head"],
+            intermediate_size=hf_cfg.get("n_inner") or 4 * hf_cfg["n_embd"],
+            max_seq_len=hf_cfg.get("n_positions", 2048),
+            norm="layernorm", positions="rotary", mlp="gelu", use_bias=True,
+            tie_embeddings=False, parallel_residual=True, shared_ln=True,
+            rotary_dim=hf_cfg.get("rotary_dim") or hf_cfg["n_embd"] // hf_cfg["n_head"],
+            norm_eps=float(hf_cfg.get("layer_norm_epsilon", 1e-5))), mt
+    if mt == "gpt_neox":
+        hd = hf_cfg["hidden_size"] // hf_cfg["num_attention_heads"]
+        return TransformerConfig(
+            vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["hidden_size"],
+            num_layers=hf_cfg["num_hidden_layers"], num_heads=hf_cfg["num_attention_heads"],
+            intermediate_size=hf_cfg["intermediate_size"],
+            max_seq_len=hf_cfg.get("max_position_embeddings", 2048),
+            norm="layernorm", positions="rotary", mlp="gelu", use_bias=True,
+            tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", False)),
+            parallel_residual=bool(hf_cfg.get("use_parallel_residual", True)), shared_ln=False,
+            rotary_dim=max(2, int(hd * float(hf_cfg.get("rotary_pct", 0.25))) // 2 * 2),
+            rope_theta=float(hf_cfg.get("rotary_emb_base", 10000.0)),
+            norm_eps=float(hf_cfg.get("layer_norm_eps", 1e-5))), mt
+    if mt == "falcon":
+        nh = hf_cfg.get("num_attention_heads", hf_cfg.get("n_head"))
+        new_arch = bool(hf_cfg.get("new_decoder_architecture", False))
+        # HF semantics: num_kv_heads applies whenever new_decoder_architecture
+        # or not multi_query; only legacy multi_query models force MQA (1)
+        if new_arch or not hf_cfg.get("multi_query", True):
+            nkv = hf_cfg.get("num_kv_heads") or hf_cfg.get("n_head_kv") or nh
+        else:
+            nkv = 1
+        if hf_cfg.get("alibi", False):
+            raise ValueError("falcon checkpoints with alibi=true (falcon-rw family) are not "
+                             "supported yet: the converter maps falcon to rotary positions")
+        return TransformerConfig(
+            vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["hidden_size"],
+            num_layers=hf_cfg.get("num_hidden_layers", hf_cfg.get("n_layer")),
+            num_heads=nh, num_kv_heads=nkv,
+            intermediate_size=4 * hf_cfg["hidden_size"], max_seq_len=2048,
+            norm="layernorm", positions="rotary", mlp="gelu",
+            use_bias=bool(hf_cfg.get("bias", False)),
+            tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", True)),
+            parallel_residual=bool(hf_cfg.get("parallel_attn", True)) or new_arch,
+            shared_ln=bool(hf_cfg.get("parallel_attn", True)) and not new_arch,
+            norm_eps=float(hf_cfg.get("layer_norm_epsilon", 1e-5))), mt
+    raise ValueError(f"unsupported model_type {mt!r}; supported: llama, mistral, gpt2, opt, "
+                     "bloom, gptj, gpt_neox, falcon")
 
 
 # ---------------------------------------------------------------------------
@@ -121,6 +178,44 @@ def _stack(sd, fmt, L, transpose=False):
     if transpose:
         ws = [w.T for w in ws]
     return np.stack(ws)
+
+
+def _split_fused_qkv(w, nh, hd, nkv=None):
+    """Split a fused per-head query_key_value weight [(…)*hd, H] (torch
+    [out, in] layout) into our [L-free] (H, nh*hd) q and (H, nkv*hd) k/v.
+
+    ``nkv=None``: Bloom/NeoX per-head interleave (nh, 3, hd); else the
+    Falcon MQA/GQA layout [q heads..., k heads, v heads] on the out dim.
+    """
+    H = w.shape[1]
+    if nkv is None:
+        w3 = w.reshape(nh, 3, hd, H)
+        q, k, v = (w3[:, j].reshape(nh * hd, H).T for j in range(3))
+        return q, k, v
+    w3 = w.reshape(nkv, nh // nkv + 2, hd, H)
+    q = w3[:, :-2].reshape(nh * hd, H).T
+    k = w3[:, -2].reshape(nkv * hd, H).T
+    v = w3[:, -1].reshape(nkv * hd, H).T
+    return q, k, v
+
+
+def _split_fused_qkv_bias(b, nh, hd):
+    b3 = b.reshape(nh, 3, hd)
+    return b3[:, 0].reshape(-1), b3[:, 1].reshape(-1), b3[:, 2].reshape(-1)
+
+
+def _interleaved_to_half_perm(w_cols, nh, hd, rotary_dim):
+    """Permute q/k projection OUTPUT columns so HF's interleaved (GPT-J
+    rotate_every_two) rotary becomes our half-style rope: within each head's
+    first ``rotary_dim`` dims, reorder [0,1,2,...] -> [0,2,4,...,1,3,...].
+    Score-preserving because the same orthogonal permutation hits q and k."""
+    perm_r = list(range(0, rotary_dim, 2)) + list(range(1, rotary_dim, 2))
+    idx = []
+    for h in range(nh):
+        off = h * hd
+        idx.extend(off + np.asarray(perm_r))
+        idx.extend(range(off + rotary_dim, off + hd))
+    return w_cols[..., np.asarray(idx)]
 
 
 def convert_hf_state_dict(sd: Dict[str, np.ndarray], cfg, model_type: str):
@@ -199,6 +294,133 @@ def convert_hf_state_dict(sd: Dict[str, np.ndarray], cfg, model_type: str):
             "final_norm": {"scale": np.asarray(sd["model.decoder.final_layer_norm.weight"], np.float32),
                            "bias": np.asarray(sd["model.decoder.final_layer_norm.bias"], np.float32)},
         }
+        return p
+    if model_type == "bloom":
+        L_, nh, hd = L, cfg.num_heads, cfg.head_dim
+        base = "transformer.h.{i}."
+        qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+        for i in range(L_):
+            w = np.asarray(sd[base.format(i=i) + "self_attention.query_key_value.weight"], np.float32)
+            b = np.asarray(sd[base.format(i=i) + "self_attention.query_key_value.bias"], np.float32)
+            q, k, v = _split_fused_qkv(w, nh, hd)
+            bq, bk, bv = _split_fused_qkv_bias(b, nh, hd)
+            qs.append(q), ks.append(k), vs.append(v)
+            bqs.append(bq), bks.append(bk), bvs.append(bv)
+        p = {
+            "embed": {"embedding": np.asarray(sd["transformer.word_embeddings.weight"], np.float32)},
+            "embed_norm": {"scale": np.asarray(sd["transformer.word_embeddings_layernorm.weight"], np.float32),
+                           "bias": np.asarray(sd["transformer.word_embeddings_layernorm.bias"], np.float32)},
+            "blocks": {
+                "ln1_scale": _stack(sd, base + "input_layernorm.weight", L_),
+                "ln1_bias": _stack(sd, base + "input_layernorm.bias", L_),
+                "wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+                "bq": np.stack(bqs), "bk": np.stack(bks), "bv": np.stack(bvs),
+                "wo": _stack(sd, base + "self_attention.dense.weight", L_, transpose=True),
+                "bo": _stack(sd, base + "self_attention.dense.bias", L_),
+                "ln2_scale": _stack(sd, base + "post_attention_layernorm.weight", L_),
+                "ln2_bias": _stack(sd, base + "post_attention_layernorm.bias", L_),
+                "w_up": _stack(sd, base + "mlp.dense_h_to_4h.weight", L_, transpose=True),
+                "b_up": _stack(sd, base + "mlp.dense_h_to_4h.bias", L_),
+                "w_down": _stack(sd, base + "mlp.dense_4h_to_h.weight", L_, transpose=True),
+                "b_down": _stack(sd, base + "mlp.dense_4h_to_h.bias", L_),
+            },
+            "final_norm": {"scale": np.asarray(sd["transformer.ln_f.weight"], np.float32),
+                           "bias": np.asarray(sd["transformer.ln_f.bias"], np.float32)},
+        }
+        return p
+    if model_type == "gptj":
+        nh, hd, r = cfg.num_heads, cfg.head_dim, cfg.rotary_dim
+        base = "transformer.h.{i}."
+        Z = np.zeros((L, nh * hd), np.float32)
+        p = {
+            "embed": {"embedding": np.asarray(sd["transformer.wte.weight"], np.float32)},
+            "blocks": {
+                "ln1_scale": _stack(sd, base + "ln_1.weight", L),
+                "ln1_bias": _stack(sd, base + "ln_1.bias", L),
+                # interleaved->half rotary handled by column permutation
+                "wq": _interleaved_to_half_perm(
+                    _stack(sd, base + "attn.q_proj.weight", L, transpose=True), nh, hd, r),
+                "wk": _interleaved_to_half_perm(
+                    _stack(sd, base + "attn.k_proj.weight", L, transpose=True), nh, hd, r),
+                "wv": _stack(sd, base + "attn.v_proj.weight", L, transpose=True),
+                "bq": Z, "bk": Z, "bv": Z,  # GPT-J attention has no biases
+                "wo": _stack(sd, base + "attn.out_proj.weight", L, transpose=True),
+                "bo": np.zeros((L, cfg.hidden_size), np.float32),
+                "w_up": _stack(sd, base + "mlp.fc_in.weight", L, transpose=True),
+                "b_up": _stack(sd, base + "mlp.fc_in.bias", L),
+                "w_down": _stack(sd, base + "mlp.fc_out.weight", L, transpose=True),
+                "b_down": _stack(sd, base + "mlp.fc_out.bias", L),
+            },
+            "final_norm": {"scale": np.asarray(sd["transformer.ln_f.weight"], np.float32),
+                           "bias": np.asarray(sd["transformer.ln_f.bias"], np.float32)},
+            "lm_head": {"kernel": np.asarray(sd["lm_head.weight"], np.float32).T,
+                        "bias": np.asarray(sd["lm_head.bias"], np.float32)},
+        }
+        return p
+    if model_type == "gpt_neox":
+        nh, hd = cfg.num_heads, cfg.head_dim
+        base = "gpt_neox.layers.{i}."
+        qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+        for i in range(L):
+            w = np.asarray(sd[base.format(i=i) + "attention.query_key_value.weight"], np.float32)
+            b = np.asarray(sd[base.format(i=i) + "attention.query_key_value.bias"], np.float32)
+            q, k, v = _split_fused_qkv(w, nh, hd)
+            bq, bk, bv = _split_fused_qkv_bias(b, nh, hd)
+            qs.append(q), ks.append(k), vs.append(v)
+            bqs.append(bq), bks.append(bk), bvs.append(bv)
+        p = {
+            "embed": {"embedding": np.asarray(sd["gpt_neox.embed_in.weight"], np.float32)},
+            "blocks": {
+                "ln1_scale": _stack(sd, base + "input_layernorm.weight", L),
+                "ln1_bias": _stack(sd, base + "input_layernorm.bias", L),
+                "wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+                "bq": np.stack(bqs), "bk": np.stack(bks), "bv": np.stack(bvs),
+                "wo": _stack(sd, base + "attention.dense.weight", L, transpose=True),
+                "bo": _stack(sd, base + "attention.dense.bias", L),
+                "ln2_scale": _stack(sd, base + "post_attention_layernorm.weight", L),
+                "ln2_bias": _stack(sd, base + "post_attention_layernorm.bias", L),
+                "w_up": _stack(sd, base + "mlp.dense_h_to_4h.weight", L, transpose=True),
+                "b_up": _stack(sd, base + "mlp.dense_h_to_4h.bias", L),
+                "w_down": _stack(sd, base + "mlp.dense_4h_to_h.weight", L, transpose=True),
+                "b_down": _stack(sd, base + "mlp.dense_4h_to_h.bias", L),
+            },
+            "final_norm": {"scale": np.asarray(sd["gpt_neox.final_layer_norm.weight"], np.float32),
+                           "bias": np.asarray(sd["gpt_neox.final_layer_norm.bias"], np.float32)},
+        }
+        if not cfg.tie_embeddings:
+            p["lm_head"] = {"kernel": np.asarray(sd["embed_out.weight"], np.float32).T}
+        return p
+    if model_type == "falcon":
+        nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        base = "transformer.h.{i}."
+        # new_decoder_architecture (40b/180b) names its two parallel norms
+        # ln_attn/ln_mlp; the 7b family has a single input_layernorm
+        new_arch = base.format(i=0) + "ln_attn.weight" in sd
+        ln1 = "ln_attn" if new_arch else "input_layernorm"
+        qs, ks, vs = [], [], []
+        for i in range(L):
+            w = np.asarray(sd[base.format(i=i) + "self_attention.query_key_value.weight"], np.float32)
+            q, k, v = _split_fused_qkv(w, nh, hd, nkv=nkv)
+            qs.append(q), ks.append(k), vs.append(v)
+        blocks = {
+            "ln1_scale": _stack(sd, base + ln1 + ".weight", L),
+            "ln1_bias": _stack(sd, base + ln1 + ".bias", L),
+            "wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+            "wo": _stack(sd, base + "self_attention.dense.weight", L, transpose=True),
+            "w_up": _stack(sd, base + "mlp.dense_h_to_4h.weight", L, transpose=True),
+            "w_down": _stack(sd, base + "mlp.dense_4h_to_h.weight", L, transpose=True),
+        }
+        if new_arch:  # separate MLP-branch norm (shared_ln=False)
+            blocks["ln2_scale"] = _stack(sd, base + "ln_mlp.weight", L)
+            blocks["ln2_bias"] = _stack(sd, base + "ln_mlp.bias", L)
+        p = {
+            "embed": {"embedding": np.asarray(sd["transformer.word_embeddings.weight"], np.float32)},
+            "blocks": blocks,
+            "final_norm": {"scale": np.asarray(sd["transformer.ln_f.weight"], np.float32),
+                           "bias": np.asarray(sd["transformer.ln_f.bias"], np.float32)},
+        }
+        if not cfg.tie_embeddings:
+            p["lm_head"] = {"kernel": np.asarray(sd["lm_head.weight"], np.float32).T}
         return p
     raise ValueError(f"unsupported model_type {model_type!r}")
 

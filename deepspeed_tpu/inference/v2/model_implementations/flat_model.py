@@ -29,7 +29,8 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from ....models.transformer import TransformerConfig, _norm, mlp_activation, rope_table, apply_rope
+from ....models.transformer import (TransformerConfig, _norm, alibi_slopes, apply_rope,
+                                    mlp_activation, rope_table)
 
 
 def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, Any], token_ids, seq_idx, pos, valid,
@@ -50,6 +51,9 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
     x = params["embed"]["embedding"].astype(dt)[token_ids]  # [T, H]
     if cfg.positions == "learned":
         x = x + params["pos_embed"]["embedding"].astype(dt)[pos]
+    if cfg.embed_layernorm:
+        en = params["embed_norm"]
+        x = _norm(x, en["scale"], en.get("bias"), cfg.norm, cfg.norm_eps)
     sin, cos = rope_table(cfg, pos) if cfg.positions == "rotary" else (None, None)
 
     # flat KV slot of each token; padding tokens dropped via OOB scatter
@@ -58,10 +62,10 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
 
     def layer(x, blk_kv):
         blk, k_pool_l, v_pool_l = blk_kv
-        h = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
-        q = jnp.einsum("th,hd->td", h, blk["wq"].astype(dt)).reshape(T, nq, d)
-        k = jnp.einsum("th,hd->td", h, blk["wk"].astype(dt)).reshape(T, nkv, d)
-        v = jnp.einsum("th,hd->td", h, blk["wv"].astype(dt)).reshape(T, nkv, d)
+        h1 = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+        q = jnp.einsum("th,hd->td", h1, blk["wq"].astype(dt)).reshape(T, nq, d)
+        k = jnp.einsum("th,hd->td", h1, blk["wk"].astype(dt)).reshape(T, nkv, d)
+        v = jnp.einsum("th,hd->td", h1, blk["wv"].astype(dt)).reshape(T, nkv, d)
         if cfg.use_bias:
             q = q + blk["bq"].astype(dt).reshape(nq, d)
             k = k + blk["bk"].astype(dt).reshape(nkv, d)
@@ -76,31 +80,38 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
 
         from ....ops.pallas.paged_attention import paged_attention, paged_attention_reference
 
+        alibi = alibi_slopes(nq) if cfg.positions == "alibi" else None
         if use_pallas:
             ctx = paged_attention(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos, block_size,
-                                  window=cfg.sliding_window)
+                                  window=cfg.sliding_window, alibi=alibi)
         else:
             ctx = paged_attention_reference(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos,
-                                            block_size, window=cfg.sliding_window)
+                                            block_size, window=cfg.sliding_window, alibi=alibi)
 
         attn_out = jnp.einsum("td,dh->th", ctx.reshape(T, nq * d), blk["wo"].astype(dt))
         if cfg.use_bias:
             attn_out = attn_out + blk["bo"].astype(dt)
-        x = x + attn_out
 
-        h = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
-        up = jnp.einsum("th,hf->tf", h, blk["w_up"].astype(dt))
-        if cfg.use_bias:
-            up = up + blk["b_up"].astype(dt)
-        if cfg.mlp == "swiglu":
-            gate = jnp.einsum("th,hf->tf", h, blk["w_gate"].astype(dt))
-            act = mlp_activation(cfg, up, gate)
-        else:
-            act = mlp_activation(cfg, up)
-        down = jnp.einsum("tf,fh->th", act, blk["w_down"].astype(dt))
-        if cfg.use_bias:
-            down = down + blk["b_down"].astype(dt)
-        return x + down, (k_pool_l, v_pool_l)
+        def mlp(h):
+            up = jnp.einsum("th,hf->tf", h, blk["w_up"].astype(dt))
+            if cfg.use_bias:
+                up = up + blk["b_up"].astype(dt)
+            if cfg.mlp == "swiglu":
+                act = mlp_activation(cfg, up, jnp.einsum("th,hf->tf", h, blk["w_gate"].astype(dt)))
+            else:
+                act = mlp_activation(cfg, up)
+            down = jnp.einsum("tf,fh->th", act, blk["w_down"].astype(dt))
+            if cfg.use_bias:
+                down = down + blk["b_down"].astype(dt)
+            return down
+
+        if cfg.parallel_residual:  # GPT-J / NeoX / Falcon
+            h2 = h1 if cfg.shared_ln else _norm(x, blk["ln2_scale"], blk.get("ln2_bias"),
+                                                cfg.norm, cfg.norm_eps)
+            return x + attn_out + mlp(h2), (k_pool_l, v_pool_l)
+        x = x + attn_out
+        h2 = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        return x + mlp(h2), (k_pool_l, v_pool_l)
 
     def scan_body(x, blk_kv):
         x, pools = layer(x, blk_kv)
@@ -114,4 +125,6 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
         logits = jnp.einsum("sh,vh->sv", h_last, params["embed"]["embedding"].astype(dt))
     else:
         logits = jnp.einsum("sh,hv->sv", h_last, params["lm_head"]["kernel"].astype(dt))
+        if "bias" in params["lm_head"]:
+            logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
     return logits.astype(jnp.float32), k_pool, v_pool

@@ -3,3 +3,7 @@ from .llama import llama2, llama2_config
 from .gpt import gpt2, gpt2_config
 from .mistral import mistral, mistral_config
 from .opt import opt, opt_config
+from .bloom import bloom, bloom_config
+from .gptj import gptj, gptj_config
+from .gpt_neox import gpt_neox, gpt_neox_config
+from .falcon import falcon, falcon_config

@@ -1,0 +1,27 @@
+"""Bloom model family configs.
+
+Analog of the reference ``module_inject/containers/bloom.py`` +
+``model_implementations/bloom/``: LayerNorm (plus a word-embedding
+LayerNorm), ALiBi positions, GELU MLP, biases everywhere, tied embeddings,
+fused per-head query_key_value in HF checkpoints (split by the converter).
+"""
+
+from .transformer import TransformerConfig, TransformerLM
+
+
+def bloom_config(size: str = "560m", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4, max_seq_len=512),
+        "560m": dict(vocab_size=250880, hidden_size=1024, num_layers=24, num_heads=16, max_seq_len=2048),
+        "7b1": dict(vocab_size=250880, hidden_size=4096, num_layers=30, num_heads=32, max_seq_len=2048),
+        "176b": dict(vocab_size=250880, hidden_size=14336, num_layers=70, num_heads=112, max_seq_len=2048),
+    }
+    base = dict(presets[size], norm="layernorm", positions="alibi", mlp="gelu", use_bias=True,
+                intermediate_size=4 * presets[size]["hidden_size"], tie_embeddings=True,
+                embed_layernorm=True, norm_eps=1e-5)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def bloom(size: str = "560m", **overrides) -> TransformerLM:
+    return TransformerLM(bloom_config(size, **overrides))

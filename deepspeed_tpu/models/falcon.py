@@ -1,0 +1,29 @@
+"""Falcon model family configs.
+
+Analog of the reference ``module_inject/containers/`` falcon-style
+parallel-attention container: parallel residual with a single pre-norm
+(falcon-7b ``parallel_attn`` + no ``new_decoder_architecture``), full
+rotary, GELU, no biases, MQA/GQA (falcon-7b: 1 kv head), tied embeddings.
+"""
+
+from .transformer import TransformerConfig, TransformerLM
+
+
+def falcon_config(size: str = "7b", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4, num_kv_heads=1,
+                     max_seq_len=512),
+        "7b": dict(vocab_size=65024, hidden_size=4544, num_layers=32, num_heads=71, num_kv_heads=1,
+                   max_seq_len=2048),
+        "40b": dict(vocab_size=65024, hidden_size=8192, num_layers=60, num_heads=128, num_kv_heads=8,
+                    max_seq_len=2048),
+    }
+    base = dict(presets[size], norm="layernorm", positions="rotary", mlp="gelu", use_bias=False,
+                intermediate_size=4 * presets[size]["hidden_size"], tie_embeddings=True,
+                parallel_residual=True, shared_ln=True, norm_eps=1e-5)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def falcon(size: str = "7b", **overrides) -> TransformerLM:
+    return TransformerLM(falcon_config(size, **overrides))

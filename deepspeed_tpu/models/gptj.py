@@ -1,0 +1,28 @@
+"""GPT-J model family configs.
+
+Analog of the reference ``module_inject/containers/gptj.py``: parallel
+attention+MLP residual with a SINGLE pre-norm (shared_ln), partial rotary
+(rotary_dim=64), GELU, no attention biases (the converter zero-fills them),
+untied lm_head with bias. HF's interleaved rotary is handled by permuting
+the q/k projection columns at conversion time (half-style equivalence).
+"""
+
+from .transformer import TransformerConfig, TransformerLM
+
+
+def gptj_config(size: str = "6b", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4, max_seq_len=512,
+                     rotary_dim=16),
+        "6b": dict(vocab_size=50400, hidden_size=4096, num_layers=28, num_heads=16, max_seq_len=2048,
+                   rotary_dim=64),
+    }
+    base = dict(presets[size], norm="layernorm", positions="rotary", mlp="gelu", use_bias=True,
+                intermediate_size=4 * presets[size]["hidden_size"], tie_embeddings=False,
+                parallel_residual=True, shared_ln=True, norm_eps=1e-5)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def gptj(size: str = "6b", **overrides) -> TransformerLM:
+    return TransformerLM(gptj_config(size, **overrides))

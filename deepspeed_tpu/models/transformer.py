@@ -46,12 +46,23 @@ class TransformerConfig:
     num_kv_heads: Optional[int] = None  # GQA; None = MHA
     max_seq_len: int = 2048
     norm: str = "rmsnorm"  # 'rmsnorm' | 'layernorm'
-    positions: str = "rotary"  # 'rotary' | 'learned'
+    positions: str = "rotary"  # 'rotary' | 'learned' | 'alibi'
     mlp: str = "swiglu"  # 'swiglu' | 'gelu' | 'relu'
     use_bias: bool = False
     tie_embeddings: bool = False
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
+    # GPT-J / GPT-NeoX / Falcon style: attention and MLP read the SAME
+    # residual input and their outputs add jointly (x + attn + mlp)
+    parallel_residual: bool = False
+    # parallel_residual models with a single pre-norm (GPT-J, Falcon-7B);
+    # False = separate ln2 for the MLP branch (GPT-NeoX)
+    shared_ln: bool = False
+    # partial rotary (GPT-J rotary_dim, NeoX rotary_pct): rope applies to the
+    # first rotary_dim dims of each head; None = full head_dim
+    rotary_dim: Optional[int] = None
+    # Bloom: LayerNorm right after the token embedding
+    embed_layernorm: bool = False
     dtype: Any = jnp.bfloat16  # compute dtype; params are fp32 masters
     remat: bool = False
     remat_policy: str = "nothing_saveable"
@@ -128,9 +139,12 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
         blocks["w_down"] = dense_init(k[5], (L, F, H), F) / math.sqrt(2 * L)
         if cfg.mlp == "swiglu":
             blocks["w_gate"] = dense_init(k[6], (L, H, F), H)
+    if cfg.parallel_residual and cfg.shared_ln:
+        del blocks["ln2_scale"]  # single pre-norm feeds both branches
     if cfg.norm == "layernorm":
         blocks["ln1_bias"] = jnp.zeros((L, H), jnp.float32)
-        blocks["ln2_bias"] = jnp.zeros((L, H), jnp.float32)
+        if not (cfg.parallel_residual and cfg.shared_ln):
+            blocks["ln2_bias"] = jnp.zeros((L, H), jnp.float32)
     if cfg.use_bias:
         blocks["bq"] = jnp.zeros((L, nq * d), jnp.float32)
         blocks["bk"] = jnp.zeros((L, nkv * d), jnp.float32)
@@ -146,6 +160,10 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
     }
     if cfg.norm == "layernorm":
         params["final_norm"]["bias"] = jnp.zeros((H, ), jnp.float32)
+    if cfg.embed_layernorm:  # Bloom word_embeddings_layernorm
+        params["embed_norm"] = {"scale": jnp.ones((H, ), jnp.float32)}
+        if cfg.norm == "layernorm":
+            params["embed_norm"]["bias"] = jnp.zeros((H, ), jnp.float32)
     if cfg.positions == "learned":
         params["pos_embed"] = {"embedding": jax.random.normal(k[8], (cfg.max_seq_len, H), jnp.float32) * 0.02}
     if not cfg.tie_embeddings:
@@ -200,25 +218,51 @@ def _norm(x, scale, bias, kind, eps):
 
 
 def rope_table(cfg: TransformerConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    d = cfg.head_dim
+    d = cfg.rotary_dim or cfg.head_dim
     inv_freq = 1.0 / (cfg.rope_theta**(jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     freqs = jnp.einsum("s,f->sf", positions.astype(jnp.float32), inv_freq)
     return jnp.sin(freqs), jnp.cos(freqs)
 
 
 def apply_rope(x, sin, cos):
-    """x: [B, S, n, d]; sin/cos: [S, d/2] (broadcast over batch/heads)."""
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    sin = sin[None, :, None, :]
-    cos = cos[None, :, None, :]
-    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+    """x: [B, S, n, d]; sin/cos: [S, r/2] with r <= d (partial rotary, GPT-J
+    ``rotary_dim`` / NeoX ``rotary_pct``): the first r dims rotate in half
+    style, the rest pass through."""
+    r = 2 * sin.shape[-1]
+    d = x.shape[-1]
+    xr = x[..., :r] if r < d else x
+    x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)
+    sinb = sin[None, :, None, :]
+    cosb = cos[None, :, None, :]
+    rot = jnp.concatenate([x1 * cosb - x2 * sinb, x2 * cosb + x1 * sinb], axis=-1).astype(x.dtype)
+    if r < d:
+        return jnp.concatenate([rot, x[..., r:]], axis=-1)
+    return rot
 
 
-def reference_attention(q, k, v, causal=True, segment_ids=None, window=None):
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (train-short-test-long paper / Bloom
+    ``build_alibi_tensor``): pure powers of two for power-of-2 head counts,
+    the standard interleave otherwise."""
+
+    def pow2_slopes(n):
+        start = 2.0**(-(2.0**-(math.log2(n) - 3)))
+        return [start * (start**i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return np.asarray(pow2_slopes(n_heads), np.float32)
+    closest = 2**int(math.floor(math.log2(n_heads)))
+    out = pow2_slopes(closest)
+    extra = pow2_slopes(2 * closest)[0::2][:n_heads - closest]
+    return np.asarray(out + extra, np.float32)
+
+
+def reference_attention(q, k, v, causal=True, segment_ids=None, window=None, alibi=None):
     """jnp einsum attention — the numerics baseline every Pallas kernel is
     tested against (mirrors reference tests/unit/ops strategy). ``window``:
     sliding-window attention (Mistral) — query at position i sees keys in
-    (i - window, i]."""
+    (i - window, i]. ``alibi``: per-head slopes [nq]; adds
+    ``slope * (k_pos - q_pos)`` to the scores (Bloom)."""
     B, S, nq, d = q.shape
     nkv = k.shape[2]
     group = nq // nkv
@@ -227,6 +271,9 @@ def reference_attention(q, k, v, causal=True, segment_ids=None, window=None):
     vf = v.astype(jnp.float32)
     qf = qf.reshape(B, S, nkv, group, d)
     scores = jnp.einsum("bskgd,btkd->bkgst", qf, kf)
+    if alibi is not None:
+        rel = (jnp.arange(S, dtype=jnp.float32)[None, :] - jnp.arange(S, dtype=jnp.float32)[:, None])
+        scores = scores + jnp.asarray(alibi, jnp.float32).reshape(nkv, group)[:, :, None, None] * rel[None, None]
     if causal:
         mask = jnp.tril(jnp.ones((S, S), bool))
         if window is not None:
@@ -258,11 +305,13 @@ def _attention(cfg: TransformerConfig, q, k, v):
             impl = "flash" if jax.default_backend() == "tpu" else "reference"
         except Exception:
             impl = "reference"
+    alibi = cfg.positions == "alibi"
     if impl == "flash":
         from ..ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True, window=cfg.sliding_window)
-    return reference_attention(q, k, v, causal=True, window=cfg.sliding_window)
+        return flash_attention(q, k, v, causal=True, window=cfg.sliding_window, alibi=alibi)
+    return reference_attention(q, k, v, causal=True, window=cfg.sliding_window,
+                               alibi=alibi_slopes(cfg.num_heads) if alibi else None)
 
 
 def _qwz_target_specs(cfg: TransformerConfig, layer):
@@ -307,17 +356,11 @@ def _qwz_layer_view(cfg: TransformerConfig, layer):
     return out
 
 
-def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True):
-    """One transformer block; ``layer`` holds this layer's slice of the
-    stacked arrays. Returns (x, moe_aux_loss). ``constrain=False`` disables
-    GSPMD sharding constraints (for use inside shard_map pipeline stages)."""
-    if cfg.quantized_weights and constrain:
-        layer = _qwz_layer_view(cfg, layer)
+def _attn_branch(cfg: TransformerConfig, layer, h, sin, cos):
+    """Attention sub-block on pre-normed input ``h`` [B, S, H]."""
     dt = cfg.dtype
-    B, S, H = x.shape
+    B, S, H = h.shape
     nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-
-    h = _norm(x, layer["ln1_scale"], layer.get("ln1_bias"), cfg.norm, cfg.norm_eps)
     q = jnp.einsum("bsh,hd->bsd", h, layer["wq"].astype(dt))
     k = jnp.einsum("bsh,hd->bsd", h, layer["wk"].astype(dt))
     v = jnp.einsum("bsh,hd->bsd", h, layer["wv"].astype(dt))
@@ -338,6 +381,8 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True)
                 raise NotImplementedError(
                     "sliding_window + ring attention is not supported yet; use "
                     "sequence_parallel_impl='ulysses' (its local attention honors the window)")
+            if cfg.positions == "alibi":
+                raise NotImplementedError("alibi + ring attention is not supported yet; use ulysses")
             from ..parallel import groups
             from ..parallel.mesh import mesh_axis_size
             from ..sequence.ring import ring_attention_gspmd
@@ -349,6 +394,11 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True)
             else:
                 ctx = _attention(cfg, q, k, v)
         else:
+            if cfg.positions == "alibi":
+                # ulysses shards the SEQUENCE dim around local attention: the
+                # local attention sees global positions only via rope tables;
+                # alibi's relative bias would use local indices — wrong
+                raise NotImplementedError("alibi + ulysses sequence parallel is not supported yet")
             from ..sequence.layer import ulysses_attention_gspmd
 
             ctx = ulysses_attention_gspmd(partial(_attention, cfg), q, k, v)
@@ -364,13 +414,15 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True)
     attn_out = jnp.einsum("bsd,dh->bsh", ctx, layer["wo"].astype(dt))
     if cfg.use_bias:
         attn_out = attn_out + layer["bo"].astype(dt)
-    x = x + attn_out
+    return attn_out
 
-    h = _norm(x, layer["ln2_scale"], layer.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+
+def _mlp_branch(cfg: TransformerConfig, layer, h, rng=None, constrain=True):
+    """MLP (dense or MoE) sub-block on pre-normed input ``h``. Returns
+    (out, moe_aux_loss)."""
+    dt = cfg.dtype
     if cfg.moe_num_experts > 0:
-        down, l_aux = _moe_mlp(cfg, layer, h, rng, constrain=constrain)
-        x = x + down
-        return _activation_constraint(cfg, x, enabled=constrain), l_aux
+        return _moe_mlp(cfg, layer, h, rng, constrain=constrain)
     up = jnp.einsum("bsh,hf->bsf", h, layer["w_up"].astype(dt))
     if cfg.use_bias:
         up = up + layer["b_up"].astype(dt)
@@ -382,8 +434,30 @@ def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True)
     down = jnp.einsum("bsf,fh->bsh", act, layer["w_down"].astype(dt))
     if cfg.use_bias:
         down = down + layer["b_down"].astype(dt)
-    x = x + down
-    return _activation_constraint(cfg, x, enabled=constrain), jnp.zeros([], jnp.float32)
+    return down, jnp.zeros([], jnp.float32)
+
+
+def _block(cfg: TransformerConfig, x, layer, sin, cos, rng=None, constrain=True):
+    """One transformer block; ``layer`` holds this layer's slice of the
+    stacked arrays. Returns (x, moe_aux_loss). ``constrain=False`` disables
+    GSPMD sharding constraints (for use inside shard_map pipeline stages).
+    ``parallel_residual`` (GPT-J/NeoX/Falcon): attention and MLP both read
+    the block input and add jointly; ``shared_ln`` reuses ln1 for the MLP."""
+    if cfg.quantized_weights and constrain:
+        layer = _qwz_layer_view(cfg, layer)
+    h1 = _norm(x, layer["ln1_scale"], layer.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+    attn_out = _attn_branch(cfg, layer, h1, sin, cos)
+    if cfg.parallel_residual:
+        h2 = h1 if cfg.shared_ln else _norm(x, layer["ln2_scale"], layer.get("ln2_bias"),
+                                            cfg.norm, cfg.norm_eps)
+        mlp_out, l_aux = _mlp_branch(cfg, layer, h2, rng, constrain=constrain)
+        x = x + attn_out + mlp_out
+        return _activation_constraint(cfg, x, enabled=constrain), l_aux
+    x = x + attn_out
+    h2 = _norm(x, layer["ln2_scale"], layer.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+    mlp_out, l_aux = _mlp_branch(cfg, layer, h2, rng, constrain=constrain)
+    x = x + mlp_out
+    return _activation_constraint(cfg, x, enabled=constrain), l_aux
 
 
 def _moe_mlp(cfg: TransformerConfig, layer, h, rng=None, constrain=True):
@@ -466,6 +540,9 @@ def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: 
     x = params["embed"]["embedding"].astype(dt)[input_ids]
     if cfg.positions == "learned":
         x = x + params["pos_embed"]["embedding"].astype(dt)[:S][None]
+    if cfg.embed_layernorm:
+        en = params["embed_norm"]
+        x = _norm(x, en["scale"], en.get("bias"), cfg.norm, cfg.norm_eps)
     x = _activation_constraint(cfg, x)
 
     positions = jnp.arange(S)
@@ -490,6 +567,8 @@ def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: 
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["embedding"].astype(dt))
     else:
         logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(dt))
+        if "bias" in params["lm_head"]:  # GPT-J style biased unembedding
+            logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
     return logits.astype(jnp.float32), jnp.sum(l_auxs)
 
 
@@ -520,6 +599,9 @@ def _cached_attention(cfg, q, ck, cv, q_pos0, cache_len_total):
     scores = jnp.einsum("btkgd,bskd->bkgts", qf, ck.astype(jnp.float32))
     k_pos = jnp.arange(Smax)[None, None, None, None, :]
     q_pos = (q_pos0 + jnp.arange(T))[None, None, None, :, None]
+    if cfg.positions == "alibi":
+        slopes = jnp.asarray(alibi_slopes(nq), jnp.float32).reshape(nkv, group)
+        scores = scores + slopes[None, :, :, None, None] * (k_pos - q_pos).astype(jnp.float32)
     mask = (k_pos <= q_pos) & (k_pos < cache_len_total)
     if cfg.sliding_window is not None:
         mask = mask & (q_pos - k_pos < cfg.sliding_window)
@@ -539,6 +621,9 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
     if cfg.positions == "learned":
         pos_table = params["pos_embed"]["embedding"].astype(dt)
         x = x + jax.lax.dynamic_slice_in_dim(pos_table, start, T, axis=0)[None]
+    if cfg.embed_layernorm:
+        en = params["embed_norm"]
+        x = _norm(x, en["scale"], en.get("bias"), cfg.norm, cfg.norm_eps)
     positions = start + jnp.arange(T)
     sin, cos = rope_table(cfg, positions) if cfg.positions == "rotary" else (None, None)
     nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -546,10 +631,10 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
     def scan_body(carry, layer_and_cache):
         x = carry
         layer, ck, cv = layer_and_cache
-        h = _norm(x, layer["ln1_scale"], layer.get("ln1_bias"), cfg.norm, cfg.norm_eps)
-        q = jnp.einsum("bsh,hd->bsd", h, layer["wq"].astype(dt))
-        k = jnp.einsum("bsh,hd->bsd", h, layer["wk"].astype(dt))
-        v = jnp.einsum("bsh,hd->bsd", h, layer["wv"].astype(dt))
+        h1 = _norm(x, layer["ln1_scale"], layer.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+        q = jnp.einsum("bsh,hd->bsd", h1, layer["wq"].astype(dt))
+        k = jnp.einsum("bsh,hd->bsd", h1, layer["wk"].astype(dt))
+        v = jnp.einsum("bsh,hd->bsd", h1, layer["wv"].astype(dt))
         if cfg.use_bias:
             q, k, v = q + layer["bq"].astype(dt), k + layer["bk"].astype(dt), v + layer["bv"].astype(dt)
         q = q.reshape(B, T, nq, d)
@@ -561,21 +646,20 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), start, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), start, axis=1)
         ctx = _cached_attention(cfg, q, ck, cv, start, start + T)
-        x = x + jnp.einsum("bsd,dh->bsh", ctx, layer["wo"].astype(dt)) + \
+        attn_out = jnp.einsum("bsd,dh->bsh", ctx, layer["wo"].astype(dt)) + \
             (layer["bo"].astype(dt) if cfg.use_bias else 0.0)
-        h = _norm(x, layer["ln2_scale"], layer.get("ln2_bias"), cfg.norm, cfg.norm_eps)
-        if cfg.moe_num_experts > 0:
-            down, _ = _moe_mlp(cfg, layer, h, rng=None)  # deterministic gating at inference
-            return x + down, (ck, cv)
-        up = jnp.einsum("bsh,hf->bsf", h, layer["w_up"].astype(dt))
-        if cfg.use_bias:
-            up = up + layer["b_up"].astype(dt)
-        gate = jnp.einsum("bsh,hf->bsf", h, layer["w_gate"].astype(dt)) if cfg.mlp == "swiglu" else None
-        act = mlp_activation(cfg, up, gate)
-        down = jnp.einsum("bsf,fh->bsh", act, layer["w_down"].astype(dt))
-        if cfg.use_bias:
-            down = down + layer["b_down"].astype(dt)
-        return x + down, (ck, cv)
+
+        def mlp(h):
+            # deterministic gating at inference (rng=None)
+            return _mlp_branch(cfg, layer, h, rng=None)[0]
+
+        if cfg.parallel_residual:
+            h2 = h1 if cfg.shared_ln else _norm(x, layer["ln2_scale"], layer.get("ln2_bias"),
+                                                cfg.norm, cfg.norm_eps)
+            return x + attn_out + mlp(h2), (ck, cv)
+        x = x + attn_out
+        h2 = _norm(x, layer["ln2_scale"], layer.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        return x + mlp(h2), (ck, cv)
 
     x, (new_k, new_v) = lax.scan(scan_body, x, (params["blocks"], cache["k"], cache["v"]))
     x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
@@ -583,6 +667,8 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["embedding"].astype(dt))
     else:
         logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(dt))
+        if "bias" in params["lm_head"]:
+            logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
     new_cache = {"k": new_k, "v": new_v, "length": start + T}
     return logits.astype(jnp.float32), new_cache
 

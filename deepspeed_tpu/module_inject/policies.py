@@ -81,6 +81,33 @@ class OPTPolicy(TransformerPolicy):
     """opt (reference containers/opt.py)."""
 
 
+class BloomPolicy(TransformerPolicy):
+    """bloom (reference containers/bloom.py): fused query_key_value column,
+    dense row, dense_h_to_4h column, dense_4h_to_h row."""
+    column_patterns = TransformerPolicy.column_patterns + [
+        r"(^|/)query_key_value(/|$)", r"(^|/)dense_h_to_4h(/|$)"
+    ]
+    row_patterns = TransformerPolicy.row_patterns + [r"(^|/)dense_4h_to_h(/|$)"]
+
+
+class GPTNeoXPolicy(BloomPolicy):
+    """gpt-neox/pythia (reference containers/gptneox.py): same fused
+    query_key_value + dense_h_to_4h/4h_to_h naming as bloom."""
+
+
+class GPTJPolicy(TransformerPolicy):
+    """gpt-j (reference containers/gptj.py): separate q/k/v (no bias),
+    fc_in column, fc_out row."""
+    column_patterns = TransformerPolicy.column_patterns + [r"(^|/)fc_in(/|$)"]
+    row_patterns = TransformerPolicy.row_patterns + [r"(^|/)fc_out(/|$)"]
+
+
+class FalconPolicy(BloomPolicy):
+    """falcon (parallel-attention container): fused query_key_value with
+    MQA/GQA kv heads — the kv slice stays replicated when n_kv < tp degree
+    (handled by sanitize_spec's divisibility check)."""
+
+
 class BertPolicy(TransformerPolicy):
     """bert/roberta (reference containers/bert.py): self-attention q/k/v
     column, attention output + ffn output row."""
@@ -94,9 +121,12 @@ POLICY_REGISTRY: Dict[str, type] = {
     "mistral": MistralPolicy,
     "gpt2": GPTPolicy,
     "gpt": GPTPolicy,
-    "gptj": GPTPolicy,
-    "gpt_neox": GPTPolicy,
+    "gptj": GPTJPolicy,
+    "gpt_neox": GPTNeoXPolicy,
+    "pythia": GPTNeoXPolicy,
     "opt": OPTPolicy,
     "bert": BertPolicy,
     "roberta": BertPolicy,
+    "bloom": BloomPolicy,
+    "falcon": FalconPolicy,
 }

@@ -51,17 +51,30 @@ def _shapes_supported(q, block_q, block_k):
 
 
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512,
-                    window=None):
+                    window=None, alibi: bool = False):
     """q: [B, S, nq, d]; k/v: [B, S, nkv, d] with nq % nkv == 0.
 
     Differentiable: both forward and backward run as Pallas kernels on TPU.
     ``window``: sliding-window attention (Mistral reference
     ``inference/v2/model_implementations/mistral/``) — query i attends keys
-    in (i - window, i]; requires ``causal=True``.
+    in (i - window, i]; requires ``causal=True``. ``alibi``: Bloom-style
+    per-head linear bias ``slope_h * (k_pos - q_pos)`` with the standard
+    power-of-two slopes (non-power-of-2 head counts use the reference path).
     """
     if window is not None:
         assert causal, "sliding window requires causal attention"
         window = int(window)
+    if alibi and (q.shape[2] & (q.shape[2] - 1)) != 0:
+        # non-power-of-2 head counts use the interleaved slope table, which
+        # the in-kernel closed form doesn't produce — fall through to jnp,
+        # LOUDLY (same policy as the unsupported-shape path)
+        from ...models.transformer import alibi_slopes, reference_attention
+        from ...utils.logging import warning_once
+
+        warning_once(f"flash attention: alibi with non-power-of-2 head count {q.shape[2]} — "
+                     "using O(S^2) reference attention")
+        return reference_attention(q, k, v, causal=causal, window=window,
+                                   alibi=alibi_slopes(q.shape[2]))
     if _use_pallas() and not _shapes_supported(q, block_q, block_k):
         from ...utils.logging import warning_once
 
@@ -70,7 +83,7 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: i
     if _use_pallas() and _shapes_supported(q, block_q, block_k):
         try:
             return _pallas_flash(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-                                 window=window)
+                                 window=window, alibi=alibi)
         except Exception as e:
             if os.environ.get("DS_TPU_ALLOW_ATTN_FALLBACK") != "1":
                 raise RuntimeError(
@@ -82,38 +95,48 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: i
 
             warning_once(f"pallas flash attention failed ({type(e).__name__}); "
                          f"falling back to reference attention — expect O(S^2) memory")
-    from ...models.transformer import reference_attention
+    from ...models.transformer import alibi_slopes, reference_attention
 
-    return reference_attention(q, k, v, causal=causal, window=window)
+    return reference_attention(q, k, v, causal=causal, window=window,
+                               alibi=alibi_slopes(q.shape[2]) if alibi else None)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window"))
-def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=False, window=None):
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window",
+                                             "alibi"))
+def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=False, window=None,
+                  alibi=False):
     return _flash_core(causal, min(block_q, q.shape[1]), min(block_k, q.shape[1]),
-                       interpret, window, q, k, v)
+                       interpret, window, alibi, q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
-def _flash_core(causal, block_q, block_k, interpret, window, q, k, v):
-    out, _ = _flash_fwd_impl(causal, block_q, block_k, interpret, window, q, k, v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
+def _flash_core(causal, block_q, block_k, interpret, window, alibi, q, k, v):
+    out, _ = _flash_fwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v)
     return out
 
 
-def _flash_core_fwd(causal, block_q, block_k, interpret, window, q, k, v):
-    out, lse = _flash_fwd_impl(causal, block_q, block_k, interpret, window, q, k, v)
+def _flash_core_fwd(causal, block_q, block_k, interpret, window, alibi, q, k, v):
+    out, lse = _flash_fwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v)
     return out, (q, k, v, out, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, interpret, window, res, dout):
+def _flash_core_bwd(causal, block_q, block_k, interpret, window, alibi, res, dout):
     q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd_impl(causal, block_q, block_k, interpret, window, q, k, v, out, lse, dout)
+    dq, dk, dv = _flash_bwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v, out, lse,
+                                 dout)
     return dq, dk, dv
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def _flash_fwd_impl(causal, block_q, block_k, interpret, window, q, k, v):
+def _alibi_slope(h, n_heads):
+    """Closed-form power-of-2 ALiBi slope for head ``h`` (traced int32):
+    2^(-8(h+1)/n) — matches models.transformer.alibi_slopes for pow-2 n."""
+    return jnp.exp2(-8.0 * (h.astype(jnp.float32) + 1.0) / n_heads)
+
+
+def _flash_fwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v):
     """Returns (out [B,S,nq,d], lse [B,nq,S] float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -139,6 +162,7 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, window, q, k, v):
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
         # block refs carry the singleton (batch, head) dims: [1, 1, bq|S, d]
         qi = pl.program_id(2)
+        head = pl.program_id(1)
         n_kblocks = S // block_k
 
         acc_ref[:] = jnp.zeros_like(acc_ref)
@@ -150,13 +174,16 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, window, q, k, v):
             kb = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)  # [bk, d]
             vb = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
             s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
-            if causal:
+            if causal or alibi:
                 q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
                 k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                visible = q_pos >= k_pos
-                if window is not None:
-                    visible = jnp.logical_and(visible, q_pos - k_pos < window)
-                s = jnp.where(visible, s, _NEG_INF)
+                if alibi:
+                    s = s + _alibi_slope(head, nq) * (k_pos - q_pos).astype(jnp.float32)
+                if causal:
+                    visible = q_pos >= k_pos
+                    if window is not None:
+                        visible = jnp.logical_and(visible, q_pos - k_pos < window)
+                    s = jnp.where(visible, s, _NEG_INF)
             m_prev = m_ref[:]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)
@@ -208,7 +235,7 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, window, q, k, v):
     return out.transpose(0, 2, 1, 3), lse[..., 0]
 
 
-def _flash_bwd_impl(causal, block_q, block_k, interpret, window, q, k, v, out, lse, dout):
+def _flash_bwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v, out, lse, dout):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -237,17 +264,20 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, window, q, k, v, out, l
     # fori_loop trip counts inside the kernel miscompile on some Mosaic
     # versions — observed as NaNs in the final grid programs in bf16).
 
-    def _shared_block_math(qb, ob, dob, lseb, kb, vb, qi, kj):
+    def _shared_block_math(qb, ob, dob, lseb, kb, vb, qi, kj, head):
         """Recompute p and ds for one (q-block, k-block) tile."""
         deltab = jnp.sum(dob * ob, axis=-1, keepdims=True)               # [bq, 1]
         s = scale * jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        if causal:
+        if causal or alibi:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            vis = q_pos >= k_pos
-            if window is not None:
-                vis = jnp.logical_and(vis, q_pos - k_pos < window)
-            s = jnp.where(vis, s, _NEG_INF)
+            if alibi:
+                s = s + _alibi_slope(head, nq) * (k_pos - q_pos).astype(jnp.float32)
+            if causal:
+                vis = q_pos >= k_pos
+                if window is not None:
+                    vis = jnp.logical_and(vis, q_pos - k_pos < window)
+                s = jnp.where(vis, s, _NEG_INF)
         p = jnp.exp(s - lseb)                                            # [bq, bk]
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)      # [bq, bk]
         ds = p * (dp - deltab)
@@ -259,6 +289,7 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, window, q, k, v, out, l
                     dk_ref, dv_ref, dk_acc, dv_acc):
         kj = pl.program_id(2)
         qi = pl.program_id(3)
+        head = pl.program_id(1)
 
         @pl.when(qi == 0)
         def _init():
@@ -281,7 +312,7 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, window, q, k, v, out, l
             ob = o_ref[0, 0].astype(jnp.float32)
             dob = do_ref[0, 0].astype(jnp.float32)
             lseb = lse_ref[0, 0, :, :1]           # [bq, 1]
-            p, ds = _shared_block_math(qb, ob, dob, lseb, kb, vb, qi, kj)
+            p, ds = _shared_block_math(qb, ob, dob, lseb, kb, vb, qi, kj, head)
             dv_acc[:] += jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
             dk_acc[:] += scale * jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
 
@@ -326,6 +357,7 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, window, q, k, v, out, l
     def dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc):
         qi = pl.program_id(2)
         kj = pl.program_id(3)
+        head = pl.program_id(1)
 
         @pl.when(kj == 0)
         def _init():
@@ -344,7 +376,7 @@ def _flash_bwd_impl(causal, block_q, block_k, interpret, window, q, k, v, out, l
             lseb = lse_ref[0, 0, :, :1]              # [bq, 1]
             kb = k_ref[0, 0].astype(jnp.float32)     # [bk, d]
             vb = v_ref[0, 0].astype(jnp.float32)
-            _, ds = _shared_block_math(qb, ob, dob, lseb, kb, vb, qi, kj)
+            _, ds = _shared_block_math(qb, ob, dob, lseb, kb, vb, qi, kj, head)
             dq_acc[:] += scale * jnp.dot(ds, kb, preferred_element_type=jnp.float32)
 
         @pl.when(kj == n_kblocks - 1)

@@ -24,9 +24,11 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
-def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, window=None):
+def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, window=None,
+                    alibi=None):
     """q: [T, nq, d]; k_pool/v_pool: [pool_len, nkv, d] (one layer,
     pool_len = num_blocks*block_size, may include one trailing scratch slot);
     block_tables: [S, max_blocks]; seq_idx/pos: [T].
@@ -39,21 +41,23 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: i
         window = int(window)
     if jax.default_backend() != "tpu" or nq < 8 or d % 128 != 0:
         return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
-                                         window=window)
+                                         window=window, alibi=alibi)
     try:
         return _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx.astype(jnp.int32), pos.astype(jnp.int32),
-                             block_size=block_size, window=window)
+                             block_size=block_size, window=window,
+                             alibi=tuple(np.asarray(alibi).tolist()) if alibi is not None else None)
     except Exception as e:  # pragma: no cover — kernel bring-up safety net
         from ...utils.logging import warning_once
 
         warning_once(f"pallas paged attention unavailable ({type(e).__name__}: {e}); using gather fallback")
         return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
-                                         window=window)
+                                         window=window, alibi=alibi)
 
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int,
-                              window=None):
-    """Gather-based oracle: materializes each sequence's context."""
+                              window=None, alibi=None):
+    """Gather-based oracle: materializes each sequence's context. ``alibi``:
+    per-head slopes [nq] (Bloom)."""
     T, nq, d = q.shape
     nkv = k_pool.shape[1]
     g = nq // nkv
@@ -65,6 +69,9 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, blo
     ctxv = v_pool[ctx_slots].astype(jnp.float32)
     qr = (q.astype(jnp.float32) / math.sqrt(d)).reshape(T, nkv, g, d)
     s = jnp.einsum("tngd,tcnd->tngc", qr, ctxk[seq_idx])
+    if alibi is not None:
+        rel = (jnp.arange(C, dtype=jnp.float32)[None, :] - pos[:, None].astype(jnp.float32))
+        s = s + jnp.asarray(alibi, jnp.float32).reshape(nkv, g)[None, :, :, None] * rel[:, None, None, :]
     causal = jnp.arange(C, dtype=jnp.int32)[None, :] <= pos[:, None]
     if window is not None:
         causal = causal & (pos[:, None] - jnp.arange(C, dtype=jnp.int32)[None, :] < window)
@@ -74,9 +81,9 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, blo
     return out.reshape(T, nq, d).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "interpret", "window"))
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret", "window", "alibi"))
 def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, interpret: bool = False,
-                  window=None):
+                  window=None, alibi=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -124,6 +131,9 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
                 s_heads.append(jax.lax.dot(qb[n * g:(n + 1) * g], kb[:, n, :].T))  # [g, bs]
             s = jnp.concatenate(s_heads, axis=0)  # [nq, bs]
             kpos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, (nq, block_size), 1)
+            if alibi is not None:
+                slopes = jnp.asarray(alibi, jnp.float32)[:, None]
+                s = s + slopes * (kpos - my_pos).astype(jnp.float32)
             vis = kpos <= my_pos
             if window is not None:
                 vis = jnp.logical_and(vis, my_pos - kpos < window)

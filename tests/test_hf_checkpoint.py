@@ -30,13 +30,39 @@ def _save_tiny(tmp_path, kind):
                                      num_attention_heads=4, max_position_embeddings=128,
                                      word_embed_proj_dim=64, do_layer_norm_before=True)
         model = transformers.OPTForCausalLM(cfg)
+    elif kind == "bloom":
+        cfg = transformers.BloomConfig(vocab_size=128, hidden_size=64, n_layer=2, n_head=4)
+        model = transformers.BloomForCausalLM(cfg)
+    elif kind == "gptj":
+        cfg = transformers.GPTJConfig(vocab_size=128, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+                                      n_positions=128)
+        model = transformers.GPTJForCausalLM(cfg)
+    elif kind == "gpt_neox":
+        cfg = transformers.GPTNeoXConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                                         num_attention_heads=4, intermediate_size=128, rotary_pct=0.25,
+                                         max_position_embeddings=128, use_parallel_residual=True,
+                                         tie_word_embeddings=False)
+        model = transformers.GPTNeoXForCausalLM(cfg)
+    elif kind == "falcon":
+        cfg = transformers.FalconConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                                        num_attention_heads=4, multi_query=True, parallel_attn=True,
+                                        new_decoder_architecture=False, bias=False, alibi=False)
+        model = transformers.FalconForCausalLM(cfg)
+    elif kind == "falcon40b":
+        # the 40b/180b decoder architecture: GQA kv heads + ln_attn/ln_mlp
+        cfg = transformers.FalconConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                                        num_attention_heads=4, num_kv_heads=2, multi_query=True,
+                                        parallel_attn=True, new_decoder_architecture=True,
+                                        bias=False, alibi=False)
+        model = transformers.FalconForCausalLM(cfg)
     model = model.eval()
     d = tmp_path / kind
     model.save_pretrained(str(d))
     return model, str(d)
 
 
-@pytest.mark.parametrize("kind", ["llama", "mistral", "gpt2", "opt"])
+@pytest.mark.parametrize("kind", ["llama", "mistral", "gpt2", "opt", "bloom", "gptj",
+                                  "gpt_neox", "falcon", "falcon40b"])
 def test_hf_parity(tmp_path, kind):
     from deepspeed_tpu.inference.v2.checkpoint import build_hf_engine
     from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
