@@ -155,6 +155,12 @@ def transformer_config_from_hf(hf_cfg: dict):
         if hf_cfg.get("alibi", False):
             raise ValueError("falcon checkpoints with alibi=true (falcon-rw family) are not "
                              "supported yet: the converter maps falcon to rotary positions")
+        if hf_cfg.get("bias", False):
+            raise ValueError("falcon checkpoints with bias=true are not supported yet: the "
+                             "converter does not extract attention/MLP biases for falcon")
+        if not hf_cfg.get("parallel_attn", True) and not new_arch:
+            raise ValueError("sequential falcon (parallel_attn=false) is not supported yet: the "
+                             "converter emits no post-attention norm for that layout")
         return TransformerConfig(
             vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["hidden_size"],
             num_layers=hf_cfg.get("num_hidden_layers", hf_cfg.get("n_layer")),

@@ -590,11 +590,32 @@ def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: int, dtype=N
 
 def _cached_attention(cfg, q, ck, cv, q_pos0, cache_len_total):
     """q: [B, T, nq, d] at absolute positions q_pos0..q_pos0+T-1; ck/cv:
-    [B, Smax, nkv, d] (positions < cache_len_total are valid)."""
+    [B, Smax, nkv, d] (positions < cache_len_total are valid).
+
+    On TPU with kernel-friendly shapes the dense cache is viewed as a paged
+    pool with an identity block table and handed to the fused paged-attention
+    decode kernel (the v1 analog of the reference's fused softmax_context,
+    ``csrc/transformer/inference/csrc/softmax.cu``) — one kernel per step
+    instead of the materialized [B, nq, T, Smax] score tensor."""
     B, T, nq, d = q.shape
     Smax = ck.shape[1]
     nkv = ck.shape[2]
     group = nq // nkv
+    if _use_fused_decode(cfg, nq, d, Smax):
+        from ..ops.pallas.paged_attention import paged_attention
+
+        bs = 128
+        nb = Smax // bs
+        kp = ck.reshape(B * Smax, nkv, d)
+        vp = cv.reshape(B * Smax, nkv, d)
+        tables = (jnp.arange(B, dtype=jnp.int32)[:, None] * nb
+                  + jnp.arange(nb, dtype=jnp.int32)[None, :])
+        seq_idx = jnp.repeat(jnp.arange(B, dtype=jnp.int32), T)
+        pos = jnp.tile(q_pos0 + jnp.arange(T, dtype=jnp.int32), B)
+        slopes = alibi_slopes(nq) if cfg.positions == "alibi" else None
+        ctx = paged_attention(q.reshape(B * T, nq, d), kp, vp, tables, seq_idx, pos, bs,
+                              window=cfg.sliding_window, alibi=slopes)
+        return ctx.reshape(B, T, nq * d).astype(q.dtype)
     qf = q.astype(jnp.float32).reshape(B, T, nkv, group, d) / math.sqrt(d)
     scores = jnp.einsum("btkgd,bskd->bkgts", qf, ck.astype(jnp.float32))
     k_pos = jnp.arange(Smax)[None, None, None, None, :]
@@ -609,6 +630,27 @@ def _cached_attention(cfg, q, ck, cv, q_pos0, cache_len_total):
     probs = jax.nn.softmax(scores, axis=-1)
     ctx = jnp.einsum("bkgts,bskd->btkgd", probs, cv.astype(jnp.float32))
     return ctx.reshape(B, T, nq * d).astype(q.dtype)
+
+
+def _use_fused_decode(cfg, nq, d, Smax) -> bool:
+    """Engage the paged decode kernel for the dense v1 cache: TPU backend,
+    MXU-friendly shapes, and no tensor parallelism (a pallas call on
+    model-sharded pools would make XLA replicate them)."""
+    if cfg.attention_impl == "reference":
+        return False
+    try:
+        import jax as _jax
+
+        if _jax.default_backend() != "tpu":
+            return False
+        from ..parallel import groups
+        from ..parallel.mesh import MODEL_AXIS, mesh_axis_size
+
+        if groups.is_initialized() and mesh_axis_size(groups.get_mesh(), MODEL_AXIS) > 1:
+            return False
+    except Exception:
+        return False
+    return nq >= 8 and d % 128 == 0 and Smax % 128 == 0
 
 
 def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):

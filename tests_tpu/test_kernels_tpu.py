@@ -1,0 +1,139 @@
+"""Pallas kernel numerics ON THE REAL CHIP in bf16 — flash fwd/bwd (plain,
+windowed, alibi), paged attention, blockwise quant, fused Adam. The CPU
+suite runs these in interpret mode; Mosaic compilation differences only
+show up here."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.transformer import alibi_slopes, reference_attention
+from deepspeed_tpu.ops.pallas.flash_attention import _pallas_flash
+from deepspeed_tpu.ops.pallas.paged_attention import _pallas_paged, paged_attention_reference
+from deepspeed_tpu.ops.pallas.quant import dequantize_blockwise, quantize_blockwise
+
+
+def _qkv(rng, B=2, S=512, nq=8, nkv=8, d=128, dtype=jnp.bfloat16):
+    q = jnp.asarray(rng.normal(size=(B, S, nq, d)), dtype)
+    k = jnp.asarray(rng.normal(size=(B, S, nkv, d)), dtype)
+    v = jnp.asarray(rng.normal(size=(B, S, nkv, d)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("mode", ["plain", "window", "alibi", "gqa"])
+def test_flash_fwd_bwd_bf16_on_chip(mode):
+    rng = np.random.default_rng(0)
+    kw = {}
+    nkv = 8
+    if mode == "window":
+        kw["window"] = 192
+    if mode == "alibi":
+        kw["alibi"] = True
+    if mode == "gqa":
+        nkv = 2
+    q, k, v = _qkv(rng, nkv=nkv)
+    ref_kw = dict(window=kw.get("window"),
+                  alibi=alibi_slopes(q.shape[2]) if kw.get("alibi") else None)
+
+    out = _pallas_flash(q, k, v, causal=True, block_q=256, block_k=256, **kw)
+    ref = reference_attention(q, k, v, causal=True, **ref_kw)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+    assert np.isfinite(np.asarray(out, np.float32)).all(), "NaNs from the compiled kernel"
+
+    def loss_k(q, k, v):
+        return jnp.sum(_pallas_flash(q, k, v, causal=True, block_q=256, block_k=256, **kw)
+                       .astype(jnp.float32)**2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True, **ref_kw).astype(jnp.float32)**2)
+
+    g1 = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g1, g2):
+        a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        assert np.isfinite(a32).all(), "NaNs in compiled backward"
+        # bf16 grads: compare direction+magnitude, elementwise loose
+        denom = max(np.abs(b32).max(), 1e-3)
+        assert np.abs(a32 - b32).max() / denom < 0.12, f"grad mismatch in {mode}"
+
+
+def test_paged_attention_bf16_on_chip():
+    rng = np.random.default_rng(1)
+    bs, n_blocks, nkv, g, d = 128, 8, 2, 4, 128
+    nq = nkv * g
+    pool = bs * n_blocks
+    k_pool = jnp.asarray(rng.normal(size=(pool, nkv, d)), jnp.bfloat16)
+    v_pool = jnp.asarray(rng.normal(size=(pool, nkv, d)), jnp.bfloat16)
+    tables = jnp.arange(2 * n_blocks // 2, dtype=jnp.int32).reshape(2, -1)
+    T = 16
+    q = jnp.asarray(rng.normal(size=(T, nq, d)), jnp.bfloat16)
+    seq_idx = jnp.asarray(np.arange(T) % 2, jnp.int32)
+    pos = jnp.asarray(rng.integers(0, bs * (n_blocks // 2), size=T), jnp.int32)
+
+    out = _pallas_paged(q, k_pool, v_pool, tables, seq_idx, pos, block_size=bs)
+    ref = paged_attention_reference(q, k_pool, v_pool, tables, seq_idx, pos, bs)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_quant_roundtrip_on_chip():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(256, 512)).astype(np.float32))
+    for axis in (0, 1):
+        q, s = jax.jit(lambda x: quantize_blockwise(x, 128, axis=axis))(x)
+        back = jax.jit(lambda q, s: dequantize_blockwise(q, s, 128, axis=axis))(q, s)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   atol=float(jnp.abs(x).max()) / 120)
+
+
+def test_fused_adam_on_chip():
+    import optax
+
+    from deepspeed_tpu.ops.pallas.fused_adam import fused_adam_apply
+
+    rng = np.random.default_rng(3)
+    params = {"w": jnp.asarray(rng.normal(size=(512, 1024)).astype(np.float32))}
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    grads = {"w": jnp.asarray(rng.normal(size=(512, 1024)).astype(np.float32))}
+
+    tx = optax.adamw(1e-3, weight_decay=0.01)
+    st = tx.init(params)
+    upd, _ = tx.update(grads, st, params)
+    want = optax.apply_updates(params, upd)
+
+    p, m, v = fused_adam_apply(params, zeros, zeros, grads, lr_t=1e-3, b1=0.9, b2=0.999,
+                               eps=1e-8, weight_decay=0.01, step=1, grad_scale=1.0, gate=1.0)
+    np.testing.assert_allclose(np.asarray(p["w"]), np.asarray(want["w"]), rtol=2e-6, atol=2e-7)
+
+
+def test_v1_fused_decode_matches_reference_on_chip():
+    """The v1 dense-cache decode routes through the paged kernel (identity
+    block table) on TPU; generations must match the jnp reference path."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM, _use_fused_decode
+    from deepspeed_tpu.parallel import groups
+
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, 512, size=(2, 32), dtype=np.int32)
+
+    def gen(attention_impl):
+        groups.reset()
+        cfg = TransformerConfig(vocab_size=512, hidden_size=1024, num_layers=2, num_heads=8,
+                                max_seq_len=128, intermediate_size=1024, dtype=jnp.bfloat16,
+                                attention_impl=attention_impl)
+        m = TransformerLM(cfg)
+        params = jax.jit(lambda r: m.init(r, None))(jax.random.PRNGKey(7))
+        eng = InferenceEngine(m, DeepSpeedInferenceConfig(), params=params)
+        if attention_impl == "auto":
+            assert _use_fused_decode(cfg, 8, 128, 128), "fused decode must engage on chip"
+        return eng.generate(prompt, max_new_tokens=8)
+
+    fused = gen("auto")
+    ref = gen("reference")
+    # greedy decode over the same weights: identical token streams (bf16
+    # numerics may rarely flip an argmax — allow 1 divergence point per row)
+    diverged = (fused != ref).sum(axis=1)
+    assert (diverged <= 2).all(), f"fused decode diverged from reference: {fused} vs {ref}"
