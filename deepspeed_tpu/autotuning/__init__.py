@@ -1,1 +1,2 @@
 from .autotuner import Autotuner, TuningResult
+from .tuner import BaseTuner, GridSearchTuner, ModelBasedTuner, RandomTuner

@@ -1,0 +1,117 @@
+"""Experiment-selection tuners.
+
+Analog of the reference ``deepspeed/autotuning/tuner/`` (GridSearchTuner,
+RandomTuner, ModelBasedTuner — ``model_based_tuner.py`` fits an XGBoost cost
+model over measured runs to pick the next experiment). TPU version keeps the
+same strategy surface; the cost model is a ridge-regularized least-squares
+over simple config features (no GBM dependency), which is plenty to steer a
+search space of tens of candidates.
+"""
+
+import random
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _features(cfg: dict) -> np.ndarray:
+    z = cfg.get("zero_optimization", {}).get("stage", 0)
+    micro = cfg.get("train_micro_batch_size_per_gpu", 1)
+    gas = cfg.get("gradient_accumulation_steps", 1)
+    return np.asarray([1.0, float(z), float(micro), float(np.log2(max(micro, 1))),
+                       float(gas), float(micro * gas)], np.float64)
+
+
+class BaseTuner:
+    """Iterates a candidate space, tracking the best measured throughput
+    (reference ``base_tuner.py``)."""
+
+    def __init__(self, space: Sequence[dict], metric: str = "throughput"):
+        self.space = list(space)
+        self.metric = metric
+        self.measured: List[Dict] = []
+        self.best_cfg: Optional[dict] = None
+        self.best_metric: float = -np.inf
+
+    def next_batch(self, n: int) -> List[dict]:
+        raise NotImplementedError
+
+    def update(self, cfg: dict, metric_val: Optional[float]):
+        self.measured.append({"cfg": cfg, "metric": metric_val})
+        if metric_val is not None and metric_val > self.best_metric:
+            self.best_metric, self.best_cfg = metric_val, cfg
+
+    def has_next(self) -> bool:
+        return len(self.measured) < len(self.space)
+
+    def _unmeasured(self) -> List[dict]:
+        seen = [m["cfg"] for m in self.measured]
+        return [c for c in self.space if c not in seen]
+
+    def tune(self, run_fn: Callable[[dict], Optional[float]], max_trials: int = 0,
+             batch_size: int = 1):
+        """Drive the loop: pick → measure → update (reference ``tune():...``)."""
+        trials = max_trials or len(self.space)
+        while self.has_next() and trials > 0:
+            for cfg in self.next_batch(min(batch_size, trials)):
+                self.update(cfg, run_fn(cfg))
+                trials -= 1
+                if trials <= 0:
+                    break
+        return self.best_cfg, self.best_metric
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive order (reference ``GridSearchTuner``)."""
+
+    def next_batch(self, n: int) -> List[dict]:
+        return self._unmeasured()[:n]
+
+
+class RandomTuner(BaseTuner):
+    """Uniform random order (reference ``RandomTuner``)."""
+
+    def __init__(self, space, metric="throughput", seed: int = 0):
+        super().__init__(space, metric)
+        self._rng = random.Random(seed)
+
+    def next_batch(self, n: int) -> List[dict]:
+        rest = self._unmeasured()
+        self._rng.shuffle(rest)
+        return rest[:n]
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model guided order (reference ``model_based_tuner.py``): after
+    ``warmup`` random measurements, fit throughput ~ features by ridge
+    least-squares and pick the unmeasured candidate with the best predicted
+    metric each round."""
+
+    def __init__(self, space, metric="throughput", warmup: int = 3, seed: int = 0):
+        super().__init__(space, metric)
+        self.warmup = warmup
+        self._rng = random.Random(seed)
+
+    def _predict(self, cfgs: List[dict]) -> np.ndarray:
+        good = [(m["cfg"], m["metric"]) for m in self.measured if m["metric"] is not None]
+        if len(good) < 2:
+            return np.zeros(len(cfgs))
+        # failed runs (OOM / does-not-fit) enter the fit as strongly negative
+        # so the linear model stops extrapolating toward infeasible configs
+        vals = [v for _, v in good]
+        floor = min(vals) - (max(vals) - min(vals) + 1.0)
+        pts = good + [(m["cfg"], floor) for m in self.measured if m["metric"] is None]
+        X = np.stack([_features(c) for c, _ in pts])
+        y = np.asarray([v for _, v in pts], np.float64)
+        lam = 1e-3 * np.eye(X.shape[1])
+        w = np.linalg.solve(X.T @ X + lam, X.T @ y)
+        return np.stack([_features(c) for c in cfgs]) @ w
+
+    def next_batch(self, n: int) -> List[dict]:
+        rest = self._unmeasured()
+        if len(self.measured) < self.warmup:
+            self._rng.shuffle(rest)
+            return rest[:n]
+        preds = self._predict(rest)
+        order = np.argsort(-preds)
+        return [rest[i] for i in order[:n]]

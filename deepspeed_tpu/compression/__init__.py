@@ -2,3 +2,4 @@ from .compress import init_compression, redundancy_clean, apply_compression
 from .config import get_compression_config, DeepSpeedCompressionConfig
 from .scheduler import CompressionScheduler
 from . import basic_layer
+from .distillation import apply_layer_reduction, compress_embedding, distillation_loss

@@ -1,0 +1,73 @@
+"""Knowledge distillation and layer reduction.
+
+Analog of the reference compression suite's student-teacher path
+(``compression/compress.py student_initialization`` + the
+``layer_reduction`` block of ``compression/config.py``, used by the
+DeepSpeed-Compression XTC/ZeroQuant recipes):
+
+  * ``apply_layer_reduction`` — build a shallower student by SELECTING
+    teacher layers. With scan-stacked params ([L, ...] arrays) this is one
+    gather over the layer dim, vs the reference's module-tree surgery.
+  * ``distillation_loss`` — soft-target KL (temperature-scaled) + optional
+    hard CE mix, the standard KD objective the reference recipes train with.
+  * ``compress_embedding`` — fake-quantized embedding with straight-through
+    gradients (the reference ``Embedding_Compress`` layer).
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .basic_layer import quantize_weight
+
+
+def apply_layer_reduction(params, keep_layers: Sequence[int]):
+    """Student params keeping the given teacher layer indices (reference
+    ``student_initialization``'s teacher_layer list). Works on any pytree
+    whose 'blocks' subtree stacks layers on dim 0."""
+    idx = jnp.asarray(list(keep_layers), jnp.int32)
+    out = dict(params)
+    out["blocks"] = jax.tree_util.tree_map(lambda x: x[idx], params["blocks"])
+    return out
+
+
+def distillation_loss(student_logits, teacher_logits, labels=None, temperature: float = 1.0,
+                      alpha: float = 0.5, loss_mask=None):
+    """KD objective: ``alpha * T^2 * KL(teacher_T || student_T) +
+    (1-alpha) * CE(student, labels)`` (the reference recipes' kd loss).
+
+    logits: [..., V]; labels: [...] int (optional; alpha=1 for pure soft)."""
+    T = float(temperature)
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / T, axis=-1)
+    t = jax.nn.softmax(teacher_logits.astype(jnp.float32) / T, axis=-1)
+    kl = jnp.sum(t * (jnp.log(jnp.maximum(t, 1e-20)) - s), axis=-1)
+    if loss_mask is not None:
+        m = loss_mask.astype(jnp.float32)
+        soft = (kl * m).sum() / jnp.maximum(m.sum(), 1.0)
+    else:
+        soft = kl.mean()
+    soft = (T * T) * soft
+    if labels is None or alpha >= 1.0:
+        return soft
+    logp = jax.nn.log_softmax(student_logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        m = loss_mask.astype(jnp.float32)
+        hard = -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    else:
+        hard = -ll.mean()
+    return alpha * soft + (1.0 - alpha) * hard
+
+
+def compress_embedding(params, bits: int = 8, groups: int = 1):
+    """Fake-quantize the token embedding with STE (reference
+    ``Embedding_Compress``): training sees quantized values, gradients pass
+    through to the fp32 master."""
+    out = dict(params)
+    emb = dict(out["embed"])
+    w = emb["embedding"]
+    qw = quantize_weight(w, bits=bits, groups=groups)
+    emb["embedding"] = w + jax.lax.stop_gradient(qw - w)
+    out["embed"] = emb
+    return out

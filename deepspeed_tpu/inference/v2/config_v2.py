@@ -23,7 +23,12 @@ class DSStateManagerConfig:
 class RaggedInferenceEngineConfig:
     tensor_parallel_degree: int = 1
     kv_block_size: int = 64
-    num_kv_blocks: int = 256  # pool size; 'auto' sizing TODO against HBM stats
+    # pool size in blocks; 0/'auto' sizes the pool from the device's free
+    # HBM after params (memory_config fraction below), reference
+    # DSStateManagerConfig.memory_config semantics
+    num_kv_blocks: object = "auto"
     kv_dtype: object = jnp.bfloat16
+    # fraction of post-params free HBM given to the KV pool in auto mode
+    kv_memory_fraction: float = 0.8
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
     use_pallas_kernels: str = "auto"  # 'auto' | 'never' | 'always'

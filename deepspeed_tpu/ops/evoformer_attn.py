@@ -1,0 +1,76 @@
+"""DS4Science Evoformer attention (triangle / MSA attention with bias terms).
+
+TPU equivalent of the reference's CUTLASS fused MHA
+(``csrc/deepspeed4science/evoformer_attn/`` — 14,928 LoC of fwd/bwd kernels
+exposed as ``EvoformerAttnBuilder`` → ``deepspeed.ops.deepspeed4science.
+evoformer_attn.DS4Sci_EvoformerAttention``). The contract (reference python
+wrapper): Q/K/V of shape [*, n_seq, n_res, heads, dim] and up to two bias
+terms broadcastable to the score tensor [*, n_seq, heads, n_res, n_res] —
+the pair-bias and the MSA mask bias of AlphaFold's Evoformer block.
+
+On TPU the fused-kernel goal (never materialize the O(n_res^2) probability
+tensor in HBM at fp32) is met by computing the whole attention in one jitted
+function with a chunked lax.map over the n_seq dim: XLA fuses the
+bias-add + softmax + PV chain per chunk, and the backward is jax.grad
+through the same program. Numerics are validated against a plain einsum
+oracle (reference tests/unit/ops/deepspeed4science strategy).
+"""
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        biases: Sequence[Optional[jax.Array]] = (),
+                        seq_chunk: int = 0) -> jax.Array:
+    """Fused biased attention.
+
+    q/k/v: [..., n_seq, n_res, heads, dim] (the reference layout).
+    biases: up to two arrays broadcastable to [..., n_seq, heads, n_res,
+    n_res] (e.g. mask bias [.., n_seq, 1, 1, n_res] and pair bias
+    [.., 1, heads, n_res, n_res]).
+    seq_chunk: process the n_seq dim in chunks of this size to bound the
+    live score tensor (0 = no chunking).
+    Returns [..., n_seq, n_res, heads, dim].
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    def attend(qc, kc, vc, bias_c):
+        # qc: [..., c, n_res, h, d] -> scores [..., c, h, n_res, n_res]
+        s = jnp.einsum("...qhd,...khd->...hqk", qc.astype(jnp.float32) * scale,
+                       kc.astype(jnp.float32))
+        for b in bias_c:
+            if b is not None:
+                s = s + b.astype(jnp.float32)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("...hqk,...khd->...qhd", p, vc.astype(jnp.float32)).astype(q.dtype)
+
+    if not seq_chunk or q.shape[-4] <= seq_chunk:
+        return attend(q, k, v, [b for b in biases])
+
+    n_seq = q.shape[-4]
+    assert n_seq % seq_chunk == 0, f"n_seq {n_seq} must divide by seq_chunk {seq_chunk}"
+
+    def chunk_fn(i):
+        sl = lambda x: jax.lax.dynamic_slice_in_dim(x, i * seq_chunk, seq_chunk, axis=-4)
+        bias_c = []
+        for b in biases:
+            if b is None:
+                bias_c.append(None)
+            elif b.shape[-4] == 1:  # broadcast over n_seq (pair bias)
+                bias_c.append(b)
+            else:
+                bias_c.append(jax.lax.dynamic_slice_in_dim(b, i * seq_chunk, seq_chunk, axis=-4))
+        return attend(sl(q), sl(k), sl(v), bias_c)
+
+    chunks = jax.lax.map(chunk_fn, jnp.arange(n_seq // seq_chunk))
+    # [n_chunks, ..., c, n_res, h, d] -> [..., n_seq, n_res, h, d]
+    out = jnp.moveaxis(chunks, 0, -5)
+    return out.reshape(*out.shape[:-5], n_seq, *out.shape[-3:])
+
+
+DS4Sci_EvoformerAttention = partial(evoformer_attention)  # reference public name

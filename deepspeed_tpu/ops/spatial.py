@@ -1,0 +1,41 @@
+"""Spatial (diffusers) ops.
+
+TPU equivalent of the reference ``csrc/spatial/csrc/opt_bias_add.cu``
+(``SpatialInferenceBuilder`` → bias-add variants used by the diffusers
+UNet/VAE wrappers, ``deepspeed/ops/transformer/inference/diffusers_*``).
+On TPU these are jnp expressions XLA fuses into the surrounding convs; the
+functions exist so the diffusers-policy surface has a 1:1 target and the
+numerics are pinned by tests.
+"""
+
+import jax.numpy as jnp
+
+
+def bias_add(activation, bias):
+    """opt_bias_add: NHWC activation + per-channel bias."""
+    return activation + bias.astype(activation.dtype)
+
+
+def bias_add_add(activation, bias, other):
+    """opt_bias_add_add: (activation + bias) + other (residual join)."""
+    return activation + bias.astype(activation.dtype) + other.astype(activation.dtype)
+
+
+def bias_add_bias_add(activation, bias, other, other_bias):
+    """opt_bias_add_bias_add: (a + b) + (o + ob) — the UNet dual-residual."""
+    return (activation + bias.astype(activation.dtype)
+            + other.astype(activation.dtype) + other_bias.astype(activation.dtype))
+
+
+def nhwc_bias_add_activation(activation, bias, act: str = "silu"):
+    """Fused bias + nonlinearity (reference GroupNorm epilogues)."""
+    x = activation + bias.astype(activation.dtype)
+    if act == "silu":
+        return x * jnp.reciprocal(1.0 + jnp.exp(-x.astype(jnp.float32))).astype(x.dtype)
+    if act == "gelu":
+        import jax
+
+        return jax.nn.gelu(x)
+    if act in (None, "none"):
+        return x
+    raise ValueError(f"unknown activation {act!r}")

@@ -1,0 +1,64 @@
+"""TiledLinear and memory-efficient linear.
+
+TPU equivalents of the reference ZeRO utilities:
+
+  * ``zero/tiling.py`` ``TiledLinear`` (296 LoC) — splits a giant linear
+    into (in_splits x out_splits) tiles so no single weight/activation
+    buffer exceeds a budget. Here a functional ``tiled_linear`` chunks the
+    contraction with ``lax.scan`` over input tiles: at most one
+    [in_tile, out] weight slice and one partial-sum accumulator are live —
+    the same peak-memory bound, derived from sharding-friendly slices of
+    ONE stacked weight instead of a module tree of sub-Linears.
+  * ``zero/linear.py`` ``LinearFunctionForZeroStage3`` (178 LoC) — an
+    autograd Function that avoids saving the gathered weight for backward.
+    The jax analog is ``memory_efficient_linear``: ``jax.checkpoint`` around
+    the matmul drops the gathered operand after the forward and regathers
+    at backward, exactly the reference's recompute-vs-store trade.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def tiled_linear(x: jax.Array, w: jax.Array, bias: Optional[jax.Array] = None,
+                 in_splits: int = 1) -> jax.Array:
+    """y = x @ w (+ bias), contracting in ``in_splits`` chunks.
+
+    x: [..., K]; w: [K, N]. Peak live memory holds one [K/in_splits, N]
+    weight tile and the [..., N] accumulator (reference ``TiledLinear``
+    forward loop semantics; its out_splits dimension is subsumed by XLA's
+    output tiling).
+    """
+    K, N = w.shape
+    if in_splits <= 1:
+        y = jnp.einsum("...k,kn->...n", x, w)
+        return y + bias if bias is not None else y
+    assert K % in_splits == 0, f"in_features {K} must divide by in_splits {in_splits}"
+    tk = K // in_splits
+    xt = x.reshape(*x.shape[:-1], in_splits, tk)
+    wt = w.reshape(in_splits, tk, N)
+
+    def body(acc, i):
+        acc = acc + jnp.einsum("...k,kn->...n", xt[..., i, :], wt[i])
+        return acc, None
+
+    acc0 = jnp.zeros((*x.shape[:-1], N), jnp.result_type(x.dtype, w.dtype))
+    y, _ = lax.scan(body, acc0, jnp.arange(in_splits))
+    return y + bias if bias is not None else y
+
+
+def memory_efficient_linear(x: jax.Array, w: jax.Array, bias: Optional[jax.Array] = None) -> jax.Array:
+    """Linear whose backward regathers/recomputes instead of saving the
+    (possibly ZeRO-3 gathered) weight operand — reference
+    ``LinearFunctionForZeroStage3`` / the ``memory_efficient_linear`` config
+    knob (zero/config.py)."""
+
+    @jax.checkpoint
+    def f(x, w):
+        return jnp.einsum("...k,kn->...n", x, w)
+
+    y = f(x, w)
+    return y + bias if bias is not None else y

@@ -1,0 +1,5 @@
+"""Fork tools (reference ``deepspeed/tools/``): tensor_logger for
+cross-backend accuracy diffing; pg_sim's role is filled by the virtual
+multi-device CPU mesh (tests/conftest.py)."""
+
+from .tensor_logger import TensorLogger, compare_logs

@@ -1,0 +1,114 @@
+"""Tensor logger — per-iteration tensor capture for accuracy diffing.
+
+Analog of the fork's ``deepspeed/tools/tensor_logger/tensor_logger.py``
+(fwd/bwd/grad tensor dumps used to diff HPU-vs-GPU numerics). Under jit
+there are no module hooks, so capture happens at the step boundary: params,
+gradients, and metrics snapshot per optimizer step, either as full ``.npz``
+tensors or compact statistics (mean/std/absmax/norm) in ``.jsonl`` —
+enough to bisect a cross-backend divergence to the first drifting step and
+tensor.
+"""
+
+import contextlib
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+
+def _stats(x: np.ndarray) -> dict:
+    x64 = np.asarray(x, np.float64).ravel()
+    return {
+        "shape": list(np.shape(x)),
+        "mean": float(x64.mean()) if x64.size else 0.0,
+        "std": float(x64.std()) if x64.size else 0.0,
+        "absmax": float(np.abs(x64).max()) if x64.size else 0.0,
+        "l2": float(np.linalg.norm(x64)),
+        "finite": bool(np.isfinite(x64).all()),
+    }
+
+
+class TensorLogger:
+    """Capture per-step tensors (reference class of the same name).
+
+    mode='stats' writes one JSON line per step with per-tensor statistics;
+    mode='full' additionally writes ``step_<N>.npz`` with the raw arrays.
+    """
+
+    def __init__(self, save_dir: str, start_iteration: int = 0, end_iteration: int = 10**9,
+                 mode: str = "stats", include_grads: bool = True):
+        assert mode in ("stats", "full")
+        self.save_dir = save_dir
+        self.start = start_iteration
+        self.end = end_iteration
+        self.mode = mode
+        self.include_grads = include_grads
+        os.makedirs(save_dir, exist_ok=True)
+        self._fh = open(os.path.join(save_dir, "tensor_log.jsonl"), "a")
+
+    def log_step(self, step: int, params, grads=None, metrics: Optional[dict] = None):
+        if not (self.start <= step < self.end):
+            return
+        from ..runtime.zero.partition import path_str
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        tensors = {("param/" + path_str(kp)): np.asarray(jax.device_get(v)) for kp, v in flat}
+        if grads is not None and self.include_grads:
+            gflat, _ = jax.tree_util.tree_flatten_with_path(grads)
+            tensors.update({("grad/" + path_str(kp)): np.asarray(jax.device_get(v))
+                            for kp, v in gflat})
+        rec = {"step": int(step), "tensors": {k: _stats(v) for k, v in tensors.items()}}
+        if metrics:
+            rec["metrics"] = {k: float(v) for k, v in metrics.items()}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        if self.mode == "full":
+            np.savez(os.path.join(self.save_dir, f"step_{step}.npz"), **tensors)
+
+    def close(self):
+        self._fh.close()
+
+    # ------------------------------------------------------------------
+    @contextlib.contextmanager
+    def attach(self, engine):
+        """Wrap ``engine.train_batch`` to log every step automatically."""
+        orig = engine.train_batch
+
+        def wrapped(*a, **kw):
+            loss = orig(*a, **kw)
+            self.log_step(engine.global_steps, engine.state["params"],
+                          metrics={"loss": float(loss),
+                                   **({"grad_norm": float(engine._step_metrics["grad_norm"])}
+                                      if "grad_norm" in engine._step_metrics else {})})
+            return loss
+
+        engine.train_batch = wrapped
+        try:
+            yield self
+        finally:
+            engine.train_batch = orig
+
+
+def compare_logs(dir_a: str, dir_b: str, rtol: float = 1e-3) -> list:
+    """Diff two stats logs; returns [(step, tensor, field, a, b), ...] for
+    the first divergences (the cross-backend accuracy-diff workflow)."""
+    out = []
+    fa = os.path.join(dir_a, "tensor_log.jsonl")
+    fb = os.path.join(dir_b, "tensor_log.jsonl")
+    with open(fa) as a, open(fb) as b:
+        for la, lb in zip(a, b):
+            ra, rb = json.loads(la), json.loads(lb)
+            for name in ra["tensors"]:
+                if name not in rb["tensors"]:
+                    out.append((ra["step"], name, "missing", None, None))
+                    continue
+                for field in ("mean", "std", "l2"):
+                    va, vb = ra["tensors"][name][field], rb["tensors"][name][field]
+                    if abs(va - vb) > rtol * max(abs(va), abs(vb), 1e-12):
+                        out.append((ra["step"], name, field, va, vb))
+            if out:
+                break
+    return out

@@ -1,0 +1,269 @@
+"""Auxiliary component coverage: Evoformer attention (DS4Science), spatial
+ops, TiledLinear/memory-efficient linear, state-dict factory resharding,
+tensor logger, KV-pool auto sizing — the round-2 inventory gaps
+(#12/#31/#46/#47/#50 and weak #7)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import tiny_batch
+
+
+# ---------------------------------------------------------------------------
+# Evoformer (reference csrc/deepspeed4science/evoformer_attn)
+# ---------------------------------------------------------------------------
+def _evo_oracle(q, k, v, biases):
+    d = q.shape[-1]
+    s = jnp.einsum("...qhd,...khd->...hqk", q.astype(jnp.float32) / np.sqrt(d), k.astype(jnp.float32))
+    for b in biases:
+        if b is not None:
+            s = s + b.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...hqk,...khd->...qhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.mark.parametrize("seq_chunk", [0, 2])
+def test_evoformer_attention(seq_chunk):
+    from deepspeed_tpu.ops.evoformer_attn import evoformer_attention
+
+    rng = np.random.default_rng(0)
+    B, n_seq, n_res, h, d = 2, 4, 16, 4, 32
+    q, k, v = (jnp.asarray(rng.normal(size=(B, n_seq, n_res, h, d)).astype(np.float32))
+               for _ in range(3))
+    mask_bias = jnp.asarray(rng.normal(size=(B, n_seq, 1, 1, n_res)).astype(np.float32)) * 2
+    pair_bias = jnp.asarray(rng.normal(size=(B, 1, h, n_res, n_res)).astype(np.float32))
+
+    out = evoformer_attention(q, k, v, [mask_bias, pair_bias], seq_chunk=seq_chunk)
+    ref = _evo_oracle(q, k, v, [mask_bias, pair_bias])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
+
+    # differentiable (the reference ships fwd AND bwd kernels)
+    g = jax.grad(lambda a: jnp.sum(evoformer_attention(a, k, v, [mask_bias, pair_bias],
+                                                       seq_chunk=seq_chunk)**2))(q)
+    gr = jax.grad(lambda a: jnp.sum(_evo_oracle(a, k, v, [mask_bias, pair_bias])**2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# spatial ops (reference csrc/spatial)
+# ---------------------------------------------------------------------------
+def test_spatial_bias_adds():
+    from deepspeed_tpu.ops.spatial import (bias_add, bias_add_add, bias_add_bias_add,
+                                           nhwc_bias_add_activation)
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 16)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(16, )).astype(np.float32))
+    o = jnp.asarray(rng.normal(size=(2, 8, 8, 16)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(bias_add(x, b)), np.asarray(x + b), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(bias_add_add(x, b, o)), np.asarray(x + b + o), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(bias_add_bias_add(x, b, o, b)),
+                               np.asarray(x + b + o + b), rtol=1e-6)
+    silu = np.asarray(nhwc_bias_add_activation(x, b, "silu"))
+    want = np.asarray(x + b) * (1 / (1 + np.exp(-np.asarray(x + b))))
+    np.testing.assert_allclose(silu, want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# TiledLinear / zero.linear (reference zero/tiling.py, zero/linear.py)
+# ---------------------------------------------------------------------------
+def test_tiled_linear_matches_dense():
+    from deepspeed_tpu.runtime.zero.tiling import memory_efficient_linear, tiled_linear
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(4, 96)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(96, 64)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(64, )).astype(np.float32))
+    want = np.asarray(x @ w + b)
+    for splits in (1, 2, 4):
+        got = np.asarray(tiled_linear(x, w, b, in_splits=splits))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(memory_efficient_linear(x, w, b)), want, rtol=2e-5,
+                               atol=2e-5)
+    # differentiable through the scan
+    g = jax.grad(lambda w: jnp.sum(tiled_linear(x, w, b, in_splits=4)**2))(w)
+    gr = jax.grad(lambda w: jnp.sum((x @ w + b)**2))(w)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# state-dict factory (reference runtime/state_dict_factory.py)
+# ---------------------------------------------------------------------------
+def test_sd_factory_reshard_roundtrip():
+    from deepspeed_tpu.runtime.state_dict_factory import (SDLoaderFactory, merge_fused_qkv_per_head,
+                                                          reshard_checkpoint,
+                                                          split_fused_qkv_per_head)
+
+    rng = np.random.default_rng(3)
+    nh, hd, H = 8, 4, 32
+    sd = {
+        "layer.0.attn.q_proj.weight": rng.normal(size=(H, H)).astype(np.float32),  # col: axis 0
+        "layer.0.attn.out_proj.weight": rng.normal(size=(H, H)).astype(np.float32),  # row: axis 1
+        "layer.0.attn.query_key_value.weight": rng.normal(size=(3 * H, H)).astype(np.float32),
+        "layer.0.ln.weight": rng.normal(size=(H, )).astype(np.float32),  # replicated
+    }
+    # 1 -> 4 -> 1 roundtrip must be exact
+    four = reshard_checkpoint([sd], 4, num_heads=nh)
+    assert len(four) == 4
+    assert four[0]["layer.0.attn.q_proj.weight"].shape == (H // 4, H)
+    assert four[0]["layer.0.attn.out_proj.weight"].shape == (H, H // 4)
+    assert four[0]["layer.0.attn.query_key_value.weight"].shape == (3 * H // 4, H)
+    np.testing.assert_array_equal(four[2]["layer.0.ln.weight"], sd["layer.0.ln.weight"])
+    back = reshard_checkpoint(four, 1, num_heads=nh)[0]
+    for k in sd:
+        np.testing.assert_array_equal(back[k], sd[k])
+    # 4 -> 2 re-split keeps whole heads in the fused tensor
+    two = reshard_checkpoint(four, 2, num_heads=nh)
+    merged = merge_fused_qkv_per_head([t["layer.0.attn.query_key_value.weight"] for t in two])
+    np.testing.assert_array_equal(merged, sd["layer.0.attn.query_key_value.weight"])
+
+    loader = SDLoaderFactory.get_sd_loader([sd])
+    rank1 = loader.load(mp_world_size=2, mp_rank=1, num_heads=nh)
+    np.testing.assert_array_equal(rank1["layer.0.attn.q_proj.weight"], sd["layer.0.attn.q_proj.weight"][H // 2:])
+
+
+def test_split_fused_qkv_per_head_inverse():
+    from deepspeed_tpu.runtime.state_dict_factory import (merge_fused_qkv_per_head,
+                                                          split_fused_qkv_per_head)
+
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=(8 * 3 * 4, 16)).astype(np.float32)  # 8 heads, 3x4 per head
+    parts = split_fused_qkv_per_head(w, 4, num_heads=8)
+    assert all(p.shape == (24, 16) for p in parts)
+    np.testing.assert_array_equal(merge_fused_qkv_per_head(parts), w)
+
+
+# ---------------------------------------------------------------------------
+# tensor logger (fork tools/tensor_logger)
+# ---------------------------------------------------------------------------
+def test_tensor_logger_attach_and_compare(tmp_path, eight_devices):
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.tools import TensorLogger, compare_logs
+
+    def run(save_dir, lr):
+        groups.reset()
+        m = TransformerLM(TransformerConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                                            num_heads=2, intermediate_size=64, max_seq_len=32,
+                                            dtype=jnp.float32, attention_impl="reference"))
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": lr}},
+            "zero_optimization": {"stage": 1},
+            "tpu": {"mesh": {"data": 8}},
+            "steps_per_print": 100,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+        tl = TensorLogger(str(save_dir))
+        with tl.attach(engine):
+            for i in range(2):
+                engine.train_batch(tiny_batch(8, 16, seed=0))
+        tl.close()
+
+    run(tmp_path / "a", 1e-3)
+    run(tmp_path / "b", 1e-3)
+    assert compare_logs(str(tmp_path / "a"), str(tmp_path / "b")) == []
+
+    run(tmp_path / "c", 5e-2)  # different lr -> first divergence reported
+    diffs = compare_logs(str(tmp_path / "a"), str(tmp_path / "c"))
+    assert diffs, "diverging runs must be detected"
+
+    with open(tmp_path / "a" / "tensor_log.jsonl") as f:
+        rec = json.loads(f.readline())
+    assert rec["step"] == 1 and any(k.startswith("param/") for k in rec["tensors"])
+
+
+# ---------------------------------------------------------------------------
+# KV pool auto sizing (round-2 weak #7)
+# ---------------------------------------------------------------------------
+def test_kv_pool_auto_sizing(eight_devices):
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    m = TransformerLM(TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                                        intermediate_size=128, max_seq_len=256, dtype=jnp.float32,
+                                        attention_impl="reference"))
+    ic = RaggedInferenceEngineConfig()  # num_kv_blocks defaults to 'auto'
+    ic.state_manager.max_context = 256
+    ic.state_manager.max_tracked_sequences = 8
+    engine = InferenceEngineV2(m, ic)
+    bs = ic.kv_block_size
+    assert ic.num_kv_blocks == "auto", "config object must not be mutated"
+    assert isinstance(engine.num_kv_blocks, int)
+    # at least one max-context sequence fits; at most the tracked budget
+    assert engine.num_kv_blocks >= -(-256 // bs) + 1
+    assert engine.num_kv_blocks <= 8 * -(-256 // bs)
+    out = engine.put([1], [np.arange(5, dtype=np.int32)])
+    assert out.shape[-1] == 128
+
+
+# ---------------------------------------------------------------------------
+# distillation / layer reduction / embedding compression (reference
+# compression suite student-teacher path) + model-based tuner
+# ---------------------------------------------------------------------------
+def test_layer_reduction_and_kd():
+    from deepspeed_tpu.compression.distillation import (apply_layer_reduction, compress_embedding,
+                                                        distillation_loss)
+    from deepspeed_tpu.models import TransformerConfig
+    from deepspeed_tpu.models.transformer import TransformerLM, forward
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=4, num_heads=2,
+                            intermediate_size=64, max_seq_len=32, dtype=jnp.float32,
+                            attention_impl="reference")
+    teacher = TransformerLM(cfg).init(jax.random.PRNGKey(0))
+    student = apply_layer_reduction(teacher, [0, 3])
+    assert student["blocks"]["wq"].shape[0] == 2
+    np.testing.assert_array_equal(np.asarray(student["blocks"]["wq"][1]),
+                                  np.asarray(teacher["blocks"]["wq"][3]))
+    # student forward runs with a matching shallow config
+    scfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                             intermediate_size=64, max_seq_len=32, dtype=jnp.float32,
+                             attention_impl="reference")
+    ids = np.random.default_rng(0).integers(0, 64, size=(2, 16), dtype=np.int32)
+    s_logits = forward(scfg, student, jnp.asarray(ids))
+    t_logits = forward(cfg, teacher, jnp.asarray(ids))
+
+    # KD loss: zero iff identical logits at alpha=1; positive otherwise
+    assert float(distillation_loss(t_logits, t_logits, alpha=1.0)) < 1e-6
+    kd = distillation_loss(s_logits, t_logits, labels=jnp.asarray(ids), temperature=2.0, alpha=0.5)
+    assert float(kd) > 0
+    # differentiable wrt student logits
+    g = jax.grad(lambda s: distillation_loss(s, t_logits, alpha=1.0))(s_logits)
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).max() > 0
+
+    # embedding compression: quantized forward value, STE gradient
+    comp = compress_embedding(teacher, bits=4)
+    assert not np.allclose(np.asarray(comp["embed"]["embedding"]),
+                           np.asarray(teacher["embed"]["embedding"]))
+
+
+def test_model_based_tuner_converges():
+    from deepspeed_tpu.autotuning.tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+
+    space = [{"zero_optimization": {"stage": z}, "train_micro_batch_size_per_gpu": m,
+              "gradient_accumulation_steps": 1} for z in (1, 2, 3) for m in (1, 2, 4, 8)]
+
+    def run(cfg):  # synthetic throughput: bigger micro better, stage-3 tax, m=8 OOM
+        m = cfg["train_micro_batch_size_per_gpu"]
+        if m == 8:
+            return None  # does not fit
+        return m * 100 - cfg["zero_optimization"]["stage"] * 10
+
+    for cls in (GridSearchTuner, RandomTuner, ModelBasedTuner):
+        tuner = cls(space)
+        best_cfg, best = tuner.tune(run)
+        assert best == 4 * 100 - 10, f"{cls.__name__} missed the optimum: {best_cfg} {best}"
+
+    # model-based: after warmup the cost model should hit the optimum without
+    # exhausting the space (failures teach it away from infeasible configs)
+    mb = ModelBasedTuner(space, warmup=3, seed=1)
+    best_cfg, best = mb.tune(run, max_trials=8)
+    assert best == 390

@@ -110,30 +110,29 @@ def test_fused_adam_on_chip():
 
 def test_v1_fused_decode_matches_reference_on_chip():
     """The v1 dense-cache decode routes through the paged kernel (identity
-    block table) on TPU; generations must match the jnp reference path."""
-    from deepspeed_tpu.inference.engine import InferenceEngine
-    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
-    from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM, _use_fused_decode
-    from deepspeed_tpu.parallel import groups
+    block table) on TPU; prefill and decode-step LOGITS must match the jnp
+    reference numerically (token-stream comparison would be flaky: one bf16
+    argmax tie would cascade through greedy feedback)."""
+    from deepspeed_tpu.models.transformer import (TransformerConfig, _use_fused_decode,
+                                                  forward_with_cache, init_kv_cache, init_params)
 
     rng = np.random.default_rng(4)
-    prompt = rng.integers(0, 512, size=(2, 32), dtype=np.int32)
+    prompt = jnp.asarray(rng.integers(0, 512, size=(2, 32), dtype=np.int32))
 
-    def gen(attention_impl):
-        groups.reset()
+    def logits_pair(attention_impl):
         cfg = TransformerConfig(vocab_size=512, hidden_size=1024, num_layers=2, num_heads=8,
                                 max_seq_len=128, intermediate_size=1024, dtype=jnp.bfloat16,
                                 attention_impl=attention_impl)
-        m = TransformerLM(cfg)
-        params = jax.jit(lambda r: m.init(r, None))(jax.random.PRNGKey(7))
-        eng = InferenceEngine(m, DeepSpeedInferenceConfig(), params=params)
         if attention_impl == "auto":
             assert _use_fused_decode(cfg, 8, 128, 128), "fused decode must engage on chip"
-        return eng.generate(prompt, max_new_tokens=8)
+        params = init_params(cfg, jax.random.PRNGKey(7))
+        cache = init_kv_cache(cfg, 2, 128)
+        pre, cache = jax.jit(lambda p, i, c: forward_with_cache(cfg, p, i, c))(params, prompt, cache)
+        tok = jnp.argmax(pre[:, -1:], axis=-1).astype(jnp.int32)
+        dec, _ = jax.jit(lambda p, i, c: forward_with_cache(cfg, p, i, c))(params, tok, cache)
+        return np.asarray(pre[:, -1], np.float32), np.asarray(dec[:, -1], np.float32)
 
-    fused = gen("auto")
-    ref = gen("reference")
-    # greedy decode over the same weights: identical token streams (bf16
-    # numerics may rarely flip an argmax — allow 1 divergence point per row)
-    diverged = (fused != ref).sum(axis=1)
-    assert (diverged <= 2).all(), f"fused decode diverged from reference: {fused} vs {ref}"
+    pre_f, dec_f = logits_pair("auto")
+    pre_r, dec_r = logits_pair("reference")
+    np.testing.assert_allclose(pre_f, pre_r, rtol=5e-2, atol=5e-1)
+    np.testing.assert_allclose(dec_f, dec_r, rtol=5e-2, atol=5e-1)
