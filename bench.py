@@ -58,7 +58,7 @@ def bench_serving(on_tpu: bool):
                                 num_heads=16, num_kv_heads=16, intermediate_size=5632,
                                 max_seq_len=2048, norm="rmsnorm", positions="rotary",
                                 mlp="swiglu", dtype=jnp.bfloat16, attention_impl="flash")
-        n_seqs, prompt_len, decode_steps, block_size = 32, 512, 48, 128
+        n_seqs, prompt_len, decode_steps, block_size = 32, 512, 80, 128
         n_blocks = n_seqs * (-(-(prompt_len + decode_steps + block_size) // block_size)) + 8
     else:  # CPU smoke
         cfg = TransformerConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
@@ -94,23 +94,22 @@ def bench_serving(on_tpu: bool):
         ttfts.append((time.time() - t0) * 1000.0)
     ttft_p50 = float(np.percentile(ttfts, 50))
 
-    # --- steady-state continuous-batching decode ---
-    # block=False: steps queue on the device without a per-step host fetch,
-    # so the measurement reflects engine throughput rather than the test
-    # rig's relay round-trip (on local TPU hosts the two coincide)
+    # --- steady-state continuous-batching decode: the multi-step on-device
+    # scan (engine.decode) with greedy feedback — one host round-trip per
+    # horizon instead of per token, the serving loop's steady-state shape ---
     uids = list(range(n_seqs))
     step_tok = [np.asarray([int(first_tok[0])], np.int32) for _ in uids]
-    engine.put(uids, step_tok, sample="greedy")  # compile decode bucket
-    warmup = 3
-    for _ in range(warmup):
-        out = engine.put(uids, step_tok, sample="greedy", block=False)
-    _ = np.asarray(out)
+    horizon = 16 if on_tpu else 2
+    engine.decode(uids, step_tok, horizon)  # compile the scan
+    n_rounds = max(1, (decode_steps - horizon) // horizon)
+    last = [np.asarray([int(t)], np.int32) for t in np.asarray(engine.put(
+        uids, step_tok, sample="greedy"))]
     t0 = time.time()
-    for _ in range(decode_steps - warmup):
-        out = engine.put(uids, step_tok, sample="greedy", block=False)
-    _ = np.asarray(out)
+    for _ in range(n_rounds):
+        out = engine.decode(uids, last, horizon)
+        last = [np.asarray([int(t)], np.int32) for t in out[:, -1]]
     dt = time.time() - t0
-    decode_tps = n_seqs * (decode_steps - warmup) / dt
+    decode_tps = n_seqs * n_rounds * horizon / dt
 
     # --- HBM roofline for vs_baseline (decode is bandwidth-bound) ---
     n_params = model.num_params()

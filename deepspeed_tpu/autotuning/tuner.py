@@ -53,7 +53,10 @@ class BaseTuner:
         """Drive the loop: pick → measure → update (reference ``tune():...``)."""
         trials = max_trials or len(self.space)
         while self.has_next() and trials > 0:
-            for cfg in self.next_batch(min(batch_size, trials)):
+            batch = self.next_batch(min(batch_size, trials))
+            if not batch:  # e.g. duplicate configs in the space: nothing left
+                break
+            for cfg in batch:
                 self.update(cfg, run_fn(cfg))
                 trials -= 1
                 if trials <= 0:

@@ -184,6 +184,95 @@ class InferenceEngineV2:
         return np.asarray(out)[:rb.n_seqs]
 
     # ------------------------------------------------------------------
+    def decode(self, batch_uids: List[int], first_tokens, n_steps: int, block: bool = True) -> np.ndarray:
+        """Run ``n_steps`` greedy decode steps ON DEVICE in one compiled
+        program (a ``lax.scan`` feeding each step's argmax back as the next
+        token), for sequences already tracked by the engine.
+
+        This is the steady-state continuous-batching fast path: ``put`` pays
+        one host round-trip per token, which on a relay/tunneled runtime
+        dominates the step time; ``decode`` pays it once per ``n_steps``.
+        KV blocks for the whole horizon are reserved up front (admission
+        refuses if the pool can't cover it). Returns token ids
+        [len(batch_uids), n_steps].
+        """
+        uids = list(batch_uids)
+        S = len(uids)
+        if len(set(uids)) != len(uids):
+            # same corruption mode put()'s admission rejects: two rows of one
+            # uid would write divergent KV at the same positions
+            raise SchedulingError(SchedulingResult.BatchSequenceLimitExceeded)
+        first = [np.asarray(t, np.int32).reshape(-1) for t in first_tokens]
+        assert all(t.size == 1 for t in first), "decode() takes exactly one next token per sequence"
+        seqs = []
+        for uid in uids:
+            seq = self.state_manager.get_sequence(uid)
+            if seq is None:
+                raise SchedulingError(SchedulingResult.EngineSequenceLimitExceeded)
+            if seq.seen_tokens + n_steps > self._max_context:
+                raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+            seqs.append(seq)
+        blocks_needed = sum(s.blocks_needed(n_steps) for s in seqs)
+        if blocks_needed > self.state_manager.free_blocks:
+            raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+        if not hasattr(self, "_decode_batch"):
+            # the scan packs exactly one token per sequence, so its wrapper
+            # uses the SAME bucket table for tokens and sequences
+            self._decode_batch = RaggedBatchWrapper(
+                max_ragged_batch_size=self.batch.max_seqs,
+                max_ragged_sequence_count=self.batch.max_seqs,
+                max_blocks_per_seq=self._max_blocks_per_seq, block_size=self.config.kv_block_size,
+                token_buckets=self.batch.seq_buckets, seq_buckets=self.batch.seq_buckets)
+        for seq, toks in zip(seqs, first):
+            self.state_manager.allocate_blocks(seq, n_steps)
+            seq.pre_forward(n_steps)
+
+        self._decode_batch.clear()
+        for seq, toks in zip(seqs, first):
+            # tables now cover the full horizon; positions advance in-scan
+            self._decode_batch.insert_sequence(seq, toks)
+        rb = self._decode_batch.finalize()
+
+        fn = self._get_compiled_decode(rb.token_ids.shape[0], n_steps)
+        kv = self.state_manager.kv_cache
+        toks, k_pool, v_pool = fn(self.params, jnp.asarray(rb.packed()),
+                                  jnp.asarray(rb.seq_start_len), kv.k_pool, kv.v_pool)
+        kv.update(k_pool, v_pool)
+        for seq in seqs:
+            seq.post_forward()
+        if not block:
+            return toks[:S]
+        return np.asarray(toks)[:S]
+
+    def _get_compiled_decode(self, s_bucket: int, n_steps: int):
+        key = ("decode", s_bucket, n_steps)
+        if key not in self._compiled:
+            from .ragged.ragged_wrapper import unpack_descriptors
+
+            cfg, bs, use_pallas = self.model_config, self.config.kv_block_size, self._use_pallas
+            max_blocks = self._max_blocks_per_seq
+
+            def fwd(params, packed, pos0, k_pool, v_pool):
+                token_ids, seq_idx, _pos, valid, tables, last_idx = unpack_descriptors(
+                    packed, s_bucket, s_bucket, max_blocks)
+
+                def step(carry, t):
+                    toks, kp, vp = carry
+                    pos = pos0 + t
+                    logits, kp, vp = ragged_forward(cfg, bs, params, toks, seq_idx, pos, valid,
+                                                    tables, last_idx, kp, vp, use_pallas=use_pallas)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, kp, vp), nxt
+
+                (_, k_pool, v_pool), out = jax.lax.scan(
+                    step, (token_ids, k_pool, v_pool), jnp.arange(n_steps, dtype=jnp.int32))
+                return out.T, k_pool, v_pool  # [S, n_steps]
+
+            self._compiled[key] = jax.jit(fwd, donate_argnums=(3, 4))
+            log_dist(f"compiled multi-step decode bucket seqs={s_bucket} steps={n_steps}", ranks=[0])
+        return self._compiled[key]
+
+    # ------------------------------------------------------------------
     def query(self, uid: Optional[int] = None):
         """Sequence / engine state introspection (reference ``query:153``)."""
         return self.state_manager.query(uid)

@@ -83,18 +83,28 @@ _FUSED_QKV_PAT = re.compile(r"(^|[./])(query_key_value|c_attn)([./]|$)")
 
 def _axis_for(policy, key: str, ndim: int) -> Optional[int]:
     """0-based split axis for a weight, from the policy's COL/ROW patterns.
-    Torch Linear layout [out, in]: column-parallel splits axis 0,
-    row-parallel axis 1. 1-D tensors (biases) split axis 0 iff column."""
-    spec = policy.spec_for(key.replace(".", "/"), 2)
+
+    2-D tensors are torch Linear layout [out, in]: column-parallel splits
+    axis 0, row-parallel axis 1. 3-D+ tensors are this framework's stacked
+    [L, in, out] layout, where the split axis is wherever the policy put the
+    model axis (never the leading layer dim). 1-D biases split iff column.
+    """
+    spec = policy.spec_for(key.replace(".", "/"), ndim if ndim >= 2 else 2)
     if spec is None:
         return None
     from ..parallel.mesh import MODEL_AXIS
 
     entries = list(spec)
-    col = bool(entries) and entries[-1] == MODEL_AXIS  # our layout [in, out]
+    col = bool(entries) and entries[-1] == MODEL_AXIS  # last-dim sharded == column
     if ndim == 1:
         return 0 if col else None
-    # torch checkpoints store Linear as [out, in]
+    if ndim >= 3:
+        # native stacked layout: split exactly where the spec shards
+        for i, e in enumerate(entries):
+            if e == MODEL_AXIS:
+                return i
+        return None
+    # torch checkpoints store Linear as [out, in] (transposed vs our specs)
     return 0 if col else 1
 
 

@@ -267,3 +267,43 @@ def test_model_based_tuner_converges():
     mb = ModelBasedTuner(space, warmup=3, seed=1)
     best_cfg, best = mb.tune(run, max_trials=8)
     assert best == 390
+
+
+def test_sd_factory_stacked_3d_splits_feature_dim():
+    """Native stacked [L, in, out] tensors split on the policy's model axis,
+    never the layer dim (review regression)."""
+    from deepspeed_tpu.runtime.state_dict_factory import reshard_checkpoint
+
+    w = np.arange(4 * 8 * 8, dtype=np.float32).reshape(4, 8, 8)
+    two = reshard_checkpoint([{"blocks/wq": w, "blocks/wo": w.copy()}], 2)
+    # wq is COL3 (out dim = axis 2); wo is ROW3 (in dim = axis 1)
+    assert two[0]["blocks/wq"].shape == (4, 8, 4)
+    np.testing.assert_array_equal(two[1]["blocks/wq"], w[:, :, 4:])
+    assert two[0]["blocks/wo"].shape == (4, 4, 8)
+    np.testing.assert_array_equal(two[1]["blocks/wo"], w[:, 4:, :])
+
+
+def test_tuner_duplicate_space_terminates():
+    from deepspeed_tpu.autotuning.tuner import GridSearchTuner
+
+    tuner = GridSearchTuner([{"a": 1}, {"a": 1}])
+    best_cfg, best = tuner.tune(lambda c: 1.0)
+    assert best == 1.0
+
+
+def test_decode_rejects_duplicate_uids(eight_devices):
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedInferenceEngineConfig,
+                                            SchedulingError)
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    m = TransformerLM(TransformerConfig(vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+                                        intermediate_size=64, max_seq_len=128, dtype=jnp.float32,
+                                        attention_impl="reference"))
+    ic = RaggedInferenceEngineConfig()
+    ic.num_kv_blocks = 16
+    ic.state_manager.max_context = 128
+    engine = InferenceEngineV2(m, ic)
+    engine.put([7], [np.arange(4, dtype=np.int32)])
+    tok = np.asarray([1], np.int32)
+    with pytest.raises(SchedulingError):
+        engine.decode([7, 7], [tok, tok], 2)
