@@ -44,13 +44,20 @@ def _use_pallas():
         return False
 
 
+def _fit_block(S: int, want: int) -> int:
+    """Largest power-of-two block <= want that divides S (>= 128)."""
+    b = min(want, S)
+    while b > 128 and S % b:
+        b //= 2
+    return b
+
+
 def _shapes_supported(q, block_q, block_k):
     B, S, nq, d = q.shape
-    bq, bk = min(block_q, S), min(block_k, S)
-    return (S % bq == 0 and S % bk == 0 and S % 128 == 0 and d >= 32)
+    return S % 128 == 0 and d >= 32
 
 
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512,
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024, block_k: int = 1024,
                     window=None, alibi: bool = False):
     """q: [B, S, nq, d]; k/v: [B, S, nkv, d] with nq % nkv == 0.
 
@@ -81,10 +88,22 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: i
         warning_once(f"flash attention: unsupported shape {q.shape} (S must be a "
                      f"multiple of 128, head_dim >= 32) — using O(S^2) reference attention")
     if _use_pallas() and _shapes_supported(q, block_q, block_k):
+        # block sizes snap to the largest power-of-two divisor of S, so
+        # non-power-of-two-of-1024 lengths (1536, 2560, ...) keep the kernel
+        S = q.shape[1]
+        bq, bk = _fit_block(S, block_q), _fit_block(S, block_k)
         try:
-            return _pallas_flash(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            return _pallas_flash(q, k, v, causal=causal, block_q=bq, block_k=bk,
                                  window=window, alibi=alibi)
         except Exception as e:
+            if bq > 512 or bk > 512:
+                # large tiles can exhaust VMEM on smaller TPU generations:
+                # retry once at the proven 512 tiling before going loud
+                try:
+                    return _pallas_flash(q, k, v, causal=causal, block_q=_fit_block(S, 512),
+                                         block_k=_fit_block(S, 512), window=window, alibi=alibi)
+                except Exception:
+                    pass
             if os.environ.get("DS_TPU_ALLOW_ATTN_FALLBACK") != "1":
                 raise RuntimeError(
                     "Pallas flash attention failed on a supported shape "
@@ -103,7 +122,7 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: i
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window",
                                              "alibi"))
-def _pallas_flash(q, k, v, causal=True, block_q=512, block_k=512, interpret=False, window=None,
+def _pallas_flash(q, k, v, causal=True, block_q=1024, block_k=1024, interpret=False, window=None,
                   alibi=False):
     return _flash_core(causal, min(block_q, q.shape[1]), min(block_k, q.shape[1]),
                        interpret, window, alibi, q, k, v)
@@ -169,8 +188,9 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v)
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
+        qb = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d], loaded once per q-block
+
         def body(kj, _):
-            qb = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d]
             kb = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)  # [bk, d]
             vb = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
             s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]

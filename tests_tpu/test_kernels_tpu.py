@@ -136,3 +136,21 @@ def test_v1_fused_decode_matches_reference_on_chip():
     pre_r, dec_r = logits_pair("reference")
     np.testing.assert_allclose(pre_f, pre_r, rtol=5e-2, atol=5e-1)
     np.testing.assert_allclose(dec_f, dec_r, rtol=5e-2, atol=5e-1)
+
+
+def test_flash_non_1024_multiple_seq_keeps_kernel():
+    """S=1536 (multiple of 512, not 1024): block auto-fit must keep the
+    Pallas kernel engaged rather than regress to O(S^2) reference."""
+    from deepspeed_tpu.ops.pallas.flash_attention import _fit_block, flash_attention
+
+    assert _fit_block(1536, 1024) == 512
+    assert _fit_block(2048, 1024) == 1024
+    assert _fit_block(640, 1024) == 640  # divides S, lane-aligned
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(1, 1536, 8, 128)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(1, 1536, 8, 128)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(1, 1536, 8, 128)), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
