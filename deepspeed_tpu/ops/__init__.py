@@ -47,4 +47,5 @@ op_registry = {
     "RaggedOpsBuilder": _builder("ragged_ops", "deepspeed_tpu.ops.pallas.paged_attention"),
     "InferenceCoreBuilder": _builder("inference_core_ops", "deepspeed_tpu.ops.pallas.rmsnorm"),
     "AsyncIOBuilder": _builder("async_io", "deepspeed_tpu.ops.aio"),
+    "SparseAttnBuilder": _builder("sparse_attn", "deepspeed_tpu.ops.sparse_attention"),
 }

@@ -45,19 +45,38 @@ def _use_pallas():
 
 
 def _fit_block(S: int, want: int) -> int:
-    """Largest power-of-two block <= want that divides S (>= 128)."""
-    b = min(want, S)
+    """Largest lane-aligned (multiple-of-128) divisor of S that is <= want.
+
+    Always returns a true divisor: ``_shapes_supported`` guarantees
+    S % 128 == 0, so 128 qualifies as the floor — the kernel's
+    ``S % block == 0`` precondition can never trip on the auto-fit path."""
+    b = max(128, min(want, S) // 128 * 128)
     while b > 128 and S % b:
-        b //= 2
+        b -= 128
     return b
 
 
-def _shapes_supported(q, block_q, block_k):
+# Generations where the 1024 tiling is validated (bench chip is v5e). Older /
+# unknown generations keep the proven 512 default: a VMEM exhaustion inside an
+# enclosing jit surfaces at the *caller's* compile, where the retry below
+# cannot catch it.
+_LARGE_TILE_KINDS = ("v5 lite", "v5e", "v5p", "v6")
+
+
+def _default_tile():
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 512
+    return 1024 if any(t in kind for t in _LARGE_TILE_KINDS) else 512
+
+
+def _shapes_supported(q):
     B, S, nq, d = q.shape
     return S % 128 == 0 and d >= 32
 
 
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024, block_k: int = 1024,
+def flash_attention(q, k, v, causal: bool = True, block_q: int = None, block_k: int = None,
                     window=None, alibi: bool = False):
     """q: [B, S, nq, d]; k/v: [B, S, nkv, d] with nq % nkv == 0.
 
@@ -82,14 +101,18 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024, block_k: 
                      "using O(S^2) reference attention")
         return reference_attention(q, k, v, causal=causal, window=window,
                                    alibi=alibi_slopes(q.shape[2]))
-    if _use_pallas() and not _shapes_supported(q, block_q, block_k):
+    if block_q is None:
+        block_q = _default_tile()
+    if block_k is None:
+        block_k = _default_tile()
+    if _use_pallas() and not _shapes_supported(q):
         from ...utils.logging import warning_once
 
         warning_once(f"flash attention: unsupported shape {q.shape} (S must be a "
                      f"multiple of 128, head_dim >= 32) — using O(S^2) reference attention")
-    if _use_pallas() and _shapes_supported(q, block_q, block_k):
-        # block sizes snap to the largest power-of-two divisor of S, so
-        # non-power-of-two-of-1024 lengths (1536, 2560, ...) keep the kernel
+    if _use_pallas() and _shapes_supported(q):
+        # block sizes snap to the largest lane-aligned divisor of S, so
+        # non-multiple-of-1024 lengths (1536, 2560, ...) keep the kernel
         S = q.shape[1]
         bq, bk = _fit_block(S, block_q), _fit_block(S, block_k)
         try:
@@ -98,7 +121,12 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024, block_k: 
         except Exception as e:
             if bq > 512 or bk > 512:
                 # large tiles can exhaust VMEM on smaller TPU generations:
-                # retry once at the proven 512 tiling before going loud
+                # retry once at the proven 512 tiling before going loud.
+                # NOTE this guards only the eager FORWARD call — the
+                # custom_vjp backward compiles later under jax.grad where no
+                # retry can fire; that's why the large-tile default is gated
+                # on device generation (_default_tile) and the backward is
+                # validated on-chip (tests_tpu::test_flash_bwd_large_tiles)
                 try:
                     return _pallas_flash(q, k, v, causal=causal, block_q=_fit_block(S, 512),
                                          block_k=_fit_block(S, 512), window=window, alibi=alibi)

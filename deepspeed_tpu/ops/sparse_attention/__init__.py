@@ -1,0 +1,9 @@
+"""Sparse attention (reference ``deepspeed/ops/sparse_attention/``) —
+blocked sparsity layouts + a Pallas LUT-prefetch kernel."""
+
+from .sparsity_config import (SparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
+                              VariableSparsityConfig, BigBirdSparsityConfig,
+                              BSLongformerSparsityConfig, LocalSlidingWindowSparsityConfig)
+from .attention import SparseSelfAttention, BertSparseSelfAttention, SparseAttentionUtils
+from ..pallas.block_sparse_attention import (block_sparse_attention,
+                                             block_sparse_attention_gathered, make_layout_lut)

@@ -1,0 +1,186 @@
+"""Sparse self-attention modules and integration utilities.
+
+Analog of the reference ``sparse_self_attention.py`` (:12
+``SparseSelfAttention``), ``bert_sparse_self_attention.py`` (:10) and
+``sparse_attention_utils.py`` (:14). Functional JAX style: modules are
+plain callables over explicit params, layouts are trace-time constants
+(see ``ops/pallas/block_sparse_attention.py`` for the kernel design).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..pallas.block_sparse_attention import block_sparse_attention
+from .sparsity_config import SparsityConfig
+
+
+class SparseSelfAttention:
+    """Efficient sparse self-attention over a blocked sparsity layout
+    (reference ``sparse_self_attention.py:12``).
+
+    q/k/v: [B, num_heads, L, head_dim] (the reference's layout). The master
+    layout is built once for ``max_seq_length`` and sliced per call-time L.
+    No rank-0 broadcast is needed: layouts are deterministic on every host
+    (seeded generators — see ``sparsity_config.py`` docstring).
+
+    ``causal='auto'`` (default) applies the token-level causal mask inside
+    the kernel iff the sparsity config is unidirectional. The reference
+    instead requires the user to pass a dense causal ``attn_mask``
+    (``softmax.py:80-86`` just adds it); set ``causal=False`` and pass
+    ``attn_mask`` for bit-compatible behavior.
+    """
+
+    def __init__(self, sparsity_config=None, key_padding_mask_mode="add", attn_mask_mode="mul",
+                 max_seq_length=2048, causal="auto"):
+        self.sparsity_config = sparsity_config or SparsityConfig(num_heads=4)
+        self.master_layout = np.asarray(self.sparsity_config.make_layout(max_seq_length))
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        if causal == "auto":
+            causal = getattr(self.sparsity_config, "attention", "bidirectional") == "unidirectional"
+        self.causal = bool(causal)
+        self._lut_cache = {}  # L -> (layout, lut, nvalid); layouts are static
+
+    def get_layout(self, L):
+        if L % self.sparsity_config.block != 0:
+            raise ValueError(
+                f"Sequence Length, {L}, needs to be dividable by Block size "
+                f"{self.sparsity_config.block}!")
+        num_blocks = L // self.sparsity_config.block
+        if num_blocks > self.master_layout.shape[1]:
+            raise ValueError(f"Sequence length {L} exceeds max_seq_length "
+                             f"{self.master_layout.shape[1] * self.sparsity_config.block}")
+        return self.master_layout[:, :num_blocks, :num_blocks]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None, attn_mask=None):
+        if query.shape != key.shape or key.shape != value.shape:
+            raise NotImplementedError("only self-attention is supported for now")
+        B, H, L, d = query.shape
+        if L not in self._lut_cache:
+            from ..pallas.block_sparse_attention import make_layout_lut
+
+            layout = self.get_layout(L)
+            self._lut_cache[L] = (layout,) + make_layout_lut(layout)
+        layout, lut, nvalid = self._lut_cache[L]
+        return block_sparse_attention(
+            query, key, value, layout, self.sparsity_config.block,
+            causal=self.causal, scale=1.0 / math.sqrt(d), rpe=rpe,
+            key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+            key_padding_mask_mode=self.key_padding_mask_mode,
+            attn_mask_mode=self.attn_mask_mode, lut=lut, nvalid=nvalid)
+
+
+class BertSparseSelfAttention:
+    """BERT self-attention block with sparse scores (reference
+    ``bert_sparse_self_attention.py:10``): q/k/v projections followed by
+    :class:`SparseSelfAttention`. ``init(rng, hidden_size)`` returns the
+    params pytree; ``__call__(params, hidden_states, attention_mask)``
+    returns the context layer [B, L, hidden]."""
+
+    def __init__(self, num_attention_heads, hidden_size, sparsity_config=None,
+                 max_seq_length=2048):
+        if hidden_size % num_attention_heads != 0:
+            raise ValueError(
+                f"The hidden size ({hidden_size}) is not a multiple of the number of attention "
+                f"heads ({num_attention_heads})")
+        self.num_attention_heads = num_attention_heads
+        self.hidden_size = hidden_size
+        self.attention_head_size = hidden_size // num_attention_heads
+        cfg = sparsity_config or SparsityConfig(num_heads=num_attention_heads)
+        self.sparse_self_attention = SparseSelfAttention(cfg, max_seq_length=max_seq_length)
+
+    def init(self, rng, dtype=jnp.float32):
+        keys = jax.random.split(rng, 3)
+        std = 1.0 / math.sqrt(self.hidden_size)
+        return {
+            name: {"kernel": (jax.random.normal(k, (self.hidden_size, self.hidden_size), dtype) * std),
+                   "bias": jnp.zeros((self.hidden_size,), dtype)}
+            for name, k in zip(("query", "key", "value"), keys)
+        }
+
+    def _split_heads(self, x):
+        B, L, _ = x.shape
+        return x.reshape(B, L, self.num_attention_heads, self.attention_head_size).transpose(0, 2, 1, 3)
+
+    def __call__(self, params, hidden_states, attention_mask=None):
+        proj = {name: hidden_states @ p["kernel"] + p["bias"] for name, p in params.items()}
+        q, k, v = (self._split_heads(proj[n]) for n in ("query", "key", "value"))
+        ctx = self.sparse_self_attention(q, k, v, key_padding_mask=attention_mask)
+        B, H, L, d = ctx.shape
+        return ctx.transpose(0, 2, 1, 3).reshape(B, L, H * d)
+
+
+class SparseAttentionUtils:
+    """Helpers for integrating sparse attention into transformer models
+    (reference ``sparse_attention_utils.py:14``). The reference mutates HF
+    torch modules in place; here the equivalents operate on arrays / param
+    pytrees, which is how JAX models are surgically edited."""
+
+    @staticmethod
+    def extend_position_embedding(pos_embedding, max_position):
+        """Tile an existing [P, hidden] position-embedding table to cover
+        ``max_position`` (reference :21 — 'build longer position embeddings
+        by duplicating the original')."""
+        P = pos_embedding.shape[0]
+        if max_position <= P:
+            return pos_embedding[:max_position]
+        reps = -(-max_position // P)
+        return jnp.tile(pos_embedding, (reps, 1))[:max_position]
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Reference :64 — bump the tokenizer's model_max_length."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids=None, attention_mask=None, token_type_ids=None,
+                          position_ids=None, inputs_embeds=None, pad_token_id=0,
+                          model_embeddings=None):
+        """Pad sequence-dim inputs up to a multiple of ``block_size``
+        (reference :143). Returns ``(pad_len, input_ids, attention_mask,
+        token_type_ids, position_ids, inputs_embeds)`` with None passed
+        through. Padded attention_mask positions are 0 so the kernel's
+        key-padding mask masks them out."""
+        seq_len = None
+        for t in (input_ids, attention_mask, token_type_ids, position_ids):
+            if t is not None:
+                seq_len = t.shape[1]
+                break
+        if seq_len is None and inputs_embeds is not None:
+            seq_len = inputs_embeds.shape[1]
+        if seq_len is None:
+            raise ValueError("at least one sequence input must be provided")
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len == 0:
+            return 0, input_ids, attention_mask, token_type_ids, position_ids, inputs_embeds
+
+        def pad_ids(t, value):
+            return None if t is None else jnp.pad(t, ((0, 0), (0, pad_len)), constant_values=value)
+
+        input_ids = pad_ids(input_ids, pad_token_id)
+        attention_mask = pad_ids(attention_mask, 0)
+        token_type_ids = pad_ids(token_type_ids, 0)
+        position_ids = pad_ids(position_ids, 0)
+        if inputs_embeds is not None:
+            if model_embeddings is not None:
+                pad_embed = model_embeddings[jnp.full((inputs_embeds.shape[0], pad_len),
+                                                      pad_token_id)]
+            else:
+                pad_embed = jnp.zeros((inputs_embeds.shape[0], pad_len, inputs_embeds.shape[2]),
+                                      inputs_embeds.dtype)
+            inputs_embeds = jnp.concatenate([inputs_embeds, pad_embed.astype(inputs_embeds.dtype)],
+                                            axis=1)
+        return pad_len, input_ids, attention_mask, token_type_ids, position_ids, inputs_embeds
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Reference :193 — strip the padding added by pad_to_block_size."""
+        if pad_len > 0:
+            sequence_output = sequence_output[:, :-pad_len]
+        return sequence_output

@@ -143,9 +143,13 @@ def test_flash_non_1024_multiple_seq_keeps_kernel():
     Pallas kernel engaged rather than regress to O(S^2) reference."""
     from deepspeed_tpu.ops.pallas.flash_attention import _fit_block, flash_attention
 
-    assert _fit_block(1536, 1024) == 512
+    assert _fit_block(1536, 1024) == 768  # largest lane-aligned divisor <= want
     assert _fit_block(2048, 1024) == 1024
     assert _fit_block(640, 1024) == 640  # divides S, lane-aligned
+    # non-power-of-two caller hints must still yield true divisors (the old
+    # halving loop returned 96/80 here and tripped the kernel's assert)
+    assert _fit_block(1280, 768) == 640
+    assert _fit_block(1024, 640) == 512
     rng = np.random.default_rng(5)
     q = jnp.asarray(rng.normal(size=(1, 1536, 8, 128)), jnp.bfloat16)
     k = jnp.asarray(rng.normal(size=(1, 1536, 8, 128)), jnp.bfloat16)
@@ -154,3 +158,53 @@ def test_flash_non_1024_multiple_seq_keeps_kernel():
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
                                rtol=5e-2, atol=5e-2)
+
+
+def test_block_sparse_attention_bf16_on_chip():
+    """Block-sparse LUT-prefetch kernel vs the gathered jnp oracle in bf16
+    on the real chip (BigBird layout, block=128 so the MXU gets full tiles)."""
+    from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig, make_layout_lut
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention, block_sparse_attention_gathered)
+
+    rng = np.random.default_rng(6)
+    B, H, L, d = 1, 4, 1024, 128
+    q = jnp.asarray(rng.normal(size=(B, H, L, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(B, H, L, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(B, H, L, d)), jnp.bfloat16)
+    cfg = BigBirdSparsityConfig(num_heads=H, block=128, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1,
+                                attention="unidirectional")
+    layout = cfg.make_layout(L)
+    lut, nvalid = make_layout_lut(layout)
+    out = block_sparse_attention(q, k, v, layout, 128, causal=True)
+    ref = block_sparse_attention_gathered(q, k, v, lut, nvalid, 128, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_flash_bwd_large_tiles_on_chip():
+    """Validate the 1024-tile BACKWARD on the real chip: the eager retry in
+    flash_attention only guards the forward call — the custom_vjp backward
+    compiles later, under jax.grad, where no retry can catch a VMEM failure.
+    This test is the evidence that the large-tile backward actually fits."""
+    rng = np.random.default_rng(7)
+    S = 2048  # default tile resolves to 1024 on v5e-class chips
+    q = jnp.asarray(rng.normal(size=(1, S, 8, 128)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(1, S, 8, 128)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(1, S, 8, 128)), jnp.bfloat16)
+    from deepspeed_tpu.ops.pallas.flash_attention import _default_tile, flash_attention
+
+    assert _default_tile() == 1024, "bench chip should take the large-tile default"
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32),
+                                   rtol=1e-1, atol=1.5)
