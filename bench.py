@@ -58,7 +58,7 @@ def bench_serving(on_tpu: bool):
                                 num_heads=16, num_kv_heads=16, intermediate_size=5632,
                                 max_seq_len=2048, norm="rmsnorm", positions="rotary",
                                 mlp="swiglu", dtype=jnp.bfloat16, attention_impl="flash")
-        n_seqs, prompt_len, decode_steps, block_size = 32, 512, 80, 128
+        n_seqs, prompt_len, decode_steps, block_size = 32, 512, 192, 128
         n_blocks = n_seqs * (-(-(prompt_len + decode_steps + block_size) // block_size)) + 8
     else:  # CPU smoke
         cfg = TransformerConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
@@ -99,7 +99,10 @@ def bench_serving(on_tpu: bool):
     # horizon instead of per token, the serving loop's steady-state shape ---
     uids = list(range(n_seqs))
     step_tok = [np.asarray([int(first_tok[0])], np.int32) for _ in uids]
-    horizon = 16 if on_tpu else 2
+    # horizon 64: each decode() call pays one host round-trip (~50ms on the
+    # axon relay) regardless of length — the steady-state number should
+    # measure the device, not the tunnel
+    horizon = 64 if on_tpu else 2
     engine.decode(uids, step_tok, horizon)  # compile the scan
     n_rounds = max(1, (decode_steps - horizon) // horizon)
     last = [np.asarray([int(t)], np.int32) for t in np.asarray(engine.put(

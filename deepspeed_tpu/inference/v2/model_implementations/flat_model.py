@@ -34,11 +34,20 @@ from ....models.transformer import (TransformerConfig, _norm, alibi_slopes, appl
 
 
 def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, Any], token_ids, seq_idx, pos, valid,
-                   block_tables, last_idx, k_pool, v_pool, use_pallas: bool = False):
+                   block_tables, last_idx, k_pool, v_pool, use_pallas: bool = False,
+                   unroll: bool = True):
     """Returns (last-token logits [S_pad, V], k_pool, v_pool).
 
     token_ids/seq_idx/pos/valid: [T_pad]; block_tables: [S_pad, max_blocks];
     last_idx: [S_pad]; k_pool/v_pool: [L, NB*bs, nkv, d] (donated).
+
+    ``unroll``: trace the layer loop as straight-line code instead of
+    ``lax.scan``. scan dynamic-slices each layer's weights out of the
+    stacked pytree into a fresh buffer every iteration — measured ~3x the
+    weight-streaming roofline at decode batch sizes; unrolled indexing is
+    ~1.5x. Serving compiles each shape bucket once (and caches), so the
+    extra trace/compile time only pays at warmup. Models deeper than 48
+    layers fall back to scan to bound compile time.
     """
     dt = cfg.dtype
     T = token_ids.shape[0]
@@ -56,12 +65,19 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
         x = _norm(x, en["scale"], en.get("bias"), cfg.norm, cfg.norm_eps)
     sin, cos = rope_table(cfg, pos) if cfg.positions == "rotary" else (None, None)
 
-    # flat KV slot of each token; padding tokens dropped via OOB scatter
+    # flat KV slot of each token; padding tokens dropped via OOB scatter.
+    # The pools ride the layer scan as CARRY over a layers-flattened view
+    # [(L*NB*bs), nkv, d]: scatter/gather address layer l via an l*pool_len
+    # (resp. l*NB block-table) offset. Pools as scan xs/ys would instead
+    # round-trip the whole cache through fresh stacked outputs every forward
+    # — at serving scale that copy (~2x pool bytes of HBM traffic per decode
+    # step) dominated the step budget.
+    NB = pool_len // block_size
+    L = k_pool.shape[0]
+    flat_len = L * pool_len
     slot = block_tables[seq_idx, pos // block_size] * block_size + pos % block_size
-    slot = jnp.where(valid, slot, pool_len)
 
-    def layer(x, blk_kv):
-        blk, k_pool_l, v_pool_l = blk_kv
+    def layer(x, blk, l, k_flat, v_flat):
         h1 = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
         q = jnp.einsum("th,hd->td", h1, blk["wq"].astype(dt)).reshape(T, nq, d)
         k = jnp.einsum("th,hd->td", h1, blk["wk"].astype(dt)).reshape(T, nkv, d)
@@ -74,18 +90,21 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
             q = apply_rope(q[None], sin, cos)[0]
             k = apply_rope(k[None], sin, cos)[0]
 
-        # append this batch's KV to the paged pool (linear_blocked_kv_rotary)
-        k_pool_l = k_pool_l.at[slot].set(k.astype(k_pool_l.dtype), mode="drop")
-        v_pool_l = v_pool_l.at[slot].set(v.astype(v_pool_l.dtype), mode="drop")
+        # append this batch's KV to the paged pool (linear_blocked_kv_rotary);
+        # in-place scatter on the scan carry at layer l's offset
+        slot_l = jnp.where(valid, l * pool_len + slot, flat_len)
+        k_flat = k_flat.at[slot_l].set(k.astype(k_flat.dtype), mode="drop")
+        v_flat = v_flat.at[slot_l].set(v.astype(v_flat.dtype), mode="drop")
 
         from ....ops.pallas.paged_attention import paged_attention, paged_attention_reference
 
+        tables_l = block_tables + l * NB  # layer l's blocks in the flat pool
         alibi = alibi_slopes(nq) if cfg.positions == "alibi" else None
         if use_pallas:
-            ctx = paged_attention(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos, block_size,
+            ctx = paged_attention(q, k_flat, v_flat, tables_l, seq_idx, pos, block_size,
                                   window=cfg.sliding_window, alibi=alibi)
         else:
-            ctx = paged_attention_reference(q, k_pool_l, v_pool_l, block_tables, seq_idx, pos,
+            ctx = paged_attention_reference(q, k_flat, v_flat, tables_l, seq_idx, pos,
                                             block_size, window=cfg.sliding_window, alibi=alibi)
 
         attn_out = jnp.einsum("td,dh->th", ctx.reshape(T, nq * d), blk["wo"].astype(dt))
@@ -108,16 +127,28 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
         if cfg.parallel_residual:  # GPT-J / NeoX / Falcon
             h2 = h1 if cfg.shared_ln else _norm(x, blk["ln2_scale"], blk.get("ln2_bias"),
                                                 cfg.norm, cfg.norm_eps)
-            return x + attn_out + mlp(h2), (k_pool_l, v_pool_l)
+            return x + attn_out + mlp(h2), k_flat, v_flat
         x = x + attn_out
         h2 = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
-        return x + mlp(h2), (k_pool_l, v_pool_l)
+        return x + mlp(h2), k_flat, v_flat
 
-    def scan_body(x, blk_kv):
-        x, pools = layer(x, blk_kv)
-        return x, pools
+    k_flat = k_pool.reshape(flat_len, nkv, d)
+    v_flat = v_pool.reshape(flat_len, nkv, d)
+    if unroll and L <= 48:
+        for l in range(L):
+            blk_l = jax.tree_util.tree_map(lambda a: a[l], params["blocks"])
+            x, k_flat, v_flat = layer(x, blk_l, l, k_flat, v_flat)
+    else:
+        def scan_body(carry, inp):
+            x, kf, vf = carry
+            blk, l = inp
+            return layer(x, blk, l, kf, vf), None
 
-    x, (k_pool, v_pool) = jax.lax.scan(scan_body, x, (params["blocks"], k_pool, v_pool))
+        (x, k_flat, v_flat), _ = jax.lax.scan(
+            scan_body, (x, k_flat, v_flat),
+            (params["blocks"], jnp.arange(L, dtype=jnp.int32)))
+    k_pool = k_flat.reshape(L, pool_len, nkv, d)
+    v_pool = v_flat.reshape(L, pool_len, nkv, d)
 
     h = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     h_last = h[last_idx]  # [S, H] — logits_gather: unembed only last tokens

@@ -90,7 +90,11 @@ class BertSparseSelfAttention:
         self.hidden_size = hidden_size
         self.attention_head_size = hidden_size // num_attention_heads
         cfg = sparsity_config or SparsityConfig(num_heads=num_attention_heads)
-        self.sparse_self_attention = SparseSelfAttention(cfg, max_seq_length=max_seq_length)
+        # HF-style BERT masks are 0/1 indicators -> 'mul' mode (0 means
+        # masked); 'add' would treat them as additive biases and padding
+        # produced by pad_to_block_size would stay fully attended
+        self.sparse_self_attention = SparseSelfAttention(cfg, max_seq_length=max_seq_length,
+                                                         key_padding_mask_mode="mul")
 
     def init(self, rng, dtype=jnp.float32):
         keys = jax.random.split(rng, 3)

@@ -173,7 +173,11 @@ class VariableSparsityConfig(SparsityConfig):
                 f"Number of random blocks, {self.num_random_blocks}, must be smaller than overall"
                 f" number of blocks in a row, {nb}!")
         for row in range(nb):
-            layout[h, row, rng.choice(nb, self.num_random_blocks, replace=False)] = 1
+            # unidirectional layouts must stay block-lower-triangular: sample
+            # random blocks only from the row's past (incl. diagonal)
+            pool = nb if self.attention == "bidirectional" else row + 1
+            n = min(self.num_random_blocks, pool)
+            layout[h, row, rng.choice(pool, n, replace=False)] = 1
         return layout
 
     def set_local_layout(self, h, layout):

@@ -109,6 +109,10 @@ def test_variable_layout():
                                    local_window_blocks=[2, 3], global_block_indices=[0],
                                    seed=7).make_layout(16 * 8)
     assert np.array_equal(layout, again)
+    # unidirectional: random blocks must not land above the block diagonal
+    uni = VariableSparsityConfig(num_heads=2, block=16, num_random_blocks=2,
+                                 attention="unidirectional", seed=11).make_layout(16 * 8)
+    assert not np.triu(uni, 1).any()
 
 
 def test_bigbird_and_longformer_layouts():
@@ -261,6 +265,12 @@ def test_bert_sparse_self_attention_and_pad_utils():
     out = SparseAttentionUtils.unpad_sequence_output(pad_len, out)
     assert out.shape == (2, 70, 64)
     assert bool(jnp.isfinite(out).all())
+    # padded keys must be masked out: garbage in the pad region cannot change
+    # real tokens' outputs (the 0/1 mask rides 'mul' mode, not 'add')
+    hidden_g = hidden_p.at[:, 70:].set(1e3)
+    out_g = SparseAttentionUtils.unpad_sequence_output(pad_len, layer(params, hidden_g,
+                                                                      attention_mask=mask_p))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_g), atol=1e-5)
 
 
 def test_position_embedding_and_tokenizer_utils():

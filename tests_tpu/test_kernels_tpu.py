@@ -195,7 +195,8 @@ def test_flash_bwd_large_tiles_on_chip():
     v = jnp.asarray(rng.normal(size=(1, S, 8, 128)), jnp.bfloat16)
     from deepspeed_tpu.ops.pallas.flash_attention import _default_tile, flash_attention
 
-    assert _default_tile() == 1024, "bench chip should take the large-tile default"
+    if _default_tile() != 1024:
+        pytest.skip("this generation takes the proven 512 default — no large-tile backward to validate")
 
     def loss_flash(q, k, v):
         return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
