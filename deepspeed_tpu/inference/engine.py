@@ -45,10 +45,11 @@ class InferenceEngine:
 
         rules = model.partition_rules() if hasattr(model, "partition_rules") else PartitionRules()
         self._param_rules = rules
-        self.params = self._place_params(params)
+        self.params = self._maybe_quantize(self._place_params(params))
         self._compiled: Dict[Any, Any] = {}
         self._cache = None
-        log_dist(f"InferenceEngine ready: tp={tp} dtype={self._config.dtype} mesh={dict(self.mesh.shape)}", ranks=[0])
+        log_dist(f"InferenceEngine ready: tp={tp} dtype={self._config.dtype} "
+                 f"quant={self._config.quant.enabled} mesh={dict(self.mesh.shape)}", ranks=[0])
 
     def _place_params(self, params):
         if params is None:
@@ -56,8 +57,10 @@ class InferenceEngine:
         specs = self._param_rules.tree_specs(params)
         shardings = jax.tree_util.tree_map(lambda s: NamedSharding(self.mesh, s), specs,
                                            is_leaf=lambda x: isinstance(x, P))
-        with self.mesh:
-            return jax.jit(lambda p: p, out_shardings=shardings)(params)
+        # device_put (not a jit identity with out_shardings): checkpoint
+        # loads arrive committed to one device, which jit rejects against a
+        # multi-device mesh; device_put reshards from any source placement
+        return jax.device_put(params, shardings)
 
     # ------------------------------------------------------------------
     def forward(self, input_ids):
@@ -127,8 +130,18 @@ class InferenceEngine:
         eng = OrbaxCheckpointEngine()
         loaded = eng.load(path, template=template)
         params = loaded.get("module", loaded)
-        self.params = self._place_params(params)
+        self.params = self._maybe_quantize(self._place_params(params))
         return self
+
+    def _maybe_quantize(self, params):
+        """Apply config.quant to a freshly placed fp tree — used by BOTH
+        __init__ and load_checkpoint so a loaded checkpoint cannot silently
+        revert a quantized engine to full precision."""
+        if not self._config.quant.enabled:
+            return params
+        from .quantization import quantize_params_for_inference
+
+        return quantize_params_for_inference(params, self._config.quant.num_bits)
 
     def eval(self):
         return self

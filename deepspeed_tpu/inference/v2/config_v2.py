@@ -32,3 +32,6 @@ class RaggedInferenceEngineConfig:
     kv_memory_fraction: float = 0.8
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
     use_pallas_kernels: str = "auto"  # 'auto' | 'never' | 'always'
+    # weight-only int8 (per-output-channel scales): halves the decode weight
+    # stream, which is the bandwidth-bound term at serving batch sizes
+    quantize_weights: bool = False

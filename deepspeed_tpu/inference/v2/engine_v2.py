@@ -35,6 +35,10 @@ class InferenceEngineV2:
 
         if params is None:
             params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
+        if self.config.quantize_weights:
+            from ..quantization import quantize_params_for_inference
+
+            params = quantize_params_for_inference(params)
         self.params = params
 
         bs = ic.kv_block_size

@@ -78,10 +78,16 @@ class BertSparseSelfAttention:
     ``bert_sparse_self_attention.py:10``): q/k/v projections followed by
     :class:`SparseSelfAttention`. ``init(rng, hidden_size)`` returns the
     params pytree; ``__call__(params, hidden_states, attention_mask)``
-    returns the context layer [B, L, hidden]."""
+    returns the context layer [B, L, hidden].
+
+    ``key_padding_mask_mode`` picks the mask convention: the default
+    ``'mul'`` expects HF-style 0/1 indicator masks (0 = padded, as produced
+    by :meth:`SparseAttentionUtils.pad_to_block_size`); pass ``'add'`` when
+    feeding pre-scaled additive masks (the ``(1-mask)*-10000`` extended
+    form) — under 'mul' those would be interpreted INVERTED."""
 
     def __init__(self, num_attention_heads, hidden_size, sparsity_config=None,
-                 max_seq_length=2048):
+                 max_seq_length=2048, key_padding_mask_mode="mul"):
         if hidden_size % num_attention_heads != 0:
             raise ValueError(
                 f"The hidden size ({hidden_size}) is not a multiple of the number of attention "
@@ -90,11 +96,8 @@ class BertSparseSelfAttention:
         self.hidden_size = hidden_size
         self.attention_head_size = hidden_size // num_attention_heads
         cfg = sparsity_config or SparsityConfig(num_heads=num_attention_heads)
-        # HF-style BERT masks are 0/1 indicators -> 'mul' mode (0 means
-        # masked); 'add' would treat them as additive biases and padding
-        # produced by pad_to_block_size would stay fully attended
-        self.sparse_self_attention = SparseSelfAttention(cfg, max_seq_length=max_seq_length,
-                                                         key_padding_mask_mode="mul")
+        self.sparse_self_attention = SparseSelfAttention(
+            cfg, max_seq_length=max_seq_length, key_padding_mask_mode=key_padding_mask_mode)
 
     def init(self, rng, dtype=jnp.float32):
         keys = jax.random.split(rng, 3)

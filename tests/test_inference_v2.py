@@ -241,3 +241,101 @@ def test_multi_step_decode_matches_stepwise_put(eight_devices):
     np.testing.assert_array_equal(out[:, :-1], toks_ref[:, 1:])
     # bookkeeping advanced by the whole horizon
     assert e2.query(uids[0]).seen_tokens == e1.query(uids[0]).seen_tokens
+
+
+# ---------------------------------------------------------------- int8 weights
+def test_quantized_weight_roundtrip():
+    from deepspeed_tpu.inference.quantization import QuantizedWeight, quantize_weight_int8
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(3, 32, 48)), jnp.float32) * jnp.asarray(
+        rng.uniform(0.01, 4.0, size=(1, 1, 48)), jnp.float32)  # per-channel ranges
+    qw = quantize_weight_int8(w)
+    assert qw.q.dtype == jnp.int8 and qw.scale.shape == (3, 1, 48)
+    back = qw.astype(jnp.float32)
+    # per-channel symmetric int8: error bounded by scale/2 per element
+    bound = np.asarray(qw.scale) / 2 + 1e-8
+    assert (np.abs(np.asarray(back - w)) <= bound).all()
+    # slicing preserves the pairing (the unrolled layer loop slices leaves)
+    np.testing.assert_allclose(np.asarray(qw[1].astype(jnp.float32)),
+                               np.asarray(back[1]))
+    # pytree registration: tree_map hits q and scale
+    leaves = jax.tree_util.tree_leaves(qw)
+    assert len(leaves) == 2
+
+
+def test_engine_quantized_weights_close_to_fp():
+    """v2 engine with quantize_weights: logits stay close to the fp engine
+    (weight-only int8, per-output-channel scales), and the weight leaves are
+    actually int8 on device."""
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+
+    model = llama2("tiny", num_layers=2, hidden_size=64, num_heads=4, num_kv_heads=2,
+                   intermediate_size=128, vocab_size=128, max_seq_len=256, dtype=jnp.float32,
+                   attention_impl="reference")
+    params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
+    sm = DSStateManagerConfig(max_tracked_sequences=8, max_ragged_batch_size=64,
+                              max_ragged_sequence_count=4, max_context=64)
+    mk = lambda quant: InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(kv_block_size=8, num_kv_blocks=32,
+                                           kv_dtype=jnp.float32, state_manager=sm,
+                                           use_pallas_kernels="never",
+                                           quantize_weights=quant), params=params)
+    fp = mk(False)
+    q8 = mk(True)
+    assert isinstance(q8.params["blocks"]["wq"], QuantizedWeight)
+    assert q8.params["blocks"]["wq"].q.dtype == jnp.int8
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, 128, size=17).astype(np.int32)
+    lf = fp.put([1], [prompt])
+    lq = q8.put([1], [prompt])
+    scale = np.abs(np.asarray(lf)).max()
+    assert np.abs(np.asarray(lq) - np.asarray(lf)).max() / scale < 0.05
+    # decode steps stay consistent too
+    nf = int(lf[0].argmax())
+    assert np.isfinite(np.asarray(q8.put([1], [np.array([nf])]))).all()
+
+
+def test_v1_engine_quant_config_wired():
+    """DeepSpeedInferenceConfig.quant.enabled must actually quantize (round-2
+    lesson: accepted-but-ignored config flags are worse than absence)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    model = llama2("tiny", num_layers=2, hidden_size=64, num_heads=4, num_kv_heads=2,
+                   intermediate_size=128, vocab_size=128, max_seq_len=64, dtype=jnp.float32,
+                   attention_impl="reference")
+    cfg = DeepSpeedInferenceConfig(dtype="float32", quant={"enabled": True})
+    eng = InferenceEngine(model, cfg)
+    assert isinstance(eng.params["blocks"]["wq"], QuantizedWeight)
+    ids = np.random.default_rng(3).integers(0, 128, size=(1, 12)).astype(np.int32)
+    logits = np.asarray(eng.forward(ids))
+    assert np.isfinite(logits).all()
+    groups.reset()
+
+
+def test_v1_engine_quant_survives_checkpoint_load(tmp_path):
+    """load_checkpoint must re-apply config.quant — a loaded checkpoint
+    silently reverting the engine to fp weights is the same ignored-flag bug
+    one method over."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
+
+    groups.reset()
+    model = llama2("tiny", num_layers=2, hidden_size=64, num_heads=4, num_kv_heads=2,
+                   intermediate_size=128, vocab_size=128, max_seq_len=64, dtype=jnp.float32,
+                   attention_impl="reference")
+    fp_params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(1))
+    OrbaxCheckpointEngine().save({"module": fp_params}, str(tmp_path / "ckpt"))
+
+    cfg = DeepSpeedInferenceConfig(dtype="float32", quant={"enabled": True})
+    eng = InferenceEngine(model, cfg)
+    eng.load_checkpoint(str(tmp_path / "ckpt"), template={"module": fp_params})
+    assert isinstance(eng.params["blocks"]["wq"], QuantizedWeight)
+    groups.reset()
