@@ -1,0 +1,129 @@
+"""Config-ladder benchmark — the BASELINE.md:24-25 ladder points beyond the
+driver-gated ``bench.py`` headline (which measures the ZeRO-3 proxy +
+FastGen serving).
+
+Not run by the driver (its 550s budget gates ``bench.py`` alone); run
+manually, results recorded in COVERAGE.md. Single-chip proxies are labeled
+as such: the 70B/pod-scale ladder rungs need hardware this environment
+doesn't expose (their sharding compiles in ``__graft_entry__.dryrun_multichip``).
+
+  1. BERT-base-size ZeRO-1 (110M, layernorm/gelu/learned-positions arch —
+     causal-LM proxy of the encoder workload, disclosed)
+  2. MoE 4-expert top-1 training (gating + dispatch overhead vs dense)
+  3. Long-context seq-8192 ZeRO-3 with flash attention + remat
+
+Each line: {"config": ..., "tokens_per_sec_per_chip": ..., "mfu": ...}
+"""
+
+import json
+import time
+
+
+def train_tps(cfg, micro, gas, seq, steps, warmup, stage, n_params_known=None):
+    import numpy as np
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerLM
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    model = TransformerLM(cfg)
+    n_chips = len(jax.devices())
+    config = {
+        "train_batch_size": micro * gas * n_chips,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.0}},
+        "zero_optimization": {"stage": stage},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10**9,
+        "tpu": {"mesh": {"data": n_chips}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, size=(config["train_batch_size"], seq),
+                                       dtype=np.int32)}
+    for _ in range(warmup):
+        engine.train_batch(batch)
+    float(np.asarray(engine.state["step"]))
+    t0 = time.time()
+    for _ in range(steps):
+        engine.train_batch(batch)
+    float(np.asarray(engine.state["step"]))
+    tps = steps * config["train_batch_size"] * seq / (time.time() - t0) / n_chips
+    n_params = model.num_params()
+    engine.state = None
+    engine._compiled = {}
+    del engine
+    import gc
+
+    gc.collect()
+    return tps, n_params
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import TransformerConfig
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    peak = 197e12 if on_tpu else 1e12
+
+    ladder = []
+    if on_tpu:
+        ladder = [
+            ("bert_base_zero1_proxy", TransformerConfig(
+                vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
+                max_seq_len=512, norm="layernorm", positions="learned", mlp="gelu",
+                use_bias=True, tie_embeddings=True, dtype=jnp.bfloat16,
+                attention_impl="flash"), dict(micro=16, gas=1, seq=512, steps=12, warmup=2,
+                                              stage=1)),
+            ("moe_4expert_top1", TransformerConfig(
+                vocab_size=32000, hidden_size=1024, num_layers=8, num_heads=16,
+                max_seq_len=1024, dtype=jnp.bfloat16, attention_impl="flash",
+                moe_num_experts=4, moe_top_k=1), dict(micro=4, gas=2, seq=1024, steps=8,
+                                                      warmup=2, stage=2)),
+            # 8 layers, not 12: the 748M model's fp32 Adam states + f32 grad
+            # accumulator leave no HBM headroom for seq-8192 activations on
+            # one 16G chip (measured 16.40G demand)
+            ("longctx_seq8192_zero3", TransformerConfig(
+                vocab_size=32000, hidden_size=2048, num_layers=8, num_heads=16,
+                intermediate_size=5632, max_seq_len=8192, dtype=jnp.bfloat16,
+                attention_impl="flash", remat=True,
+                remat_policy="save_only_these_names(attn_out)"), dict(micro=1, gas=2,
+                                                                      seq=8192, steps=4,
+                                                                      warmup=1, stage=3)),
+        ]
+    else:  # CPU smoke: one tiny config proves the script runs
+        ladder = [("cpu_smoke", TransformerConfig(
+            vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+            intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
+            attention_impl="reference"), dict(micro=2, gas=1, seq=256, steps=2, warmup=1,
+                                              stage=1))]
+
+    import sys
+
+    wanted = sys.argv[1:]
+    for name, cfg, kw in ladder:
+        if wanted and not any(w in name for w in wanted):
+            continue
+        tps, n_params = train_tps(cfg, **kw)
+        attn = 12 * cfg.num_layers * cfg.hidden_size * kw["seq"]
+        # MoE: FLOPs follow the ACTIVATED expert count, not the total
+        # parameter count — scale the expert MLP share down by top_k/E
+        n_active = n_params
+        if cfg.moe_num_experts > 1:
+            inter = cfg.intermediate_size or int(8 * cfg.hidden_size / 3)
+            expert_p = cfg.num_layers * 3 * cfg.hidden_size * inter * cfg.moe_num_experts
+            n_active = n_params - expert_p * (1 - cfg.moe_top_k / cfg.moe_num_experts)
+        mfu = tps * (6 * n_active + attn) / peak
+        print(json.dumps({"config": name, "tokens_per_sec_per_chip": round(tps, 1),
+                          "params_m": round(n_params / 1e6, 1),
+                          "active_params_m": round(n_active / 1e6, 1), "mfu": round(mfu, 4)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -81,7 +81,10 @@ def quantize_params_for_inference(params: Dict[str, Any], num_bits: int = 8) -> 
     if "blocks" in params:
         blocks = dict(params["blocks"])
         for name, w in blocks.items():
-            if name.startswith("w") and getattr(w, "ndim", 0) >= 2:
+            # dense (w*) AND expert (moe_w*) weights — the expert matmuls are
+            # the dominant decode weight stream in a MoE model; the tiny,
+            # routing-sensitive gate projection stays full precision
+            if (name.startswith("w") or name.startswith("moe_w")) and getattr(w, "ndim", 0) >= 2:
                 blocks[name] = quantize_weight_int8(w)
         out["blocks"] = blocks
     if "lm_head" in params and "kernel" in params["lm_head"]:

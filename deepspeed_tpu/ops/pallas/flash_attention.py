@@ -76,6 +76,47 @@ def _shapes_supported(q):
     return S % 128 == 0 and d >= 32
 
 
+_VMEM_BUDGET = 14 * 2**20  # conservative slice of the ~16MiB/core VMEM
+
+
+def _fit_tiles_vmem(S: int, d: int, bq: int, bk: int):
+    """Shrink (block_q, block_k) until the kernel's VMEM working set fits.
+
+    All four kernels (fwd + the three bwd passes) stream K/V one tile per
+    grid step, so residency is independent of S: the working set is the
+    [bq, bk] score/prob temporaries, the [bq|bk, d] tiles and accumulators.
+    A VMEM overflow inside an enclosing jit (or under jax.grad) is
+    uncatchable at runtime, so the fit happens at trace time. Returns
+    (bq, bk) — or None if even 128-tiles cannot fit (head_dim would have to
+    be pathological for that).
+    """
+    while True:
+        # ~2 live [bq, bk] f32 temporaries + tiles/accums; calibrated so the
+        # empirically-validated (1024, 1024, d=128) config passes the fit
+        tmp = 2 * bq * bk * 4 + (bq + bk) * d * 8 + bq * 128 * 4
+        if tmp <= _VMEM_BUDGET:
+            return bq, bk
+        if bq <= 128 and bk <= 128:
+            return None
+        bq2 = _fit_block(S, max(128, bq // 2)) if bq >= bk else bq
+        bk2 = _fit_block(S, max(128, bk // 2)) if bk >= bq else bk
+        if (bq2, bk2) == (bq, bk):  # both already at their floor for this S
+            return None
+        bq, bk = bq2, bk2
+
+
+def _reference_fallback(q, k, v, causal, window, alibi, reason=None):
+    """The single O(S^2) jnp fallback path; ``reason`` warns once."""
+    from ...models.transformer import alibi_slopes, reference_attention
+
+    if reason is not None:
+        from ...utils.logging import warning_once
+
+        warning_once(f"flash attention: {reason} — using O(S^2) reference attention")
+    return reference_attention(q, k, v, causal=causal, window=window,
+                               alibi=alibi_slopes(q.shape[2]) if alibi else None)
+
+
 def flash_attention(q, k, v, causal: bool = True, block_q: int = None, block_k: int = None,
                     window=None, alibi: bool = False):
     """q: [B, S, nq, d]; k/v: [B, S, nkv, d] with nq % nkv == 0.
@@ -91,30 +132,28 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = None, block_k: 
         assert causal, "sliding window requires causal attention"
         window = int(window)
     if alibi and (q.shape[2] & (q.shape[2] - 1)) != 0:
-        # non-power-of-2 head counts use the interleaved slope table, which
-        # the in-kernel closed form doesn't produce — fall through to jnp,
-        # LOUDLY (same policy as the unsupported-shape path)
-        from ...models.transformer import alibi_slopes, reference_attention
-        from ...utils.logging import warning_once
-
-        warning_once(f"flash attention: alibi with non-power-of-2 head count {q.shape[2]} — "
-                     "using O(S^2) reference attention")
-        return reference_attention(q, k, v, causal=causal, window=window,
-                                   alibi=alibi_slopes(q.shape[2]))
+        # the in-kernel closed-form slope only matches pow-2 head counts;
+        # others use the interleaved table — LOUD jnp path
+        return _reference_fallback(q, k, v, causal, window, alibi,
+                                   f"alibi with non-power-of-2 head count {q.shape[2]}")
     if block_q is None:
         block_q = _default_tile()
     if block_k is None:
         block_k = _default_tile()
     if _use_pallas() and not _shapes_supported(q):
-        from ...utils.logging import warning_once
-
-        warning_once(f"flash attention: unsupported shape {q.shape} (S must be a "
-                     f"multiple of 128, head_dim >= 32) — using O(S^2) reference attention")
-    if _use_pallas() and _shapes_supported(q):
+        return _reference_fallback(q, k, v, causal, window, alibi,
+                                   f"unsupported shape {q.shape} (S must be a multiple of 128, "
+                                   "head_dim >= 32)")
+    if _use_pallas():
         # block sizes snap to the largest lane-aligned divisor of S, so
         # non-multiple-of-1024 lengths (1536, 2560, ...) keep the kernel
-        S = q.shape[1]
+        S, d = q.shape[1], q.shape[3]
         bq, bk = _fit_block(S, block_q), _fit_block(S, block_k)
+        fitted = _fit_tiles_vmem(S, d, bq, bk)
+        if fitted is None:
+            return _reference_fallback(q, k, v, causal, window, alibi,
+                                       f"no tiling fits VMEM for S={S}, d={d}")
+        bq, bk = fitted
         try:
             return _pallas_flash(q, k, v, causal=causal, block_q=bq, block_k=bk,
                                  window=window, alibi=alibi)
@@ -138,14 +177,9 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = None, block_k: 
                     f"({type(e).__name__}: {e}). Set DS_TPU_ALLOW_ATTN_FALLBACK=1 "
                     "to permit the O(S^2) reference-attention fallback."
                 ) from e
-            from ...utils.logging import warning_once
-
-            warning_once(f"pallas flash attention failed ({type(e).__name__}); "
-                         f"falling back to reference attention — expect O(S^2) memory")
-    from ...models.transformer import alibi_slopes, reference_attention
-
-    return reference_attention(q, k, v, causal=causal, window=window,
-                               alibi=alibi_slopes(q.shape[2]) if alibi else None)
+            return _reference_fallback(q, k, v, causal, window, alibi,
+                                       f"kernel failed ({type(e).__name__}), fallback permitted")
+    return _reference_fallback(q, k, v, causal, window, alibi)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window",
@@ -184,7 +218,20 @@ def _alibi_slope(h, n_heads):
 
 
 def _flash_fwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v):
-    """Returns (out [B,S,nq,d], lse [B,nq,S] float32)."""
+    """Returns (out [B,S,nq,d], lse [B,nq,S] float32).
+
+    Streaming revisit-accumulate grid ``(B, nq, q_blocks, k_blocks)`` — the
+    same Mosaic idiom as the backward passes below: K/V arrive one
+    ``[block_k, d]`` tile per grid step, the online-softmax state lives in
+    VMEM scratch across the innermost dimension, and the output flushes on
+    the last k step. VMEM residency is therefore independent of S (the
+    previous full-S K/V slabs capped S near 8k on a 16MiB core — the
+    long-context path OOM'd inside the training jit where no retry can
+    fire). Causal/window skipping: ``pl.when`` guards the compute and the
+    K/V index map clamps out-of-range k blocks to the last visible one, so
+    the pipeline re-uses the resident tile instead of streaming blocks the
+    softmax never reads.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -193,13 +240,13 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v)
     group = nq // nkv
     assert S % block_q == 0 and S % block_k == 0
     scale = 1.0 / math.sqrt(d)
+    n_qblocks = S // block_q
+    n_kblocks = S // block_k
 
     # layout: [B, n, S, d] for contiguous per-head slabs
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-
-    grid = (B, nq, S // block_q)
 
     # TPU requires the last two block dims to be (8k, 128k)-aligned; stats get
     # a broadcast 128-lane trailing dim (same layout as jax's own TPU flash
@@ -207,20 +254,20 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v)
     LANES = 128
 
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
-        # block refs carry the singleton (batch, head) dims: [1, 1, bq|S, d]
         qi = pl.program_id(2)
+        kj = pl.program_id(3)
         head = pl.program_id(1)
-        n_kblocks = S // block_k
 
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
+        @pl.when(kj == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
 
-        qb = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d], loaded once per q-block
-
-        def body(kj, _):
-            kb = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)  # [bk, d]
-            vb = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        def compute():
+            qb = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d] (resident across kj)
+            kb = k_ref[0, 0].astype(jnp.float32)          # [bk, d]
+            vb = v_ref[0, 0].astype(jnp.float32)
             s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
             if causal or alibi:
                 q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -239,31 +286,45 @@ def _flash_fwd_impl(causal, block_q, block_k, interpret, window, alibi, q, k, v)
             l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_ref[:] = acc_ref[:] * alpha + jnp.dot(p, vb, preferred_element_type=jnp.float32)
             m_ref[:] = m_new
-            return 0
 
-        # ceil-div: the k block containing the last visible key must run;
-        # with a sliding window, k blocks entirely below (q_pos - window]
-        # are skipped (same dynamic-bound style as the upper limit)
-        n_iters = ((qi + 1) * block_q + block_k - 1) // block_k if causal else n_kblocks
-        lo = jnp.maximum(0, (qi * block_q - (window - 1)) // block_k) if (causal and window is not None) else 0
-        jax.lax.fori_loop(lo, n_iters, body, 0)
-        l_safe = jnp.maximum(l_ref[:], 1e-30)
-        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = jnp.broadcast_to(m_ref[:] + jnp.log(l_safe), (block_q, LANES))
+        if causal:
+            in_range = (qi + 1) * block_q > kj * block_k
+            if window is not None:
+                in_range = jnp.logical_and(
+                    in_range, qi * block_q - ((kj + 1) * block_k - 1) < window)
+            pl.when(in_range)(compute)
+        else:
+            compute()
 
-    def q_index(b, h, i):
+        @pl.when(kj == n_kblocks - 1)
+        def _flush():
+            l_safe = jnp.maximum(l_ref[:], 1e-30)
+            o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+            lse_ref[0, 0] = jnp.broadcast_to(m_ref[:] + jnp.log(l_safe), (block_q, LANES))
+
+    def q_index(b, h, i, j):
         return (b, h, i, 0)
 
-    def kv_index(b, h, i):
-        return (b, h // group, 0, 0)
+    def kv_index(b, h, i, j):
+        if not causal:
+            return (b, h // group, j, 0)
+        # clamp into the visible range: index maps issue their DMA even for
+        # pl.when-skipped steps, so out-of-range columns re-use the resident
+        # block (repeated index -> no refetch) instead of streaming dead data
+        hi = ((i + 1) * block_q - 1) // block_k
+        jj = jnp.minimum(j, hi)
+        if window is not None:
+            lo = jnp.maximum(i * block_q - (window - 1), 0) // block_k
+            jj = jnp.maximum(jj, jnp.minimum(lo, hi))
+        return (b, h // group, jj, 0)
 
     out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(B, nq, n_qblocks, n_kblocks),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), q_index),
-            pl.BlockSpec((1, 1, S, d), kv_index),
-            pl.BlockSpec((1, 1, S, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), q_index),

@@ -103,7 +103,17 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
         return (t, 0, 0)
 
     def kv_map(t, j, seq_ref, pos_ref, bt_ref):
-        return (bt_ref[seq_ref[t], j], 0, 0, 0)
+        # clamp j into the token's live range: the index map runs (and its
+        # DMA issues) even for grid steps the kernel's pl.when skips, so
+        # out-of-range columns are remapped to an in-range block — Mosaic
+        # sees a repeated index and skips the refetch instead of streaming
+        # blocks the online softmax never reads
+        hi = pos_ref[t] // block_size
+        jj = jnp.minimum(j, hi)
+        if window is not None:
+            lo = jnp.maximum(pos_ref[t] - (window - 1), 0) // block_size
+            jj = jnp.maximum(jj, jnp.minimum(lo, hi))
+        return (bt_ref[seq_ref[t], jj], 0, 0, 0)
 
     def kernel(seq_ref, pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
         t = pl.program_id(0)

@@ -339,3 +339,23 @@ def test_v1_engine_quant_survives_checkpoint_load(tmp_path):
     eng.load_checkpoint(str(tmp_path / "ckpt"), template={"module": fp_params})
     assert isinstance(eng.params["blocks"]["wq"], QuantizedWeight)
     groups.reset()
+
+
+def test_quantize_covers_moe_expert_weights():
+    """moe_w* expert matmuls are the dominant MoE decode weight stream —
+    quantization must cover them, not just the dense w* leaves."""
+    from deepspeed_tpu.inference.quantization import (QuantizedWeight,
+                                                      quantize_params_for_inference)
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                            intermediate_size=128, max_seq_len=64, dtype=jnp.float32,
+                            attention_impl="reference", moe_num_experts=2, moe_top_k=1)
+    model = TransformerLM(cfg)
+    params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
+    qp = quantize_params_for_inference(params)
+    for name in ("wq", "moe_wi", "moe_wo"):
+        assert isinstance(qp["blocks"][name], QuantizedWeight), name
+    back = qp["blocks"]["moe_wi"].astype(jnp.float32)
+    ref = np.asarray(params["blocks"]["moe_wi"], np.float32)
+    assert np.abs(np.asarray(back) - ref).max() <= np.abs(ref).max() / 100
