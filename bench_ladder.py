@@ -115,8 +115,8 @@ def main():
         # parameter count — scale the expert MLP share down by top_k/E
         n_active = n_params
         if cfg.moe_num_experts > 1:
-            inter = cfg.intermediate_size or int(8 * cfg.hidden_size / 3)
-            expert_p = cfg.num_layers * 3 * cfg.hidden_size * inter * cfg.moe_num_experts
+            # __post_init__ always resolves intermediate_size
+            expert_p = cfg.num_layers * 3 * cfg.hidden_size * cfg.intermediate_size * cfg.moe_num_experts
             n_active = n_params - expert_p * (1 - cfg.moe_top_k / cfg.moe_num_experts)
         mfu = tps * (6 * n_active + attn) / peak
         print(json.dumps({"config": name, "tokens_per_sec_per_chip": round(tps, 1),

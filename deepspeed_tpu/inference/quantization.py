@@ -83,12 +83,16 @@ def quantize_params_for_inference(params: Dict[str, Any], num_bits: int = 8) -> 
         for name, w in blocks.items():
             # dense (w*) AND expert (moe_w*) weights — the expert matmuls are
             # the dominant decode weight stream in a MoE model; the tiny,
-            # routing-sensitive gate projection stays full precision
-            if (name.startswith("w") or name.startswith("moe_w")) and getattr(w, "ndim", 0) >= 2:
+            # routing-sensitive gate projection stays full precision.
+            # Idempotent: already-quantized leaves pass through (the engine
+            # and replace_transformer_layer may both apply the same config)
+            if (name.startswith("w") or name.startswith("moe_w")) \
+                    and not isinstance(w, QuantizedWeight) and getattr(w, "ndim", 0) >= 2:
                 blocks[name] = quantize_weight_int8(w)
         out["blocks"] = blocks
     if "lm_head" in params and "kernel" in params["lm_head"]:
         head = dict(params["lm_head"])
-        head["kernel"] = quantize_weight_int8(head["kernel"])
+        if not isinstance(head["kernel"], QuantizedWeight):
+            head["kernel"] = quantize_weight_int8(head["kernel"])
         out["lm_head"] = head
     return out

@@ -26,11 +26,15 @@ def replace_transformer_layer(orig_layer_impl=None,
                               params=None,
                               mesh=None,
                               policy=None,
-                              model_type: Optional[str] = None):
-    """TP-shard + kernel-inject a model (reference signature adapted).
+                              model_type: Optional[str] = None,
+                              quantize: Optional[bool] = None):
+    """TP-shard + kernel-inject (+ optionally quantize) a model — the
+    reference ``replace_with_policy`` triple (fused kernels, TP slicing,
+    ``quantize=True`` int8 weights), signature adapted.
 
-    Returns (model, params): model with flash/paged attention enabled and
-    params annotated with the policy's TP shardings when a mesh is given.
+    Returns (model, params): model with flash/paged attention enabled,
+    params annotated with the policy's TP shardings when a mesh is given,
+    and weight-only int8 when ``quantize`` (or ``config.quant.enabled``).
     """
     model = model if model is not None else orig_layer_impl
     mc = model_config or getattr(model, "config", None)
@@ -42,6 +46,16 @@ def replace_transformer_layer(orig_layer_impl=None,
     if params is not None and mesh is not None and mesh.shape.get("model", 1) > 1:
         params = auto_tp.shard(params, mesh)
         logger.info(f"AutoTP: params sharded over model axis (size {mesh.shape['model']})")
+    num_bits = 8
+    if config is not None and getattr(config, "quant", None) is not None:
+        if quantize is None:
+            quantize = bool(config.quant.enabled)
+        num_bits = config.quant.num_bits
+    if quantize and params is not None:
+        from ..inference.quantization import quantize_params_for_inference
+
+        params = quantize_params_for_inference(params, num_bits)
+        logger.info(f"quantize: weight-only int{num_bits} (per-output-channel scales)")
     return model, params
 
 

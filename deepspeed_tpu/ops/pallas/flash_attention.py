@@ -91,9 +91,14 @@ def _fit_tiles_vmem(S: int, d: int, bq: int, bk: int):
     be pathological for that).
     """
     while True:
-        # ~2 live [bq, bk] f32 temporaries + tiles/accums; calibrated so the
-        # empirically-validated (1024, 1024, d=128) config passes the fit
-        tmp = 2 * bq * bk * 4 + (bq + bk) * d * 8 + bq * 128 * 4
+        # approximate LARGEST working set across fwd and the bwd passes
+        # (bwd holds p/dp/ds temporaries plus more d-sized tiles/accums —
+        # the binding term for large head_dim). Calibrated against on-chip
+        # evidence: (1024, 1024, d=128) passes (validated by
+        # tests_tpu::test_flash_bwd_large_tiles); (1024, 1024, d=256) is
+        # rejected to 512 tiles rather than risk an uncatchable grad-compile
+        # OOM.
+        tmp = 2 * bq * bk * 4 + (bq + bk) * d * 16 + bq * 128 * 4
         if tmp <= _VMEM_BUDGET:
             return bq, bk
         if bq <= 128 and bk <= 128:
