@@ -95,6 +95,15 @@ def main():
                 remat_policy="save_only_these_names(attn_out)"), dict(micro=1, gas=2,
                                                                       seq=8192, steps=4,
                                                                       warmup=1, stage=3)),
+            # seq 16k: needs BOTH the streaming flash forward (S-independent
+            # VMEM) and chunked CE (full [S, V] fp32 logits would be 2GiB)
+            ("longctx_seq16384_zero3", TransformerConfig(
+                vocab_size=32000, hidden_size=2048, num_layers=8, num_heads=16,
+                intermediate_size=5632, max_seq_len=16384, dtype=jnp.bfloat16,
+                attention_impl="flash", remat=True, loss_chunk=2048,
+                remat_policy="save_only_these_names(attn_out)"), dict(micro=1, gas=1,
+                                                                      seq=16384, steps=3,
+                                                                      warmup=1, stage=3)),
         ]
     else:  # CPU smoke: one tiny config proves the script runs
         ladder = [("cpu_smoke", TransformerConfig(

@@ -64,6 +64,11 @@ class TransformerConfig:
     # Bloom: LayerNorm right after the token embedding
     embed_layernorm: bool = False
     dtype: Any = jnp.bfloat16  # compute dtype; params are fp32 masters
+    # sequence-chunked cross entropy: compute/remat the vocabulary logits
+    # one [B, loss_chunk, V] slice at a time instead of materializing the
+    # full [B, S, V] — at seq 32k x vocab 32k the full fp32 logits alone are
+    # 4GiB/sample, the long-context HBM binding term. None = full logits.
+    loss_chunk: Optional[int] = None
     remat: bool = False
     remat_policy: str = "nothing_saveable"
     attention_impl: str = "auto"  # 'auto' | 'reference' | 'flash'
@@ -533,8 +538,10 @@ def _remat_policy(name: str):
     return policy
 
 
-def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array, rng=None):
-    """Token ids [B, S] → (logits [B, S, V], moe_aux_loss)."""
+def forward_hidden(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array, rng=None):
+    """Token ids [B, S] → (final-norm hidden [B, S, H], moe_aux_loss).
+    Split from :func:`forward_with_aux` so the chunked-CE long-context path
+    can unembed sequence chunks without materializing [B, S, V] logits."""
     dt = cfg.dtype
     B, S = input_ids.shape
     x = params["embed"]["embedding"].astype(dt)[input_ids]
@@ -563,13 +570,25 @@ def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: 
     xs = (params["blocks"], layer_keys) if use_layer_keys else params["blocks"]
     x, l_auxs = lax.scan(scan_body, x, xs)
     x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+    return x, jnp.sum(l_auxs)
+
+
+def _unembed(cfg: TransformerConfig, params, x):
+    """Final hidden [..., H] → vocabulary logits [..., V] in fp32."""
+    dt = cfg.dtype
     if cfg.tie_embeddings:
-        logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["embedding"].astype(dt))
+        logits = jnp.einsum("...h,vh->...v", x, params["embed"]["embedding"].astype(dt))
     else:
-        logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(dt))
+        logits = jnp.einsum("...h,hv->...v", x, params["lm_head"]["kernel"].astype(dt))
         if "bias" in params["lm_head"]:  # GPT-J style biased unembedding
             logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
-    return logits.astype(jnp.float32), jnp.sum(l_auxs)
+    return logits.astype(jnp.float32)
+
+
+def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array, rng=None):
+    """Token ids [B, S] → (logits [B, S, V], moe_aux_loss)."""
+    x, moe_aux = forward_hidden(cfg, params, input_ids, rng)
+    return _unembed(cfg, params, x), moe_aux
 
 
 def forward(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array) -> jax.Array:
@@ -765,14 +784,59 @@ def _stage_scan_fn(cfg: TransformerConfig):
     return stage_fn
 
 
+def _chunked_ce_loss(cfg: TransformerConfig, params, h, aux, chunk: int):
+    """Sequence-chunked next-token CE over final hidden ``h`` [B, S, H].
+
+    Each chunk's logits are computed inside ``jax.checkpoint``, so neither
+    forward nor backward ever holds more than one [B, chunk, V] logits
+    slice — the memory that caps long-context training. Numerically
+    identical to ``_ce_loss`` (same masked-mean semantics)."""
+    if "labels" in aux:
+        h_eff, labels = h, aux["labels"]
+    else:
+        h_eff, labels = h[:, :-1], aux["shift_ids"][..., 1:]
+    B, Sp, H = h_eff.shape
+    mask = aux.get("loss_mask")
+    mask = jnp.ones((B, Sp), jnp.float32) if mask is None else \
+        mask[..., :Sp].astype(jnp.float32)
+    pad = (-Sp) % chunk
+    if pad:
+        h_eff = jnp.pad(h_eff, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n = (Sp + pad) // chunk
+    hc = h_eff.reshape(B, n, chunk, H).transpose(1, 0, 2, 3)
+    lc = labels.reshape(B, n, chunk).transpose(1, 0, 2)
+    mc = mask.reshape(B, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_fn(h_c, l_c, m_c):
+        logp = jax.nn.log_softmax(_unembed(cfg, params, h_c), axis=-1)
+        ll = jnp.take_along_axis(logp, l_c[..., None], axis=-1)[..., 0]
+        return (ll * m_c).sum()
+
+    def scan_body(tot, xs):
+        return tot + chunk_fn(*xs), None
+
+    total_ll, _ = lax.scan(scan_body, jnp.float32(0.0), (hc, lc, mc))
+    return -total_ll / jnp.maximum(mask.sum(), 1.0)
+
+
 def loss_fn(cfg: TransformerConfig, params, batch, rng=None):
     """Next-token cross entropy (+ MoE aux loss). ``batch``: dict with
     'input_ids' [B, S] and optional 'labels' (defaults to shifted input) and
-    'loss_mask'."""
+    'loss_mask'. ``cfg.loss_chunk`` routes through the sequence-chunked CE
+    (logits never fully materialized)."""
     input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-    logits, moe_aux = forward_with_aux(cfg, params, input_ids, rng)
+    aux_d = _ce_aux(batch, input_ids)
+    if cfg.loss_chunk and input_ids.shape[1] > cfg.loss_chunk:
+        h, moe_aux = forward_hidden(cfg, params, input_ids, rng)
+        ce = _chunked_ce_loss(cfg, params, h, aux_d, int(cfg.loss_chunk))
+    else:
+        logits, moe_aux = forward_with_aux(cfg, params, input_ids, rng)
+        ce = _ce_loss(logits, aux_d)
     aux = cfg.moe_aux_loss_coef * moe_aux if cfg.moe_num_experts > 0 else 0.0
-    return _ce_loss(logits, _ce_aux(batch, input_ids)) + aux
+    return ce + aux
 
 
 def pipeline_loss_fn(cfg: TransformerConfig, params, batches, rng=None, *, mesh, num_stages: int):

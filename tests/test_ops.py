@@ -117,3 +117,32 @@ def test_flash_attention_backward_blockq_smaller_than_blockk():
     fl = jax.grad(loss(lambda a, b, c: _pallas_flash(a, b, c, causal=True, block_q=32,
                                                      block_k=128, interpret=True)))(q)
     np.testing.assert_allclose(np.asarray(fl), np.asarray(ref), rtol=5e-3, atol=5e-3)
+
+
+def test_chunked_ce_loss_matches_full():
+    """cfg.loss_chunk computes CE over sequence chunks with rematerialized
+    logits — value AND grads must match the full-logits path, for the
+    shift-ids, labels, and loss_mask variants."""
+    import dataclasses
+
+    import numpy as np
+    from deepspeed_tpu.models.transformer import TransformerConfig, init_params, loss_fn
+
+    cfg = TransformerConfig(vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+                            intermediate_size=128, max_seq_len=64, dtype=jnp.float32,
+                            attention_impl="reference")
+    cfg_c = dataclasses.replace(cfg, loss_chunk=24)  # 63 tokens -> 3 chunks, padded
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 97, size=(2, 64)), jnp.int32)
+    batches = [
+        {"input_ids": ids},
+        {"input_ids": ids, "labels": jnp.asarray(rng.integers(0, 97, size=(2, 64)), jnp.int32)},
+        {"input_ids": ids, "loss_mask": jnp.asarray(rng.random((2, 64)) > 0.3, jnp.float32)},
+    ]
+    for batch in batches:
+        full, gf = jax.value_and_grad(lambda p: loss_fn(cfg, p, batch))(params)
+        chunked, gc = jax.value_and_grad(lambda p: loss_fn(cfg_c, p, batch))(params)
+        np.testing.assert_allclose(float(full), float(chunked), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(gf), jax.tree_util.tree_leaves(gc)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5)
