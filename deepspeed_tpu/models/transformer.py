@@ -538,10 +538,16 @@ def _remat_policy(name: str):
     return policy
 
 
-def forward_hidden(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array, rng=None):
+def forward_hidden(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array, rng=None,
+                   pld_theta=None):
     """Token ids [B, S] → (final-norm hidden [B, S, H], moe_aux_loss).
     Split from :func:`forward_with_aux` so the chunked-CE long-context path
-    can unembed sequence chunks without materializing [B, S, V] logits."""
+    can unembed sequence chunks without materializing [B, S, V] logits.
+
+    ``pld_theta``: progressive layer dropping (reference
+    ``runtime/progressive_layer_drop.py``) — traced keep-rate scalar;
+    requires ``rng``. Each layer is wrapped in ``lax.cond`` so dropped
+    layers are genuinely skipped at runtime (the training-time saving)."""
     dt = cfg.dtype
     B, S = input_ids.shape
     x = params["embed"]["embedding"].astype(dt)[input_ids]
@@ -560,15 +566,41 @@ def forward_hidden(cfg: TransformerConfig, params: Dict[str, Any], input_ids: ja
         block_fn = jax.checkpoint(block_fn, policy=_remat_policy(cfg.remat_policy),
                                   static_argnums=())
 
+    pld_keep = None
+    if pld_theta is not None:
+        assert rng is not None, "progressive layer drop needs an rng"
+        from ..runtime.progressive_layer_drop import layer_keep_probs
+
+        rng, pld_rng = jax.random.split(rng)
+        pld_keep = jax.random.bernoulli(pld_rng, layer_keep_probs(cfg.num_layers, pld_theta))
+
     use_layer_keys = cfg.moe_num_experts > 0 and rng is not None
     layer_keys = jax.random.split(rng, cfg.num_layers) if use_layer_keys else None
 
-    def scan_body(carry, xs):
-        layer, key = xs if use_layer_keys else (xs, None)
-        return block_fn(carry, layer, sin, cos, key)
+    xs_list = [params["blocks"]]
+    if use_layer_keys:
+        xs_list.append(layer_keys)
+    if pld_keep is not None:
+        xs_list.append(pld_keep)
 
-    xs = (params["blocks"], layer_keys) if use_layer_keys else params["blocks"]
-    x, l_auxs = lax.scan(scan_body, x, xs)
+    def scan_body(carry, xs):
+        items = list(xs) if isinstance(xs, tuple) else [xs]
+        layer = items.pop(0)
+        key = items.pop(0) if use_layer_keys else None
+        if pld_keep is None:
+            return block_fn(carry, layer, sin, cos, key)
+        keep = items.pop(0)
+
+        def run(x):
+            y, aux = block_fn(x, layer, sin, cos, key)
+            return y, jnp.asarray(aux, jnp.float32)
+
+        def skip(x):
+            return x, jnp.zeros((), jnp.float32)
+
+        return lax.cond(keep, run, skip, carry)
+
+    x, l_auxs = lax.scan(scan_body, x, tuple(xs_list) if len(xs_list) > 1 else xs_list[0])
     x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     return x, jnp.sum(l_auxs)
 
@@ -585,9 +617,10 @@ def _unembed(cfg: TransformerConfig, params, x):
     return logits.astype(jnp.float32)
 
 
-def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array, rng=None):
+def forward_with_aux(cfg: TransformerConfig, params: Dict[str, Any], input_ids: jax.Array, rng=None,
+                     pld_theta=None):
     """Token ids [B, S] → (logits [B, S, V], moe_aux_loss)."""
-    x, moe_aux = forward_hidden(cfg, params, input_ids, rng)
+    x, moe_aux = forward_hidden(cfg, params, input_ids, rng, pld_theta=pld_theta)
     return _unembed(cfg, params, x), moe_aux
 
 
@@ -829,11 +862,12 @@ def loss_fn(cfg: TransformerConfig, params, batch, rng=None):
     (logits never fully materialized)."""
     input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
     aux_d = _ce_aux(batch, input_ids)
+    pld_theta = batch.get("pld_theta") if isinstance(batch, dict) else None
     if cfg.loss_chunk and input_ids.shape[1] > cfg.loss_chunk:
-        h, moe_aux = forward_hidden(cfg, params, input_ids, rng)
+        h, moe_aux = forward_hidden(cfg, params, input_ids, rng, pld_theta=pld_theta)
         ce = _chunked_ce_loss(cfg, params, h, aux_d, int(cfg.loss_chunk))
     else:
-        logits, moe_aux = forward_with_aux(cfg, params, input_ids, rng)
+        logits, moe_aux = forward_with_aux(cfg, params, input_ids, rng, pld_theta=pld_theta)
         ce = _ce_loss(logits, aux_d)
     aux = cfg.moe_aux_loss_coef * moe_aux if cfg.moe_num_experts > 0 else 0.0
     return ce + aux

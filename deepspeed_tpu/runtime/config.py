@@ -158,6 +158,14 @@ class TPUConfig(DeepSpeedConfigModel):
         return MeshConfig(**known)
 
 
+class PLDConfig(DeepSpeedConfigModel):
+    """``progressive_layer_drop`` block (reference
+    ``runtime/progressive_layer_drop.py``; constants PLD_THETA/PLD_GAMMA)."""
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
 class HybridEngineConfig(DeepSpeedConfigModel):
     """``hybrid_engine`` block (reference ``runtime/hybrid_engine.py`` config:
     enable_hybrid_engine, inference_tp_size, release_inference_cache,
@@ -269,6 +277,7 @@ class DeepSpeedConfig:
         self.elasticity_enabled = bool(pd.get(ELASTICITY, {}).get("enabled", False))
         self.elasticity_config = ElasticityConfig(**pd.get(ELASTICITY, {}))
         self.hybrid_engine_config = HybridEngineConfig(**pd.get("hybrid_engine", {}))
+        self.pld_config = PLDConfig(**pd.get("progressive_layer_drop", {}))
         self.pipeline_config = PipelineConfig(**pd.get(PIPELINE, {})) if isinstance(pd.get(PIPELINE, {}),
                                                                                     dict) else PipelineConfig()
         self.tpu_config = TPUConfig(**pd.get(TPU, {}))

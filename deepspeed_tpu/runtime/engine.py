@@ -275,6 +275,18 @@ class DeepSpeedEngine:
             from .data_pipeline.data_routing.random_ltd import RandomLTDScheduler
 
             self.random_ltd_scheduler = RandomLTDScheduler(rl_cfg.random_ltd)
+        self.progressive_layer_drop = None
+        if config.pld_config.enabled:
+            if self.pipe_world_size > 1:
+                # silent no-op would be worse: pipeline_loss_fn runs every
+                # stage's layers unconditionally and never sees pld_theta
+                raise NotImplementedError(
+                    "progressive_layer_drop does not compose with pipeline parallelism "
+                    "(the compiled stage executors run all layers); disable one of them")
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(theta=config.pld_config.theta,
+                                                               gamma=config.pld_config.gamma)
 
         # --- aux subsystems ---
         self.monitor = MonitorMaster(config.monitor_config)
@@ -697,6 +709,10 @@ class DeepSpeedEngine:
         params_treedef = jax.tree_util.tree_structure(self.state["params"])
 
         def batch_spec(ndim):
+            # rank-1 leaves (e.g. the per-microbatch pld_theta scalar track)
+            # are replicated — only [gas, micro, ...] leaves shard over data
+            if ndim < 2:
+                return P(*([None] * ndim))
             return P(*([None, DATA_AXIS] + [None] * (ndim - 2)))
 
         def local_fn(params, batches, rng, loss_scale, step, err_w, err_s):
@@ -827,7 +843,8 @@ class DeepSpeedEngine:
 
         param_manual = jax.tree_util.tree_map(manual_spec, params, dims)
         batch_manual = jax.tree_util.tree_map(
-            lambda nd: P(*([None, DATA_REPL_AXIS] + [None] * (max(nd - 2, 0)))), self._last_batch_struct)
+            lambda nd: P(*([None] * nd)) if nd < 2 else
+            P(*([None, DATA_REPL_AXIS] + [None] * (nd - 2))), self._last_batch_struct)
 
         def local_fn(p_shard, batches, rng, loss_scale):
             def gather(x, d):
@@ -948,6 +965,13 @@ class DeepSpeedEngine:
             batch = self._apply_curriculum(batch)
         if self.random_ltd_scheduler is not None:
             self.random_ltd_scheduler.update_seq(self.global_steps)
+        if self.progressive_layer_drop is not None:
+            # traced scalar per microbatch: theta decays without recompiling
+            self.progressive_layer_drop.update_state(self.global_steps)
+            if not isinstance(batch, dict):
+                batch = {"input_ids": batch}
+            batch = {**batch, "pld_theta": np.full((gas,), self.progressive_layer_drop.get_theta(),
+                                                   np.float32)}
         step_rng, self._rng = jax.random.split(self._rng)
         self.tput_timer.start()
         if self.host_optimizer is not None:
@@ -1026,6 +1050,12 @@ class DeepSpeedEngine:
             batch = self._apply_curriculum(batch, seq_axis=1)
         if self.random_ltd_scheduler is not None and self._train_mode:
             self.random_ltd_scheduler.update_seq(self.global_steps)
+        if self.progressive_layer_drop is not None and self._train_mode:
+            # same injection as train_batch so the 3-call API gets PLD too
+            self.progressive_layer_drop.update_state(self.global_steps)
+            if not isinstance(batch, dict):
+                batch = {"input_ids": batch}
+            batch = {**batch, "pld_theta": np.float32(self.progressive_layer_drop.get_theta())}
         fwd_rng, self._rng = jax.random.split(self._rng)
         if not self._train_mode:  # eval: loss only, no grads
             if "loss" not in self._compiled:

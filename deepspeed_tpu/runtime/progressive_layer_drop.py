@@ -1,0 +1,48 @@
+"""Progressive Layer Dropping (PLD) — compressed-model training.
+
+Analog of the reference ``deepspeed/runtime/progressive_layer_drop.py:10``
+(arxiv 2010.13369): a global keep-probability schedule
+``theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar`` driven by the
+engine each step, with per-layer keep probabilities that shrink with depth.
+
+TPU integration: the engine injects the current ``theta`` into the batch
+(``pld_theta``, a traced scalar — no recompilation as it decays) and the
+model's layer scan wraps each block in ``lax.cond`` so dropped layers are
+genuinely skipped at runtime (TPU conditionals execute one branch), which is
+where PLD's training-time saving comes from.
+"""
+
+import math
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    """Reference-parity API: ``get_state`` / ``get_theta`` / ``update_state``."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})", ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        self.current_theta = (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta
+
+
+def layer_keep_probs(num_layers: int, theta):
+    """Per-layer keep probabilities at global keep-rate ``theta`` (traced
+    scalar ok): depth-progressive — layer l keeps with
+    ``1 - (l+1)/L * (1 - theta)``, so early layers are almost always kept
+    and the last layer drops with probability ``1 - theta`` (paper sec 3.2's
+    progressive schedule along depth)."""
+    import jax.numpy as jnp
+
+    frac = (jnp.arange(num_layers, dtype=jnp.float32) + 1.0) / num_layers
+    return 1.0 - frac * (1.0 - jnp.asarray(theta, jnp.float32))

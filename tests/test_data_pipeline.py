@@ -177,3 +177,108 @@ def test_engine_curriculum_seqlen_trains():
     engine.backward()
     engine.step()
     assert engine.curriculum_scheduler.get_current_difficulty() == 64
+
+
+def test_progressive_layer_drop_schedule_and_forward():
+    """PLD (reference runtime/progressive_layer_drop.py): schedule parity,
+    theta=1 is an exact no-op, small theta actually changes the forward."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.runtime.progressive_layer_drop import (ProgressiveLayerDrop,
+                                                              layer_keep_probs)
+    from deepspeed_tpu.models.transformer import TransformerConfig, forward_hidden, init_params
+
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    pld.update_state(100)
+    assert abs(pld.get_theta() - (0.5 * math.exp(-1.0) + 0.5)) < 1e-9
+    st = pld.get_state()
+    assert st["progressive_layer_drop"] and st["pld_theta"] == pld.get_theta()
+    kp = np.asarray(layer_keep_probs(4, 0.5))
+    np.testing.assert_allclose(kp, [1 - 0.125, 1 - 0.25, 1 - 0.375, 1 - 0.5])
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                            intermediate_size=64, max_seq_len=32, dtype=jnp.float32,
+                            attention_impl="reference")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, size=(2, 16)), jnp.int32)
+    key = jax.random.PRNGKey(7)
+    h0, _ = forward_hidden(cfg, params, ids, rng=key)
+    h1, _ = forward_hidden(cfg, params, ids, rng=key, pld_theta=1.0)
+    np.testing.assert_allclose(np.asarray(h0), np.asarray(h1), atol=1e-6)
+    h2, _ = forward_hidden(cfg, params, ids, rng=key, pld_theta=0.05)
+    assert not np.allclose(np.asarray(h0), np.asarray(h2), atol=1e-3)
+
+
+def test_pld_engine_end_to_end(eight_devices):
+    """Engine consumes the progressive_layer_drop config block: theta decays
+    per step and training stays finite."""
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                            intermediate_size=64, max_seq_len=32, dtype=jnp.float32,
+                            attention_impl="reference")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(cfg), config={
+        "train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.1},
+        "steps_per_print": 10**9, "tpu": {"mesh": {"data": 8}},
+    })
+    assert engine.progressive_layer_drop is not None
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(3):
+        batch = {"input_ids": rng.integers(0, 64, size=(16, 32), dtype=np.int32)}
+        losses.append(float(engine.train_batch(batch)))
+    assert all(np.isfinite(l) for l in losses)
+    assert engine.progressive_layer_drop.get_theta() < 1.0  # decayed past step 0
+    groups.reset()
+
+
+def test_pld_eager_path_and_pp_rejection(eight_devices):
+    """PLD applies on the 3-call API too, and PP x PLD is rejected loudly
+    (a compiled pipeline would silently run every layer)."""
+    import pytest
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                            intermediate_size=64, max_seq_len=32, dtype=jnp.float32,
+                            attention_impl="reference")
+    base = {
+        "train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.1},
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(cfg),
+                                               config={**base, "tpu": {"mesh": {"data": 8}}})
+    rng = np.random.default_rng(1)
+    batch = {"input_ids": rng.integers(0, 64, size=(16, 32), dtype=np.int32)}
+    for _ in range(2):  # theta(0) == 1.0 by the schedule; step 1 decays it
+        loss = engine.forward(batch)  # eager 3-call path
+        engine.backward(loss)
+        engine.step()
+    assert np.isfinite(float(loss))
+    assert engine.progressive_layer_drop.get_theta() < 1.0  # updated on eager path
+    groups.reset()
+
+    with pytest.raises(NotImplementedError, match="pipeline"):
+        deepspeed_tpu.initialize(model=TransformerLM(cfg),
+                                 config={**base, "train_batch_size": 8,
+                                         "tpu": {"mesh": {"data": 4, "pipe": 2}}})
+    groups.reset()
