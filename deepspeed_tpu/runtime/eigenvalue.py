@@ -45,7 +45,12 @@ class Eigenvalue:
         self.gas_boundary_resolution = gas_boundary_resolution
         self.layer_name = layer_name
         self.layer_num = layer_num
-        self._hvp_cache = {}  # loss_fn -> jitted hvp (reused across calls)
+        # single-entry (loss_fn, jitted hvp) cache: repeated sweeps with the
+        # SAME function object reuse the compile; per-call lambdas replace
+        # the entry instead of growing an unbounded executable/closure pile —
+        # callers that rebind a batch each call should close over a stable
+        # function and pass the batch through params-side state instead
+        self._hvp_cache = None
         log_dist(f"enabled eigenvalue: max_iter={max_iter} tol={tol} layer_name={layer_name!r}",
                  ranks=[0])
 
@@ -83,13 +88,15 @@ class Eigenvalue:
     def compute_eigenvalue(self, loss_fn: Callable, params, rng: Optional[jax.Array] = None):
         """Dominant Hessian eigenvalue of ``loss_fn(params)``; the HVP
         (forward-over-reverse, no materialized H) is jitted once per
-        ``loss_fn`` and cached for repeated estimation sweeps."""
+        ``loss_fn`` and reused across repeated estimation sweeps. Estimation
+        runs in float32: bf16/fp16 params are upcast so jvp tangent dtypes
+        match and the Rayleigh quotient keeps precision."""
         rng = jax.random.PRNGKey(0) if rng is None else rng
-        if loss_fn not in self._hvp_cache:
+        params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.float32), params)
+        if self._hvp_cache is None or self._hvp_cache[0] is not loss_fn:
             grad_fn = jax.grad(loss_fn)
-            self._hvp_cache[loss_fn] = jax.jit(
-                lambda p, v: jax.jvp(grad_fn, (p,), (v,))[1])
-        hvp_full = self._hvp_cache[loss_fn]
+            self._hvp_cache = (loss_fn, jax.jit(lambda p, v: jax.jvp(grad_fn, (p,), (v,))[1]))
+        hvp_full = self._hvp_cache[1]
         return self._power_iterate(lambda v: hvp_full(params, v), params, rng)
 
     def compute_layer_eigenvalues(self, loss_fn: Callable, params,
@@ -99,7 +106,13 @@ class Eigenvalue:
         index rides as a traced argument, so the whole sweep compiles the
         HVP exactly once."""
         blocks = params[self.layer_name]
-        L = self.layer_num or jax.tree_util.tree_leaves(blocks)[0].shape[0]
+        depth = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+        if self.layer_num and self.layer_num > depth:
+            # JAX clamps out-of-bounds indices, which would silently report
+            # the LAST layer's curvature for phantom layers — refuse instead
+            raise ValueError(f"layer_num={self.layer_num} exceeds stacked depth {depth} "
+                             f"of params[{self.layer_name!r}]")
+        L = self.layer_num or depth
         rng = jax.random.PRNGKey(0) if rng is None else rng
 
         def layer_loss(blk_l, l):

@@ -193,3 +193,24 @@ def test_eigenvalue_power_iteration():
     per = Eigenvalue(max_iter=100, tol=1e-6).compute_layer_eigenvalues(
         stacked_loss, {"blocks": blocks})
     assert abs(per[0] - 2.0) < 1e-2 and abs(per[1] - 8.0) < 1e-2
+
+
+def test_eigenvalue_bf16_params_and_bounds():
+    """bf16 params upcast for the HVP (the MoQ mixed-precision case), and
+    layer_num beyond the stacked depth is refused instead of silently
+    clamping to the last layer."""
+    import jax.numpy as jnp
+    import pytest
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    def loss(params):
+        x = params["x"].astype(jnp.float32)
+        return 3.0 * jnp.sum(x * x)
+
+    e = Eigenvalue(max_iter=50, tol=1e-6, layer_name="x")
+    est = e.compute_eigenvalue(loss, {"x": jnp.ones((4,), jnp.bfloat16)})
+    assert abs(est - 6.0) < 1e-2
+
+    with pytest.raises(ValueError, match="exceeds stacked depth"):
+        Eigenvalue(layer_num=4).compute_layer_eigenvalues(
+            lambda p: jnp.sum(p["blocks"]["w"] ** 2), {"blocks": {"w": jnp.ones((2, 3))}})
