@@ -3,7 +3,8 @@ blocked sparsity layouts + a Pallas LUT-prefetch kernel."""
 
 from .sparsity_config import (SparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
                               VariableSparsityConfig, BigBirdSparsityConfig,
-                              BSLongformerSparsityConfig, LocalSlidingWindowSparsityConfig)
+                              BSLongformerSparsityConfig, LocalSlidingWindowSparsityConfig,
+                              build_sparsity_config)
 from .attention import SparseSelfAttention, BertSparseSelfAttention, SparseAttentionUtils
 from ..pallas.block_sparse_attention import (block_sparse_attention,
                                              block_sparse_attention_gathered, make_layout_lut)

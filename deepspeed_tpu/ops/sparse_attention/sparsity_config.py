@@ -383,3 +383,40 @@ class LocalSlidingWindowSparsityConfig(SparsityConfig):
         for h in range(self.num_layout_heads):
             layout = self.set_sliding_window_layout(h, layout)
         return self.check_and_propagate_first_head_layout(layout)
+
+
+_MODE_CLASSES = {
+    "dense": (DenseSparsityConfig,
+              ("block", "different_layout_per_head")),
+    "fixed": (FixedSparsityConfig,
+              ("block", "different_layout_per_head", "num_local_blocks",
+               "num_global_blocks", "attention", "horizontal_global_attention",
+               "num_different_global_patterns")),
+    "variable": (VariableSparsityConfig,
+                 ("block", "different_layout_per_head", "num_random_blocks",
+                  "local_window_blocks", "global_block_indices",
+                  "global_block_end_indices", "attention",
+                  "horizontal_global_attention")),
+    "bigbird": (BigBirdSparsityConfig,
+                ("block", "different_layout_per_head", "num_random_blocks",
+                 "num_sliding_window_blocks", "num_global_blocks", "attention")),
+    "bslongformer": (BSLongformerSparsityConfig,
+                     ("block", "different_layout_per_head",
+                      "num_sliding_window_blocks", "global_block_indices",
+                      "global_block_end_indices", "attention")),
+    "local": (LocalSlidingWindowSparsityConfig,
+              ("block", "num_sliding_window_blocks", "attention")),
+}
+
+
+def build_sparsity_config(sparsity: dict, num_heads: int):
+    """Build a SparsityConfig from a ``sparse_attention`` JSON config block
+    (reference ``runtime/config.py:289`` ``get_sparse_attention`` — mode +
+    per-mode keys, same names). Unknown modes raise, matching the reference's
+    NotImplementedError."""
+    mode = sparsity.get("mode", "fixed")
+    if mode not in _MODE_CLASSES:
+        raise NotImplementedError(f"Given sparsity mode, {mode}, has not been implemented yet!")
+    cls, keys = _MODE_CLASSES[mode]
+    kwargs = {k: sparsity[k] for k in keys if k in sparsity}
+    return cls(num_heads=num_heads, **kwargs)

@@ -246,6 +246,10 @@ class DeepSpeedConfig:
         self.gradient_predivide_factor = get_scalar_param(pd, GRADIENT_PREDIVIDE_FACTOR,
                                                           GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
         self.sparse_gradients_enabled = get_scalar_param(pd, SPARSE_GRADIENTS, SPARSE_GRADIENTS_DEFAULT)
+        # sparse attention block (reference config.py:289 get_sparse_attention):
+        # raw dict; ops.sparse_attention.build_sparsity_config turns it into a
+        # SparsityConfig at injection time (mode validated there)
+        self.sparse_attention = pd.get("sparse_attention")
         self.steps_per_print = get_scalar_param(pd, STEPS_PER_PRINT, STEPS_PER_PRINT_DEFAULT)
         self.wall_clock_breakdown = get_scalar_param(pd, WALL_CLOCK_BREAKDOWN, WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = get_scalar_param(pd, MEMORY_BREAKDOWN, MEMORY_BREAKDOWN_DEFAULT)

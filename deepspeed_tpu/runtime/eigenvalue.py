@@ -105,20 +105,25 @@ class Eigenvalue:
         subtree (reference per-layer dict for MoQ's schedule). The layer
         index rides as a traced argument, so the whole sweep compiles the
         HVP exactly once."""
+        # estimation runs fully in float32 (same as compute_eigenvalue): a
+        # bf16 patched tree would round the tangent inside layer_loss and
+        # the per-layer Rayleigh quotients lose the precision the tol needs
+        params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.float32), params)
         blocks = params[self.layer_name]
         depth = jax.tree_util.tree_leaves(blocks)[0].shape[0]
-        if self.layer_num and self.layer_num > depth:
+        if self.layer_num and not (0 < self.layer_num <= depth):
             # JAX clamps out-of-bounds indices, which would silently report
-            # the LAST layer's curvature for phantom layers — refuse instead
-            raise ValueError(f"layer_num={self.layer_num} exceeds stacked depth {depth} "
-                             f"of params[{self.layer_name!r}]")
+            # the LAST layer's curvature for phantom layers (and a negative
+            # count would silently return {}) — refuse instead
+            raise ValueError(f"layer_num={self.layer_num} must be in (0, {depth}] "
+                             f"(stacked depth of params[{self.layer_name!r}])")
         L = self.layer_num or depth
         rng = jax.random.PRNGKey(0) if rng is None else rng
 
         def layer_loss(blk_l, l):
             patched = jax.tree_util.tree_map(
-                lambda full, new: jax.lax.dynamic_update_index_in_dim(
-                    full, new.astype(full.dtype), l, 0), blocks, blk_l)
+                lambda full, new: jax.lax.dynamic_update_index_in_dim(full, new, l, 0),
+                blocks, blk_l)
             return loss_fn({**params, self.layer_name: patched})
 
         grad_fn = jax.grad(layer_loss, argnums=0)

@@ -287,6 +287,12 @@ class DeepSpeedEngine:
 
             self.progressive_layer_drop = ProgressiveLayerDrop(theta=config.pld_config.theta,
                                                                gamma=config.pld_config.gamma)
+        if config.sparse_gradients_enabled:
+            # accepted for config compatibility; under XLA embedding grads
+            # already lower to fused dense scatter-adds, so there is no
+            # torch-style sparse-gradient fast path to switch on
+            log_dist("sparse_gradients: no-op on TPU (XLA lowers embedding grads to fused "
+                     "scatter-adds); flag accepted for config compatibility", ranks=[0])
 
         # --- aux subsystems ---
         self.monitor = MonitorMaster(config.monitor_config)
@@ -1160,6 +1166,11 @@ class DeepSpeedEngine:
 
     def gradient_accumulation_steps(self):
         return self.config.gradient_accumulation_steps
+
+    def sparse_attention_config(self):
+        """Reference engine accessor: the raw ``sparse_attention`` config
+        block (feed to ``ops.sparse_attention.build_sparsity_config``)."""
+        return self.config.sparse_attention
 
     def zero_optimization_stage(self):
         return self.config.zero_optimization_stage

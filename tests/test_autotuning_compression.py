@@ -211,6 +211,25 @@ def test_eigenvalue_bf16_params_and_bounds():
     est = e.compute_eigenvalue(loss, {"x": jnp.ones((4,), jnp.bfloat16)})
     assert abs(est - 6.0) < 1e-2
 
-    with pytest.raises(ValueError, match="exceeds stacked depth"):
+    with pytest.raises(ValueError, match="must be in"):
         Eigenvalue(layer_num=4).compute_layer_eigenvalues(
             lambda p: jnp.sum(p["blocks"]["w"] ** 2), {"blocks": {"w": jnp.ones((2, 3))}})
+
+
+def test_eigenvalue_per_layer_bf16_and_negative_layer_num():
+    import jax.numpy as jnp
+    import pytest
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    def stacked_loss(params):
+        w = params["blocks"]["w"].astype(jnp.float32)
+        return 1.0 * jnp.sum(w[0] ** 2) + 4.0 * jnp.sum(w[1] ** 2)
+
+    # bf16 stacked params: the per-layer sweep must upcast, not round tangents
+    per = Eigenvalue(max_iter=100, tol=1e-6).compute_layer_eigenvalues(
+        stacked_loss, {"blocks": {"w": jnp.ones((2, 3), jnp.bfloat16)}})
+    assert abs(per[0] - 2.0) < 1e-2 and abs(per[1] - 8.0) < 1e-2
+
+    with pytest.raises(ValueError, match="must be in"):
+        Eigenvalue(layer_num=-1).compute_layer_eigenvalues(
+            stacked_loss, {"blocks": {"w": jnp.ones((2, 3))}})

@@ -285,3 +285,54 @@ def test_position_embedding_and_tokenizer_utils():
 
     tok = SparseAttentionUtils.update_tokenizer_model_max_length(Tok(), 128)
     assert tok.model_max_length == 128 and tok.init_kwargs["model_max_length"] == 128
+
+
+def test_build_sparsity_config_from_json_block():
+    """The ds_config 'sparse_attention' block (reference config.py:289) maps
+    to the right SparsityConfig class with its per-mode keys; unknown modes
+    raise like the reference."""
+    from deepspeed_tpu.ops.sparse_attention import build_sparsity_config
+
+    cases = [
+        ({"mode": "dense", "block": 32}, DenseSparsityConfig),
+        ({"mode": "fixed", "block": 16, "num_local_blocks": 2, "num_global_blocks": 1,
+          "attention": "unidirectional"}, FixedSparsityConfig),
+        ({"mode": "variable", "num_random_blocks": 1, "local_window_blocks": [2, 2],
+          "global_block_indices": [0]}, VariableSparsityConfig),
+        ({"mode": "bigbird", "num_sliding_window_blocks": 3}, BigBirdSparsityConfig),
+        ({"mode": "bslongformer", "global_block_indices": [0, 3]}, BSLongformerSparsityConfig),
+        ({"mode": "local", "num_sliding_window_blocks": 3}, LocalSlidingWindowSparsityConfig),
+    ]
+    for blockcfg, cls in cases:
+        cfg = build_sparsity_config(blockcfg, num_heads=4)
+        assert isinstance(cfg, cls), blockcfg["mode"]
+        assert cfg.make_layout(cfg.block * 8).shape == (4, 8, 8)
+    fx = build_sparsity_config(cases[1][0], num_heads=4)
+    assert fx.num_local_blocks == 2 and fx.attention == "unidirectional"
+    with pytest.raises(NotImplementedError):
+        build_sparsity_config({"mode": "striped"}, num_heads=4)
+
+
+def test_engine_exposes_sparse_attention_config(eight_devices):
+    """ds_config sparse_attention block round-trips through the engine
+    accessor (reference engine.sparse_attention_config)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    sa = {"mode": "fixed", "block": 16, "num_local_blocks": 4}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=TransformerLM(TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                                              num_heads=4, intermediate_size=64, max_seq_len=32,
+                                              dtype=jnp.float32, attention_impl="reference")),
+        config={"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "sparse_attention": sa, "sparse_gradients": True,
+                "steps_per_print": 10**9, "tpu": {"mesh": {"data": 8}}})
+    assert engine.sparse_attention_config() == sa
+    from deepspeed_tpu.ops.sparse_attention import build_sparsity_config
+    assert isinstance(build_sparsity_config(engine.sparse_attention_config(), 4),
+                      FixedSparsityConfig)
+    groups.reset()
