@@ -79,6 +79,9 @@ class TransformerConfig:
     sequence_parallel: bool = False  # Ulysses/ring sharding over the seq axis
     sequence_parallel_impl: str = "ulysses"  # 'ulysses' (a2a) | 'ring' (ppermute)
     dropout: float = 0.0
+    # block-sparse attention: the ds_config 'sparse_attention' dict (mode +
+    # per-mode keys, reference config.py:289). None = dense attention.
+    sparse_attention: Optional[dict] = None
     # MoE (reference deepspeed/moe): 0 = dense; experts shard over the data
     # axes (expert parallelism); XLA inserts the dispatch/combine all-to-alls
     # at the sharding-constraint boundaries.
@@ -103,6 +106,14 @@ class TransformerConfig:
                 self.intermediate_size = 4 * self.hidden_size
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads
+        if self.sparse_attention is not None:
+            if self.sliding_window is not None or self.positions == "alibi":
+                raise NotImplementedError("sparse_attention does not compose with sliding_window "
+                                          "or alibi (express the window via the layout instead)")
+            if self.num_kv_heads != self.num_heads:
+                raise NotImplementedError(
+                    "sparse_attention requires num_kv_heads == num_heads (MHA) — reject at "
+                    "config time rather than deep inside the first jitted forward")
         assert self.hidden_size % self.num_heads == 0
         assert self.num_heads % self.num_kv_heads == 0
 
@@ -301,7 +312,44 @@ def mlp_activation(cfg: TransformerConfig, up, gate=None):
     return jax.nn.gelu(up)
 
 
+_SPARSE_LAYOUT_CACHE = {}
+
+
+def _sparse_attention(cfg: TransformerConfig, q, k, v):
+    """Block-sparse training attention, configured by the ds_config
+    ``sparse_attention`` block (reference ``SparseSelfAttention`` training
+    path). The layout/LUT is a host-side trace-time constant cached per
+    (config, heads, S); causality follows the layout's ``attention`` type
+    (unidirectional layouts get the token-level causal mask in-kernel)."""
+    B, S, nq, d = q.shape
+    if k.shape[2] != nq:
+        raise NotImplementedError("sparse_attention requires num_kv_heads == num_heads (MHA)")
+    key = (repr(sorted(cfg.sparse_attention.items())), nq, S)
+    if key not in _SPARSE_LAYOUT_CACHE:
+        from ..ops.sparse_attention import build_sparsity_config, make_layout_lut
+
+        sc = build_sparsity_config(cfg.sparse_attention, nq)
+        layout = sc.make_layout(S)
+        causal = getattr(sc, "attention", "bidirectional") == "unidirectional"
+        if not causal:
+            from ..utils.logging import warning_once
+
+            warning_once("sparse_attention layout is BIDIRECTIONAL: next-token training would "
+                         "see future tokens. Set attention='unidirectional' in the sparsity "
+                         "config unless this is an encoder-style objective.")
+        _SPARSE_LAYOUT_CACHE[key] = (sc.block, causal, layout) + make_layout_lut(layout)
+    block, causal, layout, lut, nvalid = _SPARSE_LAYOUT_CACHE[key]
+    from ..ops.pallas.block_sparse_attention import block_sparse_attention
+
+    ctx = block_sparse_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                                 v.transpose(0, 2, 1, 3), layout, block, causal=causal,
+                                 lut=lut, nvalid=nvalid)
+    return ctx.transpose(0, 2, 1, 3)
+
+
 def _attention(cfg: TransformerConfig, q, k, v):
+    if cfg.sparse_attention is not None:
+        return _sparse_attention(cfg, q, k, v)
     impl = cfg.attention_impl
     if impl == "auto":
         try:
@@ -388,6 +436,10 @@ def _attn_branch(cfg: TransformerConfig, layer, h, sin, cos):
                     "sequence_parallel_impl='ulysses' (its local attention honors the window)")
             if cfg.positions == "alibi":
                 raise NotImplementedError("alibi + ring attention is not supported yet; use ulysses")
+            if cfg.sparse_attention is not None:
+                raise NotImplementedError("sparse_attention + ring attention is not supported; "
+                                          "use sequence_parallel_impl='ulysses' (its local "
+                                          "attention routes through the sparse kernel)")
             from ..parallel import groups
             from ..parallel.mesh import mesh_axis_size
             from ..sequence.ring import ring_attention_gspmd

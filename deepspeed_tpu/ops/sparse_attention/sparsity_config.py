@@ -413,10 +413,18 @@ def build_sparsity_config(sparsity: dict, num_heads: int):
     """Build a SparsityConfig from a ``sparse_attention`` JSON config block
     (reference ``runtime/config.py:289`` ``get_sparse_attention`` — mode +
     per-mode keys, same names). Unknown modes raise, matching the reference's
-    NotImplementedError."""
+    NotImplementedError; unknown/wrong-mode KEYS also raise — a typo'd key
+    silently falling back to a class default would train a different
+    sparsity pattern than configured."""
     mode = sparsity.get("mode", "fixed")
     if mode not in _MODE_CLASSES:
         raise NotImplementedError(f"Given sparsity mode, {mode}, has not been implemented yet!")
     cls, keys = _MODE_CLASSES[mode]
-    kwargs = {k: sparsity[k] for k in keys if k in sparsity}
-    return cls(num_heads=num_heads, **kwargs)
+    if mode in ("variable", "bigbird"):  # the randomized layouts take a seed
+        keys = keys + ("seed",)
+    allowed = set(keys) | {"mode"}
+    unknown = set(sparsity) - allowed
+    if unknown:
+        raise ValueError(f"sparse_attention mode {mode!r} got unknown keys {sorted(unknown)}; "
+                         f"allowed: {sorted(allowed)}")
+    return cls(num_heads=num_heads, **{k: sparsity[k] for k in keys if k in sparsity})

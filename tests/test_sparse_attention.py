@@ -336,3 +336,53 @@ def test_engine_exposes_sparse_attention_config(eight_devices):
     assert isinstance(build_sparsity_config(engine.sparse_attention_config(), 4),
                       FixedSparsityConfig)
     groups.reset()
+
+
+def test_training_model_sparse_attention_path():
+    """TransformerConfig.sparse_attention routes training attention through
+    the block-sparse kernel: an all-visible unidirectional 'fixed' layout is
+    numerically the dense causal forward, a genuinely sparse layout differs,
+    and gradients flow (loss_fn trains)."""
+    import dataclasses
+
+    from deepspeed_tpu.models.transformer import (TransformerConfig, forward, init_params,
+                                                  loss_fn)
+
+    base = TransformerConfig(vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+                             intermediate_size=128, max_seq_len=64, dtype=jnp.float32,
+                             attention_impl="reference")
+    # num_local_blocks=4 covers all 4 block rows at S=64/block=16 -> full causal
+    full = dataclasses.replace(base, sparse_attention={
+        "mode": "fixed", "block": 16, "num_local_blocks": 4, "attention": "unidirectional"})
+    sparse = dataclasses.replace(base, sparse_attention={
+        "mode": "local", "block": 16, "num_sliding_window_blocks": 1,
+        "attention": "unidirectional"})
+    params = init_params(base, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 97, size=(2, 64)), jnp.int32)
+
+    dense_logits = forward(base, params, ids)
+    full_logits = forward(full, params, ids)
+    np.testing.assert_allclose(np.asarray(full_logits), np.asarray(dense_logits),
+                               atol=2e-4, rtol=2e-4)
+    sparse_logits = forward(sparse, params, ids)
+    assert not np.allclose(np.asarray(sparse_logits), np.asarray(dense_logits), atol=1e-2)
+
+    loss, grads = jax.value_and_grad(lambda p: loss_fn(sparse, p, {"input_ids": ids}))(params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree_util.tree_leaves(grads))
+
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        dataclasses.replace(base, sparse_attention={"mode": "bigbird"}, sliding_window=32)
+
+
+def test_build_sparsity_config_rejects_unknown_keys():
+    from deepspeed_tpu.ops.sparse_attention import build_sparsity_config
+
+    with pytest.raises(ValueError, match="unknown keys"):
+        build_sparsity_config({"mode": "fixed", "num_local_block": 8}, num_heads=4)  # typo
+    with pytest.raises(ValueError, match="unknown keys"):
+        build_sparsity_config({"mode": "fixed", "num_sliding_window_blocks": 3}, num_heads=4)
+    # seed belongs to the randomized layouts only
+    assert build_sparsity_config({"mode": "bigbird", "seed": 3}, num_heads=4).seed == 3
+    with pytest.raises(ValueError, match="unknown keys"):
+        build_sparsity_config({"mode": "fixed", "seed": 3}, num_heads=4)
