@@ -49,6 +49,11 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
     extra trace/compile time only pays at warmup. Models deeper than 48
     layers fall back to scan to bound compile time.
     """
+    if getattr(cfg, "sparse_attention", None) is not None:
+        # same policy as forward_with_cache: dense paged decode would
+        # silently mismatch a sparse-trained model's attention distribution
+        raise NotImplementedError("sparse_attention serving is not implemented on the ragged "
+                                  "plane; unset sparse_attention for inference")
     dt = cfg.dtype
     T = token_ids.shape[0]
     S, max_blocks = block_tables.shape

@@ -322,8 +322,7 @@ def _sparse_attention(cfg: TransformerConfig, q, k, v):
     (config, heads, S); causality follows the layout's ``attention`` type
     (unidirectional layouts get the token-level causal mask in-kernel)."""
     B, S, nq, d = q.shape
-    if k.shape[2] != nq:
-        raise NotImplementedError("sparse_attention requires num_kv_heads == num_heads (MHA)")
+    assert k.shape[2] == nq, "MHA enforced at config time (TransformerConfig.__post_init__)"
     key = (repr(sorted(cfg.sparse_attention.items())), nq, S)
     if key not in _SPARSE_LAYOUT_CACHE:
         from ..ops.sparse_attention import build_sparsity_config, make_layout_lut
@@ -760,6 +759,13 @@ def _use_fused_decode(cfg, nq, d, Smax) -> bool:
 def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
     """Prefill/decode step: consumes tokens at positions [len, len+T), appends
     their k/v into the cache and returns (logits [B, T, V], new_cache)."""
+    if cfg.sparse_attention is not None:
+        # serving a sparse-trained model with dense cached attention would
+        # silently use a distribution the model never saw — reject loudly
+        # (same policy as the other unsupported combinations)
+        raise NotImplementedError("sparse_attention serving is not implemented: the KV-cache "
+                                  "decode applies dense attention; unset sparse_attention "
+                                  "for inference")
     dt = cfg.dtype
     B, T = input_ids.shape
     start = cache["length"]

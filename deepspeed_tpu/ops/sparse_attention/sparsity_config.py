@@ -396,10 +396,10 @@ _MODE_CLASSES = {
                  ("block", "different_layout_per_head", "num_random_blocks",
                   "local_window_blocks", "global_block_indices",
                   "global_block_end_indices", "attention",
-                  "horizontal_global_attention")),
+                  "horizontal_global_attention", "seed")),
     "bigbird": (BigBirdSparsityConfig,
                 ("block", "different_layout_per_head", "num_random_blocks",
-                 "num_sliding_window_blocks", "num_global_blocks", "attention")),
+                 "num_sliding_window_blocks", "num_global_blocks", "attention", "seed")),
     "bslongformer": (BSLongformerSparsityConfig,
                      ("block", "different_layout_per_head",
                       "num_sliding_window_blocks", "global_block_indices",
@@ -420,8 +420,6 @@ def build_sparsity_config(sparsity: dict, num_heads: int):
     if mode not in _MODE_CLASSES:
         raise NotImplementedError(f"Given sparsity mode, {mode}, has not been implemented yet!")
     cls, keys = _MODE_CLASSES[mode]
-    if mode in ("variable", "bigbird"):  # the randomized layouts take a seed
-        keys = keys + ("seed",)
     allowed = set(keys) | {"mode"}
     unknown = set(sparsity) - allowed
     if unknown:
