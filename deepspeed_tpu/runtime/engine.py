@@ -269,6 +269,7 @@ class DeepSpeedEngine:
             from .data_pipeline.curriculum_scheduler import CurriculumScheduler
 
             self.curriculum_scheduler = CurriculumScheduler(cl_cfg if cl_cfg.enabled else de_cl)
+        self._data_post_process_func = None
         self.random_ltd_scheduler = None
         rl_cfg = config.data_efficiency_config.data_routing
         if config.data_efficiency_config.enabled and rl_cfg.enabled and rl_cfg.random_ltd.enabled:
@@ -960,9 +961,13 @@ class DeepSpeedEngine:
         """
         gas = self.config.gradient_accumulation_steps
         micro = self.config.train_micro_batch_size_per_gpu
+        if batch is not None and self._data_post_process_func is not None:
+            batch = self._data_post_process_func(batch)
         if batch is None:
             assert data_iter is not None
             mbs = [next(data_iter) for _ in range(gas)]
+            if self._data_post_process_func is not None:
+                mbs = [self._data_post_process_func(mb) for mb in mbs]
             batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *mbs)
         else:
             batch = jax.tree_util.tree_map(lambda x: np.asarray(x).reshape(gas, -1, *np.shape(x)[1:]), batch)
@@ -1377,18 +1382,107 @@ class DeepSpeedEngine:
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin", exclude_frozen_parameters=False):
         """Gather full (unsharded) bf16 weights for export (reference
         ``save_16bit_model`` engine.py:3552 / ``_zero3_consolidated_16bit_state_dict``)."""
-        full = jax.device_get(
-            jax.jit(lambda p: jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), p),
-                    out_shardings=jax.tree_util.tree_map(lambda _: NamedSharding(self.mesh, P()),
-                                                         self.state["params"]))(self.state["params"]))
+        full = self._gather_full_params(dtype=jnp.bfloat16)
         if dist.get_rank() == 0:
             os.makedirs(save_dir, exist_ok=True)
             import pickle
 
             with open(os.path.join(save_dir, save_filename), "wb") as f:
-                pickle.dump(jax.tree_util.tree_map(np.asarray, full), f)
+                pickle.dump(full, f)
         dist.barrier()
         return True
+
+    def save_fp16_model(self, save_dir, save_filename="pytorch_model.bin"):
+        """Reference alias (engine.py:3544) of :meth:`save_16bit_model`."""
+        return self.save_16bit_model(save_dir, save_filename)
+
+    def _gather_full_params(self, dtype=None):
+        """Gather the (possibly sharded) param tree replicated onto host —
+        shared by ``save_16bit_model`` and ``module_state_dict``."""
+        cast = (lambda x: x.astype(dtype)) if dtype is not None else (lambda x: x)
+        full = jax.device_get(
+            jax.jit(lambda p: jax.tree_util.tree_map(cast, p),
+                    out_shardings=jax.tree_util.tree_map(lambda _: NamedSharding(self.mesh, P()),
+                                                         self.state["params"]))(self.state["params"]))
+        return jax.tree_util.tree_map(np.asarray, full)
+
+    def module_state_dict(self):
+        """Full (unsharded) fp32 param tree on host (reference
+        ``module_state_dict`` — consumed by save paths and integrations)."""
+        return self._gather_full_params()
+
+    def load_module_state_dict(self, state_dict, strict=True):
+        """Install a full param tree into the engine's (sharded) state
+        (reference ``load_module_state_dict``). ``strict`` verifies the tree
+        structure matches before placement. With ZeRO-Offload the host fp32
+        masters are overwritten too — otherwise the next step would
+        resurrect the pre-load weights from the stale masters."""
+        if strict:
+            want = jax.tree_util.tree_structure(self.state["params"])
+            got = jax.tree_util.tree_structure(state_dict)
+            if want != got:
+                raise ValueError(f"state_dict structure mismatch: engine has {want}, got {got}")
+        shardings = jax.tree_util.tree_map(lambda x: x.sharding, self.state["params"])
+        placed = jax.device_put(
+            jax.tree_util.tree_map(lambda new, cur: jnp.asarray(new, cur.dtype),
+                                   state_dict, self.state["params"]), shardings)
+        self.state = {**self.state, "params": placed}
+        if self.host_optimizer is not None:
+            self.host_optimizer.reset_masters(placed)
+        return self
+
+    def set_train_batch_size(self, train_batch_size: int):
+        """Adjust the global batch by changing gradient accumulation only
+        (reference ``set_train_batch_size`` engine.py:446: micro-batch and
+        dp world size stay fixed; indivisible values are rejected). Uses the
+        BATCH dp extent (data x data_repl axes — the seq axis does not
+        multiply the batch)."""
+        micro_global = self.config.train_micro_batch_size_per_gpu * self.batch_dp_world_size
+        if train_batch_size % micro_global != 0:
+            raise ValueError(f"train_batch_size {train_batch_size} must be divisible by "
+                             f"micro_batch*dp = {micro_global}")
+        self.config.gradient_accumulation_steps = train_batch_size // micro_global
+        self.config.train_batch_size = train_batch_size
+        # gas is baked into every compiled step (fused, offload, pipeline) —
+        # drop them all and recompile on next use
+        self._compiled = {}
+
+    def set_train_micro_batch_size(self, micro_batch_size: int):
+        """Reference ``set_train_micro_batch_size`` (engine.py:460): change
+        the micro batch, keeping gas — the global batch follows."""
+        self.config.train_micro_batch_size_per_gpu = micro_batch_size
+        self.config.train_batch_size = (micro_batch_size * self.batch_dp_world_size *
+                                        self.config.gradient_accumulation_steps)
+        self._compiled = {}
+
+    def get_mom(self):
+        """Current momentum (reference ``get_mom`` engine.py:1744): betas for
+        the Adam family, the scalar momentum for SGD."""
+        params = self.config.optimizer_params or {}
+        if str(self.config.optimizer_name or "").lower() == "sgd":
+            return [params.get("momentum", 0.0)]
+        betas = params.get("betas", (params.get("beta1", 0.9), params.get("beta2", 0.999)))
+        return [list(betas)]
+
+    def set_data_post_process_func(self, fn):
+        """Reference ``set_data_post_process_func`` (data-efficiency hook).
+        Contract: ``fn`` receives exactly what the caller feeds
+        ``train_batch`` — each dataloader microbatch on the ``data_iter``
+        path, or the whole ``gas*micro`` batch on the ``batch=`` path (no
+        hidden re-slicing)."""
+        self._data_post_process_func = fn
+
+    def destroy(self):
+        """Release compiled executables, device state, accumulated grads and
+        host optimizer masters (reference ``destroy`` — lets a process build
+        a fresh engine without holding two copies in HBM/host RAM)."""
+        self._compiled = {}
+        self.state = None
+        self._grad_acc_buffer = None
+        self.host_optimizer = None
+        import gc
+
+        gc.collect()
 
     # convenience (torch-style mode flags; eval() makes forward() loss-only)
     def eval(self):

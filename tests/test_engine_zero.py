@@ -183,3 +183,83 @@ def test_mics_indivisible_raises(eight_devices):
     cfg["zero_optimization"]["mics_shard_size"] = 3
     with pytest.raises(ValueError, match="mics_shard_size"):
         deepspeed_tpu.initialize(model=tiny_model(), config=cfg)
+
+
+def test_engine_api_surface_parity(eight_devices):
+    """Reference public engine methods used by integrations:
+    module_state_dict/load_module_state_dict round-trip (sharded state),
+    set_train_batch_size adjusts gas, get_mom, data post-process hook,
+    save_fp16_model alias, destroy."""
+    import tempfile
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                            intermediate_size=64, max_seq_len=32, dtype=jnp.float32,
+                            attention_impl="reference")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(cfg), config={
+        "train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "betas": [0.8, 0.95]}},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 10**9, "tpu": {"mesh": {"data": 8}}})
+
+    sd = engine.module_state_dict()
+    w0 = np.array(sd["blocks"]["wq"])
+    sd["blocks"]["wq"] = sd["blocks"]["wq"] + 1.0
+    engine.load_module_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(engine.module_state_dict()["blocks"]["wq"]), w0 + 1.0,
+                               atol=1e-6)
+    with pytest.raises(ValueError, match="structure mismatch"):
+        engine.load_module_state_dict({"nope": np.zeros(3)})
+
+    assert engine.get_mom() == [[0.8, 0.95]]
+    engine.set_train_batch_size(32)  # gas 1 -> 2
+    assert engine.gradient_accumulation_steps() == 2
+    with pytest.raises(ValueError, match="divisible"):
+        engine.set_train_batch_size(17)
+
+    seen = []
+    engine.set_data_post_process_func(lambda b: (seen.append(1), b)[1])
+    rng = np.random.default_rng(0)
+    loss = engine.train_batch({"input_ids": rng.integers(0, 64, size=(32, 32), dtype=np.int32)})
+    assert np.isfinite(float(loss)) and seen == [1]
+
+    with tempfile.TemporaryDirectory() as d:
+        assert engine.save_fp16_model(d)
+
+    engine.destroy()
+    assert engine.state is None
+    groups.reset()
+
+
+def test_load_module_state_dict_resets_offload_masters(eight_devices, tmp_path):
+    """ZeRO-Offload: load_module_state_dict must overwrite the host fp32
+    masters — otherwise the next step resurrects the pre-load weights."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                            intermediate_size=64, max_seq_len=32, dtype=jnp.float32,
+                            attention_impl="reference")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(cfg), config={
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 0.0}},  # lr 0: step is identity
+        "zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}},
+        "steps_per_print": 10**9, "tpu": {"mesh": {"data": 8}}})
+    sd = engine.module_state_dict()
+    sd = jax.tree_util.tree_map(lambda a: a + 1.0, sd)
+    engine.load_module_state_dict(sd)
+    rng = np.random.default_rng(0)
+    engine.train_batch({"input_ids": rng.integers(0, 64, size=(8, 32), dtype=np.int32)})
+    after = engine.module_state_dict()
+    # with lr=0 the loaded (+1) weights must survive the host-optimizer step
+    np.testing.assert_allclose(np.asarray(after["blocks"]["wq"]),
+                               np.asarray(sd["blocks"]["wq"]), atol=1e-5)
+    groups.reset()
