@@ -5,3 +5,4 @@ from .config_v2 import DSStateManagerConfig, RaggedInferenceEngineConfig
 from .engine_v2 import InferenceEngineV2
 from .engine_factory import build_engine, build_model_engine
 from .scheduling_utils import SchedulingError, SchedulingResult
+from .scheduler import DynamicSplitFuseScheduler

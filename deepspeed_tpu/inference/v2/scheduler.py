@@ -1,0 +1,216 @@
+"""Dynamic SplitFuse serving scheduler.
+
+The policy layer the reference keeps in MII above ``InferenceEngineV2``
+(engine mechanism: ``put``/``decode``/``can_schedule``/``flush``; policy:
+the DeepSpeed-FastGen Dynamic SplitFuse composition,
+``blogs/deepspeed-fastgen/README.md`` "Dynamic SplitFuse" — every forward
+carries a bounded token budget filled with all runnable DECODE steps first,
+then chunks of pending prefills, so long prompts never stall decode latency
+and the batch shape stays in a narrow, compiled-bucket-friendly band).
+
+Design points beyond the happy path:
+- admission RESERVES capacity for a request's whole lifetime (full prompt +
+  max_new_tokens worth of KV blocks), so a request that is admitted can
+  always run to completion — no mid-run KV exhaustion can strand the batch;
+- when the queue drains to pure decode, the loop switches to the engine's
+  multi-step on-device ``decode`` (one host round-trip per horizon instead
+  of per token — the steady-state fast path);
+- nothing is dropped silently: un-runnable work raises with the stalled
+  uids named, and partial generations stay readable via ``results``.
+"""
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .scheduling_utils import SchedulingResult
+
+
+class _Request:
+    __slots__ = ("uid", "prompt", "max_new_tokens", "eos_token_id", "fed", "generated", "done")
+
+    def __init__(self, uid, prompt, max_new_tokens, eos_token_id):
+        self.uid = uid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.fed = 0          # prompt tokens already given to the engine
+        self.generated: List[int] = []
+        self.done = False
+
+    @property
+    def prefilling(self) -> bool:
+        return self.fed < self.prompt.size
+
+    @property
+    def total_tokens(self) -> int:
+        return self.prompt.size + self.max_new_tokens
+
+
+class DynamicSplitFuseScheduler:
+    """Continuous-batching loop over :class:`InferenceEngineV2`.
+
+    ``token_budget`` bounds the tokens per forward (clamped to the engine's
+    ``max_ragged_batch_size``; must be positive). ``submit`` enqueues
+    requests; ``step`` runs one composed forward; ``run`` drives to
+    completion and returns ``{uid: generated token list}``.
+    """
+
+    DECODE_HORIZON = 32  # max on-device steps per multi-step decode call
+
+    def __init__(self, engine, token_budget: Optional[int] = None):
+        self.engine = engine
+        sm = engine.config.state_manager
+        if token_budget is None:
+            token_budget = sm.max_ragged_batch_size
+        if token_budget <= 0:
+            raise ValueError(f"token_budget must be positive, got {token_budget}")
+        self.token_budget = min(int(token_budget), sm.max_ragged_batch_size)
+        self.max_seqs = sm.max_ragged_sequence_count
+        self._pending: List[_Request] = []   # not yet tracked by the engine
+        self._active: Dict[int, _Request] = {}
+        self._results: Dict[int, List[int]] = {}
+        self._reserved_blocks = 0  # KV blocks promised to active requests
+
+    def submit(self, uid: int, prompt, max_new_tokens: int = 32, eos_token_id=None):
+        if uid in self._active or any(r.uid == uid for r in self._pending):
+            raise ValueError(f"uid {uid} already queued")
+        req = _Request(uid, prompt, max_new_tokens, eos_token_id)
+        if req.total_tokens > self.engine._max_context:
+            raise ValueError(f"uid {uid}: prompt {req.prompt.size} + max_new_tokens "
+                             f"{req.max_new_tokens} exceeds the engine max_context "
+                             f"{self.engine._max_context}")
+        self._pending.append(req)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._pending or self._active)
+
+    @property
+    def results(self) -> Dict[int, List[int]]:
+        """Generations so far — finished requests complete, active partial."""
+        out = dict(self._results)
+        for uid, req in self._active.items():
+            out[uid] = list(req.generated)
+        return out
+
+    def _blocks_for(self, n_tokens: int) -> int:
+        bs = self.engine.config.kv_block_size
+        return -(-n_tokens // bs)
+
+    def _finish(self, req: _Request):
+        req.done = True
+        self.engine.flush(req.uid)
+        self._reserved_blocks -= self._blocks_for(req.total_tokens)
+        self._active.pop(req.uid, None)
+        self._results[req.uid] = req.generated
+
+    def _try_admit(self, req: _Request, batch_seqs: int, batch_tokens: int) -> bool:
+        """Admission reserves the request's WHOLE lifetime: full-prompt KV
+        blocks + generation headroom, so an admitted request can always run
+        to completion regardless of later arrivals."""
+        if batch_seqs >= self.max_seqs:
+            return False
+        need = self._blocks_for(req.total_tokens)
+        if self._reserved_blocks + need > self.engine.free_blocks + self._used_blocks():
+            return False
+        first = min(self.token_budget - batch_tokens, req.prompt.size)
+        if first <= 0:
+            return False
+        if self.engine.can_schedule([req.uid], [first]) is not SchedulingResult.Success:
+            return False
+        self._reserved_blocks += need
+        self._active[req.uid] = req
+        return True
+
+    def _used_blocks(self) -> int:
+        sm = self.engine.state_manager
+        return sum(s.cur_allocated_blocks for s in (sm.get_sequence(u) for u in self._active)
+                   if s is not None)
+
+    def _append_token(self, req: _Request, tok: int) -> None:
+        req.generated.append(tok)
+        hit_eos = req.eos_token_id is not None and tok == req.eos_token_id
+        if len(req.generated) >= req.max_new_tokens or hit_eos:
+            self._finish(req)
+
+    def _decode_burst(self, decoding: List[_Request]) -> int:
+        """Pure-decode steady state: the engine's multi-step on-device scan
+        (one host round-trip per horizon instead of per token)."""
+        horizon = min(min(r.max_new_tokens - len(r.generated) for r in decoding),
+                      self.DECODE_HORIZON)
+        uids = [r.uid for r in decoding]
+        first = [np.asarray([r.generated[-1]], np.int32) for r in decoding]
+        toks = np.asarray(self.engine.decode(uids, first, horizon))  # [S, horizon]
+        for req, row in zip(decoding, toks):
+            for tok in row.tolist():
+                self._append_token(req, int(tok))
+                if req.done:
+                    break  # eos/max_new inside the burst: drop the tail
+        return len(decoding) * horizon
+
+    def step(self) -> int:
+        """Compose and run ONE engine call: all runnable decodes first, then
+        prefill chunks up to the token budget. Returns tokens processed
+        (0 = nothing runnable)."""
+        decoding = [r for r in self._active.values() if not r.prefilling and not r.done]
+        prefilling = [r for r in self._active.values() if r.prefilling]
+        if decoding and not prefilling and not self._pending and len(decoding) <= self.max_seqs:
+            return self._decode_burst(decoding)
+
+        uids: List[int] = []
+        chunks: List[np.ndarray] = []
+        budget = self.token_budget
+
+        for req in decoding[:min(budget, self.max_seqs)]:
+            uids.append(req.uid)
+            chunks.append(np.asarray([req.generated[-1]], np.int32))
+            budget -= 1
+
+        def add_prefill(req):
+            nonlocal budget
+            if budget <= 0 or len(uids) >= self.max_seqs:
+                return False
+            take = min(budget, req.prompt.size - req.fed)
+            uids.append(req.uid)
+            chunks.append(req.prompt[req.fed:req.fed + take])
+            req.fed += take
+            budget -= take
+            return True
+
+        for req in prefilling:
+            add_prefill(req)
+        while self._pending and budget > 0 and len(uids) < self.max_seqs:
+            if not self._try_admit(self._pending[0], len(uids), self.token_budget - budget):
+                break
+            add_prefill(self._pending.pop(0))
+
+        if not uids:
+            return 0
+        toks = self.engine.put(uids, chunks, sample="greedy")
+        n = sum(c.size for c in chunks)
+        for uid, tok in zip(uids, np.asarray(toks).reshape(-1)):
+            req = self._active[uid]
+            if req.prefilling:
+                continue  # mid-prompt chunk: the "next token" is still prompt
+            self._append_token(req, int(tok))
+        return n
+
+    def run(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
+        """Drive to completion. Raises (with partial generations preserved in
+        ``results``) if work remains but nothing is runnable — silent drops
+        would hide stalled requests."""
+        steps = 0
+        while self.has_work and steps < max_steps:
+            if self.step() == 0:
+                stalled = [r.uid for r in self._pending] + list(self._active)
+                raise RuntimeError(f"scheduler stalled with unrunnable requests {stalled}: "
+                                   "first pending request cannot be admitted (shrink it, raise "
+                                   "the KV pool, or drain active work); partial generations "
+                                   "remain in .results")
+            steps += 1
+        if self.has_work:
+            raise RuntimeError(f"max_steps={max_steps} exhausted with work remaining "
+                               f"({len(self._pending)} pending, {len(self._active)} active); "
+                               "partial generations remain in .results")
+        return dict(self._results)

@@ -359,3 +359,71 @@ def test_quantize_covers_moe_expert_weights():
     back = qp["blocks"]["moe_wi"].astype(jnp.float32)
     ref = np.asarray(params["blocks"]["moe_wi"], np.float32)
     assert np.abs(np.asarray(back) - ref).max() <= np.abs(ref).max() / 100
+
+
+def test_dynamic_splitfuse_scheduler():
+    """Continuous-batching policy loop: decodes compose with prefill chunks
+    under a token budget; chunked prefill produces the SAME greedy tokens as
+    feeding each whole prompt at once (identical KV), and eos/max_new_tokens
+    terminate and free KV."""
+    from deepspeed_tpu.inference.v2 import DynamicSplitFuseScheduler
+
+    rng = np.random.default_rng(0)
+    prompts = {1: rng.integers(0, 128, size=40, dtype=np.int32),
+               2: rng.integers(0, 128, size=9, dtype=np.int32),
+               3: rng.integers(0, 128, size=23, dtype=np.int32)}
+
+    eng = _tiny_engine(max_tracked_sequences=8, max_ragged_batch_size=64,
+                       max_ragged_sequence_count=4, max_context=64)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=16)  # forces chunked prefill
+    for uid, p in prompts.items():
+        sched.submit(uid, p, max_new_tokens=5)
+    out = sched.run()
+    assert set(out) == {1, 2, 3}
+    assert all(len(v) == 5 for v in out.values())
+    assert eng.state_manager.n_tracked_sequences == 0  # all flushed
+
+    # oracle: per-sequence whole-prompt prefill + stepwise decode
+    eng2 = _tiny_engine(max_tracked_sequences=8, max_ragged_batch_size=64,
+                        max_ragged_sequence_count=4, max_context=64)
+    for uid, p in prompts.items():
+        toks = []
+        tok = int(np.asarray(eng2.put([uid], [p], sample="greedy"))[0])
+        toks.append(tok)
+        for _ in range(4):
+            tok = int(np.asarray(eng2.put([uid], [np.asarray([tok], np.int32)],
+                                          sample="greedy"))[0])
+            toks.append(tok)
+        assert out[uid] == toks, f"uid {uid}: splitfuse {out[uid]} != sequential {toks}"
+        eng2.flush(uid)
+
+    # eos termination
+    eng3 = _tiny_engine()
+    s3 = DynamicSplitFuseScheduler(eng3, token_budget=32)
+    s3.submit(7, prompts[2], max_new_tokens=50, eos_token_id=out[2][0])
+    got = s3.run()
+    assert got[7] == [out[2][0]]  # stopped at the first (eos) token
+
+
+def test_splitfuse_scheduler_rejections_and_stall():
+    """Un-runnable work is loud, not dropped: oversize submissions are
+    rejected up front, bad budgets raise, and a stalled queue raises with
+    partial results preserved."""
+    from deepspeed_tpu.inference.v2 import DynamicSplitFuseScheduler
+
+    eng = _tiny_engine(max_tracked_sequences=2, max_ragged_batch_size=32,
+                       max_ragged_sequence_count=2, max_context=64)
+    with pytest.raises(ValueError, match="positive"):
+        DynamicSplitFuseScheduler(eng, token_budget=0)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=16)
+    with pytest.raises(ValueError, match="max_context"):
+        sched.submit(1, np.zeros(60, np.int32), max_new_tokens=10)  # 70 > 64
+
+    # KV-pool reservation: engine has 32 blocks of 8 = 256 slots; two
+    # 64-token lifetimes fit, a third concurrent one must wait (admission
+    # reserves full lifetimes), and everything still completes.
+    rng = np.random.default_rng(1)
+    for uid in (1, 2, 3):
+        sched.submit(uid, rng.integers(0, 128, size=20, dtype=np.int32), max_new_tokens=3)
+    out = sched.run()
+    assert set(out) == {1, 2, 3} and all(len(v) == 3 for v in out.values())
