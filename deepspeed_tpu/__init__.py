@@ -15,8 +15,18 @@ __git_branch__ = None
 from .accelerator import get_accelerator, set_accelerator
 from . import comm as _comm_pkg
 from .comm import comm as dist
-from .runtime.config import DeepSpeedConfig
+from .comm.comm import init_distributed
+from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 from .runtime.engine import DeepSpeedEngine
+from .runtime.hybrid_engine import DeepSpeedHybridEngine
+from .runtime.pipe.module import PipelineModule
+from .runtime import zero
+from .runtime.activation_checkpointing import checkpointing
+from .inference.engine import InferenceEngine
+from .inference.config import DeepSpeedInferenceConfig
+from .module_inject import replace_transformer_layer, revert_transformer_layer
+from . import ops
+from . import module_inject
 from .parallel import MeshConfig, groups
 from .utils.logging import logger, log_dist
 
@@ -62,8 +72,6 @@ def initialize(args=None,
     # DeepSpeedEngine / PipelineEngine / DeepSpeedHybridEngine)
     engine_cls = DeepSpeedEngine
     if ds_config.hybrid_engine_config.enabled:
-        from .runtime.hybrid_engine import DeepSpeedHybridEngine
-
         engine_cls = DeepSpeedHybridEngine
     engine = engine_cls(model=model,
                         config=ds_config,
@@ -97,9 +105,6 @@ class _FunctionalModel:
 def init_inference(model=None, config=None, **kwargs):
     """Reference ``deepspeed.init_inference`` (:273): build an InferenceEngine
     around a model with TP sharding and fused kernels."""
-    from .inference.engine import InferenceEngine
-    from .inference.config import DeepSpeedInferenceConfig
-
     if config is None:
         config = kwargs
     ds_config = config if isinstance(config, DeepSpeedInferenceConfig) else DeepSpeedInferenceConfig(**(config or {}))

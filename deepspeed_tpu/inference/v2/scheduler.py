@@ -76,6 +76,11 @@ class DynamicSplitFuseScheduler:
         if uid in self._active or any(r.uid == uid for r in self._pending):
             raise ValueError(f"uid {uid} already queued")
         req = _Request(uid, prompt, max_new_tokens, eos_token_id)
+        if req.prompt.size == 0:
+            raise ValueError(f"uid {uid}: empty prompt")
+        if req.max_new_tokens <= 0:
+            raise ValueError(f"uid {uid}: max_new_tokens must be positive, "
+                             f"got {req.max_new_tokens}")
         if req.total_tokens > self.engine._max_context:
             raise ValueError(f"uid {uid}: prompt {req.prompt.size} + max_new_tokens "
                              f"{req.max_new_tokens} exceeds the engine max_context "
@@ -136,9 +141,13 @@ class DynamicSplitFuseScheduler:
 
     def _decode_burst(self, decoding: List[_Request]) -> int:
         """Pure-decode steady state: the engine's multi-step on-device scan
-        (one host round-trip per horizon instead of per token)."""
+        (one host round-trip per horizon instead of per token). The horizon
+        quantizes DOWN to a power of two: the engine compiles one program
+        per exact n_steps, so free-running horizons would pay a fresh XLA
+        compile for every distinct remaining-token count."""
         horizon = min(min(r.max_new_tokens - len(r.generated) for r in decoding),
                       self.DECODE_HORIZON)
+        horizon = 1 << (horizon.bit_length() - 1)  # 1,2,4,...,32: <=6 programs per bucket
         uids = [r.uid for r in decoding]
         first = [np.asarray([r.generated[-1]], np.int32) for r in decoding]
         toks = np.asarray(self.engine.decode(uids, first, horizon))  # [S, horizon]

@@ -1,0 +1,7 @@
+"""ZeRO subpackage surface (reference ``deepspeed.runtime.zero`` /
+``deepspeed.zero``): sharding-spec policies, offload, ZeRO++ config,
+TiledLinear analogs."""
+
+from .partition import ZeroShardingPolicy
+from .config import DeepSpeedZeroConfig
+from .tiling import tiled_linear, memory_efficient_linear

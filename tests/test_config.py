@@ -85,3 +85,18 @@ def test_scheduler_optimizer_blocks():
     assert cfg.optimizer_name == "adamw"
     assert cfg.optimizer_params["lr"] == 3e-4
     assert cfg.scheduler_name == "WarmupLR"
+
+
+def test_top_level_package_surface():
+    """Reference `import deepspeed` surface: the names integrations touch
+    must exist on the package root (deepspeed/__init__.py parity)."""
+    import deepspeed_tpu as ds
+
+    for name in ("initialize", "init_inference", "init_distributed", "add_config_arguments",
+                 "DeepSpeedEngine", "DeepSpeedHybridEngine", "InferenceEngine",
+                 "DeepSpeedInferenceConfig", "DeepSpeedConfig", "DeepSpeedConfigError",
+                 "PipelineModule", "zero", "checkpointing", "replace_transformer_layer",
+                 "revert_transformer_layer", "ops", "module_inject", "dist"):
+        assert hasattr(ds, name), name
+    assert ds.zero.ZeroShardingPolicy is not None
+    assert callable(ds.checkpointing.checkpoint)
