@@ -28,10 +28,22 @@ Attention runs the Pallas flash kernel (fwd+bwd); the remat policy saves the
 attention context (`save_only_these_names(attn_out)`) so the backward never
 recomputes the flash kernel; gradient accumulation amortizes the
 HBM-bandwidth-bound Adam step over 16 microbatches.
+
+Process layout (round-3 lesson: `BENCH_r03.json` died rc=1 on an unguarded
+``jax.devices()`` when the TPU plugin failed to initialize, forfeiting the
+round's perf evidence): ``python bench.py`` runs a SUPERVISOR that never
+imports jax itself. It probes the backend in a subprocess with bounded
+retries, runs the real bench in a child process with a timeout, falls back
+to ``JAX_PLATFORMS=cpu`` with an explicit ``"on_tpu": false`` disclosure if
+the TPU is truly unreachable, and — even if every child dies — emits a
+parseable final JSON line and exits 0.
 """
 
 import gc
 import json
+import os
+import subprocess
+import sys
 import time
 
 
@@ -137,10 +149,15 @@ def bench_serving(on_tpu: bool):
     return out
 
 
-def main():
-    import os
-
+def run_bench():
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the image's sitecustomize registers the axon PJRT plugin and sets
+        # jax_platforms="axon,cpu" at the CONFIG level, which beats the env
+        # var — without this the "CPU fallback" child still initializes the
+        # (possibly hung) TPU tunnel (the __graft_entry__ round-1 lesson)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
@@ -153,7 +170,19 @@ def main():
     except Exception:
         pass
 
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    try:
+        on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    except Exception as e:  # backend init died mid-child: disclose, run CPU
+        print(f"# WARNING: jax.devices() failed ({type(e).__name__}); forcing CPU", flush=True)
+        # config-level update + backend-cache clear — the env var alone is
+        # beaten by the sitecustomize's jax_platforms='axon,cpu' config
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge
+
+        xla_bridge._clear_backends()
+        on_tpu = False
+    tpu_error = os.environ.get("DS_TPU_BENCH_TPU_ERROR", "")
     import deepspeed_tpu
     from deepspeed_tpu.models import TransformerConfig, TransformerLM
 
@@ -249,7 +278,7 @@ def main():
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
     mfu = tok_per_sec_per_chip * flops_per_token / peak
     mfu4 = gas4_tps * flops_per_token / peak
-    print(json.dumps({
+    line = {
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_per_chip, 1),
         "unit": "tokens/s/chip",
@@ -260,8 +289,92 @@ def main():
         # llama-arch model one v5e chip fits, against the same 54% bar
         "workload": f"{n_params/1e6:.0f}M llama-arch, seq {seq}, ZeRO-3, single v5e chip",
         "serving": {k: serving[k] for k in ("value", "ttft_p50_ms", "vs_baseline")},
-    }))
+        "on_tpu": on_tpu,
+    }
+    if not on_tpu:
+        line["tpu_unavailable_reason"] = tpu_error or "no TPU device visible"
+    print(json.dumps(line))
+
+
+def _run_child(extra_env, timeout):
+    """Run this script in child mode; returns (rc, stdout, stderr)."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["DS_TPU_BENCH_CHILD"] = "1"
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              capture_output=True, text=True, timeout=timeout, env=env)
+        return proc.returncode, proc.stdout or "", proc.stderr or ""
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        return -9, out, err + f"\n[supervisor] child timed out after {timeout}s"
+
+
+def _forward(stdout):
+    """Re-emit a child's JSON/comment lines; True iff a parseable metric line
+    with a 'metric' key was found."""
+    ok = False
+    for ln in stdout.splitlines():
+        ln = ln.rstrip()
+        if ln.startswith("{"):
+            try:
+                ok = "metric" in json.loads(ln) or ok
+            except ValueError:
+                continue
+            print(ln, flush=True)
+        elif ln.startswith("#"):
+            print(ln, flush=True)
+    return ok
+
+
+def supervise():
+    """Never exit nonzero, never leave the driver without a final JSON line."""
+    # 1) probe the TPU backend in a throwaway subprocess (bounded retries —
+    #    the round-3 outage may have been transient)
+    probe_src = ("import jax, json; d = jax.devices(); "
+                 "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))")
+    probe_timeout = int(os.environ.get("DS_TPU_BENCH_PROBE_TIMEOUT", "420"))
+    probe_attempts = int(os.environ.get("DS_TPU_BENCH_PROBE_ATTEMPTS", "3"))
+    tpu_ok, tpu_error = False, ""
+    for attempt in range(probe_attempts):
+        try:
+            proc = subprocess.run([sys.executable, "-c", probe_src], capture_output=True,
+                                  text=True, timeout=probe_timeout, env=dict(os.environ))
+            if proc.returncode == 0 and '"platform": "tpu"' in proc.stdout:
+                tpu_ok = True
+                break
+            tpu_error = (proc.stderr or proc.stdout).strip().splitlines()[-1:] or ["unknown"]
+            tpu_error = tpu_error[0][:300]
+        except subprocess.TimeoutExpired:
+            tpu_error = f"backend probe timed out after {probe_timeout}s"
+        print(f"# bench supervisor: TPU probe attempt {attempt + 1}/{probe_attempts} "
+              f"failed: {tpu_error}", flush=True)
+        if attempt + 1 < probe_attempts:  # no dead wait before the CPU fallback
+            time.sleep(20 * (attempt + 1))
+
+    # 2) real bench on the probed platform (one retry on TPU)
+    attempts = ([({}, 3000), ({}, 3000)] if tpu_ok else [])
+    cpu_reason = ("TPU bench child failed after successful probe" if tpu_ok
+                  else tpu_error or "TPU probe failed")
+    attempts.append(({"JAX_PLATFORMS": "cpu", "DS_TPU_BENCH_TPU_ERROR": cpu_reason}, 1500))
+    last_err = ""
+    for extra_env, timeout in attempts:
+        rc, out, err = _run_child(extra_env, timeout)
+        if rc == 0 and _forward(out):
+            return
+        last_err = (err.strip().splitlines() or ["?"])[-1][:300]
+        print(f"# bench supervisor: child rc={rc}: {last_err}", flush=True)
+
+    # 3) last resort: the driver still gets a parseable line, with the reason
+    print(json.dumps({"metric": "train_tokens_per_sec_per_chip", "value": 0.0,
+                      "unit": "tokens/s/chip", "vs_baseline": 0.0, "on_tpu": False,
+                      "error": f"all bench children failed; tpu: {tpu_error}; "
+                               f"last: {last_err}"}))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("DS_TPU_BENCH_CHILD") == "1":
+        run_bench()
+    else:
+        supervise()

@@ -20,6 +20,20 @@ class DSStateManagerConfig:
 
 
 @dataclass
+class ModulesConfig:
+    """Per-op implementation selection (reference ``modules/heuristics.py``
+    config surface). Each slot is ``"auto"`` (heuristic pick), a registered
+    implementation name, or ``{"name": ..., "implementation_config": {...}}``
+    — resolved through the interface registries in
+    ``modules/heuristics.build_modules`` at engine construction."""
+    attention: object = "auto"
+    linear: object = "auto"
+    embedding: object = "auto"
+    unembed: object = "auto"
+    norm: object = "auto"
+
+
+@dataclass
 class RaggedInferenceEngineConfig:
     tensor_parallel_degree: int = 1
     kv_block_size: int = 64
@@ -35,3 +49,5 @@ class RaggedInferenceEngineConfig:
     # weight-only int8 (per-output-channel scales): halves the decode weight
     # stream, which is the bandwidth-bound term at serving batch sizes
     quantize_weights: bool = False
+    # pluggable module layer: which implementation serves each op slot
+    modules: ModulesConfig = field(default_factory=ModulesConfig)

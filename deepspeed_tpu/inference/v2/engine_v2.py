@@ -33,12 +33,24 @@ class InferenceEngineV2:
         self.model_config = model.config
         mc, ic = self.model_config, self.config
 
+        if ic.use_pallas_kernels == "auto":
+            self._use_pallas = jax.default_backend() == "tpu"
+        else:
+            self._use_pallas = ic.use_pallas_kernels == "always"
+
+        # pluggable module layer (reference FastGen's DSModule registry +
+        # heuristics): config→implementation selection happens HERE, once;
+        # every compiled bucket traces through the same module set
+        from .modules.heuristics import build_modules
+
+        self._modules = build_modules(mc, ic, use_pallas=self._use_pallas)
+
         if params is None:
             params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
-        if self.config.quantize_weights:
-            from ..quantization import quantize_params_for_inference
-
-            params = quantize_params_for_inference(params)
+        for m in self._modules.values():
+            # one-time parameter-layout transforms (e.g. the int8 linear
+            # implementation quantizes the weight stream)
+            params = m.transform_params(params)
         self.params = params
 
         bs = ic.kv_block_size
@@ -66,10 +78,6 @@ class InferenceEngineV2:
             max_ragged_sequence_count=ic.state_manager.max_ragged_sequence_count,
             max_blocks_per_seq=self._max_blocks_per_seq, block_size=bs)
 
-        if ic.use_pallas_kernels == "auto":
-            self._use_pallas = jax.default_backend() == "tpu"
-        else:
-            self._use_pallas = ic.use_pallas_kernels == "always"
         self._compiled: Dict[Tuple[int, int, Optional[str]], object] = {}
         log_dist(
             f"InferenceEngineV2 ready: blocks={self.num_kv_blocks}x{bs} "
@@ -254,7 +262,7 @@ class InferenceEngineV2:
             from .ragged.ragged_wrapper import unpack_descriptors
 
             cfg, bs, use_pallas = self.model_config, self.config.kv_block_size, self._use_pallas
-            max_blocks = self._max_blocks_per_seq
+            max_blocks, modules = self._max_blocks_per_seq, self._modules
 
             def fwd(params, packed, pos0, k_pool, v_pool):
                 token_ids, seq_idx, _pos, valid, tables, last_idx = unpack_descriptors(
@@ -264,7 +272,8 @@ class InferenceEngineV2:
                     toks, kp, vp = carry
                     pos = pos0 + t
                     logits, kp, vp = ragged_forward(cfg, bs, params, toks, seq_idx, pos, valid,
-                                                    tables, last_idx, kp, vp, use_pallas=use_pallas)
+                                                    tables, last_idx, kp, vp, use_pallas=use_pallas,
+                                                    modules=modules)
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     return (nxt, kp, vp), nxt
 
@@ -296,7 +305,7 @@ class InferenceEngineV2:
             from .ragged.ragged_wrapper import unpack_descriptors
 
             cfg, bs, use_pallas = self.model_config, self.config.kv_block_size, self._use_pallas
-            max_blocks = self._max_blocks_per_seq
+            max_blocks, modules = self._max_blocks_per_seq, self._modules
             if sample not in (None, "greedy"):
                 raise ValueError(f"unsupported sample mode {sample!r}: None | 'greedy'")
 
@@ -305,7 +314,7 @@ class InferenceEngineV2:
                     packed, t_bucket, s_bucket, max_blocks)
                 logits, k_pool, v_pool = ragged_forward(cfg, bs, params, token_ids, seq_idx, pos, valid,
                                                         tables, last_idx, k_pool, v_pool,
-                                                        use_pallas=use_pallas)
+                                                        use_pallas=use_pallas, modules=modules)
                 out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
                 return out, k_pool, v_pool
 

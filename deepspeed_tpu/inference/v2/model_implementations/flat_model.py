@@ -29,13 +29,12 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from ....models.transformer import (TransformerConfig, _norm, alibi_slopes, apply_rope,
-                                    mlp_activation, rope_table)
+from ....models.transformer import TransformerConfig, apply_rope, mlp_activation, rope_table
 
 
 def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, Any], token_ids, seq_idx, pos, valid,
                    block_tables, last_idx, k_pool, v_pool, use_pallas: bool = False,
-                   unroll: bool = True):
+                   unroll: bool = True, modules: Dict[str, Any] = None):
     """Returns (last-token logits [S_pad, V], k_pool, v_pool).
 
     token_ids/seq_idx/pos/valid: [T_pad]; block_tables: [S_pad, max_blocks];
@@ -48,26 +47,31 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
     ~1.5x. Serving compiles each shape bucket once (and caches), so the
     extra trace/compile time only pays at warmup. Models deeper than 48
     layers fall back to scan to bound compile time.
+
+    ``modules``: the pluggable module set (``modules/heuristics.build_modules``
+    — attention / linear / embedding / unembed / norm slots, reference
+    FastGen's DSModule layer). None builds the auto set from ``cfg`` and
+    ``use_pallas``, preserving the pre-registry call surface.
     """
+    if modules is None:
+        from ..config_v2 import RaggedInferenceEngineConfig
+        from ..modules.heuristics import build_modules
+
+        ec = RaggedInferenceEngineConfig()
+        ec.kv_block_size = block_size
+        modules = build_modules(cfg, ec, use_pallas=use_pallas)
+    attention, linear = modules["attention"], modules["linear"]
+    embedding, unembed, pre_norm = modules["embedding"], modules["unembed"], modules["norm"]
     if getattr(cfg, "sparse_attention", None) is not None:
         # same policy as forward_with_cache: dense paged decode would
         # silently mismatch a sparse-trained model's attention distribution
         raise NotImplementedError("sparse_attention serving is not implemented on the ragged "
                                   "plane; unset sparse_attention for inference")
-    dt = cfg.dtype
     T = token_ids.shape[0]
-    S, max_blocks = block_tables.shape
-    C = max_blocks * block_size
     nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    g = nq // nkv
     pool_len = k_pool.shape[1]
 
-    x = params["embed"]["embedding"].astype(dt)[token_ids]  # [T, H]
-    if cfg.positions == "learned":
-        x = x + params["pos_embed"]["embedding"].astype(dt)[pos]
-    if cfg.embed_layernorm:
-        en = params["embed_norm"]
-        x = _norm(x, en["scale"], en.get("bias"), cfg.norm, cfg.norm_eps)
+    x = embedding(params, token_ids, pos)  # [T, H]
     sin, cos = rope_table(cfg, pos) if cfg.positions == "rotary" else (None, None)
 
     # flat KV slot of each token; padding tokens dropped via OOB scatter.
@@ -83,14 +87,11 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
     slot = block_tables[seq_idx, pos // block_size] * block_size + pos % block_size
 
     def layer(x, blk, l, k_flat, v_flat):
-        h1 = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
-        q = jnp.einsum("th,hd->td", h1, blk["wq"].astype(dt)).reshape(T, nq, d)
-        k = jnp.einsum("th,hd->td", h1, blk["wk"].astype(dt)).reshape(T, nkv, d)
-        v = jnp.einsum("th,hd->td", h1, blk["wv"].astype(dt)).reshape(T, nkv, d)
-        if cfg.use_bias:
-            q = q + blk["bq"].astype(dt).reshape(nq, d)
-            k = k + blk["bk"].astype(dt).reshape(nkv, d)
-            v = v + blk["bv"].astype(dt).reshape(nkv, d)
+        h1 = pre_norm(x, blk["ln1_scale"], blk.get("ln1_bias"))
+        bias = (lambda n: blk[n]) if cfg.use_bias else (lambda n: None)
+        q = linear(h1, blk["wq"], bias("bq")).reshape(T, nq, d)
+        k = linear(h1, blk["wk"], bias("bk")).reshape(T, nkv, d)
+        v = linear(h1, blk["wv"], bias("bv")).reshape(T, nkv, d)
         if cfg.positions == "rotary":
             q = apply_rope(q[None], sin, cos)[0]
             k = apply_rope(k[None], sin, cos)[0]
@@ -101,40 +102,24 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
         k_flat = k_flat.at[slot_l].set(k.astype(k_flat.dtype), mode="drop")
         v_flat = v_flat.at[slot_l].set(v.astype(v_flat.dtype), mode="drop")
 
-        from ....ops.pallas.paged_attention import paged_attention, paged_attention_reference
-
         tables_l = block_tables + l * NB  # layer l's blocks in the flat pool
-        alibi = alibi_slopes(nq) if cfg.positions == "alibi" else None
-        if use_pallas:
-            ctx = paged_attention(q, k_flat, v_flat, tables_l, seq_idx, pos, block_size,
-                                  window=cfg.sliding_window, alibi=alibi)
-        else:
-            ctx = paged_attention_reference(q, k_flat, v_flat, tables_l, seq_idx, pos,
-                                            block_size, window=cfg.sliding_window, alibi=alibi)
+        ctx = attention(q, k_flat, v_flat, tables_l, seq_idx, pos)
 
-        attn_out = jnp.einsum("td,dh->th", ctx.reshape(T, nq * d), blk["wo"].astype(dt))
-        if cfg.use_bias:
-            attn_out = attn_out + blk["bo"].astype(dt)
+        attn_out = linear(ctx.reshape(T, nq * d), blk["wo"], bias("bo"))
 
         def mlp(h):
-            up = jnp.einsum("th,hf->tf", h, blk["w_up"].astype(dt))
-            if cfg.use_bias:
-                up = up + blk["b_up"].astype(dt)
+            up = linear(h, blk["w_up"], bias("b_up"))
             if cfg.mlp == "swiglu":
-                act = mlp_activation(cfg, up, jnp.einsum("th,hf->tf", h, blk["w_gate"].astype(dt)))
+                act = mlp_activation(cfg, up, linear(h, blk["w_gate"], None))
             else:
                 act = mlp_activation(cfg, up)
-            down = jnp.einsum("tf,fh->th", act, blk["w_down"].astype(dt))
-            if cfg.use_bias:
-                down = down + blk["b_down"].astype(dt)
-            return down
+            return linear(act, blk["w_down"], bias("b_down"))
 
         if cfg.parallel_residual:  # GPT-J / NeoX / Falcon
-            h2 = h1 if cfg.shared_ln else _norm(x, blk["ln2_scale"], blk.get("ln2_bias"),
-                                                cfg.norm, cfg.norm_eps)
+            h2 = h1 if cfg.shared_ln else pre_norm(x, blk["ln2_scale"], blk.get("ln2_bias"))
             return x + attn_out + mlp(h2), k_flat, v_flat
         x = x + attn_out
-        h2 = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        h2 = pre_norm(x, blk["ln2_scale"], blk.get("ln2_bias"))
         return x + mlp(h2), k_flat, v_flat
 
     k_flat = k_pool.reshape(flat_len, nkv, d)
@@ -155,12 +140,6 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
     k_pool = k_flat.reshape(L, pool_len, nkv, d)
     v_pool = v_flat.reshape(L, pool_len, nkv, d)
 
-    h = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
-    h_last = h[last_idx]  # [S, H] — logits_gather: unembed only last tokens
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("sh,vh->sv", h_last, params["embed"]["embedding"].astype(dt))
-    else:
-        logits = jnp.einsum("sh,hv->sv", h_last, params["lm_head"]["kernel"].astype(dt))
-        if "bias" in params["lm_head"]:
-            logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
-    return logits.astype(jnp.float32), k_pool, v_pool
+    # logits_gather semantics: final norm + unembed only each sequence's
+    # last token, through the pluggable unembed module
+    return unembed(params, x, last_idx), k_pool, v_pool

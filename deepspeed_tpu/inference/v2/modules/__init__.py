@@ -1,0 +1,28 @@
+"""Pluggable inference module layer (reference
+``deepspeed/inference/v2/modules/`` — ``ds_module.py`` /
+``module_registry.py`` / ``heuristics.py`` / ``interfaces/`` /
+``implementations/``): the config→implementation selection point where an
+alternative attention/linear/embedding/unembed/MoE kernel can be swapped
+per-op without touching the engine."""
+
+from .configs import (DSEmbeddingsConfig, DSLinearConfig, DSMoEConfig, DSNormConfig,
+                      DSSelfAttentionConfig, DSUnembedConfig)
+from .ds_module import DSModuleBase, DSModuleConfig
+from .heuristics import (build_modules, instantiate_attention, instantiate_embed,
+                         instantiate_linear, instantiate_pre_norm, instantiate_unembed)
+from .interfaces import (DSEmbeddingBase, DSEmbeddingRegistry, DSLinearBase, DSLinearRegistry,
+                         DSMoEBase, DSMoERegistry, DSPreNormBase, DSPreNormRegistry,
+                         DSSelfAttentionBase, DSSelfAttentionRegistry, DSUnembedBase,
+                         DSUnembedRegistry)
+from .module_registry import ConfigBundle, DSModuleRegistryBase
+
+__all__ = [
+    "DSModuleBase", "DSModuleConfig", "ConfigBundle", "DSModuleRegistryBase",
+    "DSSelfAttentionConfig", "DSLinearConfig", "DSEmbeddingsConfig", "DSUnembedConfig",
+    "DSNormConfig", "DSMoEConfig",
+    "DSSelfAttentionBase", "DSSelfAttentionRegistry", "DSLinearBase", "DSLinearRegistry",
+    "DSEmbeddingBase", "DSEmbeddingRegistry", "DSUnembedBase", "DSUnembedRegistry",
+    "DSPreNormBase", "DSPreNormRegistry", "DSMoEBase", "DSMoERegistry",
+    "build_modules", "instantiate_attention", "instantiate_linear", "instantiate_embed",
+    "instantiate_unembed", "instantiate_pre_norm",
+]

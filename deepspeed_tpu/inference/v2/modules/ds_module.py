@@ -1,0 +1,65 @@
+"""DSModule base — the unit of FastGen extensibility.
+
+Analog of the reference ``inference/v2/modules/ds_module.py:19``
+(``DSModuleBase``: ``name()`` / ``config_class()`` / ``supports_config()``),
+re-designed for JAX: a module is a lightweight *host-side* object built once
+at engine construction (outside ``jit``) whose ``__call__`` is pure traced
+code. Implementations therefore carry no parameters of their own — params
+stay in the engine's pytree and flow through the call — and swapping an
+implementation never changes the compiled program's signature, only its body.
+"""
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Type
+
+
+@dataclass
+class DSModuleConfig:
+    """Base class for per-interface module configs (reference
+    ``ds_module.py:14``). Subclasses are plain dataclasses: everything a
+    module needs to trace its forward must be here or in the
+    ``implementation_config`` dict — never read from globals at trace time."""
+
+
+class DSModuleBase(ABC):
+    """Base class for all inference modules. Only abstract functionality
+    interfaces (attention / linear / embedding / ...) inherit directly;
+    concrete implementations inherit from those interfaces and are looked up
+    by ``name()`` through their interface's registry."""
+
+    @staticmethod
+    @abstractmethod
+    def name() -> str:
+        """Memorable, human-readable key used in inference configurations."""
+
+    @staticmethod
+    @abstractmethod
+    def config_class() -> Type[DSModuleConfig]:
+        """The config dataclass this interface consumes."""
+
+    @staticmethod
+    @abstractmethod
+    def supports_config(config: DSModuleConfig) -> bool:
+        """Whether this implementation can be instantiated for ``config``
+        (static feasibility only — device availability is the heuristics
+        layer's concern)."""
+
+    def __init__(self, config: DSModuleConfig,
+                 implementation_config: Optional[Dict[str, Any]] = None) -> None:
+        self._config = config
+        self._implementation_config = dict(implementation_config or {})
+
+    @property
+    def config(self):
+        return self._config
+
+    @property
+    def implementation_config(self) -> Dict[str, Any]:
+        return self._implementation_config
+
+    def transform_params(self, params):
+        """Optional one-time parameter-layout transform applied at engine
+        build (reference's ``transform_param`` hooks on the module
+        interfaces). Default: identity."""
+        return params

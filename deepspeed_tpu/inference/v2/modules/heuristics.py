@@ -1,0 +1,88 @@
+"""Config → implementation selection (reference
+``inference/v2/modules/heuristics.py``).
+
+``build_modules`` is the single point where an engine decides which concrete
+implementation serves each functionality slot. Every slot accepts either
+``"auto"`` (policy below), an implementation name, or a
+``{"name": ..., "implementation_config": {...}}`` dict; the chosen bundle
+goes through the interface registry so third-party implementations
+registered with ``@<Interface>Registry.register_module`` are selectable by
+config string alone.
+
+Auto policy:
+- attention: the Pallas paged kernel when the engine resolved
+  ``use_pallas_kernels`` to true (TPU), else the dense gather oracle;
+- linear: int8 blockwise when the engine asks for weight quantization
+  (decode is weight-stream-bound), else the plain-dtype gemm;
+- embedding / unembed / norm: the single TPU implementation each (XLA fuses
+  what the reference ships as kernel variants).
+"""
+
+from typing import Union
+
+from .configs import (DSEmbeddingsConfig, DSLinearConfig, DSNormConfig,
+                      DSSelfAttentionConfig, DSUnembedConfig)
+from .interfaces import (DSEmbeddingRegistry, DSLinearRegistry, DSPreNormRegistry,
+                         DSSelfAttentionRegistry, DSUnembedRegistry)
+from .module_registry import ConfigBundle
+from . import implementations  # noqa: F401 — populates the registries
+
+
+def _bundle(choice: Union[str, dict], default_name: str, config) -> ConfigBundle:
+    if isinstance(choice, dict):
+        return ConfigBundle(name=choice.get("name", default_name), config=config,
+                            implementation_config=choice.get("implementation_config", {}))
+    name = default_name if choice in (None, "auto") else choice
+    return ConfigBundle(name=name, config=config)
+
+
+def instantiate_attention(attention_config: DSSelfAttentionConfig, engine_config,
+                          use_pallas: bool = False):
+    choice = getattr(engine_config.modules, "attention", "auto")
+    default = "paged_pallas_attention" if use_pallas else "dense_blocked_attention"
+    return DSSelfAttentionRegistry.instantiate_config(_bundle(choice, default, attention_config))
+
+
+def instantiate_linear(linear_config: DSLinearConfig, engine_config):
+    choice = getattr(engine_config.modules, "linear", "auto")
+    default = ("int8_blockwise_linear" if getattr(engine_config, "quantize_weights", False)
+               else "blas_fp_linear")
+    return DSLinearRegistry.instantiate_config(_bundle(choice, default, linear_config))
+
+
+def instantiate_embed(embed_config: DSEmbeddingsConfig, engine_config):
+    choice = getattr(engine_config.modules, "embedding", "auto")
+    return DSEmbeddingRegistry.instantiate_config(_bundle(choice, "ragged_embedding", embed_config))
+
+
+def instantiate_unembed(unembed_config: DSUnembedConfig, engine_config):
+    choice = getattr(engine_config.modules, "unembed", "auto")
+    return DSUnembedRegistry.instantiate_config(_bundle(choice, "last_token_unembed", unembed_config))
+
+
+def instantiate_pre_norm(norm_config: DSNormConfig, engine_config):
+    choice = getattr(engine_config.modules, "norm", "auto")
+    return DSPreNormRegistry.instantiate_config(_bundle(choice, "fused_pre_norm", norm_config))
+
+
+def build_modules(model_config, engine_config, use_pallas: bool = False) -> dict:
+    """Derive every slot's config from the model config and instantiate the
+    full module set the ragged forward consumes."""
+    mc = model_config
+    dt = mc.dtype
+    attn = DSSelfAttentionConfig(
+        num_heads=mc.num_heads, num_kv_heads=mc.num_kv_heads, head_dim=mc.head_dim,
+        block_size=engine_config.kv_block_size, sliding_window=mc.sliding_window,
+        positions=mc.positions, dtype=dt)
+    return {
+        "attention": instantiate_attention(attn, engine_config, use_pallas=use_pallas),
+        "linear": instantiate_linear(DSLinearConfig(dtype=dt), engine_config),
+        "embedding": instantiate_embed(DSEmbeddingsConfig(
+            positions=mc.positions, embed_layernorm=mc.embed_layernorm, norm=mc.norm,
+            norm_eps=mc.norm_eps, dtype=dt), engine_config),
+        "unembed": instantiate_unembed(DSUnembedConfig(
+            tie_embeddings=mc.tie_embeddings, norm=mc.norm, norm_eps=mc.norm_eps,
+            dtype=dt), engine_config),
+        "norm": instantiate_pre_norm(DSNormConfig(norm=mc.norm, norm_eps=mc.norm_eps,
+                                                  dtype=dt), engine_config),
+    }

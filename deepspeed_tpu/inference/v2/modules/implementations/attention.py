@@ -1,0 +1,69 @@
+"""Ragged paged-attention implementations (reference
+``implementations/attention/dense_blocked_attention.py``).
+
+Two real implementations behind one interface:
+
+- ``dense_blocked_attention``: the gather-based jnp oracle — runs anywhere,
+  the numerics reference.
+- ``paged_pallas_attention``: the Pallas LUT-prefetch paged kernel — the TPU
+  serving path; ``implementation_config={'interpret': True}`` runs the same
+  kernel through the Pallas interpreter so CPU CI can cover the kernel's
+  program (not its Mosaic lowering).
+"""
+
+import numpy as np
+
+from .....models.transformer import alibi_slopes
+from .....ops.pallas.paged_attention import _pallas_paged, paged_attention, paged_attention_reference
+from ..configs import DSSelfAttentionConfig
+from ..interfaces import DSSelfAttentionBase, DSSelfAttentionRegistry
+
+
+def _alibi(cfg: DSSelfAttentionConfig):
+    return alibi_slopes(cfg.num_heads) if cfg.positions == "alibi" else None
+
+
+@DSSelfAttentionRegistry.register_module
+class DenseBlockedAttention(DSSelfAttentionBase):
+
+    @staticmethod
+    def name() -> str:
+        return "dense_blocked_attention"
+
+    @staticmethod
+    def supports_config(config: DSSelfAttentionConfig) -> bool:
+        return config.num_heads % max(config.num_kv_heads, 1) == 0
+
+    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos):
+        cfg = self.config
+        return paged_attention_reference(q, k_flat, v_flat, tables_l, seq_idx, pos,
+                                         cfg.block_size, window=cfg.sliding_window,
+                                         alibi=_alibi(cfg))
+
+
+@DSSelfAttentionRegistry.register_module
+class PallasPagedAttention(DSSelfAttentionBase):
+
+    @staticmethod
+    def name() -> str:
+        return "paged_pallas_attention"
+
+    @staticmethod
+    def supports_config(config: DSSelfAttentionConfig) -> bool:
+        # the kernel tiles heads on the 8-lane sublane dim and d on 128 lanes
+        return (config.num_heads % max(config.num_kv_heads, 1) == 0
+                and config.head_dim % 2 == 0)
+
+    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos):
+        cfg = self.config
+        if self.implementation_config.get("interpret", False):
+            import jax.numpy as jnp
+
+            al = _alibi(cfg)
+            return _pallas_paged(q, k_flat, v_flat, tables_l, seq_idx.astype(jnp.int32),
+                                 pos.astype(jnp.int32), block_size=cfg.block_size,
+                                 interpret=True, window=cfg.sliding_window,
+                                 alibi=tuple(np.asarray(al).tolist()) if al is not None else None)
+        # paged_attention itself falls back (loudly) off-TPU / tiny heads
+        return paged_attention(q, k_flat, v_flat, tables_l, seq_idx, pos,
+                               cfg.block_size, window=cfg.sliding_window, alibi=_alibi(cfg))

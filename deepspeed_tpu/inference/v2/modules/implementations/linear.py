@@ -1,0 +1,65 @@
+"""Linear (gemm) implementations (reference
+``implementations/linear/blas_fp_linear.py`` + the quantized variants under
+``csrc/quantization/`` exposed through module_inject's ``quantize=True``).
+
+- ``blas_fp_linear``: plain dot in the module's compute dtype; XLA maps it
+  onto the MXU.
+- ``int8_blockwise_linear``: ``transform_params`` re-stores every block
+  weight as int8 + per-output-channel fp32 scales (``QuantizedWeight``);
+  the dequant is fused into the dot's operand read so only int8 bytes leave
+  HBM — the decode weight stream halves, which is the bandwidth-bound term
+  at serving batch sizes.
+
+Both accept either raw arrays or ``QuantizedWeight`` (its ``.astype`` is the
+dequant), so a checkpoint quantized elsewhere still serves through
+``blas_fp_linear``.
+"""
+
+import jax.numpy as jnp
+
+from ..configs import DSLinearConfig
+from ..interfaces import DSLinearBase, DSLinearRegistry
+
+
+def _matmul(x, w, b, dt):
+    # w is [in, out] (possibly pre-reshaped by the caller); QuantizedWeight
+    # dequantizes inside astype and XLA fuses it into the dot read
+    out = jnp.einsum("ti,io->to", x, w.astype(dt))
+    if b is not None:
+        out = out + b.astype(dt)
+    return out
+
+
+@DSLinearRegistry.register_module
+class BlasFPLinear(DSLinearBase):
+
+    @staticmethod
+    def name() -> str:
+        return "blas_fp_linear"
+
+    @staticmethod
+    def supports_config(config: DSLinearConfig) -> bool:
+        return True
+
+    def __call__(self, x, w, b=None):
+        return _matmul(x, w, b, self.config.dtype)
+
+
+@DSLinearRegistry.register_module
+class Int8BlockwiseLinear(DSLinearBase):
+
+    @staticmethod
+    def name() -> str:
+        return "int8_blockwise_linear"
+
+    @staticmethod
+    def supports_config(config: DSLinearConfig) -> bool:
+        return True
+
+    def transform_params(self, params):
+        from ....quantization import quantize_params_for_inference
+
+        return quantize_params_for_inference(params)
+
+    def __call__(self, x, w, b=None):
+        return _matmul(x, w, b, self.config.dtype)

@@ -1,0 +1,51 @@
+"""MoE implementation (reference
+``implementations/moe/cutlass_multi_gemm_moe.py``).
+
+The reference's CUTLASS multi-gemm gathers each expert's tokens and runs E
+variable-size gemms. On TPU, dynamic per-expert token counts are shape-hostile
+(XLA wants static shapes), so the serving MoE uses *dense dispatch*: every
+token is pushed through every expert as one batched [E]-stacked einsum and
+combined with the (renormalized) top-k gate weights. For serving expert
+counts (8-64) the batched gemm keeps the MXU saturated and avoids the
+gather/scatter latency chain; training-scale EP sharding lives in
+``moe/sharded_moe.py``'s capacity-based all-to-all instead.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..configs import DSMoEConfig
+from ..interfaces import DSMoEBase, DSMoERegistry
+
+
+@DSMoERegistry.register_module
+class TopKGatedMoE(DSMoEBase):
+
+    @staticmethod
+    def name() -> str:
+        return "top_k_gated_moe"
+
+    @staticmethod
+    def supports_config(config: DSMoEConfig) -> bool:
+        return 1 <= config.top_k <= config.n_experts
+
+    def __call__(self, x, gate_w, expert_up, expert_gate, expert_down):
+        """x: [T, H]; gate_w: [H, E]; expert_up/expert_gate: [E, H, F]
+        (expert_gate may be None for non-glu); expert_down: [E, F, H]."""
+        cfg = self.config
+        dt = cfg.dtype
+        logits = jnp.einsum("th,he->te", x, gate_w.astype(dt)).astype(jnp.float32)
+        top_vals, top_idx = jax.lax.top_k(logits, cfg.top_k)  # [T, k]
+        weights = jax.nn.softmax(top_vals, axis=-1).astype(dt)
+        # dense dispatch: combine weight is nonzero only for the top-k experts
+        combine = jnp.zeros(logits.shape, dt).at[
+            jnp.arange(logits.shape[0])[:, None], top_idx].set(weights)  # [T, E]
+
+        up = jnp.einsum("th,ehf->etf", x, expert_up.astype(dt))
+        if expert_gate is not None:  # swiglu
+            g = jnp.einsum("th,ehf->etf", x, expert_gate.astype(dt))
+            act = jax.nn.silu(g) * up
+        else:
+            act = jax.nn.gelu(up)
+        out = jnp.einsum("etf,efh->eth", act, expert_down.astype(dt))
+        return jnp.einsum("te,eth->th", combine, out)

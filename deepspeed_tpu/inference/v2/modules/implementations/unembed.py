@@ -1,0 +1,35 @@
+"""Unembed implementation (reference
+``implementations/unembed/ragged_unembed.py``): final norm → last-token
+gather (``logits_gather``: only each sequence's last token is projected to
+the vocabulary) → tied/untied head → fp32 logits."""
+
+import jax.numpy as jnp
+
+from .....models.transformer import _norm
+from ..configs import DSUnembedConfig
+from ..interfaces import DSUnembedBase, DSUnembedRegistry
+
+
+@DSUnembedRegistry.register_module
+class LastTokenUnembed(DSUnembedBase):
+
+    @staticmethod
+    def name() -> str:
+        return "last_token_unembed"
+
+    @staticmethod
+    def supports_config(config: DSUnembedConfig) -> bool:
+        return True
+
+    def __call__(self, params, hidden, last_idx):
+        cfg = self.config
+        h = _norm(hidden, params["final_norm"]["scale"], params["final_norm"].get("bias"),
+                  cfg.norm, cfg.norm_eps)
+        h_last = h[last_idx]  # [S, H]
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("sh,vh->sv", h_last, params["embed"]["embedding"].astype(cfg.dtype))
+        else:
+            logits = jnp.einsum("sh,hv->sv", h_last, params["lm_head"]["kernel"].astype(cfg.dtype))
+            if "bias" in params["lm_head"]:
+                logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
+        return logits.astype(jnp.float32)

@@ -110,19 +110,24 @@ class DynamicSplitFuseScheduler:
         self._active.pop(req.uid, None)
         self._results[req.uid] = req.generated
 
-    def _try_admit(self, req: _Request, batch_seqs: int, batch_tokens: int) -> bool:
+    def _try_admit(self, req: _Request, batch_uids: List[int], batch_lengths: List[int],
+                   budget: int) -> bool:
         """Admission reserves the request's WHOLE lifetime: full-prompt KV
         blocks + generation headroom, so an admitted request can always run
-        to completion regardless of later arrivals."""
-        if batch_seqs >= self.max_seqs:
+        to completion regardless of later arrivals. Validation is CUMULATIVE
+        — the engine sees the whole batch composed so far plus this request,
+        so a combination that passes here can never be rejected by the
+        final ``put(do_checks=True)`` after state was already mutated."""
+        if len(batch_uids) >= self.max_seqs:
             return False
         need = self._blocks_for(req.total_tokens)
         if self._reserved_blocks + need > self.engine.free_blocks + self._used_blocks():
             return False
-        first = min(self.token_budget - batch_tokens, req.prompt.size)
+        first = min(budget, req.prompt.size)
         if first <= 0:
             return False
-        if self.engine.can_schedule([req.uid], [first]) is not SchedulingResult.Success:
+        if self.engine.can_schedule(batch_uids + [req.uid],
+                                    batch_lengths + [first]) is not SchedulingResult.Success:
             return False
         self._reserved_blocks += need
         self._active[req.uid] = req
@@ -189,10 +194,18 @@ class DynamicSplitFuseScheduler:
 
         for req in prefilling:
             add_prefill(req)
-        while self._pending and budget > 0 and len(uids) < self.max_seqs:
-            if not self._try_admit(self._pending[0], len(uids), self.token_budget - budget):
-                break
-            add_prefill(self._pending.pop(0))
+        # FIFO-preferred admission with head-of-line skip-ahead: a pending
+        # request that cannot be admitted (e.g. its lifetime KV reservation
+        # exceeds what the pool can currently promise) must not starve later
+        # pending requests that do fit — scan past it instead of breaking
+        i = 0
+        while i < len(self._pending) and budget > 0 and len(uids) < self.max_seqs:
+            req = self._pending[i]
+            if self._try_admit(req, uids, [c.size for c in chunks], budget):
+                self._pending.pop(i)
+                add_prefill(req)
+            else:
+                i += 1
 
         if not uids:
             return 0
@@ -214,7 +227,7 @@ class DynamicSplitFuseScheduler:
             if self.step() == 0:
                 stalled = [r.uid for r in self._pending] + list(self._active)
                 raise RuntimeError(f"scheduler stalled with unrunnable requests {stalled}: "
-                                   "first pending request cannot be admitted (shrink it, raise "
+                                   "no pending request can be admitted (shrink them, raise "
                                    "the KV pool, or drain active work); partial generations "
                                    "remain in .results")
             steps += 1

@@ -4,6 +4,7 @@ PDSH_LAUNCHER = "pdsh"
 SSH_LAUNCHER = "ssh"
 OPENMPI_LAUNCHER = "openmpi"
 SLURM_LAUNCHER = "slurm"
+MPICH_LAUNCHER = "mpich"
 
 DEFAULT_MASTER_PORT = 29500
 DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed default service port

@@ -58,6 +58,27 @@ def maybe_init_distributed():
     return False
 
 
+def resolve_node_rank(rank_env=None, environ=None):
+    """Node rank for a ``--node_rank=-1`` launch. ``rank_env`` (passed by the
+    runner that built the command) names the ONE env var this launcher sets —
+    a global guess chain would mis-resolve e.g. an mpich launch inside a
+    SLURM allocation, where the inherited SLURM_NODEID=0 shadows PMI_RANK on
+    every node. The fallback chain only runs when no runner told us."""
+    env = os.environ if environ is None else environ
+    if rank_env:
+        if rank_env not in env:
+            raise RuntimeError(f"--rank_env={rank_env} was promised by the launcher "
+                               f"but is not set on this node")
+        return int(env[rank_env])
+    for var in ("OMPI_COMM_WORLD_RANK",  # OpenMPI
+                "SLURM_NODEID",          # srun
+                "PMI_RANK",              # MPICH / Intel MPI (hydra)
+                "PMIX_RANK"):            # generic PMIx
+        if var in env:
+            return int(env[var])
+    return 0
+
+
 def main():
     """Exec the user script with the worker env (invoked on each node by the
     multinode runner: ``python -m deepspeed_tpu.launcher.launch --world_info=…
@@ -67,6 +88,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--world_info", required=True)
     p.add_argument("--node_rank", type=int, required=True)
+    p.add_argument("--rank_env", type=str, default=None,
+                   help="env var holding this node's rank (set by the runner when node_rank=-1)")
     p.add_argument("--master_addr", required=True)
     p.add_argument("--master_port", type=int, required=True)
     p.add_argument("script_and_args", nargs=argparse.REMAINDER)
@@ -75,8 +98,8 @@ def main():
     rest = args.script_and_args
     if rest and rest[0] == "--":
         rest = rest[1:]
-    if args.node_rank < 0:  # OpenMPI runner: rank comes from the MPI env
-        args.node_rank = int(os.environ.get("OMPI_COMM_WORLD_RANK", "0"))
+    if args.node_rank < 0:  # MPI/SLURM runners: rank comes from the launcher env
+        args.node_rank = resolve_node_rank(args.rank_env)
     env = dict(os.environ)
     env.update(build_worker_env(args.world_info, args.master_addr, args.master_port, args.node_rank))
     cmd = [sys.executable, *rest]

@@ -99,7 +99,94 @@ class OpenMPIRunner(MultiNodeRunner):
                "--map-by", "ppr:1:node", *extra,
                sys.executable, "-m", "deepspeed_tpu.launcher.launch",
                f"--world_info={self.world_info_b64}",
-               "--node_rank=-1",  # resolved from OMPI_COMM_WORLD_RANK by launch
+               "--node_rank=-1", "--rank_env=OMPI_COMM_WORLD_RANK",
+               f"--master_addr={self.master_addr}",
+               f"--master_port={self.master_port}",
+               "--", self.user_script, *self.user_arguments]
+        return [cmd]
+
+
+class PDSHRunner(MultiNodeRunner):
+    """pdsh fan-out (reference PDSHRunner:51): ONE pdsh process executing the
+    per-node launch command on every host in parallel. pdsh runs an
+    identical command everywhere, so each node derives its node_rank from
+    its hostname's position in the exported DSTPU_NODE_HOSTS list."""
+
+    def backend_exists(self):
+        import shutil
+
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, active_resources):
+        hosts = ",".join(active_resources)
+        extra = shlex.split(getattr(self.args, "launcher_args", "") or "")
+        cwd = os.getcwd()
+        # pdsh runs the SAME command on every host; the remote side derives
+        # node_rank from its position in the exported host list. The export
+        # must be its own statement — a prefix assignment is NOT visible to a
+        # command substitution within the same simple command.
+        remote = (
+            f"cd {shlex.quote(cwd)} && "
+            f"export DSTPU_NODE_HOSTS={shlex.quote(hosts)} && "
+            f"{shlex.quote(sys.executable)} -m deepspeed_tpu.launcher.launch "
+            f"--world_info={self.world_info_b64} "
+            f"--node_rank=$(python3 -c \"import os,socket;hs=os.environ['DSTPU_NODE_HOSTS'].split(',');"
+            f"h=socket.gethostname();print(hs.index(h) if h in hs else 0)\") "
+            f"--master_addr={self.master_addr} --master_port={self.master_port} "
+            f"-- {shlex.quote(self.user_script)} "
+            + " ".join(shlex.quote(a) for a in self.user_arguments)
+        )
+        return [["pdsh", "-S", "-f", "1024", "-w", hosts, *extra, remote]]
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun-based fan-out (reference SlurmRunner:318): one srun task per
+    node; each task's node_rank resolves from $SLURM_NODEID inside
+    ``launch.py``. Table stakes for shared TPU-pod clusters fronted by
+    SLURM."""
+
+    def backend_exists(self):
+        import shutil
+
+        return shutil.which("sinfo") is not None
+
+    def get_cmd(self, active_resources):
+        n_nodes = len(active_resources)
+        extra = shlex.split(getattr(self.args, "launcher_args", "") or "")
+        srun = ["srun", "-n", str(n_nodes), "--ntasks-per-node=1", *extra]
+        if getattr(self.args, "slurm_comment", ""):
+            srun += ["--comment", self.args.slurm_comment]
+        # include/exclude filters were already applied by runner.main to
+        # active_resources (and their host@host:slots grammar is not a slurm
+        # nodelist); the filtered host set IS the --nodelist
+        srun += ["--nodelist", ",".join(active_resources)]
+        cmd = srun + ["--export=ALL",
+                      sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+                      f"--world_info={self.world_info_b64}",
+                      "--node_rank=-1", "--rank_env=SLURM_NODEID",
+                      f"--master_addr={self.master_addr}",
+                      f"--master_port={self.master_port}",
+                      "--", self.user_script, *self.user_arguments]
+        return [cmd]
+
+
+class MPICHRunner(MultiNodeRunner):
+    """MPICH/hydra fan-out (reference MPICHRunner:229): mpiexec with one
+    process per host; node_rank resolves from $PMI_RANK inside launch.py."""
+
+    def backend_exists(self):
+        import shutil
+
+        return shutil.which("mpiexec") is not None
+
+    def get_cmd(self, active_resources):
+        extra = shlex.split(getattr(self.args, "launcher_args", "") or "")
+        hosts = ",".join(active_resources)
+        cmd = ["mpiexec", "-n", str(len(active_resources)), "-hosts", hosts,
+               "-ppn", "1", *extra,
+               sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+               f"--world_info={self.world_info_b64}",
+               "--node_rank=-1", "--rank_env=PMI_RANK",
                f"--master_addr={self.master_addr}",
                f"--master_port={self.master_port}",
                "--", self.user_script, *self.user_arguments]

@@ -24,7 +24,8 @@ import shlex
 import subprocess
 import sys
 
-from .constants import (DEFAULT_COORDINATOR_PORT, ENV_WORLD_INFO, SSH_LAUNCHER, OPENMPI_LAUNCHER)
+from .constants import (DEFAULT_COORDINATOR_PORT, ENV_WORLD_INFO, MPICH_LAUNCHER, OPENMPI_LAUNCHER,
+                        PDSH_LAUNCHER, SLURM_LAUNCHER, SSH_LAUNCHER)
 from ..utils.logging import logger
 
 
@@ -40,7 +41,11 @@ def parse_args(args=None):
     parser.add_argument("--num_gpus", "--num_chips", dest="num_gpus", type=int, default=-1)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--master_port", type=int, default=DEFAULT_COORDINATOR_PORT)
-    parser.add_argument("--launcher", type=str, default=SSH_LAUNCHER, choices=[SSH_LAUNCHER, OPENMPI_LAUNCHER])
+    parser.add_argument("--launcher", type=str, default=SSH_LAUNCHER,
+                        choices=[SSH_LAUNCHER, PDSH_LAUNCHER, OPENMPI_LAUNCHER, SLURM_LAUNCHER,
+                                 MPICH_LAUNCHER])
+    parser.add_argument("--slurm_comment", type=str, default="",
+                        help="--comment passed to srun (slurm launcher only)")
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str)
@@ -168,9 +173,12 @@ def main(args=None):
         result = subprocess.run(cmd)
         sys.exit(result.returncode)
 
-    from .multinode_runner import OpenMPIRunner, SSHRunner
+    from .multinode_runner import (MPICHRunner, OpenMPIRunner, PDSHRunner, SlurmRunner,
+                                   SSHRunner)
 
-    runner_cls = {SSH_LAUNCHER: SSHRunner, OPENMPI_LAUNCHER: OpenMPIRunner}[args.launcher]
+    runner_cls = {SSH_LAUNCHER: SSHRunner, PDSH_LAUNCHER: PDSHRunner,
+                  OPENMPI_LAUNCHER: OpenMPIRunner, SLURM_LAUNCHER: SlurmRunner,
+                  MPICH_LAUNCHER: MPICHRunner}[args.launcher]
     runner = runner_cls(args, world_info, master_addr, args.master_port)
     sys.exit(runner.launch(active))
 

@@ -35,14 +35,29 @@ class AutoTP:
         return True
 
     def tree_specs(self, params) -> Dict:
-        """PartitionSpec per leaf (replicated where no rule matches)."""
+        """PartitionSpec per leaf (replicated where no rule matches).
+
+        ``QuantizedWeight`` leaves (weight-only int8) are specced as a unit
+        from the int8 matrix's shape: ``q`` takes the weight's rule; the
+        per-output-channel ``scale`` (one block spanning the whole
+        contraction axis) replicates along that axis — a row-parallel ``q``
+        slice still dequantizes correctly with the full-axis scale."""
+        from ..inference.quantization import QuantizedWeight
 
         def spec(kp, leaf):
             path = path_str(kp)
-            s = self.policy.spec_for(path, np.ndim(leaf))
-            return s if s is not None else P(*([None] * np.ndim(leaf)))
+            quant = isinstance(leaf, QuantizedWeight)
+            nd = np.ndim(leaf.q) if quant else np.ndim(leaf)
+            s = self.policy.spec_for(path, nd)
+            s = s if s is not None else P(*([None] * nd))
+            if quant:
+                sc = list(s)
+                sc[-2] = None
+                return QuantizedWeight(s, P(*sc))
+            return s
 
-        return jax.tree_util.tree_map_with_path(spec, params)
+        return jax.tree_util.tree_map_with_path(
+            spec, params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
 
     def shard(self, params, mesh):
         """Annotate params with TP shardings over ``mesh`` (in-memory path)."""

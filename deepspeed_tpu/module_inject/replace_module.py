@@ -43,19 +43,24 @@ def replace_transformer_layer(orig_layer_impl=None,
         mc.attention_impl = "auto"
         logger.info("kernel injection: attention_impl -> auto (Pallas flash/paged where applicable)")
     auto_tp = AutoTP(policy=policy, model_type=model_type or getattr(mc, "model_type", None))
-    if params is not None and mesh is not None and mesh.shape.get("model", 1) > 1:
-        params = auto_tp.shard(params, mesh)
-        logger.info(f"AutoTP: params sharded over model axis (size {mesh.shape['model']})")
     num_bits = 8
     if config is not None and getattr(config, "quant", None) is not None:
         if quantize is None:
             quantize = bool(config.quant.enabled)
         num_bits = config.quant.num_bits
+    # quantize BEFORE sharding: the eager reshape/moveaxis inside blockwise
+    # quantization would not preserve a NamedSharding on already-placed
+    # leaves, so standalone multi-device callers would silently end up with
+    # replicated int8 weights; quantizing first lets auto_tp.shard place the
+    # QuantizedWeight leaves (its pytree children follow the weight's spec)
     if quantize and params is not None:
         from ..inference.quantization import quantize_params_for_inference
 
         params = quantize_params_for_inference(params, num_bits)
         logger.info(f"quantize: weight-only int{num_bits} (per-output-channel scales)")
+    if params is not None and mesh is not None and mesh.shape.get("model", 1) > 1:
+        params = auto_tp.shard(params, mesh)
+        logger.info(f"AutoTP: params sharded over model axis (size {mesh.shape['model']})")
     return model, params
 
 

@@ -76,7 +76,7 @@ class SparseSelfAttention:
 class BertSparseSelfAttention:
     """BERT self-attention block with sparse scores (reference
     ``bert_sparse_self_attention.py:10``): q/k/v projections followed by
-    :class:`SparseSelfAttention`. ``init(rng, hidden_size)`` returns the
+    :class:`SparseSelfAttention`. ``init(rng, dtype=jnp.float32)`` returns the
     params pytree; ``__call__(params, hidden_states, attention_mask)``
     returns the context layer [B, L, hidden].
 

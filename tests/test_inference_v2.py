@@ -427,3 +427,44 @@ def test_splitfuse_scheduler_rejections_and_stall():
         sched.submit(uid, rng.integers(0, 128, size=20, dtype=np.int32), max_new_tokens=3)
     out = sched.run()
     assert set(out) == {1, 2, 3} and all(len(v) == 3 for v in out.values())
+
+
+def test_splitfuse_head_of_line_skip_ahead():
+    """ADVICE r3: a pending request that can NEVER be admitted (lifetime KV
+    reservation exceeds the whole pool) must not starve later pending work
+    that fits. The runnable request completes; the stall raises only once
+    nothing else is runnable, with completed results preserved."""
+    from deepspeed_tpu.inference.v2 import DynamicSplitFuseScheduler
+
+    # pool: 6 blocks x 8 = 48 slots; max_context 64 > pool, so an in-range
+    # request can still be pool-infeasible
+    eng = _tiny_engine(max_tracked_sequences=4, max_ragged_batch_size=64,
+                       max_ragged_sequence_count=4, max_context=64)
+    eng.state_manager = type(eng.state_manager)(
+        eng.model_config.num_layers, eng.model_config.num_kv_heads, eng.model_config.head_dim,
+        max_tracked_sequences=4, num_blocks=6, block_size=8, dtype=jnp.float32)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=32)
+    rng = np.random.default_rng(0)
+    sched.submit(1, rng.integers(0, 128, size=50, dtype=np.int32), max_new_tokens=10)  # 60 tok > 48-slot pool
+    sched.submit(2, rng.integers(0, 128, size=10, dtype=np.int32), max_new_tokens=4)   # fits
+    with pytest.raises(RuntimeError, match="stalled"):
+        sched.run()
+    assert sched.results.get(2) is not None and len(sched.results[2]) == 4, \
+        "admissible request behind an infeasible head was starved"
+
+
+def test_splitfuse_cumulative_admission_no_partial_state():
+    """ADVICE r3: with max_tracked_sequences < max_ragged_sequence_count,
+    same-step admissions that individually pass must be validated
+    cumulatively — the composed put() must never raise SchedulingError after
+    scheduler state was mutated. Both requests complete (serially)."""
+    from deepspeed_tpu.inference.v2 import DynamicSplitFuseScheduler
+
+    eng = _tiny_engine(max_tracked_sequences=1, max_ragged_batch_size=32,
+                       max_ragged_sequence_count=2, max_context=32)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=32)
+    rng = np.random.default_rng(1)
+    sched.submit(1, rng.integers(0, 128, size=6, dtype=np.int32), max_new_tokens=3)
+    sched.submit(2, rng.integers(0, 128, size=6, dtype=np.int32), max_new_tokens=3)
+    out = sched.run()
+    assert set(out) == {1, 2} and all(len(v) == 3 for v in out.values())

@@ -11,7 +11,8 @@ import pytest
 
 from deepspeed_tpu.launcher.runner import (decode_world_info, encode_world_info, fetch_hostfile,
                                            parse_args, parse_inclusion_exclusion)
-from deepspeed_tpu.launcher.multinode_runner import OpenMPIRunner, SSHRunner
+from deepspeed_tpu.launcher.multinode_runner import (MPICHRunner, OpenMPIRunner, PDSHRunner,
+                                                     SlurmRunner, SSHRunner)
 from deepspeed_tpu.launcher import launch
 from deepspeed_tpu.elasticity import (ElasticityConfigError, ElasticityIncompatibleWorldSize,
                                       compute_elastic_config, get_compatible_gpus_v01)
@@ -88,6 +89,70 @@ def test_openmpi_runner_cmd():
     assert cmd[:3] == ["mpirun", "-np", "3"]
     assert "w0:1,w1:1,w2:1" in cmd
     assert "train.py" in cmd
+
+
+def test_slurm_runner_cmd():
+    """Reference multinode_runner.py:318 SlurmRunner parity: one srun task
+    per node, nodelist from the hostfile, node_rank deferred to
+    $SLURM_NODEID, slurm_comment forwarded."""
+    args = _args(["--launcher", "slurm", "--slurm_comment", "dstpu-job"])
+    wi = encode_world_info({"w0": [0], "w1": [0]})
+    runner = SlurmRunner(args, wi, master_addr="w0", master_port=1234)
+    (cmd, ) = runner.get_cmd(["w0", "w1"])
+    assert cmd[:2] == ["srun", "-n"] and cmd[2] == "2"
+    assert "--ntasks-per-node=1" in cmd
+    assert "--comment" in cmd and "dstpu-job" in cmd
+    assert "--nodelist" in cmd and "w0,w1" in cmd
+    assert "--node_rank=-1" in cmd  # resolved from SLURM_NODEID in launch.py
+    assert "train.py" in cmd
+
+
+def test_slurm_runner_filters_not_double_applied():
+    """include/exclude are applied by runner.main BEFORE command build (and
+    their host@host:slots grammar is not a slurm nodelist) — the srun command
+    must carry only the already-filtered --nodelist, never srun-unknown
+    --include flags."""
+    args = _args(["--launcher", "slurm", "--num_nodes", "2", "--exclude", "w9"])
+    wi = encode_world_info({"w0": [0], "w1": [0]})
+    (cmd, ) = SlurmRunner(args, wi, "w0", 1234).get_cmd(["w0", "w1"])
+    assert "--include" not in cmd and "--exclude" not in cmd and "w9" not in cmd
+    assert "--nodelist" in cmd and "w0,w1" in cmd
+
+
+def test_pdsh_runner_cmd():
+    args = _args(["--launcher", "pdsh"])
+    wi = encode_world_info({"w0": [0], "w1": [0]})
+    (cmd, ) = PDSHRunner(args, wi, "w0", 1234).get_cmd(["w0", "w1"])
+    assert cmd[0] == "pdsh" and "-w" in cmd and "w0,w1" in cmd
+    remote = cmd[-1]
+    assert "deepspeed_tpu.launcher.launch" in remote
+    assert "DSTPU_NODE_HOSTS=w0,w1" in remote  # per-host rank derivation
+    assert "train.py" in remote
+
+
+def test_mpich_runner_cmd():
+    args = _args(["--launcher", "mpich"])
+    wi = encode_world_info({"w0": [0], "w1": [0], "w2": [0]})
+    (cmd, ) = MPICHRunner(args, wi, "w0", 1234).get_cmd(["w0", "w1", "w2"])
+    assert cmd[:3] == ["mpiexec", "-n", "3"]
+    assert "-hosts" in cmd and "w0,w1,w2" in cmd and "-ppn" in cmd
+    assert "--node_rank=-1" in cmd  # resolved from PMI_RANK in launch.py
+
+
+def test_launch_node_rank_env_resolution():
+    """launch.resolve_node_rank: an explicit --rank_env wins (and raises when
+    the promised var is missing); the fallback chain resolves each launcher's
+    var when no runner named one; a stale inherited SLURM_NODEID must not
+    shadow an mpich launch's PMI_RANK when rank_env says PMI_RANK."""
+    assert launch.resolve_node_rank(None, environ={"SLURM_NODEID": "3"}) == 3
+    assert launch.resolve_node_rank(None, environ={"PMI_RANK": "2"}) == 2
+    assert launch.resolve_node_rank(None, environ={"OMPI_COMM_WORLD_RANK": "1"}) == 1
+    assert launch.resolve_node_rank(None, environ={}) == 0
+    # mpich inside a SLURM allocation: inherited SLURM_NODEID=0 everywhere
+    env = {"SLURM_NODEID": "0", "PMI_RANK": "5"}
+    assert launch.resolve_node_rank("PMI_RANK", environ=env) == 5
+    with pytest.raises(RuntimeError, match="rank_env"):
+        launch.resolve_node_rank("PMI_RANK", environ={"SLURM_NODEID": "0"})
 
 
 def test_build_worker_env_slot_filter():

@@ -147,3 +147,44 @@ def test_lora_fuse_unfuse_roundtrip():
     assert not np.allclose(fused, w)
     back = DeepSpeedHybridEngine.unfuse_lora_weight(fused, a, b, scaling=0.5)
     np.testing.assert_allclose(back, w, rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_then_shard_preserves_tp_placement():
+    """ADVICE r3: quantize must happen BEFORE auto_tp.shard so the int8/scale
+    leaves end up with the policy's TP NamedSharding (quantizing after would
+    rebuild them eagerly, silently replicated). The scale (one block over the
+    whole contraction axis) must replicate along any row-sharded dim."""
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+
+    groups.reset()
+    mesh = groups.initialize_mesh(MeshConfig(data=2, model=4))
+    model = TransformerLM(TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                                            intermediate_size=64, max_seq_len=16, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
+    _, qparams = replace_transformer_layer(model=model, params=params, model_type="llama",
+                                           mesh=mesh, quantize=True)
+
+    leaves = jax.tree_util.tree_leaves(qparams, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+    qw = [x for x in leaves if isinstance(x, QuantizedWeight)]
+    assert qw, "quantize=True produced no QuantizedWeight leaves"
+    sharded = [w for w in qw
+               if any(ax is not None for ax in w.q.sharding.spec)]
+    assert sharded, "no quantized weight carries a model-axis sharding"
+    for w in qw:
+        # the scale's contraction dim (size 1) must never be partitioned
+        spec = w.scale.sharding.spec
+        assert len(spec) < 2 or spec[-2] is None
+
+    # numerics: sharded int8 forward matches the unsharded quantized forward
+    from deepspeed_tpu.models.transformer import forward
+
+    ids = np.random.default_rng(0).integers(0, 64, size=(2, 16), dtype=np.int32)
+    from deepspeed_tpu.inference.quantization import quantize_params_for_inference
+
+    ref = np.asarray(jax.jit(lambda p, i: forward(model.config, p, i))(
+        quantize_params_for_inference(params), ids))
+    with mesh:
+        tp = np.asarray(jax.jit(lambda p, i: forward(model.config, p, i))(qparams, ids))
+    np.testing.assert_allclose(ref, tp, rtol=2e-4, atol=2e-4)
+    groups.reset()
