@@ -23,9 +23,55 @@ import jax
 import jax.numpy as jnp
 
 
+def _pallas_route(q, biases, interpret=False):
+    """The Pallas biased-flash kernel handles the AlphaFold bias pattern —
+    mask bias [.., n_seq, 1, 1, n_res] + pair bias [.., 1, heads, n_res,
+    n_res] (either may be absent) — on TPU, for lane-aligned n_res.
+    Returns (bias1 [.., n_seq, 1, 1, R], bias2 [.., 1, h, R, R]) or None.
+    ``interpret`` runs the kernel through the Pallas interpreter off-TPU
+    (CPU CI coverage of the kernel program)."""
+    if not interpret and jax.default_backend() != "tpu":
+        return None
+    *lead, n_seq, R, h, d = q.shape
+    if R % 128 != 0 or d < 32:
+        return None
+    b1 = b2 = None
+    for b in biases:
+        if b is None:
+            continue
+        if b.shape[-4:] == (n_seq, 1, 1, R) and b1 is None:
+            b1 = b
+        elif b.shape[-4:] == (1, h, R, R) and b2 is None:
+            b2 = b
+        else:
+            return None  # a bias layout the kernel doesn't cover
+    return b1, b2
+
+
+def _evoformer_pallas(q, k, v, b1, b2, interpret=False):
+    """Collapse leading dims and run the fused kernel
+    (``ops/pallas/evoformer_attention.py``)."""
+    from .pallas.evoformer_attention import evo_flash
+
+    *lead, n_seq, R, h, d = q.shape
+    G = 1
+    for x in lead:
+        G *= x
+    N = G * n_seq
+    qf = q.reshape(N, R, h, d)
+    kf = k.reshape(N, R, h, d)
+    vf = v.reshape(N, R, h, d)
+    b1f = (jnp.broadcast_to(b1, (*lead, n_seq, 1, 1, R)).reshape(N, R).astype(jnp.float32)
+           if b1 is not None else jnp.zeros((N, R), jnp.float32))
+    b2f = (jnp.broadcast_to(b2, (*lead, 1, h, R, R)).reshape(G, h, R, R).astype(jnp.float32)
+           if b2 is not None else jnp.zeros((G, h, R, R), jnp.float32))
+    out = evo_flash(qf, kf, vf, b1f, b2f, interpret=interpret)
+    return out.reshape(*lead, n_seq, R, h, d)
+
+
 def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         biases: Sequence[Optional[jax.Array]] = (),
-                        seq_chunk: int = 0) -> jax.Array:
+                        seq_chunk: int = 0, interpret: bool = False) -> jax.Array:
     """Fused biased attention.
 
     q/k/v: [..., n_seq, n_res, heads, dim] (the reference layout).
@@ -33,9 +79,18 @@ def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     n_res] (e.g. mask bias [.., n_seq, 1, 1, n_res] and pair bias
     [.., 1, heads, n_res, n_res]).
     seq_chunk: process the n_seq dim in chunks of this size to bound the
-    live score tensor (0 = no chunking).
+    live score tensor (0 = no chunking; ignored on the Pallas route, whose
+    residency is already tile-bounded).
     Returns [..., n_seq, n_res, heads, dim].
+
+    On TPU, AlphaFold-pattern biases route to the Pallas biased-flash
+    kernel (fwd + bwd incl. bias gradients, never materializing the
+    [n_res, n_res] probabilities in HBM); other layouts use the chunked
+    jnp path below.
     """
+    routed = _pallas_route(q, biases, interpret=interpret)
+    if routed is not None:
+        return _evoformer_pallas(q, k, v, routed[0], routed[1], interpret=interpret)
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
 

@@ -1,0 +1,357 @@
+"""Evoformer biased flash attention — Pallas fwd + bwd with bias gradients.
+
+TPU replacement for the reference's CUTLASS fused MHA
+(``csrc/deepspeed4science/evoformer_attn/`` — ``attention.cu`` fwd,
+``attention_back.cu`` bwd with dB1/dB2), the kernel behind
+``DS4Sci_EvoformerAttention``. AlphaFold's triangle/MSA attention adds TWO
+bias terms to the scores:
+
+  - ``bias1`` (MSA mask): [N, R] — one additive value per key position,
+    broadcast over heads and query rows (the reference's
+    ``[*, n_seq, 1, 1, n_res]`` layout, batch dims collapsed into N);
+  - ``bias2`` (pair bias): [G, h, R, R] — a full per-head score bias shared
+    by the ``n_seq = N // G`` sequence rows of each batch group (the
+    reference's ``[*, 1, heads, n_res, n_res]``).
+
+The whole point of the fused kernel is never materializing the
+[*, h, R, R] probability tensor in HBM at fp32: the forward is the flash
+online-softmax with the two bias tiles added to each [bq, bk] score block
+(VMEM residency: q/k/v/o tiles + one bias2 tile — independent of R), and
+the backward recomputes p blockwise from the saved lse.
+
+Backward structure (the flash two-pass split plus two bias passes — each is
+a revisit-accumulate grid whose innermost dimension matches what that
+cotangent sums over):
+
+  * dq    — grid (N, h, qi, kj):      dq[n,h,qi]    += ds·k      over kj
+  * dk/dv — grid (N, h, kj, qi):      dk/dv[n,h,kj] += ds^T·q    over qi
+  * dbias2 — grid (G, h, qi, kj, n):  db2[g,h,qi,kj] += ds       over n_seq
+  * dbias1 — grid (N, kj, h, qi):     db1[n,kj]     += Σ_q ds    over (h, qi)
+
+dbias2/dbias1 cannot share a pass with dk/dv (or each other): TPU grids
+execute sequentially and an output block only accumulates across
+*consecutive* revisits, so each cotangent needs its own innermost-loop
+order. The two extra recompute passes cost ~2/3 of the dk/dv pass each —
+the price of keeping every bias gradient HBM-resident-free.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+_VMEM_BUDGET = 14 * 2**20
+
+
+def _fit_block(S: int, want: int) -> int:
+    b = max(128, min(want, S) // 128 * 128)
+    while b > 128 and S % b:
+        b -= 128
+    return b
+
+
+def _fit_tiles(R: int, d: int, bq: int, bk: int):
+    """Shrink (bq, bk) until the largest pass's VMEM working set fits.
+    vs flash: +1 fp32 [bq, bk] bias2 tile and the [1, bk] bias1 row."""
+    while True:
+        tmp = 3 * bq * bk * 4 + (bq + bk) * d * 16 + bq * 128 * 4 + bk * 4
+        if tmp <= _VMEM_BUDGET:
+            return bq, bk
+        if bq <= 128 and bk <= 128:
+            return None
+        bq2 = _fit_block(R, max(128, bq // 2)) if bq >= bk else bq
+        bk2 = _fit_block(R, max(128, bk // 2)) if bk >= bq else bk
+        if (bq2, bk2) == (bq, bk):
+            return None
+        bq, bk = bq2, bk2
+
+
+def evo_flash(q, k, v, bias1, bias2, block_q=512, block_k=512, interpret=False):
+    """q/k/v: [N, R, h, d]; bias1: [N, R] fp32; bias2: [G, h, R, R] fp32
+    with N % G == 0. Returns [N, R, h, d]. Differentiable in all five
+    operands (bias cotangents accumulate in fp32 inside the kernel)."""
+    N, R, h, d = q.shape
+    G = bias2.shape[0]
+    assert N % G == 0, f"N={N} must be a multiple of bias2 groups G={G}"
+    assert bias1.shape == (N, R) and bias2.shape == (G, h, R, R)
+    bq = _fit_block(R, min(block_q, R))
+    bk = _fit_block(R, min(block_k, R))
+    fitted = _fit_tiles(R, d, bq, bk)
+    if fitted is None:
+        raise ValueError(f"no evoformer tiling fits VMEM for R={R}, d={d}")
+    return _evo_core(fitted[0], fitted[1], interpret, q, k, v,
+                     bias1.astype(jnp.float32), bias2.astype(jnp.float32))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _evo_core(block_q, block_k, interpret, q, k, v, bias1, bias2):
+    out, _ = _evo_fwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2)
+    return out
+
+
+def _evo_core_fwd(block_q, block_k, interpret, q, k, v, bias1, bias2):
+    out, lse = _evo_fwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2)
+    return out, (q, k, v, bias1, bias2, out, lse)
+
+
+def _evo_core_bwd(block_q, block_k, interpret, res, dout):
+    q, k, v, bias1, bias2, out, lse = res
+    return _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, dout)
+
+
+_evo_core.defvjp(_evo_core_fwd, _evo_core_bwd)
+
+
+def _evo_fwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, R, h, d = q.shape
+    G = bias2.shape[0]
+    n_seq = N // G
+    scale = 1.0 / math.sqrt(d)
+    nqb, nkb = R // block_q, R // block_k
+    LANES = 128
+
+    qt = q.transpose(0, 2, 1, 3)  # [N, h, R, d]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    b1 = bias1[:, None, :]        # [N, 1, R]
+
+    def kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
+        kj = pl.program_id(3)
+
+        @pl.when(kj == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        qb = q_ref[0, 0].astype(jnp.float32) * scale
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        s = s + b2_ref[0, 0] + b1_ref[0, 0][None, :]
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(p, vb, preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+        @pl.when(kj == nkb - 1)
+        def _flush():
+            l_safe = jnp.maximum(l_ref[:], 1e-30)
+            o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+            lse_ref[0, 0] = jnp.broadcast_to(m_ref[:] + jnp.log(l_safe), (block_q, LANES))
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(N, h, nqb, nkb),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda n, hh, i, j: (n, hh, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda n, hh, i, j: (n, hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda n, hh, i, j: (n, hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda n, hh, i, j: (n, 0, j)),
+            pl.BlockSpec((1, 1, block_q, block_k), lambda n, hh, i, j: (n // n_seq, hh, i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda n, hh, i, j: (n, hh, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda n, hh, i, j: (n, hh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, h, R, d), q.dtype),
+            jax.ShapeDtypeStruct((N, h, R, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, b1, bias2)
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
+
+
+def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, dout):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, R, h, d = q.shape
+    G = bias2.shape[0]
+    n_seq = N // G
+    scale = 1.0 / math.sqrt(d)
+    nqb, nkb = R // block_q, R // block_k
+    LANES = 128
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    ot = out.transpose(0, 2, 1, 3)
+    dot_ = dout.transpose(0, 2, 1, 3)
+    lse_b = jnp.broadcast_to(lse[..., None], (N, h, R, LANES))
+    b1 = bias1[:, None, :]
+
+    def block_math(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref):
+        """Recompute p and ds for the current [bq, bk] tile."""
+        qb = q_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        ob = o_ref[0, 0].astype(jnp.float32)
+        dob = do_ref[0, 0].astype(jnp.float32)
+        lseb = lse_ref[0, 0, :, :1]
+        s = scale * jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
+        s = s + b2_ref[0, 0] + b1_ref[0, 0][None, :]
+        p = jnp.exp(s - lseb)
+        delta = jnp.sum(dob * ob, axis=-1, keepdims=True)
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return qb, kb, vb, dob, p, ds
+
+    # ---- pass 1: dq — grid (N, h, qi, kj), kj innermost ----
+    def dq_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc):
+        kj = pl.program_id(3)
+
+        @pl.when(kj == 0)
+        def _init():
+            dq_acc[:] = jnp.zeros_like(dq_acc)
+
+        _, kb, _, _, _, ds = block_math(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref)
+        dq_acc[:] += scale * jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+        @pl.when(kj == nkb - 1)
+        def _flush():
+            dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda n, hh, i, j: (n, hh, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda n, hh, i, j: (n, hh, j, 0))
+    b1_spec = pl.BlockSpec((1, 1, block_k), lambda n, hh, i, j: (n, 0, j))
+    b2_spec = pl.BlockSpec((1, 1, block_q, block_k), lambda n, hh, i, j: (n // n_seq, hh, i, j))
+    lse_spec = pl.BlockSpec((1, 1, block_q, LANES), lambda n, hh, i, j: (n, hh, i, 0))
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(N, h, nqb, nkb),
+        in_specs=[q_spec, kv_spec, kv_spec, b1_spec, b2_spec, q_spec, q_spec, lse_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((N, h, R, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, b1, bias2, ot, dot_, lse_b)[0]
+
+    # ---- pass 2: dk/dv — grid (N, h, kj, qi), qi innermost ----
+    def dkdv_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc):
+        qi = pl.program_id(3)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        qb, _, _, dob, p, ds = block_math(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref)
+        dv_acc[:] += jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
+        dk_acc[:] += scale * jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
+
+        @pl.when(qi == nqb - 1)
+        def _flush():
+            dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+    q_spec4 = pl.BlockSpec((1, 1, block_q, d), lambda n, hh, j, i: (n, hh, i, 0))
+    kv_spec4 = pl.BlockSpec((1, 1, block_k, d), lambda n, hh, j, i: (n, hh, j, 0))
+    b1_spec4 = pl.BlockSpec((1, 1, block_k), lambda n, hh, j, i: (n, 0, j))
+    b2_spec4 = pl.BlockSpec((1, 1, block_q, block_k), lambda n, hh, j, i: (n // n_seq, hh, i, j))
+    lse_spec4 = pl.BlockSpec((1, 1, block_q, LANES), lambda n, hh, j, i: (n, hh, i, 0))
+
+    dk, dv = pl.pallas_call(
+        dkdv_kernel,
+        grid=(N, h, nkb, nqb),
+        in_specs=[q_spec4, kv_spec4, kv_spec4, b1_spec4, b2_spec4, q_spec4, q_spec4, lse_spec4],
+        out_specs=[kv_spec4, kv_spec4],
+        out_shape=[jax.ShapeDtypeStruct((N, h, R, d), k.dtype),
+                   jax.ShapeDtypeStruct((N, h, R, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, b1, bias2, ot, dot_, lse_b)
+
+    # ---- pass 3: dbias2 — grid (G, h, qi, kj, n), n (within group) innermost ----
+    def db2_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref,
+                   db2_ref, db2_acc):
+        n_in = pl.program_id(4)
+
+        @pl.when(n_in == 0)
+        def _init():
+            db2_acc[:] = jnp.zeros_like(db2_acc)
+
+        _, _, _, _, _, ds = block_math(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref)
+        db2_acc[:] += ds
+
+        @pl.when(n_in == n_seq - 1)
+        def _flush():
+            db2_ref[0, 0] = db2_acc[:]
+
+    def abs_n(g, hh, i, j, n):
+        return g * n_seq + n
+
+    db2 = pl.pallas_call(
+        db2_kernel,
+        grid=(G, h, nqb, nkb, n_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), 0, j)),
+            pl.BlockSpec((1, 1, block_q, block_k), lambda g, hh, i, j, n: (g, hh, i, j)),
+            pl.BlockSpec((1, 1, block_q, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, i, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, 1, block_q, block_k), lambda g, hh, i, j, n: (g, hh, i, j))],
+        out_shape=[jax.ShapeDtypeStruct((G, h, R, R), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, b1, bias2, ot, dot_, lse_b)[0]
+
+    # ---- pass 4: dbias1 — grid (N, kj, h, qi), (h, qi) innermost ----
+    def db1_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref,
+                   db1_ref, db1_acc):
+        hh = pl.program_id(2)
+        qi = pl.program_id(3)
+
+        @pl.when(jnp.logical_and(hh == 0, qi == 0))
+        def _init():
+            db1_acc[:] = jnp.zeros_like(db1_acc)
+
+        _, _, _, _, _, ds = block_math(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref)
+        db1_acc[:] += jnp.sum(ds, axis=0, keepdims=True)  # [1, bk]
+
+        @pl.when(jnp.logical_and(hh == h - 1, qi == nqb - 1))
+        def _flush():
+            db1_ref[0, 0] = db1_acc[0]
+
+    db1 = pl.pallas_call(
+        db1_kernel,
+        grid=(N, nkb, h, nqb),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda n, j, hh, i: (n, hh, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda n, j, hh, i: (n, hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda n, j, hh, i: (n, hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda n, j, hh, i: (n, 0, j)),
+            pl.BlockSpec((1, 1, block_q, block_k), lambda n, j, hh, i: (n // n_seq, hh, i, j)),
+            pl.BlockSpec((1, 1, block_q, d), lambda n, j, hh, i: (n, hh, i, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda n, j, hh, i: (n, hh, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda n, j, hh, i: (n, hh, i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, 1, block_k), lambda n, j, hh, i: (n, 0, j))],
+        out_shape=[jax.ShapeDtypeStruct((N, 1, R), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, block_k), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, b1, bias2, ot, dot_, lse_b)[0]
+
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3), dv.transpose(0, 2, 1, 3),
+            db1[:, 0, :], db2)

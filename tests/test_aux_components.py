@@ -49,6 +49,58 @@ def test_evoformer_attention(seq_chunk):
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=2e-4, atol=2e-5)
 
 
+def test_evoformer_pallas_kernel_interpret_full_grads():
+    """The Pallas biased-flash kernel (VERDICT r3 item 4) vs the einsum
+    oracle — forward AND all five cotangents (q, k, v, mask bias, pair
+    bias), through the Pallas interpreter on CPU. The on-chip twin lives in
+    tests_tpu/test_kernels_tpu.py."""
+    from deepspeed_tpu.ops.evoformer_attn import evoformer_attention
+
+    rng = np.random.default_rng(1)
+    B, n_seq, n_res, h, d = 2, 3, 128, 4, 32
+    q, k, v = (jnp.asarray(rng.normal(size=(B, n_seq, n_res, h, d)).astype(np.float32))
+               for _ in range(3))
+    mask_bias = jnp.asarray(rng.normal(size=(B, n_seq, 1, 1, n_res)).astype(np.float32)) * 2
+    pair_bias = jnp.asarray(rng.normal(size=(B, 1, h, n_res, n_res)).astype(np.float32))
+
+    out = evoformer_attention(q, k, v, [mask_bias, pair_bias], interpret=True)
+    ref = _evo_oracle(q, k, v, [mask_bias, pair_bias])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-5, atol=3e-5)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a) * 0.01)
+
+    g_ref = jax.grad(loss(lambda *a: _evo_oracle(a[0], a[1], a[2], a[3:])),
+                     argnums=(0, 1, 2, 3, 4))(q, k, v, mask_bias, pair_bias)
+    g_pal = jax.grad(loss(lambda *a: evoformer_attention(a[0], a[1], a[2], a[3:], interpret=True)),
+                     argnums=(0, 1, 2, 3, 4))(q, k, v, mask_bias, pair_bias)
+    for name, a, b in zip(("dq", "dk", "dv", "dbias1", "dbias2"), g_ref, g_pal):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=5e-5, atol=5e-5,
+                                   err_msg=name)
+
+
+def test_evoformer_pallas_single_bias_and_route_guard():
+    """Missing pair bias still routes (zero-filled group tile); a bias
+    layout outside the AlphaFold pattern falls back to the jnp path."""
+    from deepspeed_tpu.ops.evoformer_attn import _pallas_route, evoformer_attention
+
+    rng = np.random.default_rng(2)
+    B, n_seq, n_res, h, d = 1, 2, 128, 2, 32
+    q, k, v = (jnp.asarray(rng.normal(size=(B, n_seq, n_res, h, d)).astype(np.float32))
+               for _ in range(3))
+    mask_bias = jnp.asarray(rng.normal(size=(B, n_seq, 1, 1, n_res)).astype(np.float32))
+    out = evoformer_attention(q, k, v, [mask_bias], interpret=True)
+    ref = _evo_oracle(q, k, v, [mask_bias])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-5, atol=3e-5)
+
+    # full per-(seq, head) bias is not the AlphaFold pattern -> no route
+    odd_bias = jnp.zeros((B, n_seq, h, n_res, n_res), jnp.float32)
+    assert _pallas_route(q, [odd_bias], interpret=True) is None
+    # unaligned n_res -> no route
+    q97 = jnp.zeros((B, n_seq, 96, h, d), jnp.float32)
+    assert _pallas_route(q97, [], interpret=True) is None
+
+
 # ---------------------------------------------------------------------------
 # spatial ops (reference csrc/spatial)
 # ---------------------------------------------------------------------------
