@@ -20,6 +20,7 @@ single-compiler stack.
 """
 
 import itertools
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
@@ -185,4 +186,86 @@ class Autotuner:
                                                  -r.config["zero_optimization"]["stage"]))
         logger.info(f"autotune best: stage={best.config['zero_optimization']['stage']} "
                     f"micro={best.config['train_micro_batch_size_per_gpu']}")
+        return best
+
+    def tune_measured(self,
+                      output_dir: str,
+                      zero_stages: Sequence[int] = (0, 1, 2, 3),
+                      micro_batches: Optional[Sequence[int]] = None,
+                      top_k: int = 3,
+                      model_spec: Optional[dict] = None,
+                      steps: int = 3,
+                      warmup: int = 1,
+                      trial_timeout: int = 600):
+        """Full reference-style sweep (``Autotuner.tune():404`` +
+        ``scheduler.ResourceManager``): model-phase compile pruning picks the
+        ``top_k`` candidates, each then runs as a REAL measured trial in a
+        fresh subprocess via the ResourceManager, which writes the
+        per-experiment JSONs, ranked summary and ``best_config.json``.
+
+        ``model_spec``: TransformerConfig kwargs (process-portable — the
+        preferred trial transport). Without it the ``model_factory`` is
+        pickled, which requires an importable module-level callable.
+        Returns the best ``Experiment`` (``.ds_config`` is the winner).
+        """
+        import pickle
+
+        from .scheduler import Experiment, ResourceManager
+
+        micro_batches = list(micro_batches or [1, 2, 4, 8, 16, 32])
+        self.results = []
+        for stage, micro in itertools.product(zero_stages, micro_batches):
+            self.results.append(self.profile_candidate(stage, micro))
+        survivors = [r for r in self.results if r.fits]
+        if not survivors:
+            raise RuntimeError("autotuning found no config that fits; smallest attempt errors: " +
+                               "; ".join(filter(None, (r.error for r in self.results[:3]))))
+        # model-phase ranking (largest micro, lowest stage) picks the trial set
+        survivors.sort(key=lambda r: (-r.config["train_micro_batch_size_per_gpu"],
+                                      r.config["zero_optimization"]["stage"]))
+        picked = survivors[:max(1, top_k)]
+        logger.info(f"autotune: model phase kept {len(survivors)}/{len(self.results)} "
+                    f"candidates; measuring top {len(picked)} in subprocess trials")
+
+        os.makedirs(output_dir, exist_ok=True)
+        experiments = []
+        for i, r in enumerate(picked):
+            stage = r.config["zero_optimization"]["stage"]
+            micro = r.config["train_micro_batch_size_per_gpu"]
+            name = f"z{stage}_mbs{micro}"
+            spec = {"ds_config": r.config, "steps": steps, "warmup": warmup}
+            if model_spec is not None:
+                spec["model_spec"] = dict(model_spec)
+            else:
+                try:
+                    pickle.dumps(self.model_factory)
+                except Exception as e:
+                    raise ValueError(
+                        "model_factory is not picklable for subprocess trials; pass "
+                        f"model_spec=TransformerConfig kwargs instead ({e})") from e
+                import jax
+
+                spec["model_factory"] = self.model_factory
+                probe = self.batch_factory(1)
+                spec["seq"] = int(np.shape(jax.tree_util.tree_leaves(probe)[0])[-1])
+                spec["vocab"] = int(getattr(getattr(self.model_factory(), "config", None),
+                                            "vocab_size", 32000))
+            spec_path = os.path.join(output_dir, f"{name}.spec.pkl")
+            with open(spec_path, "wb") as f:
+                pickle.dump(spec, f)
+            experiments.append(Experiment(
+                exp_id=i, name=name, ds_config=r.config, spec_path=spec_path,
+                result_path=os.path.join(output_dir, f"{name}.result.json")))
+
+        rm = ResourceManager(output_dir, trial_timeout=trial_timeout)
+        rm.run(experiments)
+        best = rm.write_summary()
+        if best is None:
+            raise RuntimeError("autotuning: every measured trial failed; see " +
+                               os.path.join(output_dir, "autotuning_summary.txt"))
+        # fold measured numbers back into the model-phase results
+        for exp in experiments:
+            for r in self.results:
+                if r.config is exp.ds_config and exp.metric_val:
+                    r.measured_tokens_per_s = exp.metric_val
         return best

@@ -1,0 +1,117 @@
+"""Experiment orchestration for the autotuner.
+
+Analog of the reference ``deepspeed/autotuning/scheduler.py`` —
+``ResourceManager`` (``:34``) schedules candidate configs as REAL training
+trials, collects their metrics, and persists the reference's artifact set
+(per-experiment JSON under ``exps/``, a ranked summary, the winning config).
+The reference fans experiments out over cluster nodes via the launcher; one
+TPU host owns all its chips through a single process, so trials run as
+sequential local subprocesses — each in a FRESH process so a candidate that
+OOMs, wedges the runtime, or leaks HBM cannot poison the next trial (the
+same isolation the reference gets from per-node launches).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..utils.logging import logger
+
+
+@dataclass
+class Experiment:
+    """One measured trial (reference scheduler.py experiment dict)."""
+    exp_id: int
+    name: str
+    ds_config: dict
+    spec_path: str = ""           # pickled trial spec consumed by trial.py
+    result_path: str = ""
+    status: str = "pending"       # pending | running | done | failed | timeout
+    metric_val: Optional[float] = None  # tokens/sec
+    peak_bytes: Optional[int] = None
+    error: Optional[str] = None
+    wall_seconds: float = 0.0
+
+
+class ResourceManager:
+    """Run experiments and collect results (reference ``ResourceManager:34``
+    ``schedule_experiments`` / ``run_job`` / ``parse_results``)."""
+
+    def __init__(self, output_dir: str, trial_timeout: int = 600):
+        self.output_dir = output_dir
+        self.exp_dir = os.path.join(output_dir, "exps")
+        os.makedirs(self.exp_dir, exist_ok=True)
+        self.trial_timeout = trial_timeout
+        self.experiments: List[Experiment] = []
+
+    def run(self, experiments: List[Experiment]) -> List[Experiment]:
+        self.experiments = list(experiments)
+        for exp in self.experiments:
+            self._run_one(exp)
+            self._persist(exp)
+        return self.experiments
+
+    def _run_one(self, exp: Experiment):
+        exp.status = "running"
+        cmd = [sys.executable, "-m", "deepspeed_tpu.autotuning.trial",
+               exp.spec_path, exp.result_path]
+        t0 = time.time()
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self.trial_timeout, env=dict(os.environ))
+        except subprocess.TimeoutExpired:
+            exp.status, exp.error = "timeout", f"trial exceeded {self.trial_timeout}s"
+            exp.wall_seconds = time.time() - t0
+            return
+        exp.wall_seconds = time.time() - t0
+        if proc.returncode != 0 or not os.path.exists(exp.result_path):
+            exp.status = "failed"
+            exp.error = (proc.stderr or proc.stdout or "no output").strip()[-400:]
+            return
+        with open(exp.result_path) as f:
+            result = json.load(f)
+        if result.get("error"):
+            exp.status, exp.error = "failed", result["error"][:400]
+            return
+        exp.status = "done"
+        exp.metric_val = result.get("tokens_per_s")
+        exp.peak_bytes = result.get("peak_bytes")
+        logger.info(f"[autotune exp {exp.exp_id}] {exp.name}: "
+                    f"{exp.metric_val:.0f} tokens/s in {exp.wall_seconds:.1f}s")
+
+    def _persist(self, exp: Experiment):
+        with open(os.path.join(self.exp_dir, f"{exp.name}.json"), "w") as f:
+            json.dump({
+                "exp_id": exp.exp_id, "name": exp.name, "status": exp.status,
+                "ds_config": exp.ds_config, "tokens_per_s": exp.metric_val,
+                "peak_bytes": exp.peak_bytes, "error": exp.error,
+                "wall_seconds": round(exp.wall_seconds, 2),
+            }, f, indent=2)
+
+    def write_summary(self) -> Optional[Experiment]:
+        """Ranked summary + best config (reference autotuner's
+        ``autotuning_results`` artifacts). Returns the best experiment."""
+        done = [e for e in self.experiments if e.status == "done" and e.metric_val]
+        ranked = sorted(done, key=lambda e: -e.metric_val)
+        lines = [f"{'rank':>4} {'experiment':<40} {'status':<8} {'tokens/s':>12} {'wall_s':>8}"]
+        for rank, e in enumerate(ranked, 1):
+            lines.append(f"{rank:>4} {e.name:<40} {e.status:<8} {e.metric_val:>12.0f} "
+                         f"{e.wall_seconds:>8.1f}")
+        for e in self.experiments:
+            if e.status != "done":
+                lines.append(f"{'-':>4} {e.name:<40} {e.status:<8} {'-':>12} "
+                             f"{e.wall_seconds:>8.1f}  {e.error and e.error[:60]}")
+        with open(os.path.join(self.output_dir, "autotuning_summary.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+        if not ranked:
+            return None
+        best = ranked[0]
+        with open(os.path.join(self.output_dir, "best_config.json"), "w") as f:
+            json.dump(best.ds_config, f, indent=2)
+        logger.info(f"autotune best measured: {best.name} @ {best.metric_val:.0f} tokens/s "
+                    f"-> {os.path.join(self.output_dir, 'best_config.json')}")
+        return best

@@ -95,8 +95,10 @@ class InferenceEngineV2:
         import numpy as _np
 
         bs = ic.kv_block_size
-        dt_bytes = _np.dtype(ic.kv_dtype).itemsize
+        dt_bytes = _np.dtype(ic.kv_dtype).itemsize  # accepts "int8" and jnp dtypes alike
         per_block = 2 * mc.num_layers * mc.num_kv_heads * mc.head_dim * bs * dt_bytes
+        if dt_bytes == 1:  # int8 KV: absmax scales ride along, fp32 per (token, head)
+            per_block += 2 * mc.num_layers * mc.num_kv_heads * bs * 4
         min_blocks = -(-max_context // bs) + 1
         want_blocks = ic.state_manager.max_tracked_sequences * -(-max_context // bs)
         free = None
@@ -187,8 +189,13 @@ class InferenceEngineV2:
         kv = self.state_manager.kv_cache
         # ONE descriptor upload per forward (reference single pinned-buffer
         # upload; each separate array would be its own RPC on a tunnel)
-        out, k_pool, v_pool = fn(self.params, jnp.asarray(rb.packed()), kv.k_pool, kv.v_pool)
-        kv.update(k_pool, v_pool)
+        if kv.quantized:
+            out, k_pool, v_pool, ks, vs = fn(self.params, jnp.asarray(rb.packed()),
+                                             kv.k_pool, kv.v_pool, kv.k_scale, kv.v_scale)
+            kv.update(k_pool, v_pool, ks, vs)
+        else:
+            out, k_pool, v_pool = fn(self.params, jnp.asarray(rb.packed()), kv.k_pool, kv.v_pool)
+            kv.update(k_pool, v_pool)
         for seq in descs:
             seq.post_forward()
         if not block:
@@ -247,9 +254,15 @@ class InferenceEngineV2:
 
         fn = self._get_compiled_decode(rb.token_ids.shape[0], n_steps)
         kv = self.state_manager.kv_cache
-        toks, k_pool, v_pool = fn(self.params, jnp.asarray(rb.packed()),
-                                  jnp.asarray(rb.seq_start_len), kv.k_pool, kv.v_pool)
-        kv.update(k_pool, v_pool)
+        if kv.quantized:
+            toks, k_pool, v_pool, ks, vs = fn(self.params, jnp.asarray(rb.packed()),
+                                              jnp.asarray(rb.seq_start_len),
+                                              kv.k_pool, kv.v_pool, kv.k_scale, kv.v_scale)
+            kv.update(k_pool, v_pool, ks, vs)
+        else:
+            toks, k_pool, v_pool = fn(self.params, jnp.asarray(rb.packed()),
+                                      jnp.asarray(rb.seq_start_len), kv.k_pool, kv.v_pool)
+            kv.update(k_pool, v_pool)
         for seq in seqs:
             seq.post_forward()
         if not block:
@@ -263,25 +276,48 @@ class InferenceEngineV2:
 
             cfg, bs, use_pallas = self.model_config, self.config.kv_block_size, self._use_pallas
             max_blocks, modules = self._max_blocks_per_seq, self._modules
+            quant = self.state_manager.kv_cache.quantized
 
-            def fwd(params, packed, pos0, k_pool, v_pool):
-                token_ids, seq_idx, _pos, valid, tables, last_idx = unpack_descriptors(
-                    packed, s_bucket, s_bucket, max_blocks)
+            if quant:
+                def fwd(params, packed, pos0, k_pool, v_pool, k_scale, v_scale):
+                    token_ids, seq_idx, _pos, valid, tables, last_idx = unpack_descriptors(
+                        packed, s_bucket, s_bucket, max_blocks)
 
-                def step(carry, t):
-                    toks, kp, vp = carry
-                    pos = pos0 + t
-                    logits, kp, vp = ragged_forward(cfg, bs, params, toks, seq_idx, pos, valid,
-                                                    tables, last_idx, kp, vp, use_pallas=use_pallas,
-                                                    modules=modules)
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    return (nxt, kp, vp), nxt
+                    def step(carry, t):
+                        toks, kp, vp, ks, vs = carry
+                        pos = pos0 + t
+                        logits, kp, vp, ks, vs = ragged_forward(
+                            cfg, bs, params, toks, seq_idx, pos, valid, tables, last_idx,
+                            kp, vp, use_pallas=use_pallas, modules=modules,
+                            k_scale=ks, v_scale=vs)
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                        return (nxt, kp, vp, ks, vs), nxt
 
-                (_, k_pool, v_pool), out = jax.lax.scan(
-                    step, (token_ids, k_pool, v_pool), jnp.arange(n_steps, dtype=jnp.int32))
-                return out.T, k_pool, v_pool  # [S, n_steps]
+                    (_, k_pool, v_pool, k_scale, v_scale), out = jax.lax.scan(
+                        step, (token_ids, k_pool, v_pool, k_scale, v_scale),
+                        jnp.arange(n_steps, dtype=jnp.int32))
+                    return out.T, k_pool, v_pool, k_scale, v_scale  # [S, n_steps]
 
-            self._compiled[key] = jax.jit(fwd, donate_argnums=(3, 4))
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(3, 4, 5, 6))
+            else:
+                def fwd(params, packed, pos0, k_pool, v_pool):
+                    token_ids, seq_idx, _pos, valid, tables, last_idx = unpack_descriptors(
+                        packed, s_bucket, s_bucket, max_blocks)
+
+                    def step(carry, t):
+                        toks, kp, vp = carry
+                        pos = pos0 + t
+                        logits, kp, vp = ragged_forward(cfg, bs, params, toks, seq_idx, pos, valid,
+                                                        tables, last_idx, kp, vp, use_pallas=use_pallas,
+                                                        modules=modules)
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                        return (nxt, kp, vp), nxt
+
+                    (_, k_pool, v_pool), out = jax.lax.scan(
+                        step, (token_ids, k_pool, v_pool), jnp.arange(n_steps, dtype=jnp.int32))
+                    return out.T, k_pool, v_pool  # [S, n_steps]
+
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(3, 4))
             log_dist(f"compiled multi-step decode bucket seqs={s_bucket} steps={n_steps}", ranks=[0])
         return self._compiled[key]
 
@@ -306,19 +342,33 @@ class InferenceEngineV2:
 
             cfg, bs, use_pallas = self.model_config, self.config.kv_block_size, self._use_pallas
             max_blocks, modules = self._max_blocks_per_seq, self._modules
+            quant = self.state_manager.kv_cache.quantized
             if sample not in (None, "greedy"):
                 raise ValueError(f"unsupported sample mode {sample!r}: None | 'greedy'")
 
-            def fwd(params, packed, k_pool, v_pool):
-                token_ids, seq_idx, pos, valid, tables, last_idx = unpack_descriptors(
-                    packed, t_bucket, s_bucket, max_blocks)
-                logits, k_pool, v_pool = ragged_forward(cfg, bs, params, token_ids, seq_idx, pos, valid,
-                                                        tables, last_idx, k_pool, v_pool,
-                                                        use_pallas=use_pallas, modules=modules)
-                out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
-                return out, k_pool, v_pool
+            if quant:
+                def fwd(params, packed, k_pool, v_pool, k_scale, v_scale):
+                    token_ids, seq_idx, pos, valid, tables, last_idx = unpack_descriptors(
+                        packed, t_bucket, s_bucket, max_blocks)
+                    logits, k_pool, v_pool, k_scale, v_scale = ragged_forward(
+                        cfg, bs, params, token_ids, seq_idx, pos, valid, tables, last_idx,
+                        k_pool, v_pool, use_pallas=use_pallas, modules=modules,
+                        k_scale=k_scale, v_scale=v_scale)
+                    out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
+                    return out, k_pool, v_pool, k_scale, v_scale
 
-            self._compiled[key] = jax.jit(fwd, donate_argnums=(2, 3))
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(2, 3, 4, 5))
+            else:
+                def fwd(params, packed, k_pool, v_pool):
+                    token_ids, seq_idx, pos, valid, tables, last_idx = unpack_descriptors(
+                        packed, t_bucket, s_bucket, max_blocks)
+                    logits, k_pool, v_pool = ragged_forward(cfg, bs, params, token_ids, seq_idx, pos, valid,
+                                                            tables, last_idx, k_pool, v_pool,
+                                                            use_pallas=use_pallas, modules=modules)
+                    out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
+                    return out, k_pool, v_pool
+
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(2, 3))
             log_dist(f"compiled ragged forward bucket tokens={t_bucket} seqs={s_bucket} "
                      f"sample={sample}", ranks=[0])
         return self._compiled[key]

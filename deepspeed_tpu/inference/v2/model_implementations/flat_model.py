@@ -34,7 +34,8 @@ from ....models.transformer import TransformerConfig, apply_rope, mlp_activation
 
 def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, Any], token_ids, seq_idx, pos, valid,
                    block_tables, last_idx, k_pool, v_pool, use_pallas: bool = False,
-                   unroll: bool = True, modules: Dict[str, Any] = None):
+                   unroll: bool = True, modules: Dict[str, Any] = None,
+                   k_scale=None, v_scale=None):
     """Returns (last-token logits [S_pad, V], k_pool, v_pool).
 
     token_ids/seq_idx/pos/valid: [T_pad]; block_tables: [S_pad, max_blocks];
@@ -52,6 +53,13 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
     — attention / linear / embedding / unembed / norm slots, reference
     FastGen's DSModule layer). None builds the auto set from ``cfg`` and
     ``use_pallas``, preserving the pre-registry call surface.
+
+    ``k_scale``/``v_scale``: int8-KV mode — [nkv, L*pool_len] fp32 absmax
+    scales (lane-major over slots, the layout both the scatter and the
+    Pallas kernel consume without a transpose). When given, the pools hold
+    int8, each layer quantizes its fresh K/V per (token, head) before the
+    scatter, and the return gains the updated scale pools:
+    (logits, k_pool, v_pool, k_scale, v_scale).
     """
     if modules is None:
         from ..config_v2 import RaggedInferenceEngineConfig
@@ -86,7 +94,9 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
     flat_len = L * pool_len
     slot = block_tables[seq_idx, pos // block_size] * block_size + pos % block_size
 
-    def layer(x, blk, l, k_flat, v_flat):
+    quant = k_scale is not None
+
+    def layer(x, blk, l, k_flat, v_flat, ks_flat, vs_flat):
         h1 = pre_norm(x, blk["ln1_scale"], blk.get("ln1_bias"))
         bias = (lambda n: blk[n]) if cfg.use_bias else (lambda n: None)
         q = linear(h1, blk["wq"], bias("bq")).reshape(T, nq, d)
@@ -99,11 +109,23 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
         # append this batch's KV to the paged pool (linear_blocked_kv_rotary);
         # in-place scatter on the scan carry at layer l's offset
         slot_l = jnp.where(valid, l * pool_len + slot, flat_len)
+        if quant:
+            # symmetric int8 per (token, kv-head): absmax/127 over head_dim
+            ks = jnp.maximum(jnp.max(jnp.abs(k.astype(jnp.float32)), axis=-1) / 127.0, 1e-8)
+            vs = jnp.maximum(jnp.max(jnp.abs(v.astype(jnp.float32)), axis=-1) / 127.0, 1e-8)
+            k = jnp.round(k.astype(jnp.float32) / ks[..., None])
+            v = jnp.round(v.astype(jnp.float32) / vs[..., None])
+            heads = jnp.arange(nkv, dtype=jnp.int32)[None, :]
+            ks_flat = ks_flat.at[heads, slot_l[:, None]].set(ks, mode="drop")
+            vs_flat = vs_flat.at[heads, slot_l[:, None]].set(vs, mode="drop")
         k_flat = k_flat.at[slot_l].set(k.astype(k_flat.dtype), mode="drop")
         v_flat = v_flat.at[slot_l].set(v.astype(v_flat.dtype), mode="drop")
 
         tables_l = block_tables + l * NB  # layer l's blocks in the flat pool
-        ctx = attention(q, k_flat, v_flat, tables_l, seq_idx, pos)
+        # scales only passed in int8 mode, so full-precision third-party
+        # attention implementations keep the original 6-arg call signature
+        scales = {"k_scale": ks_flat, "v_scale": vs_flat} if quant else {}
+        ctx = attention(q, k_flat, v_flat, tables_l, seq_idx, pos, **scales)
 
         attn_out = linear(ctx.reshape(T, nq * d), blk["wo"], bias("bo"))
 
@@ -117,29 +139,34 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
 
         if cfg.parallel_residual:  # GPT-J / NeoX / Falcon
             h2 = h1 if cfg.shared_ln else pre_norm(x, blk["ln2_scale"], blk.get("ln2_bias"))
-            return x + attn_out + mlp(h2), k_flat, v_flat
+            return x + attn_out + mlp(h2), k_flat, v_flat, ks_flat, vs_flat
         x = x + attn_out
         h2 = pre_norm(x, blk["ln2_scale"], blk.get("ln2_bias"))
-        return x + mlp(h2), k_flat, v_flat
+        return x + mlp(h2), k_flat, v_flat, ks_flat, vs_flat
 
     k_flat = k_pool.reshape(flat_len, nkv, d)
     v_flat = v_pool.reshape(flat_len, nkv, d)
+    ks_flat, vs_flat = k_scale, v_scale  # already [nkv, flat_len] or None
     if unroll and L <= 48:
         for l in range(L):
             blk_l = jax.tree_util.tree_map(lambda a: a[l], params["blocks"])
-            x, k_flat, v_flat = layer(x, blk_l, l, k_flat, v_flat)
+            x, k_flat, v_flat, ks_flat, vs_flat = layer(x, blk_l, l, k_flat, v_flat,
+                                                        ks_flat, vs_flat)
     else:
         def scan_body(carry, inp):
-            x, kf, vf = carry
+            x, kf, vf, ksf, vsf = carry
             blk, l = inp
-            return layer(x, blk, l, kf, vf), None
+            return layer(x, blk, l, kf, vf, ksf, vsf), None
 
-        (x, k_flat, v_flat), _ = jax.lax.scan(
-            scan_body, (x, k_flat, v_flat),
+        (x, k_flat, v_flat, ks_flat, vs_flat), _ = jax.lax.scan(
+            scan_body, (x, k_flat, v_flat, ks_flat, vs_flat),
             (params["blocks"], jnp.arange(L, dtype=jnp.int32)))
     k_pool = k_flat.reshape(L, pool_len, nkv, d)
     v_pool = v_flat.reshape(L, pool_len, nkv, d)
 
     # logits_gather semantics: final norm + unembed only each sequence's
     # last token, through the pluggable unembed module
-    return unembed(params, x, last_idx), k_pool, v_pool
+    logits = unembed(params, x, last_idx)
+    if quant:
+        return logits, k_pool, v_pool, ks_flat, vs_flat
+    return logits, k_pool, v_pool

@@ -34,11 +34,11 @@ class DenseBlockedAttention(DSSelfAttentionBase):
     def supports_config(config: DSSelfAttentionConfig) -> bool:
         return config.num_heads % max(config.num_kv_heads, 1) == 0
 
-    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos):
+    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None, v_scale=None):
         cfg = self.config
         return paged_attention_reference(q, k_flat, v_flat, tables_l, seq_idx, pos,
                                          cfg.block_size, window=cfg.sliding_window,
-                                         alibi=_alibi(cfg))
+                                         alibi=_alibi(cfg), k_scale=k_scale, v_scale=v_scale)
 
 
 @DSSelfAttentionRegistry.register_module
@@ -54,7 +54,7 @@ class PallasPagedAttention(DSSelfAttentionBase):
         return (config.num_heads % max(config.num_kv_heads, 1) == 0
                 and config.head_dim % 2 == 0)
 
-    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos):
+    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None, v_scale=None):
         cfg = self.config
         if self.implementation_config.get("interpret", False):
             import jax.numpy as jnp
@@ -63,7 +63,9 @@ class PallasPagedAttention(DSSelfAttentionBase):
             return _pallas_paged(q, k_flat, v_flat, tables_l, seq_idx.astype(jnp.int32),
                                  pos.astype(jnp.int32), block_size=cfg.block_size,
                                  interpret=True, window=cfg.sliding_window,
-                                 alibi=tuple(np.asarray(al).tolist()) if al is not None else None)
+                                 alibi=tuple(np.asarray(al).tolist()) if al is not None else None,
+                                 k_scale=k_scale, v_scale=v_scale)
         # paged_attention itself falls back (loudly) off-TPU / tiny heads
         return paged_attention(q, k_flat, v_flat, tables_l, seq_idx, pos,
-                               cfg.block_size, window=cfg.sliding_window, alibi=_alibi(cfg))
+                               cfg.block_size, window=cfg.sliding_window, alibi=_alibi(cfg),
+                               k_scale=k_scale, v_scale=v_scale)

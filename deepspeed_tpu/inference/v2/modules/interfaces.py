@@ -20,10 +20,12 @@ from .module_registry import DSModuleRegistryBase
 class DSSelfAttentionBase(DSModuleBase):
     """Ragged paged attention (reference ``interfaces/attention_base.py``).
 
-    ``__call__(q, k_flat, v_flat, tables_l, seq_idx, pos)`` with
-    q: [T, nq, d]; k_flat/v_flat: flat layer-offset KV pool views
-    [(L*NB*bs), nkv, d]; tables_l: [S, max_blocks] block tables already
-    offset to layer l; seq_idx/pos: [T]. Returns context [T, nq, d].
+    ``__call__(q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None,
+    v_scale=None)`` with q: [T, nq, d]; k_flat/v_flat: flat layer-offset KV
+    pool views [(L*NB*bs), nkv, d]; tables_l: [S, max_blocks] block tables
+    already offset to layer l; seq_idx/pos: [T]; k_scale/v_scale: int8-KV
+    dequant factors [nkv, (L*NB*bs)] (None = full-precision pools).
+    Returns context [T, nq, d].
     """
 
     @staticmethod
@@ -31,7 +33,7 @@ class DSSelfAttentionBase(DSModuleBase):
         return DSSelfAttentionConfig
 
     @abstractmethod
-    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos):
+    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None, v_scale=None):
         ...
 
 

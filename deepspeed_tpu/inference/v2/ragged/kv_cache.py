@@ -23,6 +23,14 @@ from .blocked_allocator import BlockedAllocator
 
 
 class BlockedKVCache:
+    """``dtype=jnp.int8`` (or the string ``"int8"``) selects the quantized
+    cache (the TPU analog of the reference FastGen quantized KV variants,
+    ``csrc/quantization/``): values stored int8 with one fp32 absmax/127
+    scale per (token, kv-head) in side pools ``k_scale``/``v_scale``
+    [nkv, L*NB*bs] (kv-heads on sublanes, flat slots on lanes — the layout
+    the forward's scatter and the Pallas kernel read without a transpose).
+    Decode is bound by the KV byte stream, so int8 halves that term (scales
+    add 1/(2·head_dim) back)."""
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int, num_blocks: int, block_size: int = 64,
                  dtype=jnp.bfloat16, sharding=None):
@@ -31,11 +39,22 @@ class BlockedKVCache:
         self.head_dim = head_dim
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        if dtype in ("int8", jnp.int8, np.int8):
+            dtype = jnp.int8
         self.dtype = dtype
+        self.quantized = dtype == jnp.int8
         self._allocator = BlockedAllocator(num_blocks)
         shape = (num_layers, self.num_blocks * self.block_size, num_kv_heads, head_dim)
         self.k_pool = jnp.zeros(shape, dtype)
         self.v_pool = jnp.zeros(shape, dtype)
+        self.k_scale = self.v_scale = None
+        if self.quantized:
+            # [nkv, L * NB * bs] — kv-heads on sublanes, slots on lanes: the
+            # layout the forward's scatter and the Pallas kernel's scale
+            # BlockSpec both consume without a per-call transpose
+            flat = num_layers * self.num_blocks * self.block_size
+            self.k_scale = jnp.zeros((num_kv_heads, flat), jnp.float32)
+            self.v_scale = jnp.zeros((num_kv_heads, flat), jnp.float32)
         if sharding is not None:
             self.k_pool = jax.device_put(self.k_pool, sharding)
             self.v_pool = jax.device_put(self.v_pool, sharding)
@@ -51,9 +70,14 @@ class BlockedKVCache:
     def free(self, blocks) -> None:
         self._allocator.free(blocks)
 
-    def update(self, k_pool, v_pool) -> None:
+    def update(self, k_pool, v_pool, k_scale=None, v_scale=None) -> None:
         """Install the pools returned by the jitted forward (donated in/out)."""
         self.k_pool, self.v_pool = k_pool, v_pool
+        if k_scale is not None:
+            self.k_scale, self.v_scale = k_scale, v_scale
 
     def memory_bytes(self) -> int:
-        return 2 * self.k_pool.size * self.k_pool.dtype.itemsize
+        n = 2 * self.k_pool.size * self.k_pool.dtype.itemsize
+        if self.quantized:
+            n += 2 * self.k_scale.size * 4
+        return n

@@ -1,2 +1,3 @@
 from .layer import MoE
+from .mappings import drop_tokens, drop_tokens_constraint, gather_tokens, gather_tokens_constraint
 from .sharded_moe import MOELayer, TopKGate, top1gating, top2gating

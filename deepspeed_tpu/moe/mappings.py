@@ -1,0 +1,67 @@
+"""MoE ↔ tensor-parallel token mappings.
+
+Analog of the reference ``deepspeed/moe/mappings.py`` (``_gather_tokens:28``
+/ ``_drop_tokens:47`` with their autograd duals ``_GatherTokens:60`` /
+``_DropTokens``): a TP-sharded transformer feeds its MoE layer tokens that
+are REPLICATED across the model axis; the expert all-to-all wants each rank
+to own a distinct token shard, so the MoE block drops to a 1/tp slice on
+entry and gathers back on exit.
+
+TPU form: inside ``shard_map`` the two mappings are one collective each —
+``jax.lax.all_gather`` over the model axis (gather) and a static slice of
+this rank's chunk (drop). They are exact transposes of each other, so
+``jax.grad`` derives each one's backward as the other automatically — the
+reference's hand-written autograd Function pair is subsumed by the functional
+transform. Outside ``shard_map`` (GSPMD-auto code), use the
+``*_constraint`` forms: a ``with_sharding_constraint`` re-annotation that
+lets XLA insert the identical collective.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import MODEL_AXIS
+
+
+def gather_tokens(x, dim: int = 0, axis_name: str = MODEL_AXIS):
+    """All-gather token shards along ``dim`` across the TP axis
+    (reference ``_gather_tokens:28``). shard_map-traced form."""
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def drop_tokens(x, dim: int = 0, axis_name: str = MODEL_AXIS):
+    """Keep this rank's 1/tp slice along ``dim`` (reference
+    ``_drop_tokens:47``). shard_map-traced form."""
+    tp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    n = x.shape[dim]
+    assert n % tp == 0, (f"input dimension {dim} ({n}) is not divisible by "
+                         f"tensor parallel world size ({tp})")
+    chunk = n // tp
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=dim)
+
+
+def gather_tokens_constraint(x, dim: int = 0, mesh=None, axis_name: str = MODEL_AXIS):
+    """GSPMD-auto form of ``gather_tokens``: constrain ``dim`` replicated so
+    XLA materializes the model-axis all-gather at this point. Every OTHER
+    dim stays UNCONSTRAINED — a batch dim sharded over the data axis keeps
+    its sharding instead of being collaterally all-gathered."""
+    from ..parallel import groups
+
+    mesh = mesh or groups.get_mesh()
+    spec = [P.UNCONSTRAINED] * x.ndim
+    spec[dim] = None
+    return jax.lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, P(*spec)))
+
+
+def drop_tokens_constraint(x, dim: int = 0, mesh=None, axis_name: str = MODEL_AXIS):
+    """GSPMD-auto form of ``drop_tokens``: constrain ``dim`` sharded over the
+    model axis so XLA slices each rank's chunk here; other dims stay
+    UNCONSTRAINED (DP shardings compose untouched)."""
+    from ..parallel import groups
+
+    mesh = mesh or groups.get_mesh()
+    spec = [P.UNCONSTRAINED] * x.ndim
+    spec[dim] = axis_name
+    return jax.lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, P(*spec)))

@@ -61,10 +61,12 @@ def _evoformer_pallas(q, k, v, b1, b2, interpret=False):
     qf = q.reshape(N, R, h, d)
     kf = k.reshape(N, R, h, d)
     vf = v.reshape(N, R, h, d)
+    # absent biases pass through as None: the kernel substitutes one
+    # resident zero tile and skips that bias's backward pass entirely
     b1f = (jnp.broadcast_to(b1, (*lead, n_seq, 1, 1, R)).reshape(N, R).astype(jnp.float32)
-           if b1 is not None else jnp.zeros((N, R), jnp.float32))
+           if b1 is not None else None)
     b2f = (jnp.broadcast_to(b2, (*lead, 1, h, R, R)).reshape(G, h, R, R).astype(jnp.float32)
-           if b2 is not None else jnp.zeros((G, h, R, R), jnp.float32))
+           if b2 is not None else None)
     out = evo_flash(qf, kf, vf, b1f, b2f, interpret=interpret)
     return out.reshape(*lead, n_seq, R, h, d)
 

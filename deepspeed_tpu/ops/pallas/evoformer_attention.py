@@ -68,21 +68,27 @@ def _fit_tiles(R: int, d: int, bq: int, bk: int):
         bq, bk = bq2, bk2
 
 
-def evo_flash(q, k, v, bias1, bias2, block_q=512, block_k=512, interpret=False):
-    """q/k/v: [N, R, h, d]; bias1: [N, R] fp32; bias2: [G, h, R, R] fp32
-    with N % G == 0. Returns [N, R, h, d]. Differentiable in all five
-    operands (bias cotangents accumulate in fp32 inside the kernel)."""
+def evo_flash(q, k, v, bias1=None, bias2=None, block_q=512, block_k=512, interpret=False):
+    """q/k/v: [N, R, h, d]; bias1: [N, R] fp32 or None; bias2: [G, h, R, R]
+    fp32 (N % G == 0) or None. Returns [N, R, h, d]. Differentiable in every
+    present operand (bias cotangents accumulate in fp32 inside the kernel);
+    an absent bias costs one resident zero tile in the forward and skips its
+    backward pass entirely."""
     N, R, h, d = q.shape
-    G = bias2.shape[0]
-    assert N % G == 0, f"N={N} must be a multiple of bias2 groups G={G}"
-    assert bias1.shape == (N, R) and bias2.shape == (G, h, R, R)
+    if bias1 is not None:
+        assert bias1.shape == (N, R), f"bias1 {bias1.shape} != {(N, R)}"
+        bias1 = bias1.astype(jnp.float32)
+    if bias2 is not None:
+        G = bias2.shape[0]
+        assert N % G == 0, f"N={N} must be a multiple of bias2 groups G={G}"
+        assert bias2.shape == (G, h, R, R), f"bias2 {bias2.shape} != {(G, h, R, R)}"
+        bias2 = bias2.astype(jnp.float32)
     bq = _fit_block(R, min(block_q, R))
     bk = _fit_block(R, min(block_k, R))
     fitted = _fit_tiles(R, d, bq, bk)
     if fitted is None:
         raise ValueError(f"no evoformer tiling fits VMEM for R={R}, d={d}")
-    return _evo_core(fitted[0], fitted[1], interpret, q, k, v,
-                     bias1.astype(jnp.float32), bias2.astype(jnp.float32))
+    return _evo_core(fitted[0], fitted[1], interpret, q, k, v, bias1, bias2)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
@@ -109,7 +115,8 @@ def _evo_fwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2):
     from jax.experimental.pallas import tpu as pltpu
 
     N, R, h, d = q.shape
-    G = bias2.shape[0]
+    has_b1, has_b2 = bias1 is not None, bias2 is not None
+    G = bias2.shape[0] if has_b2 else 1
     n_seq = N // G
     scale = 1.0 / math.sqrt(d)
     nqb, nkb = R // block_q, R // block_k
@@ -118,7 +125,13 @@ def _evo_fwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2):
     qt = q.transpose(0, 2, 1, 3)  # [N, h, R, d]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    b1 = bias1[:, None, :]        # [N, 1, R]
+    # absent bias: ONE resident zero tile (index map constant -> the DMA
+    # refetches nothing, and no [G, h, R, R] zeros ever exist in HBM)
+    b1 = bias1[:, None, :] if has_b1 else jnp.zeros((1, 1, block_k), jnp.float32)
+    b2 = bias2 if has_b2 else jnp.zeros((1, 1, block_q, block_k), jnp.float32)
+    b1_ix = (lambda n, hh, i, j: (n, 0, j)) if has_b1 else (lambda n, hh, i, j: (0, 0, 0))
+    b2_ix = ((lambda n, hh, i, j: (n // n_seq, hh, i, j)) if has_b2
+             else (lambda n, hh, i, j: (0, 0, 0, 0)))
 
     def kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
         kj = pl.program_id(3)
@@ -155,8 +168,8 @@ def _evo_fwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2):
             pl.BlockSpec((1, 1, block_q, d), lambda n, hh, i, j: (n, hh, i, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda n, hh, i, j: (n, hh, j, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda n, hh, i, j: (n, hh, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda n, hh, i, j: (n, 0, j)),
-            pl.BlockSpec((1, 1, block_q, block_k), lambda n, hh, i, j: (n // n_seq, hh, i, j)),
+            pl.BlockSpec((1, 1, block_k), b1_ix),
+            pl.BlockSpec((1, 1, block_q, block_k), b2_ix),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda n, hh, i, j: (n, hh, i, 0)),
@@ -172,7 +185,7 @@ def _evo_fwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, b1, bias2)
+    )(qt, kt, vt, b1, b2)
     return out.transpose(0, 2, 1, 3), lse[..., 0]
 
 
@@ -181,7 +194,8 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
     from jax.experimental.pallas import tpu as pltpu
 
     N, R, h, d = q.shape
-    G = bias2.shape[0]
+    has_b1, has_b2 = bias1 is not None, bias2 is not None
+    G = bias2.shape[0] if has_b2 else 1
     n_seq = N // G
     scale = 1.0 / math.sqrt(d)
     nqb, nkb = R // block_q, R // block_k
@@ -193,7 +207,9 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
     ot = out.transpose(0, 2, 1, 3)
     dot_ = dout.transpose(0, 2, 1, 3)
     lse_b = jnp.broadcast_to(lse[..., None], (N, h, R, LANES))
-    b1 = bias1[:, None, :]
+    # absent bias: one resident zero tile (see _evo_fwd_impl)
+    b1 = bias1[:, None, :] if has_b1 else jnp.zeros((1, 1, block_k), jnp.float32)
+    b2 = bias2 if has_b2 else jnp.zeros((1, 1, block_q, block_k), jnp.float32)
 
     def block_math(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref):
         """Recompute p and ds for the current [bq, bk] tile."""
@@ -228,8 +244,11 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
 
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda n, hh, i, j: (n, hh, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda n, hh, i, j: (n, hh, j, 0))
-    b1_spec = pl.BlockSpec((1, 1, block_k), lambda n, hh, i, j: (n, 0, j))
-    b2_spec = pl.BlockSpec((1, 1, block_q, block_k), lambda n, hh, i, j: (n // n_seq, hh, i, j))
+    b1_spec = pl.BlockSpec((1, 1, block_k), (lambda n, hh, i, j: (n, 0, j)) if has_b1
+                           else (lambda n, hh, i, j: (0, 0, 0)))
+    b2_spec = pl.BlockSpec((1, 1, block_q, block_k),
+                           (lambda n, hh, i, j: (n // n_seq, hh, i, j)) if has_b2
+                           else (lambda n, hh, i, j: (0, 0, 0, 0)))
     lse_spec = pl.BlockSpec((1, 1, block_q, LANES), lambda n, hh, i, j: (n, hh, i, 0))
 
     dq = pl.pallas_call(
@@ -240,7 +259,7 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
         out_shape=[jax.ShapeDtypeStruct((N, h, R, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, b1, bias2, ot, dot_, lse_b)[0]
+    )(qt, kt, vt, b1, b2, ot, dot_, lse_b)[0]
 
     # ---- pass 2: dk/dv — grid (N, h, kj, qi), qi innermost ----
     def dkdv_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref,
@@ -263,8 +282,11 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
 
     q_spec4 = pl.BlockSpec((1, 1, block_q, d), lambda n, hh, j, i: (n, hh, i, 0))
     kv_spec4 = pl.BlockSpec((1, 1, block_k, d), lambda n, hh, j, i: (n, hh, j, 0))
-    b1_spec4 = pl.BlockSpec((1, 1, block_k), lambda n, hh, j, i: (n, 0, j))
-    b2_spec4 = pl.BlockSpec((1, 1, block_q, block_k), lambda n, hh, j, i: (n // n_seq, hh, i, j))
+    b1_spec4 = pl.BlockSpec((1, 1, block_k), (lambda n, hh, j, i: (n, 0, j)) if has_b1
+                            else (lambda n, hh, j, i: (0, 0, 0)))
+    b2_spec4 = pl.BlockSpec((1, 1, block_q, block_k),
+                            (lambda n, hh, j, i: (n // n_seq, hh, i, j)) if has_b2
+                            else (lambda n, hh, j, i: (0, 0, 0, 0)))
     lse_spec4 = pl.BlockSpec((1, 1, block_q, LANES), lambda n, hh, j, i: (n, hh, i, 0))
 
     dk, dv = pl.pallas_call(
@@ -277,9 +299,10 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, b1, bias2, ot, dot_, lse_b)
+    )(qt, kt, vt, b1, b2, ot, dot_, lse_b)
 
-    # ---- pass 3: dbias2 — grid (G, h, qi, kj, n), n (within group) innermost ----
+    # ---- pass 3: dbias2 — grid (G, h, qi, kj, n), n (within group) innermost.
+    # Skipped entirely when the pair bias is absent (no discarded gradient) ----
     def db2_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref,
                    db2_ref, db2_acc):
         n_in = pl.program_id(4)
@@ -298,14 +321,15 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
     def abs_n(g, hh, i, j, n):
         return g * n_seq + n
 
-    db2 = pl.pallas_call(
+    db2 = None if not has_b2 else pl.pallas_call(
         db2_kernel,
         grid=(G, h, nqb, nkb, n_seq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, i, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, j, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), 0, j)),
+            pl.BlockSpec((1, 1, block_k), (lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), 0, j))
+                         if has_b1 else (lambda g, hh, i, j, n: (0, 0, 0))),
             pl.BlockSpec((1, 1, block_q, block_k), lambda g, hh, i, j, n: (g, hh, i, j)),
             pl.BlockSpec((1, 1, block_q, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, i, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda g, hh, i, j, n: (abs_n(g, hh, i, j, n), hh, i, 0)),
@@ -315,9 +339,10 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
         out_shape=[jax.ShapeDtypeStruct((G, h, R, R), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, b1, bias2, ot, dot_, lse_b)[0]
+    )(qt, kt, vt, b1, b2, ot, dot_, lse_b)[0]
 
-    # ---- pass 4: dbias1 — grid (N, kj, h, qi), (h, qi) innermost ----
+    # ---- pass 4: dbias1 — grid (N, kj, h, qi), (h, qi) innermost.
+    # Skipped entirely when the mask bias is absent ----
     def db1_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, do_ref, lse_ref,
                    db1_ref, db1_acc):
         hh = pl.program_id(2)
@@ -334,7 +359,7 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
         def _flush():
             db1_ref[0, 0] = db1_acc[0]
 
-    db1 = pl.pallas_call(
+    db1 = None if not has_b1 else pl.pallas_call(
         db1_kernel,
         grid=(N, nkb, h, nqb),
         in_specs=[
@@ -342,7 +367,9 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
             pl.BlockSpec((1, 1, block_k, d), lambda n, j, hh, i: (n, hh, j, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda n, j, hh, i: (n, hh, j, 0)),
             pl.BlockSpec((1, 1, block_k), lambda n, j, hh, i: (n, 0, j)),
-            pl.BlockSpec((1, 1, block_q, block_k), lambda n, j, hh, i: (n // n_seq, hh, i, j)),
+            pl.BlockSpec((1, 1, block_q, block_k),
+                         (lambda n, j, hh, i: (n // n_seq, hh, i, j)) if has_b2
+                         else (lambda n, j, hh, i: (0, 0, 0, 0))),
             pl.BlockSpec((1, 1, block_q, d), lambda n, j, hh, i: (n, hh, i, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda n, j, hh, i: (n, hh, i, 0)),
             pl.BlockSpec((1, 1, block_q, LANES), lambda n, j, hh, i: (n, hh, i, 0)),
@@ -351,7 +378,7 @@ def _evo_bwd_impl(block_q, block_k, interpret, q, k, v, bias1, bias2, out, lse, 
         out_shape=[jax.ShapeDtypeStruct((N, 1, R), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((1, block_k), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, b1, bias2, ot, dot_, lse_b)[0]
+    )(qt, kt, vt, b1, b2, ot, dot_, lse_b)[0]
 
     return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3), dv.transpose(0, 2, 1, 3),
-            db1[:, 0, :], db2)
+            None if db1 is None else db1[:, 0, :], db2)

@@ -28,12 +28,17 @@ import numpy as np
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, window=None,
-                    alibi=None):
+                    alibi=None, k_scale=None, v_scale=None):
     """q: [T, nq, d]; k_pool/v_pool: [pool_len, nkv, d] (one layer,
     pool_len = num_blocks*block_size, may include one trailing scratch slot);
     block_tables: [S, max_blocks]; seq_idx/pos: [T].
     ``window``: sliding-window attention (Mistral) — token at position p
     attends cached positions in (p - window, p].
+    ``k_scale``/``v_scale``: int8-KV mode (the FastGen quantized-KV analog,
+    reference ``csrc/quantization/``) — pools hold int8 values and the
+    scales [nkv, pool_len] hold one fp32 absmax/127 factor per (kv-head,
+    slot); dequant happens at the kernel's tile read, so only int8 bytes
+    stream from HBM.
     Returns [T, nq, d]."""
     T, nq, d = q.shape
     nkv = k_pool.shape[1]
@@ -41,23 +46,25 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: i
         window = int(window)
     if jax.default_backend() != "tpu" or nq < 8 or d % 128 != 0:
         return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
-                                         window=window, alibi=alibi)
+                                         window=window, alibi=alibi, k_scale=k_scale, v_scale=v_scale)
     try:
         return _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx.astype(jnp.int32), pos.astype(jnp.int32),
                              block_size=block_size, window=window,
-                             alibi=tuple(np.asarray(alibi).tolist()) if alibi is not None else None)
+                             alibi=tuple(np.asarray(alibi).tolist()) if alibi is not None else None,
+                             k_scale=k_scale, v_scale=v_scale)
     except Exception as e:  # pragma: no cover — kernel bring-up safety net
         from ...utils.logging import warning_once
 
         warning_once(f"pallas paged attention unavailable ({type(e).__name__}: {e}); using gather fallback")
         return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
-                                         window=window, alibi=alibi)
+                                         window=window, alibi=alibi, k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int,
-                              window=None, alibi=None):
+                              window=None, alibi=None, k_scale=None, v_scale=None):
     """Gather-based oracle: materializes each sequence's context. ``alibi``:
-    per-head slopes [nq] (Bloom)."""
+    per-head slopes [nq] (Bloom). ``k_scale``/``v_scale``: int8-KV
+    dequantization factors [nkv, pool_len] (see ``paged_attention``)."""
     T, nq, d = q.shape
     nkv = k_pool.shape[1]
     g = nq // nkv
@@ -67,6 +74,9 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, blo
                  jnp.arange(block_size, dtype=jnp.int32)[None, None, :]).reshape(S, C)
     ctxk = k_pool[ctx_slots].astype(jnp.float32)  # [S, C, nkv, d]
     ctxv = v_pool[ctx_slots].astype(jnp.float32)
+    if k_scale is not None:
+        ctxk = ctxk * jnp.transpose(k_scale)[ctx_slots][..., None]  # [S, C, nkv, 1]
+        ctxv = ctxv * jnp.transpose(v_scale)[ctx_slots][..., None]
     qr = (q.astype(jnp.float32) / math.sqrt(d)).reshape(T, nkv, g, d)
     s = jnp.einsum("tngd,tcnd->tngc", qr, ctxk[seq_idx])
     if alibi is not None:
@@ -83,7 +93,7 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, blo
 
 @functools.partial(jax.jit, static_argnames=("block_size", "interpret", "window", "alibi"))
 def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, interpret: bool = False,
-                  window=None, alibi=None):
+                  window=None, alibi=None, k_scale=None, v_scale=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -95,6 +105,12 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
     n_pool_blocks = k_pool.shape[0] // block_size
     k4 = k_pool[:n_pool_blocks * block_size].reshape(n_pool_blocks, block_size, nkv, d)
     v4 = v_pool[:n_pool_blocks * block_size].reshape(n_pool_blocks, block_size, nkv, d)
+    quant = k_scale is not None
+    if quant:
+        # scales stay [nkv, cols]: sublane = nkv, lane = block_size — the
+        # layout the scatter side maintains natively, no per-call transpose
+        ks2 = k_scale[:, :n_pool_blocks * block_size]
+        vs2 = v_scale[:, :n_pool_blocks * block_size]
     scale = 1.0 / math.sqrt(d)
 
     grid = (T, max_blocks)
@@ -115,7 +131,11 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
             jj = jnp.maximum(jj, jnp.minimum(lo, hi))
         return (bt_ref[seq_ref[t], jj], 0, 0, 0)
 
-    def kernel(seq_ref, pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+    def kernel(seq_ref, pos_ref, bt_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+        else:
+            o_ref, acc_ref, m_ref, l_ref = rest
         t = pl.program_id(0)
         j = pl.program_id(1)
         my_pos = pos_ref[t]
@@ -134,6 +154,9 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
             qb = q_ref[0].astype(jnp.float32) * scale  # [nq, d]
             kb = k_ref[0].astype(jnp.float32)  # [bs, nkv, d]
             vb = v_ref[0].astype(jnp.float32)
+            if quant:  # dequant at the VMEM tile — HBM only streamed int8
+                kb = kb * ks_ref[...].T[:, :, None]  # [bs, nkv, 1]
+                vb = vb * vs_ref[...].T[:, :, None]
             # per-kv-head 2-D MXU dots (Mosaic has no mismatched-batch dots);
             # nkv is small and static so the loop unrolls at trace time
             s_heads = []
@@ -164,14 +187,25 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
         def _finalize():
             o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
 
+    def scale_map(t, j, seq_ref, pos_ref, bt_ref):
+        blk = kv_map(t, j, seq_ref, pos_ref, bt_ref)[0]
+        return (0, blk)
+
+    in_specs = [
+        pl.BlockSpec((1, nq, d), q_map),
+        pl.BlockSpec((1, block_size, nkv, d), kv_map),
+        pl.BlockSpec((1, block_size, nkv, d), kv_map),
+    ]
+    operands = [q, k4, v4]
+    if quant:
+        in_specs += [pl.BlockSpec((nkv, block_size), scale_map),
+                     pl.BlockSpec((nkv, block_size), scale_map)]
+        operands += [ks2, vs2]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, nq, d), q_map),
-            pl.BlockSpec((1, block_size, nkv, d), kv_map),
-            pl.BlockSpec((1, block_size, nkv, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, nq, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((nq, d), jnp.float32),
@@ -180,4 +214,4 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
         ],
     )
     return pl.pallas_call(kernel, grid_spec=grid_spec, out_shape=jax.ShapeDtypeStruct((T, nq, d), q.dtype),
-                          interpret=interpret)(seq_idx, pos, block_tables, q, k4, v4)
+                          interpret=interpret)(seq_idx, pos, block_tables, *operands)

@@ -39,7 +39,12 @@ def _reset_groups():
 # Per-test wall-clock gate (round-2 verdict weak #8: nothing bounded test
 # time, letting one compile-heavy test mask regressions by timeout). Default
 # generous; tighten via DS_TPU_TEST_MAX_SECONDS. 0 disables.
+# Under pytest-xdist (``-n N --dist loadfile``, the supported way to shard
+# this suite on a multi-core machine) workers oversubscribe cores, so the
+# gate scales with the worker count — wall-clock per test is not the same
+# quantity under N-way contention.
 _MAX_TEST_SECONDS = float(os.environ.get("DS_TPU_TEST_MAX_SECONDS", "300"))
+_MAX_TEST_SECONDS *= max(1, int(os.environ.get("PYTEST_XDIST_WORKER_COUNT", "1")))
 
 
 @pytest.fixture(autouse=True)

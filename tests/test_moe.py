@@ -144,3 +144,45 @@ def test_moe_model_cache_inference_matches_forward():
     cache = init_kv_cache(m.config, 2, 16, dtype=jnp.float32)
     cached, _ = forward_with_cache(m.config, params, ids, cache)
     np.testing.assert_allclose(np.asarray(full), np.asarray(cached), rtol=1e-4, atol=1e-4)
+
+
+def test_moe_tp_token_mappings(eight_devices):
+    """moe/mappings.py (reference deepspeed/moe/mappings.py): drop->gather
+    round-trips on a TP axis inside shard_map, and grad flows (each mapping
+    is the other's transpose, derived by jax.grad rather than hand-written
+    autograd Functions)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.parallel.mesh import MeshConfig
+
+    groups.reset()
+    mesh = groups.initialize_mesh(MeshConfig(data=2, model=4))
+    x = jnp.arange(8 * 6, dtype=jnp.float32).reshape(8, 6)
+
+    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False)
+    def roundtrip(x):
+        return gather_tokens(drop_tokens(x, dim=0), dim=0)
+
+    np.testing.assert_array_equal(np.asarray(roundtrip(x)), np.asarray(x))
+
+    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False)
+    def dropped_sum(x):
+        d = drop_tokens(x, dim=0)  # each model-rank owns 2 of 8 rows
+        return jax.lax.psum(jnp.sum(d * d), "model") / jax.lax.axis_size("data")
+
+    # f(x) = psum_model(sum d^2) / 2 / 4 = sum(x^2) / 8  ->  df/dx = x / 4
+    g = jax.grad(lambda x: dropped_sum(x) / 4.0)(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(x) / 4.0, rtol=1e-6)
+
+    with pytest.raises(AssertionError, match="divisible"):
+        @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False)
+        def bad(x):
+            return drop_tokens(x, dim=1)  # 6 % 4 != 0
+
+        bad(x)
+    groups.reset()

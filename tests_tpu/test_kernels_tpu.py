@@ -280,3 +280,29 @@ def test_evoformer_biased_flash_on_chip():
     for name, a, b in zip(("dq", "dk", "dv", "dbias1", "dbias2"), g_ref, g_pal):
         np.testing.assert_allclose(np.asarray(b, np.float32), np.asarray(a, np.float32),
                                    atol=3e-2, rtol=3e-2, err_msg=name)
+
+
+def test_paged_attention_int8_kv_on_chip():
+    """int8-KV paged kernel on real TPU: dequant at the tile read vs the
+    gather reference on the same quantized pools."""
+    rng = np.random.default_rng(13)
+    T, nq, nkv, d, bs, NB = 8, 16, 16, 128, 128, 8
+    pool_len = NB * bs
+    q = jnp.asarray(rng.normal(size=(T, nq, d)), jnp.bfloat16)
+    kf = rng.normal(size=(pool_len, nkv, d)).astype(np.float32)
+    vf = rng.normal(size=(pool_len, nkv, d)).astype(np.float32)
+    ks = np.maximum(np.abs(kf).max(-1) / 127.0, 1e-8)
+    vs = np.maximum(np.abs(vf).max(-1) / 127.0, 1e-8)
+    k8 = jnp.asarray(np.round(kf / ks[..., None]), jnp.int8)
+    v8 = jnp.asarray(np.round(vf / vs[..., None]), jnp.int8)
+    ksT, vsT = jnp.asarray(ks.T), jnp.asarray(vs.T)
+    tables = jnp.asarray(rng.permutation(NB).reshape(2, 4), jnp.int32)
+    seq_idx = jnp.asarray([0, 0, 0, 0, 1, 1, 1, 1], jnp.int32)
+    pos = jnp.asarray([3, 100, 200, 511, 7, 120, 300, 450], jnp.int32)
+
+    ref = paged_attention_reference(q, k8, v8, tables, seq_idx, pos, bs,
+                                    k_scale=ksT, v_scale=vsT)
+    out = _pallas_paged(q, k8, v8, tables, seq_idx, pos, block_size=bs,
+                        k_scale=ksT, v_scale=vsT)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                               atol=2e-2, rtol=2e-2)
