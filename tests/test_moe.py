@@ -154,7 +154,7 @@ def test_moe_tp_token_mappings(eight_devices):
     from functools import partial
 
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
     from deepspeed_tpu.parallel import groups
@@ -164,13 +164,13 @@ def test_moe_tp_token_mappings(eight_devices):
     mesh = groups.initialize_mesh(MeshConfig(data=2, model=4))
     x = jnp.arange(8 * 6, dtype=jnp.float32).reshape(8, 6)
 
-    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False)
+    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
     def roundtrip(x):
         return gather_tokens(drop_tokens(x, dim=0), dim=0)
 
     np.testing.assert_array_equal(np.asarray(roundtrip(x)), np.asarray(x))
 
-    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False)
+    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
     def dropped_sum(x):
         d = drop_tokens(x, dim=0)  # each model-rank owns 2 of 8 rows
         return jax.lax.psum(jnp.sum(d * d), "model") / jax.lax.axis_size("data")
@@ -180,7 +180,7 @@ def test_moe_tp_token_mappings(eight_devices):
     np.testing.assert_allclose(np.asarray(g), np.asarray(x) / 4.0, rtol=1e-6)
 
     with pytest.raises(AssertionError, match="divisible"):
-        @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False)
+        @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
         def bad(x):
             return drop_tokens(x, dim=1)  # 6 % 4 != 0
 
