@@ -85,7 +85,7 @@ def transformer_config_from_hf(hf_cfg: dict):
     from ....models.transformer import TransformerConfig
 
     mt = hf_cfg.get("model_type", "llama")
-    if mt in ("llama", "mistral"):
+    if mt in ("llama", "mistral", "qwen2"):
         return TransformerConfig(
             vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["hidden_size"],
             num_layers=hf_cfg["num_hidden_layers"], num_heads=hf_cfg["num_attention_heads"],
@@ -93,9 +93,23 @@ def transformer_config_from_hf(hf_cfg: dict):
             intermediate_size=hf_cfg["intermediate_size"],
             max_seq_len=hf_cfg.get("max_position_embeddings", 2048),
             norm="rmsnorm", positions="rotary", mlp="swiglu", use_bias=False,
+            qkv_bias=(mt == "qwen2"),  # qwen2: biased qkv only
             tie_embeddings=bool(hf_cfg.get("tie_word_embeddings", False)),
             rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
             norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5))), mt
+    if mt == "phi":
+        d = hf_cfg["hidden_size"] // hf_cfg["num_attention_heads"]
+        return TransformerConfig(
+            vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["hidden_size"],
+            num_layers=hf_cfg["num_hidden_layers"], num_heads=hf_cfg["num_attention_heads"],
+            intermediate_size=hf_cfg["intermediate_size"],
+            max_seq_len=hf_cfg.get("max_position_embeddings", 2048),
+            norm="layernorm", positions="rotary", mlp="gelu", use_bias=True,
+            parallel_residual=True, shared_ln=True,
+            rotary_dim=int(round(hf_cfg.get("partial_rotary_factor", 0.5) * d)),
+            tie_embeddings=False,
+            rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+            norm_eps=float(hf_cfg.get("layer_norm_eps", 1e-5))), mt
     if mt == "gpt2":
         return TransformerConfig(
             vocab_size=hf_cfg["vocab_size"], hidden_size=hf_cfg["n_embd"],
@@ -172,7 +186,7 @@ def transformer_config_from_hf(hf_cfg: dict):
             parallel_residual=bool(hf_cfg.get("parallel_attn", True)) or new_arch,
             shared_ln=bool(hf_cfg.get("parallel_attn", True)) and not new_arch,
             norm_eps=float(hf_cfg.get("layer_norm_epsilon", 1e-5))), mt
-    raise ValueError(f"unsupported model_type {mt!r}; supported: llama, mistral, gpt2, opt, "
+    raise ValueError(f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, phi, gpt2, opt, "
                      "bloom, gptj, gpt_neox, falcon")
 
 
@@ -227,7 +241,7 @@ def _interleaved_to_half_perm(w_cols, nh, hd, rotary_dim):
 def convert_hf_state_dict(sd: Dict[str, np.ndarray], cfg, model_type: str):
     """HF state dict → stacked param pytree (numpy, fp32)."""
     L = cfg.num_layers
-    if model_type in ("llama", "mistral"):
+    if model_type in ("llama", "mistral", "qwen2"):
         p = {
             "embed": {"embedding": np.asarray(sd["model.embed_tokens.weight"], np.float32)},
             "blocks": {
@@ -243,8 +257,39 @@ def convert_hf_state_dict(sd: Dict[str, np.ndarray], cfg, model_type: str):
             },
             "final_norm": {"scale": np.asarray(sd["model.norm.weight"], np.float32)},
         }
+        if model_type == "qwen2":  # biased qkv only
+            p["blocks"]["bq"] = _stack(sd, "model.layers.{i}.self_attn.q_proj.bias", L)
+            p["blocks"]["bk"] = _stack(sd, "model.layers.{i}.self_attn.k_proj.bias", L)
+            p["blocks"]["bv"] = _stack(sd, "model.layers.{i}.self_attn.v_proj.bias", L)
         if not cfg.tie_embeddings:
             p["lm_head"] = {"kernel": np.asarray(sd["lm_head.weight"], np.float32).T}
+        return p
+    if model_type == "phi":
+        # parallel residual, single shared input_layernorm, partial rotary;
+        # phi's rotary uses the half-split convention (same as our apply_rope)
+        p = {
+            "embed": {"embedding": np.asarray(sd["model.embed_tokens.weight"], np.float32)},
+            "blocks": {
+                "ln1_scale": _stack(sd, "model.layers.{i}.input_layernorm.weight", L),
+                "ln1_bias": _stack(sd, "model.layers.{i}.input_layernorm.bias", L),
+                "wq": _stack(sd, "model.layers.{i}.self_attn.q_proj.weight", L, transpose=True),
+                "bq": _stack(sd, "model.layers.{i}.self_attn.q_proj.bias", L),
+                "wk": _stack(sd, "model.layers.{i}.self_attn.k_proj.weight", L, transpose=True),
+                "bk": _stack(sd, "model.layers.{i}.self_attn.k_proj.bias", L),
+                "wv": _stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, transpose=True),
+                "bv": _stack(sd, "model.layers.{i}.self_attn.v_proj.bias", L),
+                "wo": _stack(sd, "model.layers.{i}.self_attn.dense.weight", L, transpose=True),
+                "bo": _stack(sd, "model.layers.{i}.self_attn.dense.bias", L),
+                "w_up": _stack(sd, "model.layers.{i}.mlp.fc1.weight", L, transpose=True),
+                "b_up": _stack(sd, "model.layers.{i}.mlp.fc1.bias", L),
+                "w_down": _stack(sd, "model.layers.{i}.mlp.fc2.weight", L, transpose=True),
+                "b_down": _stack(sd, "model.layers.{i}.mlp.fc2.bias", L),
+            },
+            "final_norm": {"scale": np.asarray(sd["model.final_layernorm.weight"], np.float32),
+                           "bias": np.asarray(sd["model.final_layernorm.bias"], np.float32)},
+            "lm_head": {"kernel": np.asarray(sd["lm_head.weight"], np.float32).T,
+                        "bias": np.asarray(sd["lm_head.bias"], np.float32)},
+        }
         return p
     if model_type == "gpt2":
         H = cfg.hidden_size

@@ -30,6 +30,8 @@ def build_model_engine(model_family: str, size: str = "tiny", engine_config=None
         "mistral": M.mistral,
         "gpt2": M.gpt2,
         "opt": M.opt,
+        "qwen2": M.qwen2,
+        "phi": M.phi,
     }
     if family not in builders:
         raise ValueError(f"unknown model family {model_family!r}; have {sorted(builders)}")

@@ -99,9 +99,10 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
     def layer(x, blk, l, k_flat, v_flat, ks_flat, vs_flat):
         h1 = pre_norm(x, blk["ln1_scale"], blk.get("ln1_bias"))
         bias = (lambda n: blk[n]) if cfg.use_bias else (lambda n: None)
-        q = linear(h1, blk["wq"], bias("bq")).reshape(T, nq, d)
-        k = linear(h1, blk["wk"], bias("bk")).reshape(T, nkv, d)
-        v = linear(h1, blk["wv"], bias("bv")).reshape(T, nkv, d)
+        qkvb = (lambda n: blk[n]) if cfg.qkv_bias_enabled else (lambda n: None)
+        q = linear(h1, blk["wq"], qkvb("bq")).reshape(T, nq, d)
+        k = linear(h1, blk["wk"], qkvb("bk")).reshape(T, nkv, d)
+        v = linear(h1, blk["wv"], qkvb("bv")).reshape(T, nkv, d)
         if cfg.positions == "rotary":
             q = apply_rope(q[None], sin, cos)[0]
             k = apply_rope(k[None], sin, cos)[0]

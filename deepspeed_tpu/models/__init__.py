@@ -2,6 +2,8 @@ from .transformer import TransformerConfig, TransformerLM, reference_attention
 from .llama import llama2, llama2_config
 from .gpt import gpt2, gpt2_config
 from .mistral import mistral, mistral_config
+from .phi import phi, phi_config
+from .qwen import qwen2, qwen2_config
 from .opt import opt, opt_config
 from .bloom import bloom, bloom_config
 from .gptj import gptj, gptj_config

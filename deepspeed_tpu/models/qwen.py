@@ -1,0 +1,28 @@
+"""Qwen2 model family configs.
+
+The reference's AutoTP supports Qwen via its name-based policy inference
+(``module_inject/auto_tp.py`` + ``supported_models``). Architecture:
+Llama-shaped (RMSNorm + rotary + SwiGLU + GQA) with BIASED qkv projections
+only (o/mlp bias-free — the ``qkv_bias`` knob) and a large rope theta.
+"""
+
+from .transformer import TransformerConfig, TransformerLM
+
+
+def qwen2_config(size: str = "7b", **overrides) -> TransformerConfig:
+    presets = {
+        "tiny": dict(vocab_size=32000, hidden_size=256, num_layers=4, num_heads=8, num_kv_heads=2,
+                     intermediate_size=704, max_seq_len=2048),
+        "0.5b": dict(vocab_size=151936, hidden_size=896, num_layers=24, num_heads=14, num_kv_heads=2,
+                     intermediate_size=4864, max_seq_len=32768, tie_embeddings=True),
+        "7b": dict(vocab_size=152064, hidden_size=3584, num_layers=28, num_heads=28, num_kv_heads=4,
+                   intermediate_size=18944, max_seq_len=32768),
+    }
+    base = dict(presets[size], norm="rmsnorm", positions="rotary", mlp="swiglu",
+                use_bias=False, qkv_bias=True, rope_theta=1e6, norm_eps=1e-6)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def qwen2(size: str = "7b", **overrides) -> TransformerLM:
+    return TransformerLM(qwen2_config(size, **overrides))

@@ -49,6 +49,9 @@ class TransformerConfig:
     positions: str = "rotary"  # 'rotary' | 'learned' | 'alibi'
     mlp: str = "swiglu"  # 'swiglu' | 'gelu' | 'relu'
     use_bias: bool = False
+    # per-site override for the qkv projections only (Qwen2: biased qkv,
+    # bias-free o/mlp). None = follow use_bias.
+    qkv_bias: Optional[bool] = None
     tie_embeddings: bool = False
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -118,6 +121,10 @@ class TransformerConfig:
         assert self.num_heads % self.num_kv_heads == 0
 
     @property
+    def qkv_bias_enabled(self) -> bool:
+        return self.use_bias if self.qkv_bias is None else self.qkv_bias
+
+    @property
     def head_dim(self):
         return self.hidden_size // self.num_heads
 
@@ -161,10 +168,11 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
         blocks["ln1_bias"] = jnp.zeros((L, H), jnp.float32)
         if not (cfg.parallel_residual and cfg.shared_ln):
             blocks["ln2_bias"] = jnp.zeros((L, H), jnp.float32)
-    if cfg.use_bias:
+    if cfg.qkv_bias_enabled:
         blocks["bq"] = jnp.zeros((L, nq * d), jnp.float32)
         blocks["bk"] = jnp.zeros((L, nkv * d), jnp.float32)
         blocks["bv"] = jnp.zeros((L, nkv * d), jnp.float32)
+    if cfg.use_bias:
         blocks["bo"] = jnp.zeros((L, H), jnp.float32)
         blocks["b_up"] = jnp.zeros((L, F), jnp.float32)
         blocks["b_down"] = jnp.zeros((L, H), jnp.float32)
@@ -416,7 +424,7 @@ def _attn_branch(cfg: TransformerConfig, layer, h, sin, cos):
     q = jnp.einsum("bsh,hd->bsd", h, layer["wq"].astype(dt))
     k = jnp.einsum("bsh,hd->bsd", h, layer["wk"].astype(dt))
     v = jnp.einsum("bsh,hd->bsd", h, layer["wv"].astype(dt))
-    if cfg.use_bias:
+    if cfg.qkv_bias_enabled:
         q = q + layer["bq"].astype(dt)
         k = k + layer["bk"].astype(dt)
         v = v + layer["bv"].astype(dt)
@@ -787,7 +795,7 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache):
         q = jnp.einsum("bsh,hd->bsd", h1, layer["wq"].astype(dt))
         k = jnp.einsum("bsh,hd->bsd", h1, layer["wk"].astype(dt))
         v = jnp.einsum("bsh,hd->bsd", h1, layer["wv"].astype(dt))
-        if cfg.use_bias:
+        if cfg.qkv_bias_enabled:
             q, k, v = q + layer["bq"].astype(dt), k + layer["bk"].astype(dt), v + layer["bv"].astype(dt)
         q = q.reshape(B, T, nq, d)
         k = k.reshape(B, T, nkv, d)

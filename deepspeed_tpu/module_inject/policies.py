@@ -108,6 +108,17 @@ class FalconPolicy(BloomPolicy):
     (handled by sanitize_spec's divisibility check)."""
 
 
+class Qwen2Policy(LlamaPolicy):
+    """qwen2: llama layout with biased qkv — the bias vectors follow their
+    projection's column sharding via the shared q/k/v_proj patterns."""
+
+
+class PhiPolicy(TransformerPolicy):
+    """phi-1.5/phi-2 (parallel-residual container): separate q/k/v with
+    ``dense`` attention output and fc1/fc2 MLP — covered by the base
+    patterns; listed for registry completeness."""
+
+
 class BertPolicy(TransformerPolicy):
     """bert/roberta (reference containers/bert.py): self-attention q/k/v
     column, attention output + ffn output row."""
@@ -129,4 +140,7 @@ POLICY_REGISTRY: Dict[str, type] = {
     "roberta": BertPolicy,
     "bloom": BloomPolicy,
     "falcon": FalconPolicy,
+    "qwen2": Qwen2Policy,
+    "qwen": Qwen2Policy,
+    "phi": PhiPolicy,
 }

@@ -55,6 +55,18 @@ def _save_tiny(tmp_path, kind):
                                         parallel_attn=True, new_decoder_architecture=True,
                                         bias=False, alibi=False)
         model = transformers.FalconForCausalLM(cfg)
+    elif kind == "qwen2":
+        cfg = transformers.Qwen2Config(vocab_size=128, hidden_size=64, intermediate_size=128,
+                                       num_hidden_layers=2, num_attention_heads=4,
+                                       num_key_value_heads=2, max_position_embeddings=128,
+                                       tie_word_embeddings=False)
+        model = transformers.Qwen2ForCausalLM(cfg)
+    elif kind == "phi":
+        cfg = transformers.PhiConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                                     num_hidden_layers=2, num_attention_heads=4,
+                                     partial_rotary_factor=0.5, max_position_embeddings=128,
+                                     tie_word_embeddings=False)
+        model = transformers.PhiForCausalLM(cfg)
     model = model.eval()
     d = tmp_path / kind
     model.save_pretrained(str(d))
@@ -62,7 +74,7 @@ def _save_tiny(tmp_path, kind):
 
 
 @pytest.mark.parametrize("kind", ["llama", "mistral", "gpt2", "opt", "bloom", "gptj",
-                                  "gpt_neox", "falcon", "falcon40b"])
+                                  "gpt_neox", "falcon", "falcon40b", "qwen2", "phi"])
 def test_hf_parity(tmp_path, kind):
     from deepspeed_tpu.inference.v2.checkpoint import build_hf_engine
     from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig

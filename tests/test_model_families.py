@@ -9,7 +9,8 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu
-from deepspeed_tpu.models import (bloom_config, falcon_config, gpt_neox_config, gptj_config)
+from deepspeed_tpu.models import (bloom_config, falcon_config, gpt_neox_config, gptj_config,
+                                  phi_config, qwen2_config)
 from deepspeed_tpu.models.transformer import TransformerLM, alibi_slopes
 from deepspeed_tpu.module_inject.policies import POLICY_REGISTRY
 from deepspeed_tpu.parallel import groups
@@ -22,6 +23,8 @@ FAMILIES = {
     "gptj": gptj_config,
     "gpt_neox": gpt_neox_config,
     "falcon": falcon_config,
+    "qwen2": qwen2_config,
+    "phi": phi_config,
 }
 
 
@@ -54,6 +57,8 @@ def test_family_policy_registered(family):
         "gpt_neox": ("layers/0/attention/query_key_value/weight", "layers/0/mlp/dense_4h_to_h/weight"),
         "gptj": ("h/0/mlp/fc_in/weight", "h/0/mlp/fc_out/weight"),
         "falcon": ("h/0/self_attention/query_key_value/weight", "h/0/mlp/dense_4h_to_h/weight"),
+        "qwen2": ("layers/0/self_attn/q_proj/weight", "layers/0/self_attn/o_proj/weight"),
+        "phi": ("layers/0/self_attn/q_proj/weight", "layers/0/self_attn/dense/weight"),
     }
     col_path, row_path = probes[family]
     assert pol.spec_for(col_path, 2) is not None, f"{family}: column pattern missed"
@@ -79,3 +84,26 @@ def test_shared_ln_has_no_ln2():
                            attention_impl="reference")
     params2 = TransformerLM(cfg2).init(jax.random.PRNGKey(0))
     assert "ln2_scale" in params2["blocks"]  # NeoX keeps both norms
+
+
+def test_qwen2_qkv_bias_only():
+    """qkv_bias knob: qwen2 creates bq/bk/bv but NO bo/b_up/b_down."""
+    import jax
+
+    cfg = qwen2_config("tiny", vocab_size=64, max_seq_len=32, dtype=jnp.float32,
+                       attention_impl="reference")
+    assert cfg.qkv_bias_enabled and not cfg.use_bias
+    m = TransformerLM(cfg)
+    params = jax.jit(lambda r: m.init(r, None))(jax.random.PRNGKey(0))
+    blocks = params["blocks"]
+    assert {"bq", "bk", "bv"} <= set(blocks)
+    assert not {"bo", "b_up", "b_down"} & set(blocks)
+    # biased-qkv forward runs and differs from the bias-zeroed forward once
+    # the biases move
+    from deepspeed_tpu.models.transformer import forward
+
+    ids = np.zeros((1, 8), np.int32)
+    base = np.asarray(forward(cfg, params, ids))
+    params["blocks"]["bq"] = params["blocks"]["bq"] + 0.5
+    moved = np.asarray(forward(cfg, params, ids))
+    assert not np.allclose(base, moved)
