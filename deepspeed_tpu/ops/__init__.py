@@ -41,11 +41,27 @@ def _builder(name, module_path, symbol=None):
 op_registry = {
     "FusedAdamBuilder": _builder("fused_adam", "deepspeed_tpu.ops.adam.fused_adam"),
     "FusedLambBuilder": _builder("fused_lamb", "deepspeed_tpu.runtime.optimizers"),
+    "FusedLionBuilder": _builder("fused_lion", "deepspeed_tpu.runtime.optimizers"),
     "CPUAdamBuilder": _builder("cpu_adam", "deepspeed_tpu.ops.adam.cpu_adam", "DeepSpeedCPUAdam"),
+    "CPULionBuilder": _builder("cpu_lion", "deepspeed_tpu.runtime.optimizers"),
+    "CPUAdagradBuilder": _builder("cpu_adagrad", "deepspeed_tpu.runtime.optimizers"),
     "QuantizerBuilder": _builder("quantizer", "deepspeed_tpu.ops.pallas.quant"),
     "FlashAttnBuilder": _builder("flash_attn", "deepspeed_tpu.ops.pallas.flash_attention"),
+    # training transformer kernel stack = the Pallas flash path (the
+    # reference's TransformerBuilder/StochasticTransformerBuilder kernels)
+    "TransformerBuilder": _builder("transformer", "deepspeed_tpu.ops.pallas.flash_attention"),
+    "StochasticTransformerBuilder": _builder(
+        "stochastic_transformer", "deepspeed_tpu.ops.pallas.flash_attention"),
+    # v1 fused inference kernels (reference transformer_inference.py)
+    "InferenceBuilder": _builder("transformer_inference", "deepspeed_tpu.ops.pallas.paged_attention"),
+    "InferenceCutlassBuilder": _builder("inference_cutlass", "deepspeed_tpu.ops.pallas.paged_attention"),
     "RaggedOpsBuilder": _builder("ragged_ops", "deepspeed_tpu.ops.pallas.paged_attention"),
+    "RaggedUtilsBuilder": _builder("ragged_utils", "deepspeed_tpu.inference.v2.ragged"),
     "InferenceCoreBuilder": _builder("inference_core_ops", "deepspeed_tpu.ops.pallas.rmsnorm"),
     "AsyncIOBuilder": _builder("async_io", "deepspeed_tpu.ops.aio"),
     "SparseAttnBuilder": _builder("sparse_attn", "deepspeed_tpu.ops.sparse_attention"),
+    "EvoformerAttnBuilder": _builder("evoformer_attn", "deepspeed_tpu.ops.pallas.evoformer_attention"),
+    "RandomLTDBuilder": _builder(
+        "random_ltd", "deepspeed_tpu.runtime.data_pipeline.data_routing.random_ltd"),
+    "SpatialInferenceBuilder": _builder("spatial_inference", "deepspeed_tpu.ops.spatial"),
 }

@@ -1001,7 +1001,38 @@ class DeepSpeedEngine:
         if self.host_optimizer is None and self.fp16_enabled and bool(metrics["overflow"]):
             self.skipped_steps += 1  # offload path counts inside _host_apply_update
         self._record_metrics(metrics)
+        self._maybe_flops_profile(batch)
         return metrics["loss"]
+
+    def _maybe_flops_profile(self, batch):
+        """Reference engine flops-profiler hook (``engine.py`` around
+        ``flops_profiler_config.profile_step``): at the configured global
+        step, capture the compiled step's XLA cost totals plus the
+        per-module breakdown and print/persist the model profile."""
+        fp = self.config.flops_profiler_config
+        if not fp.enabled or self.global_steps != fp.profile_step:
+            return
+        try:
+            prof = self.flops_profiler
+            prof.start_profile()
+            step_fn = self._compiled.get("train_step")
+            if step_fn is not None:
+                with self.mesh:
+                    step_rng = jax.random.PRNGKey(0)
+                    prof.profile_step(step_fn, self.state, self._shard_batch(batch, leading=("mb", )),
+                                      step_rng)
+            if self.module is not None and hasattr(self.module, "config"):
+                leaves = jax.tree_util.tree_leaves(batch)
+                seq = int(np.shape(leaves[0])[-1]) if leaves else self.config.train_micro_batch_size_per_gpu
+                prof.profile_model(batch_size=self.config.train_micro_batch_size_per_gpu, seq_len=seq)
+            prof.stop_profile()
+            prof.print_model_profile(profile_step=fp.profile_step, module_depth=fp.module_depth,
+                                     top_modules=fp.top_modules, detailed=fp.detailed,
+                                     output_file=fp.output_file)
+        except Exception as e:  # profiling must never kill a training step
+            from ..utils.logging import logger
+
+            logger.warning(f"flops profiler failed at step {self.global_steps}: {e}")
 
     def _apply_curriculum(self, batch, seq_axis=2):
         """seqlen curriculum: truncate the sequence dim of (gas, bsz, seq…)

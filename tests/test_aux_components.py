@@ -427,3 +427,36 @@ def test_flops_profiler_step_totals():
     assert prof.get_total_flops() > 0
     prof.end_profile()
     assert prof.profile == {}
+
+
+def test_flops_profiler_engine_integration(tmp_path, eight_devices):
+    """ds_config flops_profiler block (reference config schema): at
+    profile_step the engine captures XLA step totals + the per-module tree
+    and writes the profile file."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    out = tmp_path / "flops.txt"
+    model = TransformerLM(TransformerConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                                            num_heads=2, intermediate_size=64, max_seq_len=32,
+                                            dtype=jnp.float32, attention_impl="reference"))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "tpu": {"mesh": {"data": 8}},
+        "flops_profiler": {"enabled": True, "profile_step": 2, "output_file": str(out)},
+    })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, size=(8, 32), dtype=np.int32)}
+    engine.train_batch(batch)
+    assert not out.exists()  # profile_step is 2
+    engine.train_batch(batch)
+    assert out.exists()
+    text = out.read_text()
+    assert "qkv_proj" in text and "lm_head" in text
+    assert engine.flops_profiler.get_total_flops() > 0  # XLA step totals captured
+    groups.reset()
