@@ -79,18 +79,64 @@ class MeshConfig:
         return sizes
 
 
+def _hybrid_split(shape, axis_order, n_slices):
+    """Multi-slice (DCN-connected) pods: decide which mesh axis rides DCN.
+
+    Returns (per_slice_shape, dcn_shape) for
+    ``mesh_utils.create_hybrid_device_mesh``. DCN is ~10-100x slower than
+    ICI, so the slice boundary must carry the LOWEST-traffic axis: pipe
+    (one boundary ppermute per tick) if it spans slices, else data_repl
+    (MiCS replica groups — the reference's design point: shard groups inside
+    a node/slice, replica reduce across), else plain data (gradient
+    reduce once per step, amortized by accumulation). model/seq (per-layer
+    collectives) never cross DCN. Raises if no eligible axis divides the
+    slice count — a config that would silently put TP on DCN should not
+    build.
+    """
+    for candidate in (PIPE_AXIS, DATA_REPL_AXIS, DATA_AXIS):
+        i = list(axis_order).index(candidate)
+        if shape[i] % n_slices == 0 and shape[i] >= n_slices:
+            per_slice = list(shape)
+            per_slice[i] = shape[i] // n_slices
+            dcn = [1] * len(shape)
+            dcn[i] = n_slices
+            return per_slice, dcn
+    raise ValueError(
+        f"no DCN-eligible axis (pipe/data_repl/data) divisible by the {n_slices} slices in "
+        f"mesh {dict(zip(axis_order, shape))}; model/seq must not cross the DCN boundary")
+
+
 def build_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
     """Build the global mesh.
 
-    Device order follows ``jax.devices()`` which on TPU enumerates in
-    ICI-topology order; the axis order above therefore keeps ``model``
-    (highest-traffic collectives) on nearest neighbors.
+    Single slice: device order follows ``jax.devices()`` which on TPU
+    enumerates in ICI-topology order; the axis order above therefore keeps
+    ``model`` (highest-traffic collectives) on nearest neighbors.
+
+    Multi-slice (DCN): ``mesh_utils.create_hybrid_device_mesh`` with the
+    lowest-traffic axis (pipe > data_repl > data) spanning the slice
+    boundary — model/seq collectives stay on ICI (``_hybrid_split``).
     """
     config = config or MeshConfig()
     devices = devices if devices is not None else jax.devices()
     sizes = config.resolve(len(devices))
     shape = [sizes[a] for a in config.axis_order]
-    dev_array = np.asarray(devices).reshape(shape)
+    try:
+        n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    except Exception:
+        n_slices = 1
+    if n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        per_slice, dcn = _hybrid_split(shape, config.axis_order, n_slices)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            per_slice, dcn, devices=devices, process_is_granule=False)
+        from ..utils.logging import log_dist
+
+        log_dist(f"hybrid mesh over {n_slices} DCN slices: per-slice {per_slice} dcn {dcn}",
+                 ranks=[0])
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, axis_names=tuple(config.axis_order))
 
 

@@ -64,3 +64,23 @@ def test_pipeline_grid():
     assert grid.get_pipe_parallel_world_size() == 2
     assert grid.get_stage_id() == 1
     assert grid.stage_to_global(0) == 1  # same data/model coord, stage 0
+
+
+def test_hybrid_split_dcn_axis_selection():
+    """Multi-slice DCN placement (scaling-book recipe: low-traffic axis on
+    the slow interconnect): pipe preferred, then data_repl, then data;
+    model/seq never eligible; indivisible configs refuse to build."""
+    from deepspeed_tpu.parallel.mesh import AXIS_ORDER, _hybrid_split
+
+    # pipe=4 over 2 slices -> pipe carries DCN
+    per, dcn = _hybrid_split([4, 1, 8, 1, 2], AXIS_ORDER, 2)
+    assert per == [2, 1, 8, 1, 2] and dcn == [2, 1, 1, 1, 1]
+    # no pipe: MiCS replica groups take the boundary
+    per, dcn = _hybrid_split([1, 4, 4, 1, 4], AXIS_ORDER, 4)
+    assert per == [1, 1, 4, 1, 4] and dcn == [1, 4, 1, 1, 1]
+    # plain dp
+    per, dcn = _hybrid_split([1, 1, 16, 2, 2], AXIS_ORDER, 2)
+    assert per == [1, 1, 8, 2, 2] and dcn == [1, 1, 2, 1, 1]
+    # model-only mesh across slices: must refuse (TP over DCN)
+    with pytest.raises(ValueError, match="DCN-eligible"):
+        _hybrid_split([1, 1, 1, 1, 8], AXIS_ORDER, 2)
