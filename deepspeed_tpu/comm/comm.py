@@ -190,3 +190,72 @@ def configure(config=None, deepspeed_config=None, enabled=None, prof_all=None, p
 def log_summary(show_straggler=False):
     """Print the comms profile (reference ``comm.py:422``)."""
     return comms_logger.log_all(print_log=(get_rank() == 0), show_straggler=show_straggler)
+
+
+# ---------------------------------------------------------------------------
+# reference comm.py surface parity — host-level introspection & environment
+# ---------------------------------------------------------------------------
+def is_available() -> bool:
+    """Reference ``is_available``: the XLA backend ships with jax."""
+    return True
+
+
+def get_world_group():
+    """Reference ``get_world_group``: None IS the world group in this API
+    (every op treats group=None as all processes)."""
+    return None
+
+
+def get_global_rank(group=None, group_rank: int = 0) -> int:
+    """Reference ``get_global_rank``: groups here are mesh-axis names whose
+    members enumerate in world order, so a group-local rank maps through the
+    group's rank list."""
+    ranks = get_all_ranks_from_group(group)
+    return ranks[group_rank]
+
+
+def get_all_ranks_from_group(group=None):
+    """Reference helper of the same name."""
+    if group is None:
+        return list(range(get_world_size()))
+    if isinstance(group, (list, tuple)) and all(isinstance(r, int) for r in group):
+        return list(group)
+    return list(range(get_world_size()))  # axis-name groups span all processes
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks: bool = False):
+    """Reference ``monitored_barrier``: barrier + a log line (the jax
+    coordination service already detects/reports stragglers by timeout)."""
+    from ..utils.logging import logger
+
+    t0 = time.time()
+    barrier(group)
+    dt = time.time() - t0
+    if timeout is not None and dt > float(timeout):
+        logger.warning(f"monitored_barrier took {dt:.1f}s (> {timeout})")
+    return None
+
+
+def set_backend(backend_name: str = "xla"):
+    """Reference ``set_backend``: only the XLA backend exists here."""
+    if backend_name not in ("xla", "hccl", "nccl", "ccl"):
+        raise ValueError(f"unknown backend {backend_name!r}")
+    return None
+
+
+def init_deepspeed_backend(ds_backend=None, timeout=None, init_method=None, rank=-1, world_size=-1):
+    """Reference ``init_deepspeed_backend``: folded into init_distributed."""
+    return None
+
+
+def in_aml() -> bool:
+    """Azure ML env detection (reference comm.py)."""
+    return "AZUREML_EXPERIMENT_ID" in os.environ
+
+
+def in_aws_sm() -> bool:
+    return "SM_TRAINING_ENV" in os.environ
+
+
+def in_dlts() -> bool:
+    return "DLTS_JOB_ID" in os.environ

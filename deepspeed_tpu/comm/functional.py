@@ -113,3 +113,90 @@ def axis_index(group: AxisNames):
 
 def axis_size(group: AxisNames):
     return lax.psum(1, _axis(group))
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group: AxisNames = "data"):
+    """Rooted reduce (reference ``comm.py`` reduce): every member
+    participates; only ``dst`` keeps the reduced value, others get zeros
+    (SPMD has no rank-divergent returns — masking is the traced analog of
+    'result only materializes on dst')."""
+    red = all_reduce(tensor, op=op, group=group)
+    keep = lax.axis_index(_axis(group)) == dst
+    return jnp.where(keep, red, jnp.zeros_like(red))
+
+
+def gather(tensor, dst: int = 0, group: AxisNames = "data", axis: int = 0):
+    """Rooted gather: the concatenated result on ``dst``, zeros elsewhere."""
+    full = all_gather(tensor, group=group, axis=axis, tiled=True)
+    keep = lax.axis_index(_axis(group)) == dst
+    return jnp.where(keep, full, jnp.zeros_like(full))
+
+
+def scatter(tensor, src: int = 0, group: AxisNames = "data", axis: int = 0):
+    """Rooted scatter: ``src``'s tensor is split along ``axis``; member i
+    receives chunk i (reference comm.py scatter)."""
+    ax = _axis(group)
+    src_full = broadcast(tensor, src_index=src, group=group)
+    n = lax.axis_size(ax)  # static at trace time: chunk shapes must be static
+    chunk = tensor.shape[axis] // n
+    idx = lax.axis_index(ax)
+    return lax.dynamic_slice_in_dim(src_full, idx * chunk, chunk, axis=axis)
+
+
+def send(tensor, dst: int, group: AxisNames = "pipe", size: int = None):
+    """Point-to-point shift toward ``dst`` (reference p2p send/recv pairs).
+    XLA has no one-sided p2p: ALL group members call this; the value each
+    member sent lands on ``dst`` only when paired with the matching
+    ``recv`` permutation — for pipeline schedules prefer
+    ``send_recv_next``/``send_recv_prev``."""
+    n = size if size is not None else lax.axis_size(_axis(group))
+    perm = [(i, dst) for i in range(n) if i == (dst - 1) % n]
+    return lax.ppermute(tensor, _axis(group), perm=perm)
+
+
+def recv(tensor, src: int, group: AxisNames = "pipe", size: int = None):
+    """Receive from ``src`` (the pair of :func:`send`): src's value arrives
+    at src+1; other members get zeros."""
+    n = size if size is not None else lax.axis_size(_axis(group))
+    perm = [(src, (src + 1) % n)]
+    return lax.ppermute(tensor, _axis(group), perm=perm)
+
+
+def all_reduce_coalesced(tensors, op=ReduceOp.SUM, group: AxisNames = "data"):
+    """Reduce a LIST of tensors in one traced region (reference
+    ``all_reduce_coalesced``); XLA's combiner fuses the collectives, which
+    is the whole point of the torch coalescing manager."""
+    return [all_reduce(t, op=op, group=group) for t in tensors]
+
+
+def all_gather_coalesced(tensors, group: AxisNames = "data", axis: int = 0):
+    return [all_gather(t, group=group, axis=axis) for t in tensors]
+
+
+# capability probes (reference comm.py has_* surface): the XLA backend
+# always has the tensor variants, and coalescing is the compiler's job
+def has_all_gather_into_tensor():
+    return True
+
+
+def has_reduce_scatter_tensor():
+    return True
+
+
+def has_all_reduce_coalesced():
+    return True
+
+
+def has_coalescing_manager():
+    return True
+
+
+def allgather_fn(output_tensor, input_tensor, group: AxisNames = "data", async_op: bool = False):
+    """Reference helper of the same name: dispatches to the tensor variant
+    (the output buffer argument is meaningless in a functional API — the
+    gathered array IS the return)."""
+    return all_gather(input_tensor, group=group)
+
+
+def reduce_scatter_fn(output_tensor, input_tensor, group: AxisNames = "data", async_op: bool = False):
+    return reduce_scatter(input_tensor, group=group)

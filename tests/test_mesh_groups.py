@@ -84,3 +84,59 @@ def test_hybrid_split_dcn_axis_selection():
     # model-only mesh across slices: must refuse (TP over DCN)
     with pytest.raises(ValueError, match="DCN-eligible"):
         _hybrid_split([1, 1, 1, 1, 8], AXIS_ORDER, 2)
+
+
+def test_comm_functional_extended_surface(eight_devices):
+    """Reference comm API names beyond the core set: rooted reduce/gather/
+    scatter, coalesced variants, capability probes, *_fn helpers — all over
+    a shard_map'd data axis."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.comm import functional as F
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.parallel.mesh import MeshConfig
+
+    groups.reset()
+    mesh = groups.initialize_mesh(MeshConfig(data=8))
+    x = jnp.arange(8.0)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
+    def rooted(x):
+        r = F.reduce(x, dst=2, group="data")           # sum on rank 2 only
+        g = F.gather(x, dst=0, group="data")           # [8] on rank 0 only
+        s = F.scatter(g, src=0, group="data")          # undo on every rank: zeros except from 0
+        c = F.all_reduce_coalesced([x, 2 * x], group="data")
+        return r + c[0] * 0 + c[1] * 0 + s * 0
+
+    out = np.asarray(rooted(x)).reshape(-1)
+    assert out[2] == x.sum() and out[0] == 0 and out[5] == 0
+
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
+    def helpers(x):
+        g = F.allgather_fn(None, x, group="data")      # [8]
+        rs = F.reduce_scatter_fn(None, g, group="data")  # back to [1], *8
+        return rs
+
+    np.testing.assert_allclose(np.asarray(helpers(x)).reshape(-1), 8 * np.asarray(x))
+
+    assert F.has_all_gather_into_tensor() and F.has_reduce_scatter_tensor()
+    assert F.has_all_reduce_coalesced() and F.has_coalescing_manager()
+
+    # send and recv are the two ends of ONE matched permutation — each call
+    # is the full collective (XLA has no one-sided p2p)
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
+    def p2p_send(x):
+        return F.send(x, dst=3, group="data")
+
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
+    def p2p_recv(x):
+        return F.recv(x, src=2, group="data")
+
+    assert np.asarray(p2p_send(x)).reshape(-1)[3] == 2.0  # rank 2 -> rank 3
+    assert np.asarray(p2p_recv(x)).reshape(-1)[3] == 2.0
+    groups.reset()
