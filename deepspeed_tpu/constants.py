@@ -1,0 +1,14 @@
+"""Top-level constants (reference ``deepspeed/constants.py``)."""
+
+import os
+from datetime import timedelta
+
+TORCH_DISTRIBUTED_DEFAULT_PORT = 29500  # name kept for config compatibility
+
+# coordination-service timeout knob (reference default_pg_timeout semantics;
+# jax.distributed uses its own heartbeat but the env var is honored for
+# launcher-level waits)
+default_pg_timeout = timedelta(minutes=int(os.getenv("DEEPSPEED_TIMEOUT", default=30)))
+
+INFERENCE_GENERIC_MODE = "generic"
+INFERENCE_SPECIALIZED_MODE = "specialized"

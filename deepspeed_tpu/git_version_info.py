@@ -1,0 +1,24 @@
+"""Version info (reference ``deepspeed/git_version_info.py``; that file is
+generated at build time — this one is static, with the op compatibility
+report derived from the live registry)."""
+
+version = "0.12.4+tpu"
+git_hash = "unknown"
+git_branch = "main"
+installed_ops = {}
+compatible_ops = {}
+
+
+def _populate():
+    try:
+        from .ops import op_registry
+
+        for name, builder in op_registry.items():
+            ok = builder.is_compatible()
+            installed_ops[builder.NAME] = ok
+            compatible_ops[builder.NAME] = ok
+    except Exception:
+        pass
+
+
+_populate()

@@ -1,0 +1,7 @@
+"""Top-level model namespace (reference ``deepspeed/model_implementations``:
+DeepSpeedTransformer containers). The TPU-native model zoo lives in
+``deepspeed_tpu.models``; this module re-exports it under the reference
+package name."""
+
+from ..models import *  # noqa: F401,F403
+from ..models.transformer import TransformerConfig, TransformerLM  # noqa: F401

@@ -1,0 +1,3 @@
+"""Nebula async-checkpoint-service namespace (reference ``deepspeed/nebula``)."""
+
+from .config import DeepSpeedNebulaConfig  # noqa: F401

@@ -1,0 +1,44 @@
+"""Nebula (async checkpoint service) config.
+
+Reference ``deepspeed/nebula/config.py`` — the block that turns on
+Microsoft's asynchronous tiered checkpoint service. The TPU-native
+mechanism behind the same contract (training never blocks on persistence)
+is orbax's AsyncCheckpointer: enabling nebula flips the engine's checkpoint
+engine into async-save mode; retention/interval knobs are recorded for
+API compatibility.
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .constants import (NEBULA, NEBULA_ENABLE_NEBULA_LOAD, NEBULA_ENABLE_NEBULA_LOAD_DEFAULT,
+                        NEBULA_ENABLED, NEBULA_ENABLED_DEFAULT, NEBULA_LOAD_PATH,
+                        NEBULA_LOAD_PATH_DEFAULT, NEBULA_NUM_OF_VERSION_IN_RETENTION,
+                        NEBULA_NUM_OF_VERSION_IN_RETENTION_DEFAULT,
+                        NEBULA_PERSISTENT_STORAGE_PATH, NEBULA_PERSISTENT_STORAGE_PATH_DEFAULT,
+                        NEBULA_PERSISTENT_TIME_INTERVAL, NEBULA_PERSISTENT_TIME_INTERVAL_DEFAULT)
+
+
+@dataclass
+class DeepSpeedNebulaConfig:
+    enabled: bool = NEBULA_ENABLED_DEFAULT
+    load_path: Optional[str] = NEBULA_LOAD_PATH_DEFAULT
+    enable_nebula_load: bool = NEBULA_ENABLE_NEBULA_LOAD_DEFAULT
+    persistent_storage_path: Optional[str] = NEBULA_PERSISTENT_STORAGE_PATH_DEFAULT
+    persistent_time_interval: int = NEBULA_PERSISTENT_TIME_INTERVAL_DEFAULT
+    num_of_version_in_retention: int = NEBULA_NUM_OF_VERSION_IN_RETENTION_DEFAULT
+
+    @classmethod
+    def from_param_dict(cls, param_dict: dict) -> "DeepSpeedNebulaConfig":
+        d = dict(param_dict.get(NEBULA, {}) or {})
+        return cls(
+            enabled=bool(d.get(NEBULA_ENABLED, NEBULA_ENABLED_DEFAULT)),
+            load_path=d.get(NEBULA_LOAD_PATH, NEBULA_LOAD_PATH_DEFAULT),
+            enable_nebula_load=bool(d.get(NEBULA_ENABLE_NEBULA_LOAD,
+                                          NEBULA_ENABLE_NEBULA_LOAD_DEFAULT)),
+            persistent_storage_path=d.get(NEBULA_PERSISTENT_STORAGE_PATH,
+                                          NEBULA_PERSISTENT_STORAGE_PATH_DEFAULT),
+            persistent_time_interval=int(d.get(NEBULA_PERSISTENT_TIME_INTERVAL,
+                                               NEBULA_PERSISTENT_TIME_INTERVAL_DEFAULT)),
+            num_of_version_in_retention=int(d.get(NEBULA_NUM_OF_VERSION_IN_RETENTION,
+                                                  NEBULA_NUM_OF_VERSION_IN_RETENTION_DEFAULT)))

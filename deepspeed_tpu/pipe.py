@@ -1,0 +1,4 @@
+"""Top-level pipe namespace (reference ``deepspeed/pipe/__init__.py``:
+re-exports the pipeline container types)."""
+
+from .runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401

@@ -274,6 +274,13 @@ class DeepSpeedConfig:
         self.curriculum_learning_config = CurriculumLearningConfig(**pd.get(CURRICULUM_LEARNING_LEGACY, {}))
         ckpt_dict = pd.get(CHECKPOINT, {})
         self.checkpoint_config = CheckpointConfig(**ckpt_dict)
+        from ..nebula.config import DeepSpeedNebulaConfig
+
+        self.nebula_config = DeepSpeedNebulaConfig.from_param_dict(pd)
+        if self.nebula_config.enabled:
+            # nebula's contract = training never blocks on persistence; the
+            # TPU mechanism is orbax async save
+            self.checkpoint_config.async_save = True
         self.checkpoint_tag_validation_enabled = self.checkpoint_config.tag_validation != "Ignore"
         self.checkpoint_tag_validation_fail = self.checkpoint_config.tag_validation == "Fail"
         self.load_universal_checkpoint = self.checkpoint_config.load_universal

@@ -100,3 +100,35 @@ def test_top_level_package_surface():
         assert hasattr(ds, name), name
     assert ds.zero.ZeroShardingPolicy is not None
     assert callable(ds.checkpointing.checkpoint)
+
+
+def test_nebula_config_block_enables_async_save():
+    """Reference deepspeed/nebula config block: enabling nebula flips the
+    checkpoint engine to async (orbax AsyncCheckpointer — the TPU mechanism
+    behind the never-block-on-persistence contract)."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "nebula": {"enabled": True, "persistent_storage_path": "/tmp/neb",
+                                      "num_of_version_in_retention": 3}})
+    assert cfg.nebula_config.enabled
+    assert cfg.nebula_config.num_of_version_in_retention == 3
+    assert cfg.checkpoint_config.async_save
+    off = DeepSpeedConfig({"train_batch_size": 8})
+    assert not off.nebula_config.enabled and not off.checkpoint_config.async_save
+
+
+def test_top_level_namespace_parity():
+    """Reference top-level modules exist: pipe, constants, git_version_info,
+    model_implementations, nebula."""
+    import deepspeed_tpu.constants as c
+    import deepspeed_tpu.git_version_info as gv
+    import deepspeed_tpu.model_implementations as mi
+    import deepspeed_tpu.pipe as p
+    from deepspeed_tpu.nebula import DeepSpeedNebulaConfig
+
+    assert p.PipelineModule and p.LayerSpec and p.TiedLayerSpec
+    assert c.TORCH_DISTRIBUTED_DEFAULT_PORT == 29500
+    assert gv.version and isinstance(gv.compatible_ops, dict) and gv.compatible_ops
+    assert mi.TransformerLM is not None
+    assert DeepSpeedNebulaConfig().enabled is False
