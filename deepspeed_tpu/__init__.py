@@ -21,6 +21,7 @@ from .runtime.engine import DeepSpeedEngine
 from .runtime.hybrid_engine import DeepSpeedHybridEngine
 from .runtime.pipe.module import PipelineModule
 from .runtime import zero
+from . import pipe
 from .runtime.activation_checkpointing import checkpointing
 from .inference.engine import InferenceEngine
 from .inference.config import DeepSpeedInferenceConfig
