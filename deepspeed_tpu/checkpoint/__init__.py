@@ -3,4 +3,5 @@ from .universal_checkpoint import (load_universal_checkpoint, read_universal_che
                                    load_hp_checkpoint_state)
 from .zero_to_fp32 import (get_fp32_state_dict_from_zero_checkpoint,
                            convert_zero_checkpoint_to_fp32_state_dict, load_state_dict_from_zero_checkpoint)
+from .reshape_meg_2d import get_mpu_ranks, meg_2d_parallel_map, reshape_meg_2d_parallel
 from .reshape_utils import merge_tp_param, split_tp_param, reshard_state_dict

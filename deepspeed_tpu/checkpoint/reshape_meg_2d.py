@@ -1,0 +1,109 @@
+"""Megatron 2-D (pp × tp) checkpoint rank-map reshaping.
+
+Analog of the reference ``deepspeed/checkpoint/reshape_meg_2d.py``
+(``meg_2d_parallel_map:9``, ``reshape_meg_2d_parallel:80``,
+``get_mpu_ranks:107``) — the bookkeeping that says, for a topology change,
+which OLD ranks' checkpoint shards each NEW (pipeline, tensor) partition
+must read. On TPU the byte movement itself is subsumed by resharding
+(arrays are global, see ``ds_to_universal``/``universal_checkpoint``), but
+offline tooling converting legacy Megatron-DeepSpeed checkpoints still
+needs the rank-map math, re-derived here from the Megatron rank order
+(tp fastest, then dp, then pp).
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+
+class meg_2d_parallel_map:
+    """(pp_index, tp_index) → list of payloads (global ranks, usually)."""
+
+    def __init__(self, pp_degree: int, tp_degree: int):
+        self.pp_degree = pp_degree
+        self.tp_degree = tp_degree
+        self.map: Dict[Tuple[int, int], List] = {}
+
+    def simple_init(self):
+        """Identity map: cell (p, t) owns global rank p * tp + t (the
+        Megatron enumeration with dp folded out)."""
+        for p in range(self.pp_degree):
+            for t in range(self.tp_degree):
+                self.map[(p, t)] = [p * self.tp_degree + t]
+        return self
+
+    def add_data(self, pp_index: int, tp_index: int, data: List):
+        assert 0 <= pp_index < self.pp_degree and 0 <= tp_index < self.tp_degree
+        self.map.setdefault((pp_index, tp_index), []).extend(data)
+
+    def get_data(self, pp_index: Optional[int] = None, tp_index: Optional[int] = None) -> List:
+        """Collect payloads; None wildcards a dimension."""
+        pps = range(self.pp_degree) if pp_index is None else [pp_index]
+        tps = range(self.tp_degree) if tp_index is None else [tp_index]
+        out: List = []
+        for p in pps:
+            for t in tps:
+                out.extend(self.map.get((p, t), []))
+        return out
+
+    def print_data(self, tag: str = ""):
+        for key in sorted(self.map):
+            logger.info(f"{tag} {key} -> {self.map[key]}")
+
+
+def _merge_tp(old: meg_2d_parallel_map, new_tp: int) -> meg_2d_parallel_map:
+    assert old.tp_degree % new_tp == 0, \
+        f"tp reshape needs integer merge factor: {old.tp_degree} -> {new_tp}"
+    factor = old.tp_degree // new_tp
+    out = meg_2d_parallel_map(old.pp_degree, new_tp)
+    for p in range(old.pp_degree):
+        for t in range(new_tp):
+            for f in range(factor):
+                out.add_data(p, t, old.map[(p, t * factor + f)])
+    return out
+
+
+def _merge_pp(old: meg_2d_parallel_map, new_pp: int) -> meg_2d_parallel_map:
+    assert old.pp_degree % new_pp == 0, \
+        f"pp reshape needs integer merge factor: {old.pp_degree} -> {new_pp}"
+    factor = old.pp_degree // new_pp
+    out = meg_2d_parallel_map(new_pp, old.tp_degree)
+    for p in range(new_pp):
+        for t in range(old.tp_degree):
+            for f in range(factor):
+                out.add_data(p, t, old.map[(p * factor + f, t)])
+    return out
+
+
+def reshape_meg_2d_parallel(old_pp_degree: int, old_tp_degree: int, new_pp_degree: int,
+                            new_tp_degree: int, verbose: bool = False) -> meg_2d_parallel_map:
+    """Each new (pp, tp) cell lists the OLD global ranks whose shards feed
+    it. Degrees may only shrink by integer factors (shard merging); growing
+    goes through the universal layout instead."""
+    old = meg_2d_parallel_map(old_pp_degree, old_tp_degree).simple_init()
+    if verbose:
+        old.print_data("old:")
+    mid = _merge_tp(old, new_tp_degree)
+    new = _merge_pp(mid, new_pp_degree)
+    if verbose:
+        new.print_data("new:")
+    return new
+
+
+def get_mpu_ranks(tp_size: int = 1, pp_size: int = 1, dp_size: int = 1,
+                  virtual_pp_size=None):
+    """Group rank lists for a (tp, dp, pp) world in Megatron order
+    (global rank = pp * dp * tp + dp * tp_size... tp fastest):
+    returns (tp_groups, dp_groups, pp_groups)."""
+    world = tp_size * dp_size * pp_size
+    tp_groups = [list(range(start, start + tp_size)) for start in range(0, world, tp_size)]
+    dp_groups = []
+    for p in range(pp_size):
+        base = p * dp_size * tp_size
+        for t in range(tp_size):
+            dp_groups.append([base + d * tp_size + t for d in range(dp_size)])
+    pp_groups = []
+    per_stage = dp_size * tp_size
+    for i in range(per_stage):
+        pp_groups.append([i + p * per_stage for p in range(pp_size)])
+    return tp_groups, dp_groups, pp_groups

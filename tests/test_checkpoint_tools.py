@@ -215,3 +215,28 @@ def test_universal_pp_topology_change_bit_exact(tmp_path):
     loss = float(engine_b.train_batch(_batch(seed=9, bsz=2)))
     assert np.isfinite(loss)
     groups.reset()
+
+
+def test_reshape_meg_2d_rank_map():
+    """reshape_meg_2d_parallel (reference checkpoint/reshape_meg_2d.py):
+    each new (pp, tp) cell lists the old ranks whose shards it merges."""
+    from deepspeed_tpu.checkpoint.reshape_meg_2d import (get_mpu_ranks, meg_2d_parallel_map,
+                                                         reshape_meg_2d_parallel)
+
+    new = reshape_meg_2d_parallel(4, 4, 2, 2)
+    # new cell (0,0) merges old tp {0,1} of old pp {0,1}: old rank = p*4+t
+    assert sorted(new.map[(0, 0)]) == [0, 1, 4, 5]
+    assert sorted(new.map[(1, 1)]) == [10, 11, 14, 15]
+    assert sorted(new.get_data(pp_index=0)) == list(range(8))
+    assert sorted(new.get_data()) == list(range(16))
+
+    ident = meg_2d_parallel_map(2, 3).simple_init()
+    assert ident.map[(1, 2)] == [5]
+
+    with pytest.raises(AssertionError, match="integer merge factor"):
+        reshape_meg_2d_parallel(2, 2, 2, 4)  # growing tp needs universal
+
+    tp_g, dp_g, pp_g = get_mpu_ranks(tp_size=2, pp_size=2, dp_size=2)
+    assert tp_g[0] == [0, 1] and tp_g[-1] == [6, 7]
+    assert [0, 2] in dp_g and [5, 7] in dp_g
+    assert [0, 4] in pp_g and [3, 7] in pp_g
