@@ -9,6 +9,7 @@ capture knob is subsumed by jit), and generation is a compiled
 prefill + ``lax.scan`` decode loop over a preallocated KV cache.
 """
 
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -48,6 +49,8 @@ class InferenceEngine:
         self.params = self._maybe_quantize(self._place_params(params))
         self._compiled: Dict[Any, Any] = {}
         self._cache = None
+        self._model_profile_enabled = False
+        self._model_times = []
         log_dist(f"InferenceEngine ready: tp={tp} dtype={self._config.dtype} "
                  f"quant={self._config.quant.enabled} mesh={dict(self.mesh.shape)}", ranks=[0])
 
@@ -69,10 +72,29 @@ class InferenceEngine:
 
         if "fwd" not in self._compiled:
             self._compiled["fwd"] = jax.jit(lambda p, ids: model_forward(self.model_config, p, ids))
+        t0 = time.time() if self._model_profile_enabled else None
         with self.mesh:
-            return self._compiled["fwd"](self.params, jnp.asarray(input_ids))
+            out = self._compiled["fwd"](self.params, jnp.asarray(input_ids))
+        if t0 is not None:
+            # host fetch = the only real barrier on a relayed TPU runtime
+            np.asarray(out).reshape(-1)[:1]
+            self._model_times.append(time.time() - t0)
+        return out
 
     __call__ = forward
+
+    # ------------------------------------------------------------------
+    def profile_model_time(self, use_cuda_events: bool = True):
+        """Enable per-forward wall-clock capture (reference
+        ``engine.py:203`` — its CUDA-event hooks become a host-fetch
+        barrier here; ``use_cuda_events`` kept for signature parity)."""
+        self._model_profile_enabled = True
+
+    def model_times(self):
+        """Drain captured per-forward latencies (reference ``engine.py:552``)."""
+        assert self._model_profile_enabled, "model profiling is not enabled"
+        times, self._model_times = self._model_times, []
+        return times
 
     # ------------------------------------------------------------------
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0, top_k: int = 0,

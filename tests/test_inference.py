@@ -4,6 +4,7 @@ cache-decode must agree with full forward; generation runs end to end."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models import TransformerConfig, TransformerLM
@@ -67,3 +68,28 @@ def test_generate_matches_argmax_rollout(eight_devices):
         nxt = np.argmax(np.asarray(logits)[:, -1], axis=-1).astype(np.int32)
         seq = np.concatenate([seq, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(out, seq)
+
+
+def test_v1_profile_model_time():
+    """Reference engine.profile_model_time/model_times parity: per-forward
+    wall latencies captured after enabling, drained on read."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama2
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    eng = deepspeed_tpu.init_inference(
+        llama2("tiny", num_layers=2, hidden_size=64, num_heads=4, num_kv_heads=2,
+               vocab_size=128, intermediate_size=128, max_seq_len=64, dtype=jnp.float32,
+               attention_impl="reference"))
+    ids = np.zeros((1, 8), np.int32)
+    eng.forward(ids)  # before enabling: nothing recorded
+    with pytest.raises(AssertionError, match="not enabled"):
+        eng.model_times()
+    eng.profile_model_time()
+    eng.forward(ids)
+    eng.forward(ids)
+    times = eng.model_times()
+    assert len(times) == 2 and all(t > 0 for t in times)
+    assert eng.model_times() == []  # drained
+    groups.reset()
