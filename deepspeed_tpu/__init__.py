@@ -21,7 +21,7 @@ from .runtime.engine import DeepSpeedEngine
 from .runtime.hybrid_engine import DeepSpeedHybridEngine
 from .runtime.pipe.module import PipelineModule
 from .runtime import zero
-from . import pipe
+from . import constants, git_version_info, model_implementations, nebula, pipe
 from .runtime.activation_checkpointing import checkpointing
 from .inference.engine import InferenceEngine
 from .inference.config import DeepSpeedInferenceConfig

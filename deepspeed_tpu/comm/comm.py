@@ -215,12 +215,33 @@ def get_global_rank(group=None, group_rank: int = 0) -> int:
 
 
 def get_all_ranks_from_group(group=None):
-    """Reference helper of the same name."""
+    """Reference helper of the same name. For a mesh-axis-name group the
+    ranks are DEVICE ids (one process owns many devices here): the group is
+    the set of devices varying along that axis with this process's first
+    addressable device's other coordinates held fixed — the device-level
+    analog of "the subgroup containing my rank"."""
     if group is None:
         return list(range(get_world_size()))
     if isinstance(group, (list, tuple)) and all(isinstance(r, int) for r in group):
         return list(group)
-    return list(range(get_world_size()))  # axis-name groups span all processes
+    if isinstance(group, str):
+        from ..parallel import groups as pgroups
+
+        if pgroups.is_initialized():
+            import jax
+            import numpy as np
+
+            mesh = pgroups.get_mesh()
+            if group in mesh.axis_names:
+                ids = np.vectorize(lambda d: d.id)(mesh.devices)
+                ax = mesh.axis_names.index(group)
+                my = jax.local_devices()[0].id
+                pos = np.argwhere(ids == my)
+                if pos.size:
+                    idx = list(pos[0])
+                    idx[ax] = slice(None)
+                    return sorted(int(x) for x in np.ravel(ids[tuple(idx)]))
+    return list(range(get_world_size()))
 
 
 def monitored_barrier(group=None, timeout=None, wait_all_ranks: bool = False):

@@ -138,28 +138,36 @@ def scatter(tensor, src: int = 0, group: AxisNames = "data", axis: int = 0):
     ax = _axis(group)
     src_full = broadcast(tensor, src_index=src, group=group)
     n = lax.axis_size(ax)  # static at trace time: chunk shapes must be static
+    assert tensor.shape[axis] % n == 0, (
+        f"scatter: dim {axis} ({tensor.shape[axis]}) not divisible by group size {n} — "
+        "the reference errors on unequal chunks rather than silently dropping the tail")
     chunk = tensor.shape[axis] // n
     idx = lax.axis_index(ax)
     return lax.dynamic_slice_in_dim(src_full, idx * chunk, chunk, axis=axis)
 
 
-def send(tensor, dst: int, group: AxisNames = "pipe", size: int = None):
-    """Point-to-point shift toward ``dst`` (reference p2p send/recv pairs).
-    XLA has no one-sided p2p: ALL group members call this; the value each
-    member sent lands on ``dst`` only when paired with the matching
-    ``recv`` permutation — for pipeline schedules prefer
-    ``send_recv_next``/``send_recv_prev``."""
-    n = size if size is not None else lax.axis_size(_axis(group))
-    perm = [(i, dst) for i in range(n) if i == (dst - 1) % n]
-    return lax.ppermute(tensor, _axis(group), perm=perm)
+def send(tensor, dst: int, src: int = None, group: AxisNames = "pipe"):
+    """Point-to-point transfer ``src`` → ``dst`` (reference p2p send/recv
+    pairs). XLA has no one-sided p2p, so ALL group members trace this one
+    collective and BOTH endpoints must be named — an SPMD program cannot
+    infer "the calling rank" the way the reference's per-process send can.
+    ``src`` defaults to the ring predecessor ``(dst-1) % n``; for pipeline
+    schedules prefer ``send_recv_next``/``send_recv_prev``. Non-``dst``
+    members receive zeros."""
+    n = lax.axis_size(_axis(group))
+    if src is None:
+        src = (dst - 1) % n
+    return lax.ppermute(tensor, _axis(group), perm=[(src % n, dst % n)])
 
 
-def recv(tensor, src: int, group: AxisNames = "pipe", size: int = None):
-    """Receive from ``src`` (the pair of :func:`send`): src's value arrives
-    at src+1; other members get zeros."""
-    n = size if size is not None else lax.axis_size(_axis(group))
-    perm = [(src, (src + 1) % n)]
-    return lax.ppermute(tensor, _axis(group), perm=perm)
+def recv(tensor, src: int, dst: int = None, group: AxisNames = "pipe"):
+    """The matching end of :func:`send` — the same single permutation,
+    spelled from the receiver's side. ``dst`` defaults to the ring successor
+    ``(src+1) % n``."""
+    n = lax.axis_size(_axis(group))
+    if dst is None:
+        dst = (src + 1) % n
+    return lax.ppermute(tensor, _axis(group), perm=[(src % n, dst % n)])
 
 
 def all_reduce_coalesced(tensors, op=ReduceOp.SUM, group: AxisNames = "data"):

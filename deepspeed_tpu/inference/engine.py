@@ -76,8 +76,13 @@ class InferenceEngine:
         with self.mesh:
             out = self._compiled["fwd"](self.params, jnp.asarray(input_ids))
         if t0 is not None:
-            # host fetch = the only real barrier on a relayed TPU runtime
-            np.asarray(out).reshape(-1)[:1]
+            # a SCALAR host fetch is the barrier (block_until_ready does not
+            # actually synchronize on the relayed axon runtime, and fetching
+            # the full logits would inflate the very latency being measured)
+            try:
+                np.asarray(out[(0,) * out.ndim])
+            except Exception:  # non-addressable multi-host array: best effort
+                jax.block_until_ready(out)
             self._model_times.append(time.time() - t0)
         return out
 

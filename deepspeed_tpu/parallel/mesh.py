@@ -94,6 +94,8 @@ def _hybrid_split(shape, axis_order, n_slices):
     build.
     """
     for candidate in (PIPE_AXIS, DATA_REPL_AXIS, DATA_AXIS):
+        if candidate not in axis_order:  # custom axis orders may omit axes
+            continue
         i = list(axis_order).index(candidate)
         if shape[i] % n_slices == 0 and shape[i] >= n_slices:
             per_slice = list(shape)

@@ -128,15 +128,49 @@ def test_comm_functional_extended_surface(eight_devices):
     assert F.has_all_reduce_coalesced() and F.has_coalescing_manager()
 
     # send and recv are the two ends of ONE matched permutation — each call
-    # is the full collective (XLA has no one-sided p2p)
+    # is the full collective (XLA has no one-sided p2p); non-adjacent pairs
+    # name both endpoints explicitly
     @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
     def p2p_send(x):
-        return F.send(x, dst=3, group="data")
+        return F.send(x, dst=3, group="data")  # src defaults to ring predecessor 2
+
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
+    def p2p_far(x):
+        return F.send(x, dst=6, src=1, group="data")  # explicit non-adjacent pair
 
     @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
     def p2p_recv(x):
         return F.recv(x, src=2, group="data")
 
     assert np.asarray(p2p_send(x)).reshape(-1)[3] == 2.0  # rank 2 -> rank 3
+    assert np.asarray(p2p_far(x)).reshape(-1)[6] == 1.0   # rank 1 -> rank 6
     assert np.asarray(p2p_recv(x)).reshape(-1)[3] == 2.0
+
+    # scatter refuses silent truncation (reference torch scatter errors too)
+    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
+    def bad_scatter(y):
+        return F.scatter(y, src=0, group="data")
+
+    with pytest.raises(AssertionError, match="not divisible"):
+        bad_scatter(jnp.arange(10.0))
+    groups.reset()
+
+
+def test_get_all_ranks_from_group_multi_axis(eight_devices):
+    """ADVICE-style review fix: axis-name groups resolve to the DEVICE-id
+    subgroup containing this process's first device, not the whole world."""
+    from deepspeed_tpu.comm import comm as dist
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.parallel.mesh import MeshConfig
+
+    groups.reset()
+    groups.initialize_mesh(MeshConfig(data=2, model=4))
+    # device 0's model group = first row of the (2, 4) mesh; its data group
+    # holds the two devices at model-coordinate 0
+    model_group = dist.get_all_ranks_from_group("model")
+    data_group = dist.get_all_ranks_from_group("data")
+    assert len(model_group) == 4 and len(data_group) == 2
+    assert set(model_group) & set(data_group)  # both contain device 0's id
+    assert dist.get_global_rank("model", 1) == model_group[1]
+    assert dist.get_all_ranks_from_group(None) == list(range(dist.get_world_size()))
     groups.reset()
