@@ -1,0 +1,69 @@
+"""BF16 optimizer (reference ``runtime/bf16_optimizer.py`` —
+``BF16_Optimizer``: bf16 params in the model, fp32 masters + fp32 grads in
+the optimizer, update in fp32, cast back).
+
+The TPU engine gets these numerics structurally (params rest in fp32; the
+model casts to bf16 at compute, see ``optimizers.master_weight_wrapper``) —
+this class serves code written against the reference's object API: it OWNS
+the fp32 master tree, steps it in fp32, and hands back fresh bf16 compute
+params each step.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _is_float(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+class BF16_Optimizer:
+    """``init(params)`` → bf16 compute params (masters kept fp32 inside);
+    ``step(grads)`` → updated bf16 params."""
+
+    def __init__(self, init_optimizer: optax.GradientTransformation,
+                 compute_dtype=jnp.bfloat16, clip_grad: float = 0.0):
+        tx = init_optimizer
+        if clip_grad and clip_grad > 0:
+            tx = optax.chain(optax.clip_by_global_norm(clip_grad), tx)
+        self.tx = tx
+        self.compute_dtype = compute_dtype
+        self.state = None
+        self._masters = None
+        self._params = None
+
+    def _cast_down(self):
+        return jax.tree_util.tree_map(
+            lambda m: m.astype(self.compute_dtype) if _is_float(m) else m, self._masters)
+
+    def init(self, params):
+        self._masters = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, jnp.float32) if _is_float(p) else jnp.asarray(p), params)
+        self.state = self.tx.init(self._masters)
+        self._params = self._cast_down()
+        return self._params
+
+    def step(self, grads):
+        grads32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) if _is_float(g) else g, grads)
+        updates, self.state = self.tx.update(grads32, self.state, self._masters)
+        self._masters = optax.apply_updates(self._masters, updates)
+        self._params = self._cast_down()
+        return self._params
+
+    @property
+    def param_groups(self):  # reference surface; one flat group here
+        return [{"params": self._params}]
+
+    def fp32_params(self):
+        """The fp32 master tree (reference exposes fp32_groups_flat)."""
+        return self._masters
+
+    def state_dict(self):
+        return {"state": self.state, "masters": self._masters}
+
+    def load_state_dict(self, sd):
+        self.state = sd["state"]
+        self._masters = sd["masters"]
+        self._params = self._cast_down()

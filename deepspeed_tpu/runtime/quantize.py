@@ -1,0 +1,74 @@
+"""Training-time quantizer (reference ``runtime/quantize.py`` —
+``Quantizer``: MoQ's progressively-tightening fake quantization applied to
+the model weights every ``quantize_period`` steps, with symmetric/asymmetric
+types and a mixing ratio that anneals from fp16 toward the target bits).
+
+TPU form: a pure function over the param tree (the engine owns when to call
+it), delegating the numeric core to ``compression.basic_layer`` —
+symmetric/asymmetric fake-quant with straight-through semantics. The
+``quantize_real_ratio`` anneal (reference ``update_fp16_ratio``) mixes the
+quantized and original weights so early steps see mostly-fp values.
+"""
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..compression.basic_layer import asym_quantize, sym_quantize
+from ..utils.logging import logger
+
+TWO_D_PARAMS = 6  # reference constant: params-per-layer heuristic for layer_num
+
+
+class Quantizer:
+
+    def __init__(self, q_groups: int = 1, q_mixed_fp16: bool = False, q_change_ratio: float = 0.01,
+                 q_type: int = 0, q_rounding: int = 0, q_verbose: bool = False,
+                 q_eigenvalue: bool = False, use_quantizer_kernel: bool = False, layer_num: int = 0):
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type  # 0 = symmetric, 1 = asymmetric
+        self.q_rounding = q_rounding  # 0 nearest (stochastic not supported — disclosed)
+        self.q_verbose = q_verbose
+        self.q_eigenvalue = q_eigenvalue
+        self.use_quantizer_kernel = use_quantizer_kernel
+        self.layer_num = layer_num
+        self.qsteps = 0
+        self.quantize_real_ratio = 1.0
+
+    def any_precision_switch(self):
+        """Reference surface: whether the target bits change this step
+        (single-target-bit schedule here — always False)."""
+        return False
+
+    def update_fp16_ratio(self):
+        """Anneal the fp mixing ratio toward full quantization
+        (reference ``update_fp16_ratio``)."""
+        if self.q_mixed_fp16:
+            self.quantize_real_ratio = max(0.0, self.quantize_real_ratio - self.q_change_ratio)
+
+    def quantize(self, params: Dict[str, Any], overflow: bool = False, eigenvalue_enabled: bool = False,
+                 target_bits: int = 8) -> Dict[str, Any]:
+        """One MoQ step over the param tree: fake-quantize every >=2-D float
+        weight, mixing with the original by ``quantize_real_ratio``."""
+        if overflow and not eigenvalue_enabled:
+            return params  # reference skips quantization on overflow steps
+        self.qsteps += 1
+        ratio = self.quantize_real_ratio
+        qfn = sym_quantize if self.q_type == 0 else asym_quantize
+
+        def leaf(x):
+            if not hasattr(x, "ndim") or x.ndim < 2 or not jnp.issubdtype(
+                    jnp.asarray(x).dtype, jnp.floating):
+                return x
+            q = qfn(jnp.asarray(x), bits=target_bits, groups=self.q_groups)
+            return (ratio * jnp.asarray(x) + (1.0 - ratio) * q).astype(x.dtype)
+
+        out = jax.tree_util.tree_map(leaf, params)
+        self.update_fp16_ratio()
+        if self.q_verbose:
+            logger.info(f"MoQ step {self.qsteps}: target_bits={target_bits} "
+                        f"fp_ratio={self.quantize_real_ratio:.3f}")
+        return out

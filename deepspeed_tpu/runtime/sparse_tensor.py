@@ -1,0 +1,71 @@
+"""SparseTensor (reference ``runtime/sparse_tensor.py`` — the COO wrapper
+DeepSpeed uses for sparse embedding gradients so allreduce ships
+indices+values instead of the dense matrix).
+
+JAX form: immutable (index, value, dense_shape) triple with to_dense /
+from_dense and an add that concatenates coordinates (duplicate rows sum on
+densify — the same semantics torch sparse accumulation gives the
+reference). ``jax.experimental.sparse.BCOO`` interop is provided for code
+moving onto jax's native sparse support.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseTensor:
+    """Row-sparse matrix: ``indices`` [nnz] row ids, ``values`` [nnz, cols]."""
+
+    def __init__(self, indices, values, dense_size: Tuple[int, int]):
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.dense_size = tuple(int(s) for s in dense_size)
+        assert self.values.ndim == 2 and self.values.shape[1] == self.dense_size[1]
+        assert self.indices.shape[0] == self.values.shape[0]
+
+    @classmethod
+    def from_dense(cls, dense, threshold: float = 0.0):
+        """Rows whose max|.| exceeds ``threshold`` become the sparse payload
+        (embedding-gradient pattern: most rows are exactly zero)."""
+        dense = np.asarray(dense)
+        mask = np.abs(dense).max(axis=1) > threshold
+        idx = np.nonzero(mask)[0]
+        return cls(idx, dense[idx], dense.shape)
+
+    def to_dense(self):
+        """Duplicate row ids accumulate (torch sparse semantics)."""
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        assert self.dense_size == other.dense_size, "sparse add needs matching dense shapes"
+        return SparseTensor(jnp.concatenate([self.indices, other.indices]),
+                            jnp.concatenate([self.values, other.values]), self.dense_size)
+
+    def to_coo_tensor(self):
+        """jax-native BCOO (reference returns a torch sparse_coo_tensor)."""
+        from jax.experimental import sparse as jsparse
+
+        rows = jnp.repeat(self.indices, self.dense_size[1])
+        cols = jnp.tile(jnp.arange(self.dense_size[1], dtype=jnp.int32), self.indices.shape[0])
+        coords = jnp.stack([rows, cols], axis=1)
+        return jsparse.BCOO((self.values.reshape(-1), coords), shape=self.dense_size)
+
+    def sparse_size(self):
+        dense = int(np.prod(self.dense_size))
+        sparse = int(self.indices.size + self.values.size)
+        return sparse, dense
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def __str__(self):
+        s, d = self.sparse_size()
+        return f"SparseTensor(nnz_rows={self.indices.shape[0]}, dense={self.dense_size}, " \
+               f"payload={s}/{d})"
+
+    __repr__ = __str__

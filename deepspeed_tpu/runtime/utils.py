@@ -1,0 +1,73 @@
+"""Runtime utility surface (reference ``deepspeed/runtime/utils.py`` — the
+grab-bag user code imports from: ``see_memory_usage``, ``clip_grad_norm_``,
+``get_global_norm``, ``get_grad_norm``…). Functional JAX forms: clipping
+returns the new tree instead of mutating in place.
+"""
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def see_memory_usage(message: str, force: bool = False):
+    """Device HBM stats (when the backend exposes them) + host RSS
+    (reference ``see_memory_usage`` prints torch.cuda + psutil numbers)."""
+    if not force:
+        return
+    parts = [message]
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        if "bytes_in_use" in stats:
+            parts.append(f"HBM in use {stats['bytes_in_use'] / 2**30:.2f}GB")
+        if "peak_bytes_in_use" in stats:
+            parts.append(f"peak {stats['peak_bytes_in_use'] / 2**30:.2f}GB")
+        if "bytes_limit" in stats:
+            parts.append(f"limit {stats['bytes_limit'] / 2**30:.2f}GB")
+    except Exception:
+        parts.append("HBM stats unavailable")
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    parts.append(f"host RSS {int(line.split()[1]) / 2**20:.2f}GB")
+                    break
+    except Exception:
+        pass
+    logger.info(" | ".join(parts))
+
+
+def get_global_norm(norm_list: Iterable[float]) -> float:
+    """l2-combine per-group norms (reference ``get_global_norm``)."""
+    return float(np.sqrt(sum(float(n) ** 2 for n in norm_list)))
+
+
+def get_grad_norm(grads, norm_type: float = 2.0):
+    """Global norm of a gradient pytree (reference ``get_grad_norm`` over
+    parameter lists). Traced-compatible: returns a jnp scalar inside jit."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    if norm_type == float("inf"):
+        return jnp.max(jnp.stack([jnp.max(jnp.abs(g)).astype(jnp.float32) for g in leaves]))
+    norm_type = float(norm_type)
+    total = sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type) for g in leaves)
+    return total ** (1.0 / norm_type)
+
+
+def clip_grad_norm_(grads, max_norm: float, norm_type: float = 2.0):
+    """Reference ``clip_grad_norm_`` in functional form: returns
+    (clipped_grads, total_norm) — JAX trees are immutable, so the clipped
+    tree is the result rather than an in-place mutation."""
+    total = get_grad_norm(grads, norm_type)
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                                  grads), total
+
+
+def empty_cache():
+    """Reference ``empty_cache``: XLA owns the allocator; nothing to drop."""
+    return None

@@ -1,0 +1,57 @@
+"""WeightQuantization (reference ``runtime/weight_quantizer.py`` — the
+offline model-quantization helper ``module_inject`` uses for MoQ-style
+checkpoint loading: quantize selected weight matrices to int8 with
+per-group scales and report the scales for the kernels).
+
+TPU form: delegates the numeric core to ``ops.pallas.quant.quantize_blockwise``
+(the single absmax/127 implementation) and returns ``QuantizedWeight``
+leaves, which every forward path in this framework reads transparently via
+``.astype``.
+"""
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..inference.quantization import QuantizedWeight, quantize_weight_int8
+
+
+class WeightQuantization:
+
+    def __init__(self, mlp_extra_grouping: bool = False, mp_size: int = 1):
+        self.mlp_extra_grouping = mlp_extra_grouping  # reference knob; groups below
+        self.mp_size = mp_size
+        self.scales: List = []
+
+    def quantize_data(self, data, quantize_bits: int = 8, groups: int = 1, key=None):
+        """Quantize one matrix; returns (QuantizedWeight, scale). ``groups``
+        beyond 1 is subsumed by the blockwise kernel's per-output-channel
+        scales (finer than the reference's row groups)."""
+        if quantize_bits != 8:
+            raise NotImplementedError(f"int{quantize_bits} weight quantization not supported (int8 only)")
+        qw = quantize_weight_int8(data)
+        self.scales.append(qw.scale)
+        return qw, qw.scale
+
+    def model_quantize(self, params: Dict[str, Any], quantize_bits: int = 8,
+                       groups: int = 1) -> Dict[str, Any]:
+        """Quantize a whole param tree's weight matrices (reference
+        ``model_quantize`` walks nn.Module layers)."""
+        from ..inference.quantization import quantize_params_for_inference
+
+        return quantize_params_for_inference(params, quantize_bits)
+
+    def is_quantized(self, leaf) -> bool:
+        return isinstance(leaf, QuantizedWeight)
+
+    def sd_quantize_megatron(self, sd, quantize_bits: int = 8, groups: int = 1):
+        """Quantize every >=2-D array in a flat state dict (megatron-style
+        checkpoints arrive flat)."""
+        out = {}
+        for k, v in sd.items():
+            arr = np.asarray(v)
+            if arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating):
+                out[k], _ = self.quantize_data(arr, quantize_bits, groups)
+            else:
+                out[k] = v
+        return out

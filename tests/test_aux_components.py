@@ -460,3 +460,92 @@ def test_flops_profiler_engine_integration(tmp_path, eight_devices):
     assert "qkv_proj" in text and "lm_head" in text
     assert engine.flops_profiler.get_total_flops() > 0  # XLA step totals captured
     groups.reset()
+
+
+# ---------------------------------------------------------------------------
+# runtime module-name parity: utils / bf16_optimizer / sparse_tensor /
+# weight_quantizer / quantize (MoQ)
+# ---------------------------------------------------------------------------
+def test_runtime_utils_norms_and_clip():
+    from deepspeed_tpu.runtime.utils import (clip_grad_norm_, empty_cache, get_global_norm,
+                                             get_grad_norm, see_memory_usage)
+
+    grads = {"a": jnp.full((4,), 3.0), "b": jnp.full((2, 2), 4.0)}
+    n = float(get_grad_norm(grads))
+    np.testing.assert_allclose(n, np.sqrt(4 * 9 + 4 * 16), rtol=1e-6)
+    clipped, total = clip_grad_norm_(grads, max_norm=1.0)
+    np.testing.assert_allclose(float(get_grad_norm(clipped)), 1.0, rtol=1e-4)
+    np.testing.assert_allclose(float(total), n, rtol=1e-6)
+    # under the clip threshold: untouched
+    same, _ = clip_grad_norm_(grads, max_norm=1e6)
+    np.testing.assert_allclose(np.asarray(same["a"]), np.asarray(grads["a"]))
+    assert get_global_norm([3.0, 4.0]) == 5.0
+    assert float(get_grad_norm(grads, norm_type=float("inf"))) == 4.0
+    see_memory_usage("test", force=True)  # must not raise
+    empty_cache()
+
+
+def test_bf16_optimizer_master_weights():
+    import optax
+
+    from deepspeed_tpu.runtime.bf16_optimizer import BF16_Optimizer
+
+    opt = BF16_Optimizer(optax.sgd(0.5), clip_grad=10.0)
+    params = {"w": jnp.full((4,), 1.0, jnp.float32)}
+    p16 = opt.init(params)
+    assert p16["w"].dtype == jnp.bfloat16
+    # a gradient too small for bf16 resolution near 1.0 must still
+    # accumulate in the fp32 masters over steps
+    tiny = {"w": jnp.full((4,), 2e-3, jnp.float32)}
+    for _ in range(4):
+        p16 = opt.step(tiny)
+    masters = opt.fp32_params()
+    np.testing.assert_allclose(np.asarray(masters["w"]), 1.0 - 0.5 * 2e-3 * 4, rtol=1e-5)
+    sd = opt.state_dict()
+    opt2 = BF16_Optimizer(optax.sgd(0.5))
+    opt2.load_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(opt2.fp32_params()["w"]), np.asarray(masters["w"]))
+
+
+def test_sparse_tensor_roundtrip():
+    from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = [1, 2, 3]
+    dense[4] = [4, 5, 6]
+    st = SparseTensor.from_dense(dense)
+    assert st.indices.shape[0] == 2
+    np.testing.assert_array_equal(np.asarray(st.to_dense()), dense)
+    # duplicate rows accumulate on densify (torch sparse semantics)
+    both = st.add(st)
+    np.testing.assert_array_equal(np.asarray(both.to_dense()), 2 * dense)
+    sparse, full = st.sparse_size()
+    assert sparse < full
+    bcoo = st.to_coo_tensor()
+    np.testing.assert_array_equal(np.asarray(bcoo.todense()), dense)
+
+
+def test_weight_quantizer_and_moq():
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+    from deepspeed_tpu.runtime.quantize import Quantizer
+    from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    wq = WeightQuantization()
+    q, scale = wq.quantize_data(w)
+    assert isinstance(q, QuantizedWeight)
+    np.testing.assert_allclose(np.asarray(q.astype(jnp.float32)), w, atol=2e-2)
+    sd = wq.sd_quantize_megatron({"w": w, "bias": np.zeros(8, np.float32)})
+    assert isinstance(sd["w"], QuantizedWeight) and not isinstance(sd["bias"], QuantizedWeight)
+
+    moq = Quantizer(q_mixed_fp16=True, q_change_ratio=0.25, q_groups=1)
+    params = {"w": jnp.asarray(w), "scale": jnp.ones((8,))}
+    out = moq.quantize(params, target_bits=4)
+    assert out["w"].shape == w.shape and out["scale"].shape == (8,)
+    # ratio 1.0 on the first step -> identity mix; ratio anneals after
+    np.testing.assert_allclose(np.asarray(out["w"]), w, atol=1e-6)
+    assert moq.quantize_real_ratio == 0.75
+    out2 = moq.quantize(params, target_bits=4)
+    assert not np.allclose(np.asarray(out2["w"]), w)  # mixing now real
+    assert moq.quantize(params, overflow=True) is params  # overflow skip
