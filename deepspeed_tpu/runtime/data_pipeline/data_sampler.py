@@ -4,11 +4,10 @@
 whose difficulty metric is within the current curriculum difficulty,
 partitioned across data-parallel ranks.
 
-The reference clusters samples by metric value into an on-disk index; here
-the metric is an in-memory array (or callable evaluated once), which covers
-the same training behavior for datasets that fit an index in RAM — the
-multi-TB offline-indexed variant belongs to a data-services layer, not the
-framework core.
+The metric arrives as an in-memory array (or callable evaluated once); for
+multi-TB corpora, ``data_sampling.DataAnalyzer`` computes the per-sample
+metrics offline into Megatron mmap indexed datasets and
+``data_sampling.load_sample_to_metric`` feeds them here.
 """
 
 import math

@@ -1,0 +1,8 @@
+"""Data-sampling subpackage (reference
+``runtime/data_pipeline/data_sampling/``): curriculum sampler + offline
+metric analysis + the Megatron mmap indexed-dataset container."""
+
+from ..data_sampler import DeepSpeedDataSampler  # noqa: F401 — reference location alias
+from .data_analyzer import (DataAnalyzer, load_metric_to_sample,  # noqa: F401
+                            load_sample_to_metric)
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder  # noqa: F401

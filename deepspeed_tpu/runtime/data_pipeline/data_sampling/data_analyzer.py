@@ -1,0 +1,124 @@
+"""Offline curriculum metric analysis.
+
+Analog of the reference
+``runtime/data_pipeline/data_sampling/data_analyzer.py`` (``DataAnalyzer``:
+map-reduce over a dataset computing per-sample difficulty metrics —
+seqlen, vocab rarity, … — persisted as indexed datasets that
+``DeepSpeedDataSampler`` consumes for curriculum learning at multi-TB
+scale). Single-host form: worker sharding is a range split; the merge is a
+concatenation in worker order, so the output layout matches the reference's
+``<metric>/<metric>_sample_to_metric`` / ``_metric_to_sample`` pair.
+"""
+
+import csv
+import os
+from collections import defaultdict
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+from ....utils.logging import logger
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+
+
+class DataAnalyzer:
+
+    def __init__(self,
+                 dataset: Sequence,
+                 metric_names: List[str],
+                 metric_functions: List[Callable],
+                 save_path: str,
+                 metric_types: List[str] = None,
+                 num_workers: int = 1,
+                 worker_id: int = 0,
+                 batch_size: int = 1):
+        """``metric_functions[i](sample) -> int`` difficulty value;
+        ``metric_types``: 'single_value_per_sample' (curriculum difficulty,
+        the default) or 'accumulate_value_over_samples' (corpus statistics,
+        e.g. vocab frequency)."""
+        assert len(metric_names) == len(metric_functions)
+        self.dataset = dataset
+        self.metric_names = metric_names
+        self.metric_functions = metric_functions
+        self.metric_types = metric_types or ["single_value_per_sample"] * len(metric_names)
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+
+    # -- map phase ---------------------------------------------------------
+    def _worker_range(self, worker_id: int):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        return range(worker_id * per, min(n, (worker_id + 1) * per))
+
+    def run_map(self, worker_id: int = None):
+        """Compute this worker's shard of every metric; persist per-worker
+        partial indexes."""
+        worker_id = self.worker_id if worker_id is None else worker_id
+        rng = self._worker_range(worker_id)
+        for name, fn, mtype in zip(self.metric_names, self.metric_functions, self.metric_types):
+            mdir = os.path.join(self.save_path, name)
+            os.makedirs(mdir, exist_ok=True)
+            prefix = os.path.join(mdir, f"worker{worker_id}_sample_to_metric")
+            builder = MMapIndexedDatasetBuilder(prefix + ".bin", dtype=np.int64)
+            acc = None
+            for i in rng:
+                val = fn(self.dataset[i])
+                if mtype == "accumulate_value_over_samples":
+                    acc = np.asarray(val, np.int64) if acc is None else acc + np.asarray(val, np.int64)
+                else:
+                    builder.add_item(np.asarray([int(val)], np.int64))
+            if mtype == "accumulate_value_over_samples":
+                builder.add_item(acc if acc is not None else np.zeros(1, np.int64))
+            builder.finalize(prefix + ".idx")
+        logger.info(f"DataAnalyzer map: worker {worker_id} covered {len(rng)} samples")
+
+    # -- reduce phase ------------------------------------------------------
+    def run_reduce(self):
+        """Merge worker shards into the reference's artifact pair per metric:
+        ``<m>_sample_to_metric`` (value per global sample index) and
+        ``<m>_metric_to_sample`` (csv: value -> sample ids)."""
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            mdir = os.path.join(self.save_path, name)
+            merged = MMapIndexedDatasetBuilder(
+                os.path.join(mdir, f"{name}_sample_to_metric.bin"), dtype=np.int64)
+            values: List[int] = []
+            for w in range(self.num_workers):
+                part = MMapIndexedDataset(os.path.join(mdir, f"worker{w}_sample_to_metric"))
+                for i in range(len(part)):
+                    arr = np.asarray(part[i])
+                    merged.add_item(arr)
+                    if mtype == "single_value_per_sample":
+                        values.append(int(arr[0]))
+            merged.finalize(os.path.join(mdir, f"{name}_sample_to_metric.idx"))
+            if mtype == "single_value_per_sample":
+                buckets: Dict[int, List[int]] = defaultdict(list)
+                for sample_id, v in enumerate(values):
+                    buckets[v].append(sample_id)
+                with open(os.path.join(mdir, f"{name}_metric_to_sample.csv"), "w", newline="") as f:
+                    w = csv.writer(f)
+                    for v in sorted(buckets):
+                        w.writerow([v] + buckets[v])
+            logger.info(f"DataAnalyzer reduce: metric '{name}' merged ({len(values)} samples)")
+
+    def run_map_reduce(self):
+        for w in range(self.num_workers):
+            self.run_map(worker_id=w)
+        self.run_reduce()
+
+
+def load_sample_to_metric(save_path: str, metric_name: str) -> np.ndarray:
+    """Per-sample difficulty values — plugs directly into
+    ``DeepSpeedDataSampler(difficulty_metric=...)``."""
+    ds = MMapIndexedDataset(os.path.join(save_path, metric_name, f"{metric_name}_sample_to_metric"))
+    return np.asarray([int(np.asarray(ds[i])[0]) for i in range(len(ds))], np.int64)
+
+
+def load_metric_to_sample(save_path: str, metric_name: str) -> Dict[int, List[int]]:
+    out: Dict[int, List[int]] = {}
+    with open(os.path.join(save_path, metric_name, f"{metric_name}_metric_to_sample.csv")) as f:
+        for row in csv.reader(f):
+            if row:
+                out[int(row[0])] = [int(x) for x in row[1:]]
+    return out

@@ -1,0 +1,118 @@
+"""Memory-mapped indexed dataset — the Megatron ``.bin``/``.idx`` format.
+
+Analog of the reference
+``runtime/data_pipeline/data_sampling/indexed_dataset.py`` (the format the
+curriculum data pipeline stores metric indexes in, and the standard
+container for pre-tokenized LM corpora). Implemented against the public
+format layout with numpy memmaps — no torch:
+
+``.idx``: magic ``MMIDIDX\\x00\\x00`` · version u64 · dtype-code u8 ·
+sequence count u64 · document count u64 · sizes i32[n] · pointers i64[n]
+(byte offsets into ``.bin``) · doc_idx i64[docs].
+``.bin``: the samples' raw element data, concatenated.
+"""
+
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+_MAGIC = b"MMIDIDX\x00\x00"
+_VERSION = 1
+
+# dtype codes of the public format
+_CODE_TO_DTYPE = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+                  5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16}
+_DTYPE_TO_CODE = {np.dtype(v): k for k, v in _CODE_TO_DTYPE.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Stream samples into ``<prefix>.bin`` and write the index on finalize
+    (reference ``MMapIndexedDatasetBuilder``)."""
+
+    def __init__(self, out_file: str, dtype=np.int32):
+        self._bin = open(out_file, "wb")
+        self._dtype = np.dtype(dtype)
+        assert self._dtype in _DTYPE_TO_CODE, f"unsupported dtype {dtype}"
+        self._sizes = []
+        self._doc_idx = [0]
+
+    def add_item(self, arr) -> None:
+        arr = np.asarray(arr, self._dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def end_document(self) -> None:
+        self._doc_idx.append(len(self._sizes))
+
+    def finalize(self, index_file: str) -> None:
+        self._bin.close()
+        if len(self._doc_idx) == 1:  # no explicit documents: one per item
+            self._doc_idx = list(range(len(self._sizes) + 1))
+        sizes = np.asarray(self._sizes, np.int32)
+        itemsize = self._dtype.itemsize
+        pointers = np.zeros(len(sizes), np.int64)
+        np.cumsum(sizes[:-1] * itemsize, out=pointers[1:])
+        with open(index_file, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", _VERSION))
+            f.write(struct.pack("<B", _DTYPE_TO_CODE[self._dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(self._doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self._doc_idx, np.int64).tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader (reference ``MMapIndexedDataset``): ``ds[i]`` views
+    sample ``i`` straight out of the mapped ``.bin``."""
+
+    def __init__(self, path_prefix: str, skip_warmup: bool = True):
+        idx_path = index_file_path(path_prefix)
+        with open(idx_path, "rb") as f:
+            assert f.read(9) == _MAGIC, f"{idx_path}: bad magic (not an MMIDIDX index)"
+            (version, ) = struct.unpack("<Q", f.read(8))
+            assert version == _VERSION, f"unsupported index version {version}"
+            (code, ) = struct.unpack("<B", f.read(1))
+            self._dtype = np.dtype(_CODE_TO_DTYPE[code])
+            (n, ) = struct.unpack("<Q", f.read(8))
+            (docs, ) = struct.unpack("<Q", f.read(8))
+            offset = f.tell()
+        idx_map = np.memmap(idx_path, mode="r", offset=offset)
+        self.sizes = idx_map[:n * 4].view(np.int32)
+        self._pointers = idx_map[n * 4:n * 4 + n * 8].view(np.int64)
+        self.doc_idx = idx_map[n * 4 + n * 8:n * 4 + n * 8 + docs * 8].view(np.int64)
+        self._data = np.memmap(data_file_path(path_prefix), mode="r")
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        ptr, size = int(self._pointers[i]), int(self.sizes[i])
+        return self._data[ptr:ptr + size * self._dtype.itemsize].view(self._dtype)
+
+    def get(self, idx: int, offset: int = 0, length: Optional[int] = None) -> np.ndarray:
+        full = self[idx]
+        return full[offset:offset + length] if length is not None else full[offset:]
+
+    @property
+    def supports_prefetch(self) -> bool:
+        return False  # mmap: the OS page cache is the prefetcher
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return (os.path.exists(index_file_path(path_prefix))
+                and os.path.exists(data_file_path(path_prefix)))

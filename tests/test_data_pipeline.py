@@ -282,3 +282,66 @@ def test_pld_eager_path_and_pp_rejection(eight_devices):
                                  config={**base, "train_batch_size": 8,
                                          "tpu": {"mesh": {"data": 4, "pipe": 2}}})
     groups.reset()
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    """Megatron .bin/.idx container (reference data_sampling/indexed_dataset.py):
+    build -> mmap read, zero-copy views, documents, partial get."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling import (MMapIndexedDataset,
+                                                                   MMapIndexedDatasetBuilder)
+
+    prefix = str(tmp_path / "corpus")
+    b = MMapIndexedDatasetBuilder(prefix + ".bin", dtype=np.int32)
+    samples = [np.arange(5, dtype=np.int32), np.asarray([7, 8], np.int32),
+               np.arange(100, 103, dtype=np.int32)]
+    for s in samples[:2]:
+        b.add_item(s)
+    b.end_document()
+    b.add_item(samples[2])
+    b.end_document()
+    b.finalize(prefix + ".idx")
+
+    assert MMapIndexedDataset.exists(prefix)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 3
+    for got, want in zip([ds[i] for i in range(3)], samples):
+        np.testing.assert_array_equal(np.asarray(got), want)
+    np.testing.assert_array_equal(np.asarray(ds.get(0, offset=1, length=3)), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(ds.doc_idx), [0, 2, 3])
+    np.testing.assert_array_equal(np.asarray(ds.sizes), [5, 2, 3])
+
+
+def test_data_analyzer_map_reduce_feeds_sampler(tmp_path):
+    """DataAnalyzer (reference data_sampling/data_analyzer.py): 2-worker
+    map-reduce over a toy corpus -> sample_to_metric + metric_to_sample
+    artifacts; the loaded index drives the curriculum sampler so only
+    samples within the difficulty bound are drawn."""
+    from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling import (DataAnalyzer,
+                                                                   load_metric_to_sample,
+                                                                   load_sample_to_metric)
+
+    rng = np.random.default_rng(0)
+    corpus = [rng.integers(0, 50, size=n).astype(np.int32)
+              for n in rng.integers(4, 20, size=12)]
+    out = str(tmp_path / "metrics")
+    analyzer = DataAnalyzer(corpus, ["seqlen"], [lambda s: len(s)], out, num_workers=2)
+    analyzer.run_map_reduce()
+
+    seqlen = load_sample_to_metric(out, "seqlen")
+    np.testing.assert_array_equal(seqlen, [len(s) for s in corpus])
+    m2s = load_metric_to_sample(out, "seqlen")
+    for v, ids in m2s.items():
+        assert all(len(corpus[i]) == v for i in ids)
+
+    sched = CurriculumScheduler({"curriculum_type": "seqlen", "min_difficulty": 8,
+                                 "max_difficulty": 64, "schedule_type": "fixed_linear",
+                                 "schedule_config": {"total_curriculum_step": 10,
+                                                     "difficulty_step": 1}})
+    sampler = DeepSpeedDataSampler(len(corpus), batch_size=2, difficulty_metric=seqlen,
+                                   curriculum_scheduler=sched, data_parallel_rank=0,
+                                   data_parallel_world_size=1)
+    batch = next(iter(sampler))
+    assert all(len(corpus[i]) <= 8 for i in batch), \
+        "sampler drew a sample above the current difficulty"
