@@ -154,8 +154,11 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         return "deepspeed_tpu.ops"
 
     def create_op_builder(self, class_name):
-        builder_cls = self.get_op_builder(class_name)
-        return builder_cls() if builder_cls is not None else None
+        # the registry holds ready singleton builders (there is nothing to
+        # JIT-compile per instance on TPU), so "create" returns the handle;
+        # a class (e.g. a user-registered builder type) is instantiated
+        builder = self.get_op_builder(class_name)
+        return builder() if isinstance(builder, type) else builder
 
     def get_op_builder(self, class_name):
         from deepspeed_tpu.ops import op_registry

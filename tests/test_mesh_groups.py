@@ -174,3 +174,17 @@ def test_get_all_ranks_from_group_multi_axis(eight_devices):
     assert dist.get_global_rank("model", 1) == model_group[1]
     assert dist.get_all_ranks_from_group(None) == list(range(dist.get_world_size()))
     groups.reset()
+
+
+def test_accelerator_create_op_builder():
+    """get_accelerator().create_op_builder resolves every registry name to a
+    working builder handle (was raising TypeError on the singleton entries)."""
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.ops import op_registry
+
+    acc = get_accelerator()
+    for name in op_registry:
+        b = acc.create_op_builder(name)
+        assert b is not None and b.is_compatible(), name
+        assert b.load() is not None
+    assert acc.create_op_builder("NoSuchBuilder") is None
