@@ -1,3 +1,7 @@
 from .auto_tp import AutoTP, ReplaceWithTensorSlicing
 from .replace_module import replace_transformer_layer, revert_transformer_layer
 from .policies import TransformerPolicy, LlamaPolicy, GPTPolicy, OPTPolicy, BertPolicy, POLICY_REGISTRY
+from . import fusedqkv_utils, layers, tp_shard
+from .layers import (embedding_layer, linear_allreduce, linear_layer, lm_head_linear_allreduce,
+                     normalize, opt_embedding, rms_normalize)
+from .tp_shard import get_num_kv_heads, get_shard_size, get_shard_size_list, set_num_kv_heads

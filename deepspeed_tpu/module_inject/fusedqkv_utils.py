@@ -1,0 +1,50 @@
+"""Fused-QKV TP resharding helpers (reference
+``module_inject/fusedqkv_utils.py``): a fused [H, (q+k+v)·d] projection must
+be split per-projection-then-per-head before column-sharding, or each rank
+gets a slice mixing q/k/v of the wrong heads.
+
+Numeric cores shared with ``runtime/state_dict_factory`` (the per-head
+interleave split/merge used for MP-degree checkpoint resharding).
+"""
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..runtime.state_dict_factory import merge_fused_qkv_per_head, split_fused_qkv_per_head
+
+
+def split_by_qkvlist_and_refuse(qkv_list: Sequence[np.ndarray], split_size: int,
+                                split_dim: int = 0, cat_dim: int = 0) -> List[np.ndarray]:
+    """Reference helper: split each of q/k/v into ``split_size`` chunks along
+    ``split_dim`` and re-fuse chunk-wise — shard i gets (q_i|k_i|v_i)."""
+    chunks = [np.array_split(np.asarray(t), split_size, axis=split_dim) for t in qkv_list]
+    return [np.concatenate([c[i] for c in chunks], axis=cat_dim) for i in range(split_size)]
+
+
+def require_tp_fused_qkvw(name: str, mp_size: int) -> bool:
+    """Whether a param name is a fused qkv weight needing the per-head split
+    (reference matches the family-specific fused names)."""
+    if mp_size <= 1:
+        return False
+    fused_names = ("qkv_proj", "query_key_value", "attn.c_attn", "W_pack", "c_attn")
+    return any(f in name for f in fused_names)
+
+
+def prepare_tp_fused_qkvw(module_str: str, src: np.ndarray, mp_size: int, gpu_index: int,
+                          num_heads: int = None) -> np.ndarray:
+    """Rank ``gpu_index``'s slice of a fused qkv weight (reference dispatches
+    per model family; the per-head interleave split covers the glu-style and
+    megatron layouts this framework's families use)."""
+    src = np.asarray(src)
+    if num_heads is None:
+        from .tp_shard import get_num_kv_heads
+
+        num_heads = get_num_kv_heads() or mp_size
+    shards = split_fused_qkv_per_head(src, mp_size, num_heads)
+    return shards[gpu_index]
+
+
+def refuse_tp_fused_qkvw(shards: Sequence[np.ndarray]) -> np.ndarray:
+    """Inverse of :func:`prepare_tp_fused_qkvw` (merge all ranks' slices)."""
+    return merge_fused_qkv_per_head(list(shards))

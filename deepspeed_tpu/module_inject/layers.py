@@ -1,0 +1,83 @@
+"""TP runtime layers (reference ``module_inject/layers.py``:
+``LinearAllreduce``, ``LinearLayer``, ``EmbeddingLayer``, ``Normalize``,
+``RMSNormalize`` — the nn.Modules AutoTP swaps in).
+
+On TPU the swap is a sharding annotation, not module surgery, so these are
+FUNCTIONS with the reference's names and math, usable two ways: under GSPMD
+(plain jnp — XLA inserts the collective the sharding demands) or inside
+``shard_map`` with ``group=`` naming the model axis (explicit ``psum``,
+the literal LinearAllreduce contract).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import MODEL_AXIS
+
+
+def _in_shard_map(group: Optional[str]) -> bool:
+    if group is None:
+        return False
+    try:
+        jax.lax.axis_index(group)  # raises outside a binding context
+        return True
+    except Exception:
+        return False
+
+
+def linear_allreduce(x, weight, bias=None, group: Optional[str] = MODEL_AXIS):
+    """Row-parallel linear (reference ``LinearAllreduce:16``): each rank
+    holds a contraction-dim shard; partial products psum over the model
+    axis, bias added AFTER the reduce (once)."""
+    out = jnp.einsum("...i,io->...o", x, weight.astype(x.dtype))
+    if _in_shard_map(group):
+        out = jax.lax.psum(out, group)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+def lm_head_linear_allreduce(x, weight, bias=None, group: Optional[str] = MODEL_AXIS):
+    """Reference ``LmHeadLinearAllreduce:33`` — the same row-parallel
+    contract applied to the unembedding."""
+    return linear_allreduce(x, weight, bias, group=group)
+
+
+def linear_layer(x, weight, bias=None):
+    """Column-parallel linear (reference ``LinearLayer:62``): no collective —
+    each rank computes its output-column shard."""
+    out = jnp.einsum("...i,io->...o", x, weight.astype(x.dtype))
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+def embedding_layer(ids, weight):
+    """Reference ``EmbeddingLayer:104``."""
+    return weight[ids]
+
+
+def opt_embedding(positions, weight, offset: int = 2):
+    """Reference ``OPTEmbedding:121`` — OPT's learned positions start at a
+    +2 offset."""
+    return weight[positions + offset]
+
+
+def normalize(x, scale, bias=None, eps: float = 1e-5):
+    """LayerNorm (reference ``Normalize:86``)."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rms_normalize(x, scale, eps: float = 1e-5):
+    """RMSNorm (reference ``RMSNormalize:145``)."""
+    x32 = x.astype(jnp.float32)
+    out = x32 * jax.lax.rsqrt(jnp.mean(x32 ** 2, axis=-1, keepdims=True) + eps)
+    return (out * scale.astype(jnp.float32)).astype(x.dtype)

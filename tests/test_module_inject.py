@@ -188,3 +188,76 @@ def test_quantize_then_shard_preserves_tp_placement():
         tp = np.asarray(jax.jit(lambda p, i: forward(model.config, p, i))(qparams, ids))
     np.testing.assert_allclose(ref, tp, rtol=2e-4, atol=2e-4)
     groups.reset()
+
+
+def test_tp_shard_and_fusedqkv_utils():
+    """module_inject tp_shard + fusedqkv_utils (reference files of the same
+    names): kv-head-aware uneven shard sizes; fused-qkv per-head split
+    round-trips."""
+    from deepspeed_tpu.module_inject.fusedqkv_utils import (prepare_tp_fused_qkvw,
+                                                            refuse_tp_fused_qkvw,
+                                                            require_tp_fused_qkvw,
+                                                            split_by_qkvlist_and_refuse)
+    from deepspeed_tpu.module_inject.tp_shard import (get_shard_size, get_shard_size_list,
+                                                      set_num_kv_heads)
+
+    set_num_kv_heads(None)
+    assert get_shard_size(64, 4) == 16
+    with pytest.raises(AssertionError):
+        get_shard_size(10, 4)
+    set_num_kv_heads(6)  # uneven over 4: first two ranks take 2 heads
+    assert get_shard_size_list(96, 4) == [32, 32, 16, 16]
+    set_num_kv_heads(None)
+
+    rng = np.random.default_rng(0)
+    H, nh, d = 16, 4, 4
+    fused = rng.normal(size=(H, 3 * nh * d)).astype(np.float32)
+    shards = [prepare_tp_fused_qkvw("qkv_proj", fused, 2, i, num_heads=nh) for i in range(2)]
+    np.testing.assert_array_equal(refuse_tp_fused_qkvw(shards), fused)
+    assert require_tp_fused_qkvw("h.0.attn.qkv_proj.weight", 2)
+    assert not require_tp_fused_qkvw("h.0.attn.q_proj.weight", 2)
+    assert not require_tp_fused_qkvw("qkv_proj", 1)
+
+    q, k, v = (rng.normal(size=(8, 4)).astype(np.float32) for _ in range(3))
+    refused = split_by_qkvlist_and_refuse([q, k, v], 2)
+    assert len(refused) == 2 and refused[0].shape == (12, 4)
+    np.testing.assert_array_equal(np.concatenate([refused[0][:4], refused[1][:4]]), q)
+
+
+def test_module_inject_layers_functional(eight_devices):
+    """layers.py (reference LinearAllreduce/LinearLayer/Normalize): the
+    row-parallel contract — shard_map psum of per-rank partial products —
+    matches the unsharded matmul."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.module_inject.layers import (linear_allreduce, linear_layer, normalize,
+                                                    rms_normalize)
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.parallel.mesh import MeshConfig
+
+    groups.reset()
+    mesh = groups.initialize_mesh(MeshConfig(data=2, model=4))
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 16)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(8,)), jnp.float32)
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(), P("model", None), P()), out_specs=P(),
+             check_vma=False)
+    def row_parallel(x_full, w_shard, b):
+        x_shard = jax.lax.dynamic_slice_in_dim(  # my contraction slice
+            x_full, jax.lax.axis_index("model") * 4, 4, axis=1)
+        return linear_allreduce(x_shard, w_shard, b, group="model")
+
+    np.testing.assert_allclose(np.asarray(row_parallel(x, w, b)), np.asarray(x @ w + b),
+                               rtol=2e-5, atol=2e-5)
+    # eager forms
+    np.testing.assert_allclose(np.asarray(linear_layer(x, w, b)), np.asarray(x @ w + b), rtol=1e-6)
+    n = normalize(x, jnp.ones(16), jnp.zeros(16))
+    np.testing.assert_allclose(float(jnp.mean(n)), 0.0, atol=1e-5)
+    r = rms_normalize(x, jnp.ones(16))
+    assert r.shape == x.shape
+    groups.reset()
