@@ -1,0 +1,26 @@
+"""Offline module quantization (reference
+``module_inject/module_quantize.py`` — ``quantize_transformer_layer``:
+walk a model's transformer layers and quantize their weight matrices for
+MoQ-style inference loading).
+
+TPU form over param trees: every block weight becomes a ``QuantizedWeight``
+(int8 + per-output-channel scales) that all forward paths read via
+``.astype`` — delegates to the single quantization core."""
+
+from typing import Any, Dict
+
+from ..inference.quantization import quantize_params_for_inference
+
+
+def quantize_transformer_layer(orig_layer_impl=None, model=None, params: Dict[str, Any] = None,
+                               megatron: bool = False, preln: bool = False, num_bits: int = 8):
+    """Quantize a model's transformer-layer weights (reference signature
+    kept; ``megatron``/``preln`` select layouts in the reference's module
+    walk — the param-tree walk here is layout-agnostic).
+
+    Returns (model, quantized_params) when ``params`` is given, else the
+    model unchanged (nothing to quantize without a tree)."""
+    model = model if model is not None else orig_layer_impl
+    if params is None:
+        return model
+    return model, quantize_params_for_inference(params, num_bits)
