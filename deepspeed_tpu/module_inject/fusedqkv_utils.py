@@ -1,17 +1,20 @@
 """Fused-QKV TP resharding helpers (reference
-``module_inject/fusedqkv_utils.py``): a fused [H, (q+k+v)·d] projection must
-be split per-projection-then-per-head before column-sharding, or each rank
-gets a slice mixing q/k/v of the wrong heads.
-
-Numeric cores shared with ``runtime/state_dict_factory`` (the per-head
-interleave split/merge used for MP-degree checkpoint resharding).
+``module_inject/fusedqkv_utils.py``): a fused ``[..., (q+k+v)·nh·d]``
+projection must be split per-projection-then-per-head on the FUSED (last)
+axis before column-sharding — a naive column slice hands each rank a block
+mixing q/k/v of the wrong heads. Uneven head counts (GQA kv heads not
+divisible by the TP degree) follow ``tp_shard``'s earlier-ranks-take-the-
+remainder assignment.
 """
 
 from typing import List, Sequence
 
 import numpy as np
 
-from ..runtime.state_dict_factory import merge_fused_qkv_per_head, split_fused_qkv_per_head
+
+def _head_counts(num_heads: int, mp_size: int) -> List[int]:
+    return [num_heads // mp_size + (1 if r < num_heads % mp_size else 0)
+            for r in range(mp_size)]
 
 
 def split_by_qkvlist_and_refuse(qkv_list: Sequence[np.ndarray], split_size: int,
@@ -31,20 +34,45 @@ def require_tp_fused_qkvw(name: str, mp_size: int) -> bool:
     return any(f in name for f in fused_names)
 
 
+def _fused_view(src: np.ndarray, num_heads: int):
+    fused = src.shape[-1]
+    assert fused % (3 * num_heads) == 0, \
+        f"fused qkv dim {fused} must be 3 * {num_heads} heads * head_dim"
+    d = fused // (3 * num_heads)
+    return src.reshape(*src.shape[:-1], 3, num_heads, d), d
+
+
 def prepare_tp_fused_qkvw(module_str: str, src: np.ndarray, mp_size: int, gpu_index: int,
                           num_heads: int = None) -> np.ndarray:
-    """Rank ``gpu_index``'s slice of a fused qkv weight (reference dispatches
-    per model family; the per-head interleave split covers the glu-style and
-    megatron layouts this framework's families use)."""
+    """Rank ``gpu_index``'s slice of a fused qkv weight ``[..., 3·nh·d]``:
+    the per-projection head block, NOT a naive column slice. Uneven
+    ``num_heads % mp_size`` assigns the remainder heads to the earliest
+    ranks (``tp_shard`` contract)."""
     src = np.asarray(src)
     if num_heads is None:
         from .tp_shard import get_num_kv_heads
 
         num_heads = get_num_kv_heads() or mp_size
-    shards = split_fused_qkv_per_head(src, mp_size, num_heads)
-    return shards[gpu_index]
+    view, d = _fused_view(src, num_heads)
+    counts = _head_counts(num_heads, mp_size)
+    start = sum(counts[:gpu_index])
+    mine = view[..., :, start:start + counts[gpu_index], :]
+    return mine.reshape(*src.shape[:-1], 3 * counts[gpu_index] * d)
 
 
-def refuse_tp_fused_qkvw(shards: Sequence[np.ndarray]) -> np.ndarray:
-    """Inverse of :func:`prepare_tp_fused_qkvw` (merge all ranks' slices)."""
-    return merge_fused_qkv_per_head(list(shards))
+def refuse_tp_fused_qkvw(shards: Sequence[np.ndarray], num_heads: int = None) -> np.ndarray:
+    """Inverse of :func:`prepare_tp_fused_qkvw` (merge all ranks' slices).
+    Per-shard head counts are recovered from the shard widths."""
+    shards = [np.asarray(s) for s in shards]
+    total = sum(s.shape[-1] for s in shards)
+    if num_heads is None:
+        from .tp_shard import get_num_kv_heads
+
+        num_heads = get_num_kv_heads() or len(shards)
+    d = total // (3 * num_heads)
+    views = []
+    for s in shards:
+        cnt = s.shape[-1] // (3 * d)
+        views.append(s.reshape(*s.shape[:-1], 3, cnt, d))
+    merged = np.concatenate(views, axis=-2)
+    return merged.reshape(*shards[0].shape[:-1], total)

@@ -84,13 +84,21 @@ class DataAnalyzer:
             merged = MMapIndexedDatasetBuilder(
                 os.path.join(mdir, f"{name}_sample_to_metric.bin"), dtype=np.int64)
             values: List[int] = []
+            accum = None
             for w in range(self.num_workers):
                 part = MMapIndexedDataset(os.path.join(mdir, f"worker{w}_sample_to_metric"))
                 for i in range(len(part)):
                     arr = np.asarray(part[i])
-                    merged.add_item(arr)
-                    if mtype == "single_value_per_sample":
+                    if mtype == "accumulate_value_over_samples":
+                        # worker partials SUM into one corpus-wide statistic
+                        # (the reference's accumulate reduce), never
+                        # concatenate as if they were per-sample rows
+                        accum = arr.astype(np.int64) if accum is None else accum + arr
+                    else:
+                        merged.add_item(arr)
                         values.append(int(arr[0]))
+            if mtype == "accumulate_value_over_samples":
+                merged.add_item(accum if accum is not None else np.zeros(1, np.int64))
             merged.finalize(os.path.join(mdir, f"{name}_sample_to_metric.idx"))
             if mtype == "single_value_per_sample":
                 buckets: Dict[int, List[int]] = defaultdict(list)
@@ -100,7 +108,10 @@ class DataAnalyzer:
                     w = csv.writer(f)
                     for v in sorted(buckets):
                         w.writerow([v] + buckets[v])
-            logger.info(f"DataAnalyzer reduce: metric '{name}' merged ({len(values)} samples)")
+                logger.info(f"DataAnalyzer reduce: metric '{name}' merged ({len(values)} samples)")
+            else:
+                logger.info(f"DataAnalyzer reduce: metric '{name}' accumulated over "
+                            f"{self.num_workers} workers")
 
     def run_map_reduce(self):
         for w in range(self.num_workers):

@@ -345,3 +345,19 @@ def test_data_analyzer_map_reduce_feeds_sampler(tmp_path):
     batch = next(iter(sampler))
     assert all(len(corpus[i]) <= 8 for i in batch), \
         "sampler drew a sample above the current difficulty"
+
+
+def test_data_analyzer_accumulate_metric_sums_workers(tmp_path):
+    """accumulate_value_over_samples: worker partials must SUM into one
+    corpus-wide statistic (review finding: they were concatenated)."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling import (DataAnalyzer,
+                                                                   MMapIndexedDataset)
+
+    corpus = [np.full(3, i, np.int64) for i in range(8)]
+    out = str(tmp_path / "acc")
+    analyzer = DataAnalyzer(corpus, ["vocab_sum"], [lambda s: np.asarray(s)], out,
+                            metric_types=["accumulate_value_over_samples"], num_workers=4)
+    analyzer.run_map_reduce()
+    ds = MMapIndexedDataset(str(tmp_path / "acc" / "vocab_sum" / "vocab_sum_sample_to_metric"))
+    assert len(ds) == 1, "accumulate reduce must yield ONE corpus-wide item"
+    np.testing.assert_array_equal(np.asarray(ds[0]), sum(np.asarray(s) for s in corpus))

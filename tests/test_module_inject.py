@@ -207,13 +207,26 @@ def test_tp_shard_and_fusedqkv_utils():
         get_shard_size(10, 4)
     set_num_kv_heads(6)  # uneven over 4: first two ranks take 2 heads
     assert get_shard_size_list(96, 4) == [32, 32, 16, 16]
+    # indivisible columns: the remainder lands on the LAST rank, sizes
+    # always sum to the total (review finding: columns were being orphaned)
+    set_num_kv_heads(3)
+    assert sum(get_shard_size_list(10, 2)) == 10
     set_num_kv_heads(None)
 
     rng = np.random.default_rng(0)
     H, nh, d = 16, 4, 4
     fused = rng.normal(size=(H, 3 * nh * d)).astype(np.float32)
     shards = [prepare_tp_fused_qkvw("qkv_proj", fused, 2, i, num_heads=nh) for i in range(2)]
-    np.testing.assert_array_equal(refuse_tp_fused_qkvw(shards), fused)
+    np.testing.assert_array_equal(refuse_tp_fused_qkvw(shards, num_heads=nh), fused)
+    # the split is on the FUSED (last) axis per projection per head — rank 0
+    # must hold q/k/v of heads {0,1}, i.e. columns [p*nh*d + 0 : p*nh*d + 2d]
+    view = fused.reshape(H, 3, nh, d)
+    np.testing.assert_array_equal(shards[0], view[:, :, :2, :].reshape(H, 3 * 2 * d))
+    assert shards[0].shape == (H, 3 * 2 * d)  # full H rows, half the heads
+    # uneven GQA heads over the TP degree: 6 heads over 4 ranks -> 2/2/1/1
+    shards6 = [prepare_tp_fused_qkvw("qkv_proj", rng.normal(size=(8, 3 * 6 * 4)).astype(np.float32),
+                                     4, i, num_heads=6) for i in range(4)]
+    assert [s.shape[-1] // (3 * 4) for s in shards6] == [2, 2, 1, 1]
     assert require_tp_fused_qkvw("h.0.attn.qkv_proj.weight", 2)
     assert not require_tp_fused_qkvw("h.0.attn.q_proj.weight", 2)
     assert not require_tp_fused_qkvw("qkv_proj", 1)
