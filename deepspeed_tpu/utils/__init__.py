@@ -6,3 +6,7 @@ from .logging import logger, log_dist, warning_once
 from .tensor_fragment import (safe_get_full_fp32_param, safe_get_full_optimizer_state,
                               safe_get_local_fp32_param, safe_get_local_optimizer_state,
                               safe_set_full_fp32_param, safe_set_full_optimizer_state)
+from . import exceptions, groups, init_on_device, nvtx, types
+from .init_on_device import OnDevice
+from .nvtx import instrument_w_nvtx
+from .types import ActivationFuncType, NormType

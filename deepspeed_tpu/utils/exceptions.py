@@ -1,0 +1,5 @@
+"""Exception types (reference ``utils/exceptions.py``)."""
+
+
+class DeprecatedException(Exception):
+    pass

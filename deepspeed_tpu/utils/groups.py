@@ -1,0 +1,11 @@
+"""Reference import-path alias: ``deepspeed.utils.groups`` is where the
+reference keeps the process-group registry; the TPU-native registry (mesh
+axes) lives in ``parallel.groups`` and is re-exported here under the
+reference path."""
+
+from ..parallel.groups import *  # noqa: F401,F403
+from ..parallel import groups as _impl
+
+
+def __getattr__(name):  # anything not starred through (underscore helpers)
+    return getattr(_impl, name)

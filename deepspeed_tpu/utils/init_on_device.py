@@ -1,0 +1,50 @@
+"""OnDevice init context (reference ``utils/init_on_device.py`` —
+``with OnDevice(dtype=..., device='meta')`` builds models without
+materializing weights; with a real device, directly there).
+
+JAX mapping: 'meta' is ``jax.eval_shape`` (abstract arrays — nothing
+materializes; the engine's sharded ``_init_state`` with out_shardings is the
+production form of this, never building an unsharded tree); a real device
+is ``jax.default_device``. ``OnDevice.init(fn, *args)`` runs an init
+function under the context's placement.
+"""
+
+from typing import Optional
+
+import jax
+
+
+class OnDevice:
+
+    _active_dtype = None
+
+    def __init__(self, dtype=None, device: Optional[str] = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._ctx = None
+
+    def __enter__(self):
+        if self.enabled and self.device not in (None, "meta"):
+            dev = jax.devices(self.device)[0] if isinstance(self.device, str) else self.device
+            self._ctx = jax.default_device(dev)
+            self._ctx.__enter__()
+        OnDevice._active_dtype = self.dtype
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            self._ctx.__exit__(*exc)
+            self._ctx = None
+        OnDevice._active_dtype = None
+        return False
+
+    def init(self, init_fn, *args, **kwargs):
+        """Run ``init_fn`` under this context's placement: 'meta' returns the
+        ABSTRACT tree (jax.ShapeDtypeStruct leaves, zero bytes allocated);
+        a real device materializes there."""
+        if self.enabled and self.device == "meta":
+            # close over the args: python scalars (sizes, configs) stay
+            # concrete instead of becoming abstract tracers
+            return jax.eval_shape(lambda: init_fn(*args, **kwargs))
+        return init_fn(*args, **kwargs)

@@ -1,0 +1,36 @@
+"""Profiler range annotation (reference ``utils/nvtx.py`` —
+``instrument_w_nvtx`` wraps functions in NVTX ranges for nsight traces).
+
+TPU analog: ``jax.profiler.TraceAnnotation`` — the annotated span shows up
+named in the XLA/perfetto trace captured by ``jax.profiler``. Same decorator
+contract, same name."""
+
+import functools
+
+import jax
+
+
+def instrument_w_nvtx(func):
+    """Decorate ``func`` so its host-side span is named in profiler traces."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+def range_push(name: str):
+    """Manual range open (reference nvtx range_push); pair with range_pop."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    _stack.append(ann)
+
+
+def range_pop():
+    if _stack:
+        _stack.pop().__exit__(None, None, None)
+
+
+_stack = []

@@ -1,0 +1,18 @@
+"""Shared enums (reference ``utils/types.py``)."""
+
+from enum import IntEnum
+
+
+class ActivationFuncType(IntEnum):
+    UNKNOWN = 0
+    GELU = 1
+    ReLU = 2
+    GATED_GELU = 3
+    GATED_SILU = 4
+
+
+class NormType(IntEnum):
+    UNKNOWN = 0
+    LayerNorm = 1
+    GroupNorm = 2
+    RMSNorm = 3

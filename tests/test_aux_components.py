@@ -549,3 +549,33 @@ def test_weight_quantizer_and_moq():
     out2 = moq.quantize(params, target_bits=4)
     assert not np.allclose(np.asarray(out2["w"]), w)  # mixing now real
     assert moq.quantize(params, overflow=True) is params  # overflow skip
+
+
+def test_utils_parity_modules():
+    """utils parity: OnDevice meta init (zero bytes), nvtx annotation
+    decorator, types/exceptions/groups aliases."""
+    from deepspeed_tpu.utils import (ActivationFuncType, NormType, OnDevice,
+                                     instrument_w_nvtx)
+    from deepspeed_tpu.utils import groups as ugroups
+
+    def init_fn(n):
+        return {"w": jnp.zeros((n, n)), "b": jnp.zeros((n,))}
+
+    with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+        abstract = ctx.init(init_fn, 512)
+    assert isinstance(abstract["w"], jax.ShapeDtypeStruct)
+    assert abstract["w"].shape == (512, 512)
+    with OnDevice(device=None) as ctx:  # no placement: materialize
+        real = ctx.init(init_fn, 4)
+    assert not isinstance(real["w"], jax.ShapeDtypeStruct)
+
+    calls = []
+
+    @instrument_w_nvtx
+    def traced(x):
+        calls.append(x)
+        return x + 1
+
+    assert traced(1) == 2 and calls == [1]
+    assert ActivationFuncType.GATED_SILU == 4 and NormType.RMSNorm == 3
+    assert callable(ugroups.get_data_parallel_group)
