@@ -5,6 +5,8 @@ SSH_LAUNCHER = "ssh"
 OPENMPI_LAUNCHER = "openmpi"
 SLURM_LAUNCHER = "slurm"
 MPICH_LAUNCHER = "mpich"
+IMPI_LAUNCHER = "impi"
+MVAPICH_LAUNCHER = "mvapich"
 
 DEFAULT_MASTER_PORT = 29500
 DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed default service port

@@ -191,3 +191,59 @@ class MPICHRunner(MultiNodeRunner):
                f"--master_port={self.master_port}",
                "--", self.user_script, *self.user_arguments]
         return [cmd]
+
+
+class IMPIRunner(MultiNodeRunner):
+    """Intel MPI fan-out (reference IMPIRunner:233): hydra mpirun with one
+    process per host; node_rank resolves from $PMI_RANK inside launch.py
+    (Intel MPI's hydra exports the PMI env like MPICH)."""
+
+    def backend_exists(self):
+        import shutil
+
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, active_resources):
+        extra = shlex.split(getattr(self.args, "launcher_args", "") or "")
+        hosts = ",".join(active_resources)
+        cmd = ["mpirun", "-n", str(len(active_resources)), "-hosts", hosts,
+               "-ppn", "1", "-genvall", *extra,
+               sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+               f"--world_info={self.world_info_b64}",
+               "--node_rank=-1", "--rank_env=PMI_RANK",
+               f"--master_addr={self.master_addr}",
+               f"--master_port={self.master_port}",
+               "--", self.user_script, *self.user_arguments]
+        return [cmd]
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """MVAPICH2 fan-out (reference MVAPICHRunner:366): mpirun_rsh with a
+    hostfile; the reference's CUDA/IB tuning exports become TPU-relevant
+    defaults only (CMA off for containerized hosts, DL support on)."""
+
+    EXPORTS = {"MV2_SMP_USE_CMA": "0", "MV2_DEBUG_SHOW_BACKTRACE": "1",
+               "MV2_SUPPORT_DL": "1", "MV2_ENABLE_AFFINITY": "0"}
+
+    def backend_exists(self):
+        import shutil
+
+        return shutil.which("mpirun_rsh") is not None
+
+    def get_cmd(self, active_resources):
+        import tempfile
+
+        extra = shlex.split(getattr(self.args, "launcher_args", "") or "")
+        hostfile = tempfile.NamedTemporaryFile(mode="w", suffix=".mvapich_hosts", delete=False)
+        hostfile.write("\n".join(active_resources) + "\n")
+        hostfile.close()
+        env_args = [f"{k}={v}" for k, v in self.EXPORTS.items()]
+        cmd = ["mpirun_rsh", "-np", str(len(active_resources)),
+               "-hostfile", hostfile.name, *extra, *env_args,
+               sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+               f"--world_info={self.world_info_b64}",
+               "--node_rank=-1", "--rank_env=MV2_COMM_WORLD_RANK",
+               f"--master_addr={self.master_addr}",
+               f"--master_port={self.master_port}",
+               "--", self.user_script, *self.user_arguments]
+        return [cmd]

@@ -24,8 +24,9 @@ import shlex
 import subprocess
 import sys
 
-from .constants import (DEFAULT_COORDINATOR_PORT, ENV_WORLD_INFO, MPICH_LAUNCHER, OPENMPI_LAUNCHER,
-                        PDSH_LAUNCHER, SLURM_LAUNCHER, SSH_LAUNCHER)
+from .constants import (DEFAULT_COORDINATOR_PORT, ENV_WORLD_INFO, IMPI_LAUNCHER, MPICH_LAUNCHER,
+                        MVAPICH_LAUNCHER, OPENMPI_LAUNCHER, PDSH_LAUNCHER, SLURM_LAUNCHER,
+                        SSH_LAUNCHER)
 from ..utils.logging import logger
 
 
@@ -43,7 +44,7 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=DEFAULT_COORDINATOR_PORT)
     parser.add_argument("--launcher", type=str, default=SSH_LAUNCHER,
                         choices=[SSH_LAUNCHER, PDSH_LAUNCHER, OPENMPI_LAUNCHER, SLURM_LAUNCHER,
-                                 MPICH_LAUNCHER])
+                                 MPICH_LAUNCHER, IMPI_LAUNCHER, MVAPICH_LAUNCHER])
     parser.add_argument("--slurm_comment", type=str, default="",
                         help="--comment passed to srun (slurm launcher only)")
     parser.add_argument("--launcher_args", type=str, default="")
@@ -173,12 +174,13 @@ def main(args=None):
         result = subprocess.run(cmd)
         sys.exit(result.returncode)
 
-    from .multinode_runner import (MPICHRunner, OpenMPIRunner, PDSHRunner, SlurmRunner,
-                                   SSHRunner)
+    from .multinode_runner import (IMPIRunner, MPICHRunner, MVAPICHRunner, OpenMPIRunner,
+                                   PDSHRunner, SlurmRunner, SSHRunner)
 
     runner_cls = {SSH_LAUNCHER: SSHRunner, PDSH_LAUNCHER: PDSHRunner,
                   OPENMPI_LAUNCHER: OpenMPIRunner, SLURM_LAUNCHER: SlurmRunner,
-                  MPICH_LAUNCHER: MPICHRunner}[args.launcher]
+                  MPICH_LAUNCHER: MPICHRunner, IMPI_LAUNCHER: IMPIRunner,
+                  MVAPICH_LAUNCHER: MVAPICHRunner}[args.launcher]
     runner = runner_cls(args, world_info, master_addr, args.master_port)
     sys.exit(runner.launch(active))
 

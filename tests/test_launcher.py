@@ -269,3 +269,19 @@ def test_launcher_env_reaches_backend_distributed_init(monkeypatch):
     monkeypatch.setattr(jax.distributed, "initialize", fake_init)
     XlaBackend()
     assert calls == {"addr": "w0:9999", "n": 2, "pid": 1}
+
+
+def test_impi_and_mvapich_runner_cmds(tmp_path):
+    from deepspeed_tpu.launcher.multinode_runner import IMPIRunner, MVAPICHRunner
+
+    args = _args(["--launcher", "impi"])
+    wi = encode_world_info({"w0": [0], "w1": [0]})
+    (cmd, ) = IMPIRunner(args, wi, "w0", 1234).get_cmd(["w0", "w1"])
+    assert cmd[:3] == ["mpirun", "-n", "2"] and "-genvall" in cmd
+    assert "--rank_env=PMI_RANK" in cmd and "train.py" in cmd
+
+    (cmd, ) = MVAPICHRunner(_args(["--launcher", "mvapich"]), wi, "w0", 1234).get_cmd(["w0", "w1"])
+    assert cmd[0] == "mpirun_rsh" and "-hostfile" in cmd
+    hostfile = cmd[cmd.index("-hostfile") + 1]
+    assert open(hostfile).read().split() == ["w0", "w1"]
+    assert "MV2_SUPPORT_DL=1" in cmd and "--rank_env=MV2_COMM_WORLD_RANK" in cmd
