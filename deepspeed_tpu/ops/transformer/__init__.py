@@ -1,0 +1,4 @@
+"""Public transformer-kernel layer API (reference
+``deepspeed/ops/transformer/``)."""
+
+from .transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer  # noqa: F401

@@ -1,0 +1,165 @@
+"""BERT-style fused transformer layer — the reference's oldest public
+kernel API (``deepspeed/ops/transformer/transformer.py``:
+``DeepSpeedTransformerConfig:34``, ``DeepSpeedTransformerLayer:296`` backed
+by ~12.8k LoC of CUDA in ``csrc/transformer/``).
+
+TPU form: the layer is a pure ``apply(params, hidden, mask)`` whose
+attention routes through the Pallas flash kernel (the fused path) and whose
+elementwise chain XLA fuses — the functional face of what the CUDA kernel
+hand-fused. Pre-LN and Post-LN orderings, gelu MLP, bidirectional
+(non-causal) attention with an additive mask, matching the BERT contract.
+"""
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class DeepSpeedTransformerConfig:
+    """Reference config fields (``transformer.py:34``); CUDA-only knobs
+    (stochastic_mode, gelu/attn_dropout_checkpoint, huge_batch_optimization)
+    are accepted for compatibility and subsumed by XLA/remat."""
+    batch_size: int = 1
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None
+    heads: int = 12
+    attn_dropout_ratio: float = 0.0
+    hidden_dropout_ratio: float = 0.0
+    num_hidden_layers: int = 12
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1
+    seed: int = 0
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    return_tuple: bool = False
+    training: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class DeepSpeedTransformerLayer:
+    """Functional BERT block (reference ``DeepSpeedTransformerLayer:296``).
+
+    ``init(rng)`` → params; ``apply(params, hidden_states, attention_mask)``
+    → [B, S, H]. ``attention_mask``: additive mask broadcastable to
+    [B, 1, 1, S] (the HF extended-mask convention), or None.
+    """
+    layer_id = 0
+
+    def __init__(self, config: DeepSpeedTransformerConfig, initial_weights=None,
+                 initial_biases=None):
+        self.config = config
+        self.my_layer_id = DeepSpeedTransformerLayer.layer_id
+        DeepSpeedTransformerLayer.layer_id += 1
+        self._initial = (initial_weights, initial_biases)
+
+    def init(self, rng):
+        cfg = self.config
+        H, F = cfg.hidden_size, cfg.intermediate_size
+        k = jax.random.split(rng, 6)
+        std = cfg.initializer_range
+        if cfg.adjust_init_range:
+            output_std = std / math.sqrt(2.0 * cfg.num_hidden_layers)
+        else:
+            output_std = std
+
+        def dense(key, shape, s):
+            return jax.random.normal(key, shape, jnp.float32) * s
+
+        params = {
+            "qkv": {"kernel": dense(k[0], (H, 3 * H), std), "bias": jnp.zeros((3 * H,))},
+            "attn_out": {"kernel": dense(k[1], (H, H), output_std), "bias": jnp.zeros((H,))},
+            "attn_norm": {"scale": jnp.ones((H,)), "bias": jnp.zeros((H,))},
+            "inter": {"kernel": dense(k[2], (H, F), std), "bias": jnp.zeros((F,))},
+            "output": {"kernel": dense(k[3], (F, H), output_std), "bias": jnp.zeros((H,))},
+            "norm": {"scale": jnp.ones((H,)), "bias": jnp.zeros((H,))},
+        }
+        iw, ib = self._initial
+        if iw is not None:  # reference unit-test hook: torch-layout [out, in]
+            params["qkv"]["kernel"] = jnp.concatenate(
+                [jnp.asarray(w, jnp.float32).T for w in iw[0:3]], axis=1)
+            params["attn_out"]["kernel"] = jnp.asarray(iw[3], jnp.float32).T
+            params["attn_norm"]["scale"] = jnp.asarray(iw[4], jnp.float32)
+            params["inter"]["kernel"] = jnp.asarray(iw[5], jnp.float32).T
+            params["output"]["kernel"] = jnp.asarray(iw[6], jnp.float32).T
+            params["norm"]["scale"] = jnp.asarray(iw[7], jnp.float32)
+        if ib is not None:
+            params["qkv"]["bias"] = jnp.concatenate([jnp.asarray(b, jnp.float32) for b in ib[0:3]])
+            params["attn_out"]["bias"] = jnp.asarray(ib[3], jnp.float32)
+            params["attn_norm"]["bias"] = jnp.asarray(ib[4], jnp.float32)
+            params["inter"]["bias"] = jnp.asarray(ib[5], jnp.float32)
+            params["output"]["bias"] = jnp.asarray(ib[6], jnp.float32)
+            params["norm"]["bias"] = jnp.asarray(ib[7], jnp.float32)
+        return params
+
+    # -- forward -----------------------------------------------------------
+    def _norm(self, x, p):
+        eps = self.config.layer_norm_eps
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]).astype(x.dtype)
+
+    def _attention(self, h, mask):
+        cfg = self.config
+        B, S, H = h.shape
+        nh = cfg.heads
+        d = H // nh
+        qkv = jnp.einsum("bsh,hd->bsd", h, self._p["qkv"]["kernel"].astype(h.dtype)) \
+            + self._p["qkv"]["bias"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if mask is None and S % 128 == 0 and d >= 32:
+            from ..pallas.flash_attention import flash_attention
+
+            ctx = flash_attention(q.reshape(B, S, nh, d), k.reshape(B, S, nh, d),
+                                  v.reshape(B, S, nh, d), causal=False)
+            ctx = ctx.reshape(B, S, H)
+        else:
+            qh = q.reshape(B, S, nh, d).transpose(0, 2, 1, 3).astype(jnp.float32)
+            kh = k.reshape(B, S, nh, d).transpose(0, 2, 1, 3).astype(jnp.float32)
+            vh = v.reshape(B, S, nh, d).transpose(0, 2, 1, 3).astype(jnp.float32)
+            s = jnp.einsum("bnqd,bnkd->bnqk", qh, kh) / math.sqrt(d)
+            if mask is not None:
+                s = s + jnp.asarray(mask, jnp.float32)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bnqk,bnkd->bnqd", p, vh).transpose(0, 2, 1, 3).reshape(B, S, H)
+            ctx = ctx.astype(h.dtype)
+        out = jnp.einsum("bsh,hd->bsd", ctx, self._p["attn_out"]["kernel"].astype(h.dtype)) \
+            + self._p["attn_out"]["bias"].astype(h.dtype)
+        return out
+
+    def apply(self, params, hidden_states, attention_mask=None):
+        cfg = self.config
+        self._p = params
+        x = hidden_states.astype(jnp.bfloat16 if cfg.fp16 else hidden_states.dtype)
+        if cfg.pre_layer_norm:
+            attn = self._attention(self._norm(x, params["attn_norm"]), attention_mask)
+            x = x + attn
+            h = self._norm(x, params["norm"])
+            inter = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", h, params["inter"]["kernel"].astype(x.dtype))
+                                + params["inter"]["bias"].astype(x.dtype), approximate=False)
+            out = jnp.einsum("bsf,fh->bsh", inter, params["output"]["kernel"].astype(x.dtype)) \
+                + params["output"]["bias"].astype(x.dtype)
+            return x + out
+        # post-LN (original BERT)
+        attn = self._attention(x, attention_mask)
+        x = self._norm(x + attn, params["attn_norm"])
+        inter = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", x, params["inter"]["kernel"].astype(x.dtype))
+                            + params["inter"]["bias"].astype(x.dtype), approximate=False)
+        out = jnp.einsum("bsf,fh->bsh", inter, params["output"]["kernel"].astype(x.dtype)) \
+            + params["output"]["bias"].astype(x.dtype)
+        return self._norm(x + out, params["norm"])
+
+    __call__ = apply
