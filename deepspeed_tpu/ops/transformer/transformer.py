@@ -112,13 +112,13 @@ class DeepSpeedTransformerLayer:
         var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
         return ((x32 - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]).astype(x.dtype)
 
-    def _attention(self, h, mask):
+    def _attention(self, params, h, mask):
         cfg = self.config
         B, S, H = h.shape
         nh = cfg.heads
         d = H // nh
-        qkv = jnp.einsum("bsh,hd->bsd", h, self._p["qkv"]["kernel"].astype(h.dtype)) \
-            + self._p["qkv"]["bias"].astype(h.dtype)
+        qkv = jnp.einsum("bsh,hd->bsd", h, params["qkv"]["kernel"].astype(h.dtype)) \
+            + params["qkv"]["bias"].astype(h.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         if mask is None and S % 128 == 0 and d >= 32:
             from ..pallas.flash_attention import flash_attention
@@ -136,16 +136,15 @@ class DeepSpeedTransformerLayer:
             p = jax.nn.softmax(s, axis=-1)
             ctx = jnp.einsum("bnqk,bnkd->bnqd", p, vh).transpose(0, 2, 1, 3).reshape(B, S, H)
             ctx = ctx.astype(h.dtype)
-        out = jnp.einsum("bsh,hd->bsd", ctx, self._p["attn_out"]["kernel"].astype(h.dtype)) \
-            + self._p["attn_out"]["bias"].astype(h.dtype)
+        out = jnp.einsum("bsh,hd->bsd", ctx, params["attn_out"]["kernel"].astype(h.dtype)) \
+            + params["attn_out"]["bias"].astype(h.dtype)
         return out
 
     def apply(self, params, hidden_states, attention_mask=None):
         cfg = self.config
-        self._p = params
         x = hidden_states.astype(jnp.bfloat16 if cfg.fp16 else hidden_states.dtype)
         if cfg.pre_layer_norm:
-            attn = self._attention(self._norm(x, params["attn_norm"]), attention_mask)
+            attn = self._attention(params, self._norm(x, params["attn_norm"]), attention_mask)
             x = x + attn
             h = self._norm(x, params["norm"])
             inter = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", h, params["inter"]["kernel"].astype(x.dtype))
@@ -154,7 +153,7 @@ class DeepSpeedTransformerLayer:
                 + params["output"]["bias"].astype(x.dtype)
             return x + out
         # post-LN (original BERT)
-        attn = self._attention(x, attention_mask)
+        attn = self._attention(params, x, attention_mask)
         x = self._norm(x + attn, params["attn_norm"])
         inter = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", x, params["inter"]["kernel"].astype(x.dtype))
                             + params["inter"]["bias"].astype(x.dtype), approximate=False)
