@@ -234,12 +234,14 @@ class MVAPICHRunner(MultiNodeRunner):
         import tempfile
 
         extra = shlex.split(getattr(self.args, "launcher_args", "") or "")
-        hostfile = tempfile.NamedTemporaryFile(mode="w", suffix=".mvapich_hosts", delete=False)
-        hostfile.write("\n".join(active_resources) + "\n")
-        hostfile.close()
+        # one fixed per-process path, overwritten per call — repeated
+        # launches/tests cannot accumulate orphaned tmp files
+        host_path = os.path.join(tempfile.gettempdir(), f"dstpu_mvapich_hosts_{os.getpid()}")
+        with open(host_path, "w") as hostfile:
+            hostfile.write("\n".join(active_resources) + "\n")
         env_args = [f"{k}={v}" for k, v in self.EXPORTS.items()]
         cmd = ["mpirun_rsh", "-np", str(len(active_resources)),
-               "-hostfile", hostfile.name, *extra, *env_args,
+               "-hostfile", host_path, *extra, *env_args,
                sys.executable, "-m", "deepspeed_tpu.launcher.launch",
                f"--world_info={self.world_info_b64}",
                "--node_rank=-1", "--rank_env=MV2_COMM_WORLD_RANK",

@@ -112,7 +112,7 @@ class DeepSpeedTransformerLayer:
         var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
         return ((x32 - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]).astype(x.dtype)
 
-    def _attention(self, params, h, mask):
+    def _attention(self, params, h, mask, attn_rng=None):
         cfg = self.config
         B, S, H = h.shape
         nh = cfg.heads
@@ -120,7 +120,8 @@ class DeepSpeedTransformerLayer:
         qkv = jnp.einsum("bsh,hd->bsd", h, params["qkv"]["kernel"].astype(h.dtype)) \
             + params["qkv"]["bias"].astype(h.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        if mask is None and S % 128 == 0 and d >= 32:
+        if mask is None and attn_rng is None:
+            # flash_attention owns its shape gate and falls back internally
             from ..pallas.flash_attention import flash_attention
 
             ctx = flash_attention(q.reshape(B, S, nh, d), k.reshape(B, S, nh, d),
@@ -134,31 +135,64 @@ class DeepSpeedTransformerLayer:
             if mask is not None:
                 s = s + jnp.asarray(mask, jnp.float32)
             p = jax.nn.softmax(s, axis=-1)
+            if attn_rng is not None:  # attention-prob dropout (reference kernel)
+                keep = 1.0 - cfg.attn_dropout_ratio
+                p = p * jax.random.bernoulli(attn_rng, keep, p.shape) / keep
             ctx = jnp.einsum("bnqk,bnkd->bnqd", p, vh).transpose(0, 2, 1, 3).reshape(B, S, H)
             ctx = ctx.astype(h.dtype)
         out = jnp.einsum("bsh,hd->bsd", ctx, params["attn_out"]["kernel"].astype(h.dtype)) \
             + params["attn_out"]["bias"].astype(h.dtype)
         return out
 
-    def apply(self, params, hidden_states, attention_mask=None):
+    def _dropout_rngs(self, rng, training):
+        """Resolve the three dropout streams; LOUD when dropout is configured
+        for training but no rng was passed (a silent no-dropout would change
+        training dynamics vs the reference without warning)."""
         cfg = self.config
+        train = cfg.training if training is None else training
+        want_attn = train and cfg.attn_dropout_ratio > 0.0
+        want_hidden = train and cfg.hidden_dropout_ratio > 0.0
+        if (want_attn or want_hidden) and rng is None:
+            raise ValueError("dropout is configured (attn/hidden ratio > 0, training=True) "
+                             "but apply() received no rng — pass rng=jax.random.PRNGKey(...) "
+                             "or set training=False")
+        if not (want_attn or want_hidden):
+            return None, None, None
+        k = jax.random.split(rng, 3)
+        return (k[0] if want_attn else None,
+                k[1] if want_hidden else None,
+                k[2] if want_hidden else None)
+
+    def _hidden_dropout(self, x, rng):
+        if rng is None:
+            return x
+        keep = 1.0 - self.config.hidden_dropout_ratio
+        return x * jax.random.bernoulli(rng, keep, x.shape).astype(x.dtype) / keep
+
+    def _maybe_tuple(self, out):
+        return (out, ) if self.config.return_tuple else out
+
+    def apply(self, params, hidden_states, attention_mask=None, rng=None, training=None):
+        cfg = self.config
+        attn_rng, h1_rng, h2_rng = self._dropout_rngs(rng, training)
         x = hidden_states.astype(jnp.bfloat16 if cfg.fp16 else hidden_states.dtype)
         if cfg.pre_layer_norm:
-            attn = self._attention(params, self._norm(x, params["attn_norm"]), attention_mask)
-            x = x + attn
+            attn = self._attention(params, self._norm(x, params["attn_norm"]), attention_mask,
+                                   attn_rng)
+            x = x + self._hidden_dropout(attn, h1_rng)
             h = self._norm(x, params["norm"])
             inter = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", h, params["inter"]["kernel"].astype(x.dtype))
                                 + params["inter"]["bias"].astype(x.dtype), approximate=False)
             out = jnp.einsum("bsf,fh->bsh", inter, params["output"]["kernel"].astype(x.dtype)) \
                 + params["output"]["bias"].astype(x.dtype)
-            return x + out
+            return self._maybe_tuple(x + self._hidden_dropout(out, h2_rng))
         # post-LN (original BERT)
-        attn = self._attention(params, x, attention_mask)
-        x = self._norm(x + attn, params["attn_norm"])
+        attn = self._attention(params, x, attention_mask, attn_rng)
+        x = self._norm(x + self._hidden_dropout(attn, h1_rng), params["attn_norm"])
         inter = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", x, params["inter"]["kernel"].astype(x.dtype))
                             + params["inter"]["bias"].astype(x.dtype), approximate=False)
         out = jnp.einsum("bsf,fh->bsh", inter, params["output"]["kernel"].astype(x.dtype)) \
             + params["output"]["bias"].astype(x.dtype)
-        return self._norm(x + out, params["norm"])
+        return self._maybe_tuple(self._norm(x + self._hidden_dropout(out, h2_rng), params["norm"]))
 
     __call__ = apply

@@ -23,12 +23,14 @@ class OnDevice:
         self.device = device
         self.enabled = enabled
         self._ctx = None
+        self._prev_dtype = None
 
     def __enter__(self):
         if self.enabled and self.device not in (None, "meta"):
             dev = jax.devices(self.device)[0] if isinstance(self.device, str) else self.device
             self._ctx = jax.default_device(dev)
             self._ctx.__enter__()
+        self._prev_dtype = OnDevice._active_dtype  # nested contexts restore
         OnDevice._active_dtype = self.dtype
         return self
 
@@ -36,15 +38,30 @@ class OnDevice:
         if self._ctx is not None:
             self._ctx.__exit__(*exc)
             self._ctx = None
-        OnDevice._active_dtype = None
+        OnDevice._active_dtype = self._prev_dtype
         return False
+
+    def _cast(self, tree):
+        if self.dtype is None:
+            return tree
+        import jax.numpy as jnp
+
+        def leaf(x):
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return (jax.ShapeDtypeStruct(x.shape, self.dtype)
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x)
+            return x.astype(self.dtype) if jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating) else x
+
+        return jax.tree_util.tree_map(leaf, tree)
 
     def init(self, init_fn, *args, **kwargs):
         """Run ``init_fn`` under this context's placement: 'meta' returns the
         ABSTRACT tree (jax.ShapeDtypeStruct leaves, zero bytes allocated);
-        a real device materializes there."""
+        a real device materializes there. Floating leaves take the context's
+        ``dtype`` (the reference casts module params the same way)."""
         if self.enabled and self.device == "meta":
             # close over the args: python scalars (sizes, configs) stay
             # concrete instead of becoming abstract tracers
-            return jax.eval_shape(lambda: init_fn(*args, **kwargs))
-        return init_fn(*args, **kwargs)
+            return self._cast(jax.eval_shape(lambda: init_fn(*args, **kwargs)))
+        return self._cast(init_fn(*args, **kwargs))

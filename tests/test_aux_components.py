@@ -565,6 +565,8 @@ def test_utils_parity_modules():
         abstract = ctx.init(init_fn, 512)
     assert isinstance(abstract["w"], jax.ShapeDtypeStruct)
     assert abstract["w"].shape == (512, 512)
+    assert abstract["w"].dtype == jnp.bfloat16  # context dtype honored
+    assert OnDevice._active_dtype is None  # restored on exit
     with OnDevice(device=None) as ctx:  # no placement: materialize
         real = ctx.init(init_fn, 4)
     assert not isinstance(real["w"], jax.ShapeDtypeStruct)

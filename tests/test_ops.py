@@ -184,3 +184,26 @@ def test_deepspeed_transformer_layer_api():
 
     g = jax.grad(lambda p: float(0) + jnp.sum(layer.apply(p, x) ** 2))(params)
     assert all(np.isfinite(np.asarray(leaf)).all() for leaf in jax.tree_util.tree_leaves(g))
+
+    # dropout is REAL: configured ratios without an rng refuse loudly; with
+    # an rng the output is stochastic; eval mode is deterministic
+    dcfg = DeepSpeedTransformerConfig(batch_size=2, hidden_size=64, heads=4,
+                                      num_hidden_layers=2, hidden_dropout_ratio=0.5,
+                                      attn_dropout_ratio=0.1, training=True)
+    dlayer = DeepSpeedTransformerLayer(dcfg)
+    dp = dlayer.init(jax.random.PRNGKey(2))
+    with pytest.raises(ValueError, match="no rng"):
+        dlayer.apply(dp, x)
+    o1 = dlayer.apply(dp, x, rng=jax.random.PRNGKey(3))
+    o2 = dlayer.apply(dp, x, rng=jax.random.PRNGKey(4))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    e1 = dlayer.apply(dp, x, training=False)
+    e2 = dlayer.apply(dp, x, training=False)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+
+    # return_tuple honored
+    tcfg = DeepSpeedTransformerConfig(batch_size=2, hidden_size=64, heads=4,
+                                      num_hidden_layers=2, return_tuple=True)
+    tout = DeepSpeedTransformerLayer(tcfg).apply(
+        DeepSpeedTransformerLayer(tcfg).init(jax.random.PRNGKey(5)), x)
+    assert isinstance(tout, tuple) and tout[0].shape == x.shape
