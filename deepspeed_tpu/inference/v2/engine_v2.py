@@ -330,6 +330,28 @@ class InferenceEngineV2:
         """Finish a sequence and release its KV blocks (reference ``flush:228``)."""
         self.state_manager.flush_sequence(uid)
 
+    def serialize(self, save_path: str) -> None:
+        """Persist the engine's (possibly transformed — int8, etc.) params +
+        model/engine metadata (reference ``serialize:237`` saves the
+        flattened params + metadata per TP rank; tensorstore writes each
+        host's shards, so one call covers every rank here)."""
+        import dataclasses
+        import os
+        import pickle
+
+        from ...runtime.checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
+
+        eng = OrbaxCheckpointEngine()
+        eng.save({"module": self.params}, save_path)
+        mc = self.model_config
+        meta = {"model_config": dataclasses.asdict(mc) if dataclasses.is_dataclass(mc)
+                else dict(getattr(mc, "__dict__", {})),
+                "quantized": self._modules["linear"].name() == "int8_blockwise_linear",
+                "kv_block_size": self.config.kv_block_size}
+        with open(os.path.join(os.path.abspath(save_path), "engine_meta.pkl"), "wb") as f:
+            pickle.dump(meta, f)
+        log_dist(f"InferenceEngineV2 serialized to {save_path}", ranks=[0])
+
     @property
     def free_blocks(self) -> int:
         return self.state_manager.free_blocks
