@@ -6,3 +6,5 @@ from .engine_v2 import InferenceEngineV2
 from .engine_factory import build_engine, build_model_engine
 from .scheduling_utils import SchedulingError, SchedulingResult
 from .scheduler import DynamicSplitFuseScheduler
+from .inference_utils import (ActivationType, DtypeEnum, NormTypeEnum, ceil_div,
+                              elem_size, is_gated)
