@@ -149,7 +149,10 @@ class InferenceEngineV2:
             total = n + (seq.seen_tokens if seq is not None else 0)
             if total > self._max_context:
                 return SchedulingResult.KVCacheLimitExceeded
-            blocks_needed += (-(-total // bs) - (seq.cur_allocated_blocks if seq is not None else 0))
+            # clamp per-sequence demand at zero: a sequence holding excess
+            # blocks must not mask OTHER sequences' demand against the pool
+            blocks_needed += max(0, -(-total // bs)
+                                 - (seq.cur_allocated_blocks if seq is not None else 0))
         if blocks_needed > self.state_manager.free_blocks:
             return SchedulingResult.KVCacheLimitExceeded
         return SchedulingResult.Success
@@ -170,6 +173,11 @@ class InferenceEngineV2:
         or a benchmark on a high-latency relay) can pipeline several steps
         into the device queue."""
         batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
+        if any(t.size == 0 for t in batch_tokens):
+            # an empty chunk would alias the PREVIOUS row's last_idx in the
+            # packed batch and silently return the wrong sequence's logits
+            raise ValueError("put(): zero-length token chunk "
+                             f"(uids {[u for u, t in zip(batch_uids, batch_tokens) if t.size == 0]})")
         if do_checks:
             result = self.can_schedule(batch_uids, [t.size for t in batch_tokens])
             if result is not SchedulingResult.Success:
@@ -189,18 +197,12 @@ class InferenceEngineV2:
         kv = self.state_manager.kv_cache
         # ONE descriptor upload per forward (reference single pinned-buffer
         # upload; each separate array would be its own RPC on a tunnel)
-        if kv.quantized:
-            out, k_pool, v_pool, ks, vs = fn(self.params, jnp.asarray(rb.packed()),
-                                             kv.k_pool, kv.v_pool, kv.k_scale, kv.v_scale)
-            kv.update(k_pool, v_pool, ks, vs)
-        else:
-            out, k_pool, v_pool = fn(self.params, jnp.asarray(rb.packed()), kv.k_pool, kv.v_pool)
-            kv.update(k_pool, v_pool)
+        out, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
+        kv.update(*pools)
         for seq in descs:
             seq.post_forward()
-        if not block:
-            return out[:rb.n_seqs]
-        return np.asarray(out)[:rb.n_seqs]
+        out = out[:rb.n_seqs]  # slice ON DEVICE: the host fetch moves
+        return out if not block else np.asarray(out)  # n_seqs rows, not the padded bucket
 
     # ------------------------------------------------------------------
     def decode(self, batch_uids: List[int], first_tokens, n_steps: int, block: bool = True) -> np.ndarray:
@@ -220,6 +222,10 @@ class InferenceEngineV2:
         if len(set(uids)) != len(uids):
             # same corruption mode put()'s admission rejects: two rows of one
             # uid would write divergent KV at the same positions
+            raise SchedulingError(SchedulingResult.BatchSequenceLimitExceeded)
+        if S > self.batch.max_seqs:
+            # must reject BEFORE allocate/pre_forward: a mid-loop wrapper
+            # ValueError would strand in-flight state on every sequence
             raise SchedulingError(SchedulingResult.BatchSequenceLimitExceeded)
         first = [np.asarray(t, np.int32).reshape(-1) for t in first_tokens]
         assert all(t.size == 1 for t in first), "decode() takes exactly one next token per sequence"
@@ -254,70 +260,58 @@ class InferenceEngineV2:
 
         fn = self._get_compiled_decode(rb.token_ids.shape[0], n_steps)
         kv = self.state_manager.kv_cache
-        if kv.quantized:
-            toks, k_pool, v_pool, ks, vs = fn(self.params, jnp.asarray(rb.packed()),
-                                              jnp.asarray(rb.seq_start_len),
-                                              kv.k_pool, kv.v_pool, kv.k_scale, kv.v_scale)
-            kv.update(k_pool, v_pool, ks, vs)
-        else:
-            toks, k_pool, v_pool = fn(self.params, jnp.asarray(rb.packed()),
-                                      jnp.asarray(rb.seq_start_len), kv.k_pool, kv.v_pool)
-            kv.update(k_pool, v_pool)
+        # start positions already ride inside packed() (each decode row is
+        # one token at its position) — no separate seq_start_len upload
+        toks, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
+        kv.update(*pools)
         for seq in seqs:
             seq.post_forward()
-        if not block:
-            return toks[:S]
-        return np.asarray(toks)[:S]
+        toks = toks[:S]  # on-device slice before any host fetch
+        return toks if not block else np.asarray(toks)
+
+    def _ragged_step(self, params, packed, pools, t_bucket, s_bucket):
+        """One ragged forward over the pool tuple (2 = bf16 pools, 4 = int8
+        pools + scales). The SINGLE builder both compiled paths share —
+        quant/non-quant variation lives in the tuple arity, not in four
+        hand-copied closures."""
+        from .ragged.ragged_wrapper import unpack_descriptors
+
+        token_ids, seq_idx, pos, valid, tables, last_idx = unpack_descriptors(
+            packed, t_bucket, s_bucket, self._max_blocks_per_seq)
+        scales = {"k_scale": pools[2], "v_scale": pools[3]} if len(pools) == 4 else {}
+        out = ragged_forward(self.model_config, self.config.kv_block_size, params,
+                             token_ids, seq_idx, pos, valid, tables, last_idx,
+                             pools[0], pools[1], use_pallas=self._use_pallas,
+                             modules=self._modules, **scales)
+        return out[0], tuple(out[1:])  # logits, new pool tuple
 
     def _get_compiled_decode(self, s_bucket: int, n_steps: int):
         key = ("decode", s_bucket, n_steps)
         if key not in self._compiled:
             from .ragged.ragged_wrapper import unpack_descriptors
 
-            cfg, bs, use_pallas = self.model_config, self.config.kv_block_size, self._use_pallas
-            max_blocks, modules = self._max_blocks_per_seq, self._modules
-            quant = self.state_manager.kv_cache.quantized
+            max_blocks = self._max_blocks_per_seq
+            step_fn = self._ragged_step
 
-            if quant:
-                def fwd(params, packed, pos0, k_pool, v_pool, k_scale, v_scale):
-                    token_ids, seq_idx, _pos, valid, tables, last_idx = unpack_descriptors(
-                        packed, s_bucket, s_bucket, max_blocks)
+            def fwd(params, packed, pools):
+                token_ids = unpack_descriptors(packed, s_bucket, s_bucket, max_blocks)[0]
 
-                    def step(carry, t):
-                        toks, kp, vp, ks, vs = carry
-                        pos = pos0 + t
-                        logits, kp, vp, ks, vs = ragged_forward(
-                            cfg, bs, params, toks, seq_idx, pos, valid, tables, last_idx,
-                            kp, vp, use_pallas=use_pallas, modules=modules,
-                            k_scale=ks, v_scale=vs)
-                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                        return (nxt, kp, vp, ks, vs), nxt
+                def step(carry, t):
+                    toks, pl = carry
+                    # feed the greedy tokens back into the packed descriptor
+                    # and advance positions in-scan from the packed starts
+                    # (packed layout: [T ids][T seq_idx][T pos]...)
+                    stepped = packed.at[0:s_bucket].set(toks) \
+                                    .at[2 * s_bucket:3 * s_bucket].add(t)
+                    logits, pl = step_fn(params, stepped, pl, s_bucket, s_bucket)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, pl), nxt
 
-                    (_, k_pool, v_pool, k_scale, v_scale), out = jax.lax.scan(
-                        step, (token_ids, k_pool, v_pool, k_scale, v_scale),
-                        jnp.arange(n_steps, dtype=jnp.int32))
-                    return out.T, k_pool, v_pool, k_scale, v_scale  # [S, n_steps]
+                (_, pools), out = jax.lax.scan(
+                    step, (token_ids, pools), jnp.arange(n_steps, dtype=jnp.int32))
+                return out.T, pools  # [S, n_steps]
 
-                self._compiled[key] = jax.jit(fwd, donate_argnums=(3, 4, 5, 6))
-            else:
-                def fwd(params, packed, pos0, k_pool, v_pool):
-                    token_ids, seq_idx, _pos, valid, tables, last_idx = unpack_descriptors(
-                        packed, s_bucket, s_bucket, max_blocks)
-
-                    def step(carry, t):
-                        toks, kp, vp = carry
-                        pos = pos0 + t
-                        logits, kp, vp = ragged_forward(cfg, bs, params, toks, seq_idx, pos, valid,
-                                                        tables, last_idx, kp, vp, use_pallas=use_pallas,
-                                                        modules=modules)
-                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                        return (nxt, kp, vp), nxt
-
-                    (_, k_pool, v_pool), out = jax.lax.scan(
-                        step, (token_ids, k_pool, v_pool), jnp.arange(n_steps, dtype=jnp.int32))
-                    return out.T, k_pool, v_pool  # [S, n_steps]
-
-                self._compiled[key] = jax.jit(fwd, donate_argnums=(3, 4))
+            self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
             log_dist(f"compiled multi-step decode bucket seqs={s_bucket} steps={n_steps}", ranks=[0])
         return self._compiled[key]
 
@@ -343,10 +337,14 @@ class InferenceEngineV2:
 
         eng = OrbaxCheckpointEngine()
         eng.save({"module": self.params}, save_path)
+        from ..quantization import QuantizedWeight
+
         mc = self.model_config
+        quantized = any(isinstance(x, QuantizedWeight) for x in jax.tree_util.tree_leaves(
+            self.params, is_leaf=lambda x: isinstance(x, QuantizedWeight)))
         meta = {"model_config": dataclasses.asdict(mc) if dataclasses.is_dataclass(mc)
                 else dict(getattr(mc, "__dict__", {})),
-                "quantized": self._modules["linear"].name() == "int8_blockwise_linear",
+                "quantized": quantized,  # from the params themselves, not an impl name
                 "kv_block_size": self.config.kv_block_size}
         with open(os.path.join(os.path.abspath(save_path), "engine_meta.pkl"), "wb") as f:
             pickle.dump(meta, f)
@@ -360,37 +358,16 @@ class InferenceEngineV2:
     def _get_compiled(self, t_bucket: int, s_bucket: int, sample: Optional[str] = None):
         key = (t_bucket, s_bucket, sample)
         if key not in self._compiled:
-            from .ragged.ragged_wrapper import unpack_descriptors
-
-            cfg, bs, use_pallas = self.model_config, self.config.kv_block_size, self._use_pallas
-            max_blocks, modules = self._max_blocks_per_seq, self._modules
-            quant = self.state_manager.kv_cache.quantized
             if sample not in (None, "greedy"):
                 raise ValueError(f"unsupported sample mode {sample!r}: None | 'greedy'")
+            step_fn = self._ragged_step
 
-            if quant:
-                def fwd(params, packed, k_pool, v_pool, k_scale, v_scale):
-                    token_ids, seq_idx, pos, valid, tables, last_idx = unpack_descriptors(
-                        packed, t_bucket, s_bucket, max_blocks)
-                    logits, k_pool, v_pool, k_scale, v_scale = ragged_forward(
-                        cfg, bs, params, token_ids, seq_idx, pos, valid, tables, last_idx,
-                        k_pool, v_pool, use_pallas=use_pallas, modules=modules,
-                        k_scale=k_scale, v_scale=v_scale)
-                    out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
-                    return out, k_pool, v_pool, k_scale, v_scale
+            def fwd(params, packed, pools):
+                logits, pools = step_fn(params, packed, pools, t_bucket, s_bucket)
+                out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
+                return out, pools
 
-                self._compiled[key] = jax.jit(fwd, donate_argnums=(2, 3, 4, 5))
-            else:
-                def fwd(params, packed, k_pool, v_pool):
-                    token_ids, seq_idx, pos, valid, tables, last_idx = unpack_descriptors(
-                        packed, t_bucket, s_bucket, max_blocks)
-                    logits, k_pool, v_pool = ragged_forward(cfg, bs, params, token_ids, seq_idx, pos, valid,
-                                                            tables, last_idx, k_pool, v_pool,
-                                                            use_pallas=use_pallas, modules=modules)
-                    out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
-                    return out, k_pool, v_pool
-
-                self._compiled[key] = jax.jit(fwd, donate_argnums=(2, 3))
+            self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
             log_dist(f"compiled ragged forward bucket tokens={t_bucket} seqs={s_bucket} "
                      f"sample={sample}", ranks=[0])
         return self._compiled[key]

@@ -58,6 +58,14 @@ class BlockedKVCache:
         if sharding is not None:
             self.k_pool = jax.device_put(self.k_pool, sharding)
             self.v_pool = jax.device_put(self.v_pool, sharding)
+            if self.quantized:
+                # scales shard with the kv-head dim (pool dim 2 → scale dim 0)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                if isinstance(sharding, NamedSharding) and len(sharding.spec) >= 3:
+                    sc = NamedSharding(sharding.mesh, P(sharding.spec[2], None))
+                    self.k_scale = jax.device_put(self.k_scale, sc)
+                    self.v_scale = jax.device_put(self.v_scale, sc)
 
     @property
     def free_blocks(self) -> int:
@@ -69,6 +77,13 @@ class BlockedKVCache:
 
     def free(self, blocks) -> None:
         self._allocator.free(blocks)
+
+    def pools(self):
+        """The donated pool tuple the compiled forwards thread through:
+        (k, v) full-precision, (k, v, k_scale, v_scale) quantized."""
+        if self.quantized:
+            return (self.k_pool, self.v_pool, self.k_scale, self.v_scale)
+        return (self.k_pool, self.v_pool)
 
     def update(self, k_pool, v_pool, k_scale=None, v_scale=None) -> None:
         """Install the pools returned by the jitted forward (donated in/out)."""

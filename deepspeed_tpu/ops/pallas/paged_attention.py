@@ -45,6 +45,13 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: i
     if window is not None:
         window = int(window)
     if jax.default_backend() != "tpu" or nq < 8 or d % 128 != 0:
+        if jax.default_backend() == "tpu":
+            # off-TPU the oracle is the design; ON TPU a shape miss silently
+            # costing a full context gather per layer per step must be loud
+            from ...utils.logging import warning_once
+
+            warning_once(f"pallas paged attention: unsupported shape (nq={nq}, d={d}; needs "
+                         "nq>=8, d%128==0) — serving through the DENSE gather fallback")
         return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
                                          window=window, alibi=alibi, k_scale=k_scale, v_scale=v_scale)
     try:
