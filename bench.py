@@ -367,6 +367,15 @@ def _forward(stdout):
 
 def supervise():
     """Never exit nonzero, never leave the driver without a final JSON line."""
+    # 0) provisional line FIRST: if an external timeout kills this process
+    #    mid-probe (the one failure mode the supervisor itself cannot
+    #    outlive), the captured stdout still ends in parseable JSON. Every
+    #    later real line supersedes it as the last line.
+    print(json.dumps({"metric": "train_tokens_per_sec_per_chip", "value": 0.0,
+                      "unit": "tokens/s/chip", "vs_baseline": 0.0, "on_tpu": False,
+                      "provisional": True,
+                      "error": "bench was killed externally before completing; see tail"}),
+          flush=True)
     # 1) probe the TPU backend in a throwaway subprocess (bounded retries —
     #    the round-3 outage may have been transient)
     probe_src = ("import jax, json; d = jax.devices(); "
