@@ -566,3 +566,28 @@ def test_engine_serialize_roundtrip(tmp_path):
     eng2.params = jax.device_put(loaded)
     out2 = eng2.put([0], [prompt])
     np.testing.assert_allclose(np.asarray(out2), np.asarray(ref_logits), rtol=1e-6, atol=1e-6)
+
+
+def test_splitfuse_scheduler_over_int8_engine():
+    """Policy loop x quantized KV plane: the Dynamic SplitFuse scheduler
+    drives an int8-KV engine end to end (mixed prefill/decode composition,
+    multi-step decode bursts carrying the scale pools)."""
+    from deepspeed_tpu.inference.v2 import DynamicSplitFuseScheduler
+
+    model = llama2("tiny", num_layers=2, hidden_size=64, num_heads=4, num_kv_heads=2,
+                   intermediate_size=128, vocab_size=128, max_seq_len=256, dtype=jnp.float32,
+                   attention_impl="reference")
+    sm = dict(max_tracked_sequences=8, max_ragged_batch_size=64,
+              max_ragged_sequence_count=4, max_context=64)
+    cfg = RaggedInferenceEngineConfig(kv_block_size=8, num_kv_blocks=32, kv_dtype="int8",
+                                      state_manager=DSStateManagerConfig(**sm),
+                                      use_pallas_kernels="never")
+    eng = InferenceEngineV2(model, cfg)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=32)
+    rng = np.random.default_rng(3)
+    for uid in (1, 2, 3):
+        sched.submit(uid, rng.integers(0, 128, size=int(rng.integers(5, 20)), dtype=np.int32),
+                     max_new_tokens=6)
+    out = sched.run()
+    assert set(out) == {1, 2, 3} and all(len(v) == 6 for v in out.values())
+    assert all(0 <= t < 128 for v in out.values() for t in v)
