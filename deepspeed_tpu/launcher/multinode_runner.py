@@ -108,9 +108,10 @@ class OpenMPIRunner(MultiNodeRunner):
 
 class PDSHRunner(MultiNodeRunner):
     """pdsh fan-out (reference PDSHRunner:51): ONE pdsh process executing the
-    per-node launch command on every host in parallel. pdsh runs an
-    identical command everywhere, so each node derives its node_rank from
-    its hostname's position in the exported DSTPU_NODE_HOSTS list."""
+    per-node launch command on every host in parallel. node_rank comes from
+    pdsh's own ``%n`` substitution — the host's index in the -w list — which
+    is immune to hostfile-name vs gethostname() mismatches (IPs, ssh
+    aliases, FQDNs) that a hostname lookup would mis-rank."""
 
     def backend_exists(self):
         import shutil
@@ -121,17 +122,14 @@ class PDSHRunner(MultiNodeRunner):
         hosts = ",".join(active_resources)
         extra = shlex.split(getattr(self.args, "launcher_args", "") or "")
         cwd = os.getcwd()
-        # pdsh runs the SAME command on every host; the remote side derives
-        # node_rank from its position in the exported host list. The export
-        # must be its own statement — a prefix assignment is NOT visible to a
-        # command substitution within the same simple command.
+        # pdsh substitutes %n with the target's rank in the -w list before
+        # dispatching, so every node gets a distinct, correct node_rank with
+        # no dependence on what the remote gethostname() returns.
         remote = (
             f"cd {shlex.quote(cwd)} && "
-            f"export DSTPU_NODE_HOSTS={shlex.quote(hosts)} && "
             f"{shlex.quote(sys.executable)} -m deepspeed_tpu.launcher.launch "
             f"--world_info={self.world_info_b64} "
-            f"--node_rank=$(python3 -c \"import os,socket;hs=os.environ['DSTPU_NODE_HOSTS'].split(',');"
-            f"h=socket.gethostname();print(hs.index(h) if h in hs else 0)\") "
+            f"--node_rank=%n "
             f"--master_addr={self.master_addr} --master_port={self.master_port} "
             f"-- {shlex.quote(self.user_script)} "
             + " ".join(shlex.quote(a) for a in self.user_arguments)

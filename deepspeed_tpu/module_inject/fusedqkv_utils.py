@@ -26,26 +26,73 @@ def split_by_qkvlist_and_refuse(qkv_list: Sequence[np.ndarray], split_size: int,
 
 
 def require_tp_fused_qkvw(name: str, mp_size: int) -> bool:
-    """Whether a param name is a fused qkv weight needing the per-head split
-    (reference matches the family-specific fused names)."""
+    """Whether a PARAM NAME is a fused qkv weight needing the per-head split
+    (reference matches the family-specific fused names). Discovery only: the
+    split itself (:func:`prepare_tp_fused_qkvw`) takes the MODULE or its
+    block-class name, because a param name alone cannot always determine the
+    fused layout ('query_key_value' collides across bloom and ChatGLM)."""
     if mp_size <= 1:
         return False
-    fused_names = ("qkv_proj", "query_key_value", "attn.c_attn", "W_pack", "c_attn")
+    fused_names = ("qkv_proj", "query_key_value", "attn.c_attn", "W_pack", "c_attn",
+                   "Wqkv")  # Wqkv: MPT (glmtype in the layout table below)
     return any(f in name for f in fused_names)
 
 
-def _fused_view(src: np.ndarray, num_heads: int):
+# Layout dispatch mirrors the reference's fused_type_dict, which keys on the
+# BLOCK CLASS (BloomBlock vs GLMBlock), not the param name — the param name
+# "query_key_value" collides across layouts (bloom per-head interleaved vs
+# ChatGLM projection-major), so a bare param name cannot decide it.
+#   bloomtype: PER-HEAD interleaved [q1,k1,v1,q2,k2,v2,...] on the fused axis
+#   glmtype:   projection-major [q1..qn, k1..kn, v1..vn]
+_BLOOMTYPE_MARKERS = ("BloomBlock", "FalconDecoderLayer", "GPTNeoXLayer", "bloomtype")
+_GLMTYPE_MARKERS = ("GLMBlock", "MPTBlock", "MptBlock", "BaichuanLayer", "QWenBlock",
+                    "glmtype", "qwentype", "qkv_proj", "c_attn", "W_pack", "Wqkv")
+
+
+def _fused_layout(module_str) -> str:
+    # reference parity: callers may pass the MODULE itself (auto_tp does) —
+    # its class name carries the layout
+    if module_str is not None and not isinstance(module_str, str):
+        module_str = type(module_str).__name__
+    s = module_str or ""
+    if any(n in s for n in _BLOOMTYPE_MARKERS):
+        return "bloomtype"
+    if any(n in s for n in _GLMTYPE_MARKERS):
+        return "glmtype"
+    if "query_key_value" in s:
+        # ambiguous: bloom/falcon/gpt-neox use this name with the interleaved
+        # layout, ChatGLM with the projection-major one. Refusing beats a
+        # silent mis-split (the bug class this dispatch exists to prevent).
+        raise ValueError(
+            f"fused-qkv layout for {module_str!r} is ambiguous: 'query_key_value' is "
+            "per-head interleaved in bloom/falcon/gpt-neox but projection-major in "
+            "ChatGLM. Pass the module / block class name (e.g. 'BloomBlock', "
+            "'GLMBlock') or an explicit 'bloomtype'/'glmtype' as module_str.")
+    # unknown families (e.g. codegentype's rotated interleave) must not fall
+    # through to a shape-correct but scrambled projection-major guess
+    raise NotImplementedError(
+        f"unrecognized fused-qkv module {module_str!r}: known bloomtype markers "
+        f"{_BLOOMTYPE_MARKERS}, glmtype markers {_GLMTYPE_MARKERS}. Pass an explicit "
+        "'bloomtype'/'glmtype' if this family uses one of those layouts.")
+
+
+def _fused_view(src: np.ndarray, num_heads: int, layout: str):
     fused = src.shape[-1]
     assert fused % (3 * num_heads) == 0, \
         f"fused qkv dim {fused} must be 3 * {num_heads} heads * head_dim"
     d = fused // (3 * num_heads)
+    if layout == "bloomtype":  # [nh, 3, d] per-head interleaved
+        return src.reshape(*src.shape[:-1], num_heads, 3, d), d
     return src.reshape(*src.shape[:-1], 3, num_heads, d), d
 
 
 def prepare_tp_fused_qkvw(module_str: str, src: np.ndarray, mp_size: int, gpu_index: int,
                           num_heads: int = None) -> np.ndarray:
     """Rank ``gpu_index``'s slice of a fused qkv weight ``[..., 3·nh·d]``:
-    the per-projection head block, NOT a naive column slice. Uneven
+    the per-head block for the family's actual fused layout, NOT a naive
+    column slice. ``module_str`` selects the layout (ADVICE r4: bloom-family
+    ``query_key_value`` is per-head interleaved — one projection-major view
+    for every name silently mixed q/k/v of the wrong heads). Uneven
     ``num_heads % mp_size`` assigns the remainder heads to the earliest
     ranks (``tp_shard`` contract)."""
     src = np.asarray(src)
@@ -53,16 +100,25 @@ def prepare_tp_fused_qkvw(module_str: str, src: np.ndarray, mp_size: int, gpu_in
         from .tp_shard import get_num_kv_heads
 
         num_heads = get_num_kv_heads() or mp_size
-    view, d = _fused_view(src, num_heads)
+    layout = _fused_layout(module_str)
+    view, d = _fused_view(src, num_heads, layout)
     counts = _head_counts(num_heads, mp_size)
     start = sum(counts[:gpu_index])
-    mine = view[..., :, start:start + counts[gpu_index], :]
-    return mine.reshape(*src.shape[:-1], 3 * counts[gpu_index] * d)
+    cnt = counts[gpu_index]
+    if layout == "bloomtype":
+        mine = view[..., start:start + cnt, :, :]
+    else:
+        mine = view[..., :, start:start + cnt, :]
+    return mine.reshape(*src.shape[:-1], 3 * cnt * d)
 
 
-def refuse_tp_fused_qkvw(shards: Sequence[np.ndarray], num_heads: int = None) -> np.ndarray:
+def refuse_tp_fused_qkvw(shards: Sequence[np.ndarray], module_str: str,
+                         num_heads: int = None) -> np.ndarray:
     """Inverse of :func:`prepare_tp_fused_qkvw` (merge all ranks' slices).
-    Per-shard head counts are recovered from the shard widths."""
+    Per-shard head counts are recovered from the shard widths. ``module_str``
+    is REQUIRED and must select the same bloomtype/glmtype layout as the
+    split — a glmtype default would merge bloomtype shards into a
+    shape-correct but silently scrambled weight (code-review r5 finding)."""
     shards = [np.asarray(s) for s in shards]
     total = sum(s.shape[-1] for s in shards)
     if num_heads is None:
@@ -70,9 +126,13 @@ def refuse_tp_fused_qkvw(shards: Sequence[np.ndarray], num_heads: int = None) ->
 
         num_heads = get_num_kv_heads() or len(shards)
     d = total // (3 * num_heads)
+    layout = _fused_layout(module_str)
     views = []
     for s in shards:
         cnt = s.shape[-1] // (3 * d)
-        views.append(s.reshape(*s.shape[:-1], 3, cnt, d))
-    merged = np.concatenate(views, axis=-2)
+        if layout == "bloomtype":
+            views.append(s.reshape(*s.shape[:-1], cnt, 3, d))
+        else:
+            views.append(s.reshape(*s.shape[:-1], 3, cnt, d))
+    merged = np.concatenate(views, axis=-3 if layout == "bloomtype" else -2)
     return merged.reshape(*shards[0].shape[:-1], total)

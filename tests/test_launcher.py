@@ -126,7 +126,9 @@ def test_pdsh_runner_cmd():
     assert cmd[0] == "pdsh" and "-w" in cmd and "w0,w1" in cmd
     remote = cmd[-1]
     assert "deepspeed_tpu.launcher.launch" in remote
-    assert "DSTPU_NODE_HOSTS=w0,w1" in remote  # per-host rank derivation
+    # pdsh's own %n rank substitution — immune to hostfile-name vs
+    # gethostname() mismatches (IPs, aliases, FQDNs)
+    assert "--node_rank=%n" in remote
     assert "train.py" in remote
 
 

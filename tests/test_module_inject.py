@@ -217,7 +217,7 @@ def test_tp_shard_and_fusedqkv_utils():
     H, nh, d = 16, 4, 4
     fused = rng.normal(size=(H, 3 * nh * d)).astype(np.float32)
     shards = [prepare_tp_fused_qkvw("qkv_proj", fused, 2, i, num_heads=nh) for i in range(2)]
-    np.testing.assert_array_equal(refuse_tp_fused_qkvw(shards, num_heads=nh), fused)
+    np.testing.assert_array_equal(refuse_tp_fused_qkvw(shards, "qkv_proj", num_heads=nh), fused)
     # the split is on the FUSED (last) axis per projection per head — rank 0
     # must hold q/k/v of heads {0,1}, i.e. columns [p*nh*d + 0 : p*nh*d + 2d]
     view = fused.reshape(H, 3, nh, d)
@@ -235,6 +235,38 @@ def test_tp_shard_and_fusedqkv_utils():
     refused = split_by_qkvlist_and_refuse([q, k, v], 2)
     assert len(refused) == 2 and refused[0].shape == (12, 4)
     np.testing.assert_array_equal(np.concatenate([refused[0][:4], refused[1][:4]]), q)
+
+
+def test_fusedqkv_bloomtype_per_head_interleaved_layout():
+    """ADVICE r4: bloom/falcon ``query_key_value`` is per-head interleaved
+    [q1,k1,v1,q2,k2,v2,...] on the fused axis — splitting it with the
+    projection-major view mixes q/k/v of the wrong heads. The helper must
+    dispatch on module_str and hand each rank whole per-head (q,k,v) blocks."""
+    from deepspeed_tpu.module_inject.fusedqkv_utils import (prepare_tp_fused_qkvw,
+                                                            refuse_tp_fused_qkvw)
+
+    rng = np.random.default_rng(1)
+    H, nh, d = 8, 4, 4
+    fused = rng.normal(size=(H, nh * 3 * d)).astype(np.float32)  # [nh,3,d] layout
+    shards = [prepare_tp_fused_qkvw("BloomBlock", fused, 2, i, num_heads=nh)
+              for i in range(2)]
+    # rank 0 = heads {0,1}: in the interleaved layout that is the FIRST
+    # 2*(3d) contiguous columns — exactly view[:, :2, :, :]
+    view = fused.reshape(H, nh, 3, d)
+    np.testing.assert_array_equal(shards[0], view[:, :2].reshape(H, 2 * 3 * d))
+    np.testing.assert_array_equal(shards[1], view[:, 2:].reshape(H, 2 * 3 * d))
+    # round-trip with the same layout; FalconDecoderLayer is the same family
+    np.testing.assert_array_equal(
+        refuse_tp_fused_qkvw(shards, "FalconDecoderLayer", num_heads=nh), fused)
+    # and it must DIFFER from the projection-major split of the same tensor —
+    # the two layouts are not interchangeable (the silent-mis-split bug)
+    glm = prepare_tp_fused_qkvw("GLMBlock", fused, 2, 0, num_heads=nh)
+    assert not np.array_equal(glm, shards[0])
+    # a bare 'query_key_value' param name is AMBIGUOUS across those two
+    # layouts (bloom vs ChatGLM) — the helper must refuse, not guess
+    with pytest.raises(ValueError, match="ambiguous"):
+        prepare_tp_fused_qkvw("h.0.self_attention.query_key_value", fused, 2, 0,
+                              num_heads=nh)
 
 
 def test_module_inject_layers_functional(eight_devices):

@@ -99,7 +99,11 @@ def main():
         out = step(q, k_pool, v_pool)
     _sync(out)
     dt_kernel = (time.time() - t0) / reps
-    kv_bytes = n_seqs * ctx * nkv * (d * kv_itemsize + (4 if kv_int8 else 0))
+    # factor 2: BOTH the K and V pools stream every step (and both scale
+    # pools in int8 mode) — matches bench.py's bench_serving accounting
+    # (ADVICE r4: the single-pool count halved the ideal time and thus
+    # under-reported the kernel's fraction-of-roofline ~2x)
+    kv_bytes = 2 * n_seqs * ctx * nkv * (d * kv_itemsize + (4 if kv_int8 else 0))
     kernel_roofline = kv_bytes / hbm_bw  # one layer's KV stream
     print(json.dumps({"metric": "decode_kernel_step_s", "value": round(dt_kernel, 6),
                       "kv_bytes_per_layer": kv_bytes, "kv": args.kv,
