@@ -63,7 +63,15 @@ def train_tps(cfg, micro, gas, seq, steps, warmup, stage, n_params_known=None):
 
 
 def main():
+    import os
+
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # sitecustomize's config-level jax_platforms="axon,cpu" beats the env
+        # var; honor an explicit CPU pin instead of hanging on a dead TPU
+        # tunnel (same guard as bench.py)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from deepspeed_tpu.models import TransformerConfig
@@ -115,6 +123,17 @@ def main():
     import sys
 
     wanted = sys.argv[1:]
+
+    # serving rung: FastGen-style continuous-batching load test — Dynamic
+    # SplitFuse vs static batching on the same engine (reference methodology
+    # blogs/deepspeed-fastgen/README.md:139-144; VERDICT r4 missing #3)
+    if not wanted or any(w in "serving_load_splitfuse_vs_static" for w in wanted):
+        from tools.serving_load import serving_load_bench
+
+        out = serving_load_bench(on_tpu)
+        out["on_tpu"] = on_tpu
+        print(json.dumps(out), flush=True)
+
     for name, cfg, kw in ladder:
         if wanted and not any(w in name for w in wanted):
             continue

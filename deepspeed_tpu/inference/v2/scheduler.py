@@ -92,6 +92,12 @@ class DynamicSplitFuseScheduler:
         return bool(self._pending or self._active)
 
     @property
+    def finished(self):
+        """Uids whose generation is complete (eos or max_new_tokens) — load
+        harnesses poll this after each ``step`` to stamp completion times."""
+        return frozenset(self._results)
+
+    @property
     def results(self) -> Dict[int, List[int]]:
         """Generations so far — finished requests complete, active partial."""
         out = dict(self._results)

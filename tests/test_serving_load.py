@@ -1,0 +1,72 @@
+"""Continuous-batching load harness (tools/serving_load.py) — the repo's
+FastGen-style rps/latency methodology (reference
+``blogs/deepspeed-fastgen/README.md:139-144``). Correctness contract: both
+policies drive the SAME engine greedily, so scheduling changes WHEN work
+runs, never WHAT it computes — generations must match token-for-token."""
+
+import numpy as np
+import pytest
+
+from tools.serving_load import build_engine, make_workload, run_splitfuse, run_static
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return build_engine(on_tpu=False)
+
+
+def test_workload_shapes_and_arrivals():
+    wl = make_workload(8, prompt_lo=4, prompt_hi=10, new_lo=2, new_hi=5,
+                       rate_rps=100.0, seed=3)
+    assert len(wl) == 8
+    arr = [r["arrival"] for r in wl]
+    assert arr == sorted(arr) and arr[0] > 0
+    assert all(4 <= r["prompt"].size <= 10 for r in wl)
+    assert all(2 <= r["max_new_tokens"] <= 5 for r in wl)
+    # saturated mode: everything offered at t=0
+    sat = make_workload(4, prompt_lo=4, prompt_hi=8, new_lo=2, new_hi=4, rate_rps=None)
+    assert all(r["arrival"] == 0.0 for r in sat)
+
+
+def test_splitfuse_and_static_generate_identical_tokens(engine):
+    wl = make_workload(10, prompt_lo=6, prompt_hi=20, new_lo=3, new_hi=10,
+                       rate_rps=None, seed=7, uid_base=0)
+    sf_done, sf_span = run_splitfuse(engine, wl, token_budget=32)
+    st_done, st_span = run_static(
+        engine, [dict(r, uid=r["uid"] + 1000) for r in wl], batch_size=4)
+    assert len(sf_done) == len(st_done) == 10
+    assert sf_span > 0 and st_span > 0
+    for r in wl:
+        sf_lat, sf_toks = sf_done[r["uid"]]
+        st_lat, st_toks = st_done[r["uid"] + 1000]
+        assert len(sf_toks) == r["max_new_tokens"]
+        assert sf_toks == st_toks, (
+            f"uid {r['uid']}: splitfuse {sf_toks} != static {st_toks} — "
+            "scheduling policy changed the computation")
+        assert sf_lat > 0 and st_lat > 0
+    # everything flushed: the engine is reusable for the next run
+    assert engine.state_manager.n_tracked_sequences == 0
+
+
+def test_open_loop_arrivals_respected(engine):
+    """Open-loop mode: a request cannot finish before it arrives, and
+    latencies are measured from ARRIVAL, not from harness start."""
+    wl = make_workload(6, prompt_lo=4, prompt_hi=8, new_lo=2, new_hi=4,
+                       rate_rps=50.0, seed=11, uid_base=100)
+    done, span = run_splitfuse(engine, wl, token_budget=32)
+    assert len(done) == 6
+    assert span >= wl[-1]["arrival"]  # can't finish before the last arrival
+    assert all(lat > 0 for lat, _ in done.values())
+    assert engine.state_manager.n_tracked_sequences == 0
+
+
+def test_scheduler_finished_property(engine):
+    from deepspeed_tpu.inference.v2 import DynamicSplitFuseScheduler
+
+    sched = DynamicSplitFuseScheduler(engine, token_budget=32)
+    rng = np.random.default_rng(0)
+    sched.submit(900, rng.integers(0, 100, size=6, dtype=np.int32), max_new_tokens=2)
+    sched.submit(901, rng.integers(0, 100, size=40, dtype=np.int32), max_new_tokens=8)
+    assert sched.finished == frozenset()
+    sched.run()
+    assert sched.finished == frozenset({900, 901})
