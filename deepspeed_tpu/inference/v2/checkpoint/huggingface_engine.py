@@ -3,15 +3,17 @@
 Reference ``inference/v2/checkpoint/huggingface_engine.py`` (the FastGen
 checkpoint engine iterating HF weights into the layer containers) +
 ``engine_factory.build_hf_engine``. Here the containers are the stacked
-param pytree of ``models.transformer``: per-family name maps stack the
-per-layer HF tensors into [L, ...] arrays, transposing torch Linear weights
-([out, in]) into our [in, out] einsum layout. Supported families mirror the
-reference inventory (llama_v2, mistral, opt) plus gpt2.
+param pytree of ``models.transformer``: each family's mapping is a
+declarative ParamSpec table (``model_implementations/parameter_spec.py`` —
+the reference's parameter_base/layer_container_base mechanism) consumed by
+one generic converter that stacks per-layer HF tensors into [L, ...] arrays
+and transposes torch Linear weights ([out, in]) into our [in, out] einsum
+layout. Supported families: llama, mistral, qwen2, phi, gpt2, opt, bloom,
+gptj, gpt_neox, falcon.
 """
 
 import json
 import os
-import re
 from typing import Dict, Iterator, Tuple
 
 import numpy as np
@@ -191,289 +193,20 @@ def transformer_config_from_hf(hf_cfg: dict):
 
 
 # ---------------------------------------------------------------------------
-# weight conversion
+# weight conversion — declarative since r5: each family is a ParamSpec table
+# in model_implementations/parameter_spec.py (the reference's
+# parameter_base.py / layer_container_base.py mechanism); one generic
+# convert_with_spec replaces the former 11 hand-written converters
 # ---------------------------------------------------------------------------
-def _stack(sd, fmt, L, transpose=False):
-    ws = [np.asarray(sd[fmt.format(i=i)], np.float32) for i in range(L)]
-    if transpose:
-        ws = [w.T for w in ws]
-    return np.stack(ws)
-
-
-def _split_fused_qkv(w, nh, hd, nkv=None):
-    """Split a fused per-head query_key_value weight [(…)*hd, H] (torch
-    [out, in] layout) into our [L-free] (H, nh*hd) q and (H, nkv*hd) k/v.
-
-    ``nkv=None``: Bloom/NeoX per-head interleave (nh, 3, hd); else the
-    Falcon MQA/GQA layout [q heads..., k heads, v heads] on the out dim.
-    """
-    H = w.shape[1]
-    if nkv is None:
-        w3 = w.reshape(nh, 3, hd, H)
-        q, k, v = (w3[:, j].reshape(nh * hd, H).T for j in range(3))
-        return q, k, v
-    w3 = w.reshape(nkv, nh // nkv + 2, hd, H)
-    q = w3[:, :-2].reshape(nh * hd, H).T
-    k = w3[:, -2].reshape(nkv * hd, H).T
-    v = w3[:, -1].reshape(nkv * hd, H).T
-    return q, k, v
-
-
-def _split_fused_qkv_bias(b, nh, hd):
-    b3 = b.reshape(nh, 3, hd)
-    return b3[:, 0].reshape(-1), b3[:, 1].reshape(-1), b3[:, 2].reshape(-1)
-
-
-def _interleaved_to_half_perm(w_cols, nh, hd, rotary_dim):
-    """Permute q/k projection OUTPUT columns so HF's interleaved (GPT-J
-    rotate_every_two) rotary becomes our half-style rope: within each head's
-    first ``rotary_dim`` dims, reorder [0,1,2,...] -> [0,2,4,...,1,3,...].
-    Score-preserving because the same orthogonal permutation hits q and k."""
-    perm_r = list(range(0, rotary_dim, 2)) + list(range(1, rotary_dim, 2))
-    idx = []
-    for h in range(nh):
-        off = h * hd
-        idx.extend(off + np.asarray(perm_r))
-        idx.extend(range(off + rotary_dim, off + hd))
-    return w_cols[..., np.asarray(idx)]
-
-
 def convert_hf_state_dict(sd: Dict[str, np.ndarray], cfg, model_type: str):
     """HF state dict → stacked param pytree (numpy, fp32)."""
-    L = cfg.num_layers
-    if model_type in ("llama", "mistral", "qwen2"):
-        p = {
-            "embed": {"embedding": np.asarray(sd["model.embed_tokens.weight"], np.float32)},
-            "blocks": {
-                "ln1_scale": _stack(sd, "model.layers.{i}.input_layernorm.weight", L),
-                "wq": _stack(sd, "model.layers.{i}.self_attn.q_proj.weight", L, transpose=True),
-                "wk": _stack(sd, "model.layers.{i}.self_attn.k_proj.weight", L, transpose=True),
-                "wv": _stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, transpose=True),
-                "wo": _stack(sd, "model.layers.{i}.self_attn.o_proj.weight", L, transpose=True),
-                "ln2_scale": _stack(sd, "model.layers.{i}.post_attention_layernorm.weight", L),
-                "w_gate": _stack(sd, "model.layers.{i}.mlp.gate_proj.weight", L, transpose=True),
-                "w_up": _stack(sd, "model.layers.{i}.mlp.up_proj.weight", L, transpose=True),
-                "w_down": _stack(sd, "model.layers.{i}.mlp.down_proj.weight", L, transpose=True),
-            },
-            "final_norm": {"scale": np.asarray(sd["model.norm.weight"], np.float32)},
-        }
-        if model_type == "qwen2":  # biased qkv only
-            p["blocks"]["bq"] = _stack(sd, "model.layers.{i}.self_attn.q_proj.bias", L)
-            p["blocks"]["bk"] = _stack(sd, "model.layers.{i}.self_attn.k_proj.bias", L)
-            p["blocks"]["bv"] = _stack(sd, "model.layers.{i}.self_attn.v_proj.bias", L)
-        if not cfg.tie_embeddings:
-            p["lm_head"] = {"kernel": np.asarray(sd["lm_head.weight"], np.float32).T}
-        return p
-    if model_type == "phi":
-        # parallel residual, single shared input_layernorm, partial rotary;
-        # phi's rotary uses the half-split convention (same as our apply_rope)
-        p = {
-            "embed": {"embedding": np.asarray(sd["model.embed_tokens.weight"], np.float32)},
-            "blocks": {
-                "ln1_scale": _stack(sd, "model.layers.{i}.input_layernorm.weight", L),
-                "ln1_bias": _stack(sd, "model.layers.{i}.input_layernorm.bias", L),
-                "wq": _stack(sd, "model.layers.{i}.self_attn.q_proj.weight", L, transpose=True),
-                "bq": _stack(sd, "model.layers.{i}.self_attn.q_proj.bias", L),
-                "wk": _stack(sd, "model.layers.{i}.self_attn.k_proj.weight", L, transpose=True),
-                "bk": _stack(sd, "model.layers.{i}.self_attn.k_proj.bias", L),
-                "wv": _stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, transpose=True),
-                "bv": _stack(sd, "model.layers.{i}.self_attn.v_proj.bias", L),
-                "wo": _stack(sd, "model.layers.{i}.self_attn.dense.weight", L, transpose=True),
-                "bo": _stack(sd, "model.layers.{i}.self_attn.dense.bias", L),
-                "w_up": _stack(sd, "model.layers.{i}.mlp.fc1.weight", L, transpose=True),
-                "b_up": _stack(sd, "model.layers.{i}.mlp.fc1.bias", L),
-                "w_down": _stack(sd, "model.layers.{i}.mlp.fc2.weight", L, transpose=True),
-                "b_down": _stack(sd, "model.layers.{i}.mlp.fc2.bias", L),
-            },
-            "final_norm": {"scale": np.asarray(sd["model.final_layernorm.weight"], np.float32),
-                           "bias": np.asarray(sd["model.final_layernorm.bias"], np.float32)},
-            "lm_head": {"kernel": np.asarray(sd["lm_head.weight"], np.float32).T,
-                        "bias": np.asarray(sd["lm_head.bias"], np.float32)},
-        }
-        return p
-    if model_type == "gpt2":
-        H = cfg.hidden_size
-        # Conv1D stores [in, out] — NO transpose; c_attn fuses qkv on out dim
-        c_attn = _stack(sd, "transformer.h.{i}.attn.c_attn.weight", L)
-        b_attn = _stack(sd, "transformer.h.{i}.attn.c_attn.bias", L)
-        p = {
-            "embed": {"embedding": np.asarray(sd["transformer.wte.weight"], np.float32)},
-            "pos_embed": {"embedding": np.asarray(sd["transformer.wpe.weight"], np.float32)},
-            "blocks": {
-                "ln1_scale": _stack(sd, "transformer.h.{i}.ln_1.weight", L),
-                "ln1_bias": _stack(sd, "transformer.h.{i}.ln_1.bias", L),
-                "wq": c_attn[:, :, :H], "wk": c_attn[:, :, H:2 * H], "wv": c_attn[:, :, 2 * H:],
-                "bq": b_attn[:, :H], "bk": b_attn[:, H:2 * H], "bv": b_attn[:, 2 * H:],
-                "wo": _stack(sd, "transformer.h.{i}.attn.c_proj.weight", L),
-                "bo": _stack(sd, "transformer.h.{i}.attn.c_proj.bias", L),
-                "ln2_scale": _stack(sd, "transformer.h.{i}.ln_2.weight", L),
-                "ln2_bias": _stack(sd, "transformer.h.{i}.ln_2.bias", L),
-                "w_up": _stack(sd, "transformer.h.{i}.mlp.c_fc.weight", L),
-                "b_up": _stack(sd, "transformer.h.{i}.mlp.c_fc.bias", L),
-                "w_down": _stack(sd, "transformer.h.{i}.mlp.c_proj.weight", L),
-                "b_down": _stack(sd, "transformer.h.{i}.mlp.c_proj.bias", L),
-            },
-            "final_norm": {"scale": np.asarray(sd["transformer.ln_f.weight"], np.float32),
-                           "bias": np.asarray(sd["transformer.ln_f.bias"], np.float32)},
-        }
-        return p
-    if model_type == "opt":
-        base = "model.decoder.layers.{i}."
-        p = {
-            "embed": {"embedding": np.asarray(sd["model.decoder.embed_tokens.weight"], np.float32)},
-            # OPT's learned positions carry a +2 offset (rows 0-1 unused for
-            # dense position_ids starting at 0)
-            "pos_embed": {"embedding": np.asarray(sd["model.decoder.embed_positions.weight"], np.float32)[2:]},
-            "blocks": {
-                "ln1_scale": _stack(sd, base + "self_attn_layer_norm.weight", L),
-                "ln1_bias": _stack(sd, base + "self_attn_layer_norm.bias", L),
-                "wq": _stack(sd, base + "self_attn.q_proj.weight", L, transpose=True),
-                "wk": _stack(sd, base + "self_attn.k_proj.weight", L, transpose=True),
-                "wv": _stack(sd, base + "self_attn.v_proj.weight", L, transpose=True),
-                "bq": _stack(sd, base + "self_attn.q_proj.bias", L),
-                "bk": _stack(sd, base + "self_attn.k_proj.bias", L),
-                "bv": _stack(sd, base + "self_attn.v_proj.bias", L),
-                "wo": _stack(sd, base + "self_attn.out_proj.weight", L, transpose=True),
-                "bo": _stack(sd, base + "self_attn.out_proj.bias", L),
-                "ln2_scale": _stack(sd, base + "final_layer_norm.weight", L),
-                "ln2_bias": _stack(sd, base + "final_layer_norm.bias", L),
-                "w_up": _stack(sd, base + "fc1.weight", L, transpose=True),
-                "b_up": _stack(sd, base + "fc1.bias", L),
-                "w_down": _stack(sd, base + "fc2.weight", L, transpose=True),
-                "b_down": _stack(sd, base + "fc2.bias", L),
-            },
-            "final_norm": {"scale": np.asarray(sd["model.decoder.final_layer_norm.weight"], np.float32),
-                           "bias": np.asarray(sd["model.decoder.final_layer_norm.bias"], np.float32)},
-        }
-        return p
-    if model_type == "bloom":
-        L_, nh, hd = L, cfg.num_heads, cfg.head_dim
-        base = "transformer.h.{i}."
-        qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
-        for i in range(L_):
-            w = np.asarray(sd[base.format(i=i) + "self_attention.query_key_value.weight"], np.float32)
-            b = np.asarray(sd[base.format(i=i) + "self_attention.query_key_value.bias"], np.float32)
-            q, k, v = _split_fused_qkv(w, nh, hd)
-            bq, bk, bv = _split_fused_qkv_bias(b, nh, hd)
-            qs.append(q), ks.append(k), vs.append(v)
-            bqs.append(bq), bks.append(bk), bvs.append(bv)
-        p = {
-            "embed": {"embedding": np.asarray(sd["transformer.word_embeddings.weight"], np.float32)},
-            "embed_norm": {"scale": np.asarray(sd["transformer.word_embeddings_layernorm.weight"], np.float32),
-                           "bias": np.asarray(sd["transformer.word_embeddings_layernorm.bias"], np.float32)},
-            "blocks": {
-                "ln1_scale": _stack(sd, base + "input_layernorm.weight", L_),
-                "ln1_bias": _stack(sd, base + "input_layernorm.bias", L_),
-                "wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
-                "bq": np.stack(bqs), "bk": np.stack(bks), "bv": np.stack(bvs),
-                "wo": _stack(sd, base + "self_attention.dense.weight", L_, transpose=True),
-                "bo": _stack(sd, base + "self_attention.dense.bias", L_),
-                "ln2_scale": _stack(sd, base + "post_attention_layernorm.weight", L_),
-                "ln2_bias": _stack(sd, base + "post_attention_layernorm.bias", L_),
-                "w_up": _stack(sd, base + "mlp.dense_h_to_4h.weight", L_, transpose=True),
-                "b_up": _stack(sd, base + "mlp.dense_h_to_4h.bias", L_),
-                "w_down": _stack(sd, base + "mlp.dense_4h_to_h.weight", L_, transpose=True),
-                "b_down": _stack(sd, base + "mlp.dense_4h_to_h.bias", L_),
-            },
-            "final_norm": {"scale": np.asarray(sd["transformer.ln_f.weight"], np.float32),
-                           "bias": np.asarray(sd["transformer.ln_f.bias"], np.float32)},
-        }
-        return p
-    if model_type == "gptj":
-        nh, hd, r = cfg.num_heads, cfg.head_dim, cfg.rotary_dim
-        base = "transformer.h.{i}."
-        Z = np.zeros((L, nh * hd), np.float32)
-        p = {
-            "embed": {"embedding": np.asarray(sd["transformer.wte.weight"], np.float32)},
-            "blocks": {
-                "ln1_scale": _stack(sd, base + "ln_1.weight", L),
-                "ln1_bias": _stack(sd, base + "ln_1.bias", L),
-                # interleaved->half rotary handled by column permutation
-                "wq": _interleaved_to_half_perm(
-                    _stack(sd, base + "attn.q_proj.weight", L, transpose=True), nh, hd, r),
-                "wk": _interleaved_to_half_perm(
-                    _stack(sd, base + "attn.k_proj.weight", L, transpose=True), nh, hd, r),
-                "wv": _stack(sd, base + "attn.v_proj.weight", L, transpose=True),
-                "bq": Z, "bk": Z, "bv": Z,  # GPT-J attention has no biases
-                "wo": _stack(sd, base + "attn.out_proj.weight", L, transpose=True),
-                "bo": np.zeros((L, cfg.hidden_size), np.float32),
-                "w_up": _stack(sd, base + "mlp.fc_in.weight", L, transpose=True),
-                "b_up": _stack(sd, base + "mlp.fc_in.bias", L),
-                "w_down": _stack(sd, base + "mlp.fc_out.weight", L, transpose=True),
-                "b_down": _stack(sd, base + "mlp.fc_out.bias", L),
-            },
-            "final_norm": {"scale": np.asarray(sd["transformer.ln_f.weight"], np.float32),
-                           "bias": np.asarray(sd["transformer.ln_f.bias"], np.float32)},
-            "lm_head": {"kernel": np.asarray(sd["lm_head.weight"], np.float32).T,
-                        "bias": np.asarray(sd["lm_head.bias"], np.float32)},
-        }
-        return p
-    if model_type == "gpt_neox":
-        nh, hd = cfg.num_heads, cfg.head_dim
-        base = "gpt_neox.layers.{i}."
-        qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
-        for i in range(L):
-            w = np.asarray(sd[base.format(i=i) + "attention.query_key_value.weight"], np.float32)
-            b = np.asarray(sd[base.format(i=i) + "attention.query_key_value.bias"], np.float32)
-            q, k, v = _split_fused_qkv(w, nh, hd)
-            bq, bk, bv = _split_fused_qkv_bias(b, nh, hd)
-            qs.append(q), ks.append(k), vs.append(v)
-            bqs.append(bq), bks.append(bk), bvs.append(bv)
-        p = {
-            "embed": {"embedding": np.asarray(sd["gpt_neox.embed_in.weight"], np.float32)},
-            "blocks": {
-                "ln1_scale": _stack(sd, base + "input_layernorm.weight", L),
-                "ln1_bias": _stack(sd, base + "input_layernorm.bias", L),
-                "wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
-                "bq": np.stack(bqs), "bk": np.stack(bks), "bv": np.stack(bvs),
-                "wo": _stack(sd, base + "attention.dense.weight", L, transpose=True),
-                "bo": _stack(sd, base + "attention.dense.bias", L),
-                "ln2_scale": _stack(sd, base + "post_attention_layernorm.weight", L),
-                "ln2_bias": _stack(sd, base + "post_attention_layernorm.bias", L),
-                "w_up": _stack(sd, base + "mlp.dense_h_to_4h.weight", L, transpose=True),
-                "b_up": _stack(sd, base + "mlp.dense_h_to_4h.bias", L),
-                "w_down": _stack(sd, base + "mlp.dense_4h_to_h.weight", L, transpose=True),
-                "b_down": _stack(sd, base + "mlp.dense_4h_to_h.bias", L),
-            },
-            "final_norm": {"scale": np.asarray(sd["gpt_neox.final_layer_norm.weight"], np.float32),
-                           "bias": np.asarray(sd["gpt_neox.final_layer_norm.bias"], np.float32)},
-        }
-        if not cfg.tie_embeddings:
-            p["lm_head"] = {"kernel": np.asarray(sd["embed_out.weight"], np.float32).T}
-        return p
-    if model_type == "falcon":
-        nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-        base = "transformer.h.{i}."
-        # new_decoder_architecture (40b/180b) names its two parallel norms
-        # ln_attn/ln_mlp; the 7b family has a single input_layernorm
-        new_arch = base.format(i=0) + "ln_attn.weight" in sd
-        ln1 = "ln_attn" if new_arch else "input_layernorm"
-        qs, ks, vs = [], [], []
-        for i in range(L):
-            w = np.asarray(sd[base.format(i=i) + "self_attention.query_key_value.weight"], np.float32)
-            q, k, v = _split_fused_qkv(w, nh, hd, nkv=nkv)
-            qs.append(q), ks.append(k), vs.append(v)
-        blocks = {
-            "ln1_scale": _stack(sd, base + ln1 + ".weight", L),
-            "ln1_bias": _stack(sd, base + ln1 + ".bias", L),
-            "wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
-            "wo": _stack(sd, base + "self_attention.dense.weight", L, transpose=True),
-            "w_up": _stack(sd, base + "mlp.dense_h_to_4h.weight", L, transpose=True),
-            "w_down": _stack(sd, base + "mlp.dense_4h_to_h.weight", L, transpose=True),
-        }
-        if new_arch:  # separate MLP-branch norm (shared_ln=False)
-            blocks["ln2_scale"] = _stack(sd, base + "ln_mlp.weight", L)
-            blocks["ln2_bias"] = _stack(sd, base + "ln_mlp.bias", L)
-        p = {
-            "embed": {"embedding": np.asarray(sd["transformer.word_embeddings.weight"], np.float32)},
-            "blocks": blocks,
-            "final_norm": {"scale": np.asarray(sd["transformer.ln_f.weight"], np.float32),
-                           "bias": np.asarray(sd["transformer.ln_f.bias"], np.float32)},
-        }
-        if not cfg.tie_embeddings:
-            p["lm_head"] = {"kernel": np.asarray(sd["lm_head.weight"], np.float32).T}
-        return p
-    raise ValueError(f"unsupported model_type {model_type!r}")
+    from ..model_implementations.parameter_spec import FAMILY_SPECS, convert_with_spec
+
+    spec = FAMILY_SPECS.get(model_type)
+    if spec is None:
+        raise ValueError(f"unsupported model_type {model_type!r}; supported: "
+                         f"{sorted(FAMILY_SPECS)}")
+    return convert_with_spec(sd, cfg, spec)
 
 
 def build_hf_engine(model_name_or_path: str, engine_config=None, dtype=None):

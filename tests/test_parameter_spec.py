@@ -1,0 +1,73 @@
+"""Declarative v2 parameter-mapping layer (reference
+``inference/v2/model_implementations/parameter_base.py`` /
+``layer_container_base.py`` mechanism): family tables + one generic
+converter. Numeric parity per family is covered by test_hf_checkpoint.py's
+11-model sweep; this file tests the MECHANISM."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.model_implementations.parameter_spec import (
+    FAMILY_SPECS, ParamSpec, convert_with_spec)
+
+
+class _Cfg:
+    num_layers = 2
+    num_heads = 2
+    head_dim = 4
+    hidden_size = 8
+    num_kv_heads = 2
+    rotary_dim = 4
+    tie_embeddings = False
+    qkv_bias = False
+
+
+def test_spec_tables_cover_all_v2_families():
+    assert set(FAMILY_SPECS) == {"llama", "mistral", "qwen2", "phi", "gpt2", "opt",
+                                 "bloom", "gptj", "gpt_neox", "falcon"}
+    # llama-family tables are shared; qwen2's biases come from the predicate
+    assert FAMILY_SPECS["llama"] is FAMILY_SPECS["qwen2"]
+
+
+def test_invalid_rows_rejected_at_table_build():
+    with pytest.raises(ValueError, match="unknown transform"):
+        ParamSpec("a.b", "src", transform="nope")
+    with pytest.raises(ValueError, match="unknown predicate"):
+        ParamSpec("a.b", "src", when="nope")
+
+
+def test_per_layer_stacking_transform_and_predicates():
+    cfg = _Cfg()
+    rng = np.random.default_rng(0)
+    sd = {"emb": rng.normal(size=(10, 8)).astype(np.float32)}
+    for i in range(2):
+        sd[f"l.{i}.w"] = rng.normal(size=(8, 8)).astype(np.float32)
+    spec = (
+        ParamSpec("embed.embedding", "emb"),
+        ParamSpec("blocks.w", "l.{i}.w", "t", per_layer=True),
+        ParamSpec("blocks.zb", transform="zeros_hidden", per_layer=True),
+        ParamSpec("lm_head.kernel", "missing.weight", "t", when="qkv_bias"),  # gated OFF
+    )
+    out = convert_with_spec(sd, cfg, spec)
+    np.testing.assert_array_equal(out["embed"]["embedding"], sd["emb"])
+    assert out["blocks"]["w"].shape == (2, 8, 8)
+    np.testing.assert_array_equal(out["blocks"]["w"][1], sd["l.1.w"].T)
+    np.testing.assert_array_equal(out["blocks"]["zb"], np.zeros((2, 8), np.float32))
+    assert "lm_head" not in out  # predicate False -> row skipped, no key error
+
+
+def test_multi_target_split_and_missing_source_is_loud():
+    cfg = _Cfg()
+    rng = np.random.default_rng(1)
+    # bloom-style per-head interleaved fused qkv: [(nh*3*hd), H]
+    w = rng.normal(size=(2 * 3 * 4, 8)).astype(np.float32)
+    sd = {"l.0.qkv": w, "l.1.qkv": w}
+    spec = (ParamSpec(("blocks.wq", "blocks.wk", "blocks.wv"), "l.{i}.qkv",
+                      "qkv_interleaved", per_layer=True), )
+    out = convert_with_spec(sd, cfg, spec)
+    w3 = w.reshape(2, 3, 4, 8)
+    np.testing.assert_array_equal(out["blocks"]["wk"][0], w3[:, 1].reshape(8, 8).T)
+    # a missing source must raise naming the row, not skip silently
+    with pytest.raises(KeyError, match="l.0.gone.*blocks.wq"):
+        convert_with_spec(sd, cfg, (ParamSpec("blocks.wq", "l.{i}.gone", "t",
+                                              per_layer=True), ))
