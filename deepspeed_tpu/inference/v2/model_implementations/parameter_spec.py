@@ -120,9 +120,11 @@ TRANSFORMS: Dict[str, Callable] = {
 # predicates: (cfg, sd) -> bool, gating conditional rows
 PREDICATES: Dict[str, Callable] = {
     "untied": lambda cfg, sd: not cfg.tie_embeddings,
-    # direct attribute access on purpose: a cfg missing the flag should raise,
-    # not silently skip the qwen2 bias rows (loud-failure policy)
-    "qkv_bias": lambda cfg, sd: bool(cfg.qkv_bias),
+    # qkv_bias_enabled is what the FORWARD consults (qkv_bias with a use_bias
+    # fallback, transformer.py:129) — the converter must agree with it or the
+    # forward KeyErrors on layer['bq']. Direct attribute access on purpose: a
+    # cfg missing the property should raise, not silently skip bias rows.
+    "qkv_bias": lambda cfg, sd: bool(cfg.qkv_bias_enabled),
     # falcon's 40b/180b decoder names its two parallel norms ln_attn/ln_mlp;
     # detected from the checkpoint itself, as the HF loaders do
     "falcon_new_arch": lambda cfg, sd: "transformer.h.0.ln_attn.weight" in sd,

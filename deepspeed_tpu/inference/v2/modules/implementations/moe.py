@@ -49,3 +49,39 @@ class TopKGatedMoE(DSMoEBase):
             act = jax.nn.gelu(up)
         out = jnp.einsum("etf,efh->eth", act, expert_down.astype(dt))
         return jnp.einsum("te,eth->th", combine, out)
+
+
+@DSMoERegistry.register_module
+class GroupedGemmMoE(DSMoEBase):
+    """Grouped ragged-matmul MoE (reference cutlass_ops moe_gemm analog):
+    expert-sorted tokens through the Pallas grouped GEMM
+    (``ops/pallas/grouped_matmul.py``) — FFN work scales with the T*k routed
+    tokens instead of the dense-dispatch T*E. The large-E serving choice;
+    select via ``modules={"moe": "grouped_gemm_moe"}`` or ConfigBundle name."""
+
+    @staticmethod
+    def name() -> str:
+        return "grouped_gemm_moe"
+
+    @staticmethod
+    def supports_config(config) -> bool:
+        return 1 <= config.top_k <= config.n_experts
+
+    def __call__(self, x, gate_w, expert_up, expert_gate, expert_down):
+        """Same contract as :class:`TopKGatedMoE`."""
+        from deepspeed_tpu.moe.grouped import grouped_moe_ffn
+
+        cfg = self.config
+        dt = cfg.dtype
+        logits = jnp.einsum("th,he->te", x, gate_w.astype(dt)).astype(jnp.float32)
+        top_vals, top_idx = jax.lax.top_k(logits, cfg.top_k)
+        weights = jax.nn.softmax(top_vals, axis=-1).astype(dt)
+
+        def act(up, gate):
+            return jax.nn.silu(gate) * up if gate is not None else jax.nn.gelu(up)
+
+        # routing goes in precomputed (idx, weights) form — no dense [T, E]
+        # scatter + re-top-k round trip (the O(T*E) work this path avoids)
+        return grouped_moe_ffn(x.astype(dt), None, expert_up, expert_down,
+                               top_k=cfg.top_k, wg=expert_gate, activation=act,
+                               top_idx=top_idx, top_w=weights)

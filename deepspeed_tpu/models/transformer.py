@@ -94,6 +94,11 @@ class TransformerConfig:
     moe_min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
     moe_noisy_gate_policy: Optional[str] = None
+    # "einsum": the [S, E, C] one-hot dispatch/combine (EP-shardable, the
+    # GSPMD default); "grouped": the megablocks-style Pallas ragged matmul
+    # (ops/pallas/grouped_matmul.py) — work scales with routed tokens, the
+    # single-shard win at large E (reference cutlass_ops moe_gemm analog)
+    moe_impl: str = "einsum"
     # ZeRO++ qwZ (reference partition_parameters.py:1139 quantized all-gather
     # handles): when set (by the engine, from zero_quantized_weights), the
     # per-layer stage-3 weight gathers inside the scan body travel as int8
@@ -102,6 +107,8 @@ class TransformerConfig:
     quantized_weights: bool = False
 
     def __post_init__(self):
+        if self.moe_impl not in ("einsum", "grouped"):
+            raise ValueError(f"moe_impl must be 'einsum' or 'grouped', got {self.moe_impl!r}")
         if self.intermediate_size is None:
             if self.mlp == "swiglu":
                 self.intermediate_size = int(8 * self.hidden_size / 3 / 128 + 1) * 128
@@ -553,6 +560,21 @@ def _moe_mlp(cfg: TransformerConfig, layer, h, rng=None, constrain=True):
         l_aux, combine, dispatch = jax.vmap(gate_row)(logits, keys)
     else:
         l_aux, combine, dispatch = jax.vmap(lambda lg: gate_row(lg, None))(logits)
+
+    if cfg.moe_impl == "grouped":
+        # grouped ragged-matmul path: FFN work scales with routed tokens
+        # (B*S*k + alignment), not B*S*E*C. Kept set and gate weights come
+        # from the SAME capacity gating above, so numerics match the einsum
+        # path. Global sort/scatter makes this the single-shard choice; the
+        # einsum path remains the EP/GSPMD default.
+        from ..moe.grouped import grouped_moe_ffn
+
+        w_se = combine.sum(axis=3).reshape(B * S, E).astype(dt)  # [B*S, E]
+        y = grouped_moe_ffn(
+            h.reshape(B * S, H), w_se, layer["moe_wi"], layer["moe_wo"],
+            top_k=cfg.moe_top_k, wg=layer.get("moe_wg") if cfg.mlp == "swiglu" else None,
+            activation=lambda up, gate: mlp_activation(cfg, up, gate))
+        return y.reshape(B, S, H), jnp.mean(l_aux)
 
     dispatched = jnp.einsum("bsec,bsm->becm", dispatch.astype(dt), h)
     if constrain:

@@ -1,3 +1,4 @@
+from .grouped import block_align_dispatch, grouped_moe_ffn
 from .layer import MoE
 from .mappings import drop_tokens, drop_tokens_constraint, gather_tokens, gather_tokens_constraint
 from .sharded_moe import MOELayer, TopKGate, top1gating, top2gating

@@ -1,0 +1,192 @@
+"""Ragged grouped matmul (megablocks-style) for MoE expert FFNs.
+
+Reference counterpart: the CUTLASS grouped expert GEMM
+(``inference/v2/kernels/cutlass_ops/`` moe_gemm) — E variable-size GEMMs,
+one per expert, over that expert's gathered tokens. SURVEY §2.3 plans the
+TPU version as a Pallas ragged matmul; VERDICT r4 missing #5 flagged the
+one-hot ``[S, E, C]`` dispatch einsum as the scaling bottleneck at large E.
+
+TPU-first formulation: dynamic per-expert row counts are shape-hostile, so
+the DISPATCHER block-aligns every expert's token group (each group padded to
+a multiple of the row-block size, zero rows) and hands the kernel a
+scalar-prefetched ``block_expert[i]`` table — the expert owning row block
+``i``. Every row block then multiplies exactly one expert's weight block, so
+the kernel is a plain tiled matmul whose RHS block index is data-dependent
+through the prefetch table (the same mechanism the block-sparse attention
+kernel uses for its column LUT). Work scales with actual tokens
+(+ at most one padding block per expert), not with S*E*C.
+
+Two kernels:
+  - :func:`gmm`  — ``[T, K] x [E, K, N] -> [T, N]``: row block i uses
+    ``rhs[block_expert[i]]`` (forward, and dx with rhs transposed).
+  - :func:`tgmm` — ``[T, K] x [T, N] -> [E, K, N]``: per-expert
+    ``x_e^T @ dy_e`` accumulated across that expert's row blocks (dw).
+    Requires every expert to own >=1 row block (the dispatcher's padding
+    guarantees it) so every output block is written.
+
+:func:`grouped_matmul` wraps gmm with a custom VJP so the training MoE layer
+can differentiate through it.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _fit_block(dim: int, preferred: int) -> int:
+    """Largest power-of-two block <= preferred that divides dim (1 worst case)."""
+    b = preferred
+    while b > 1 and dim % b != 0:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_t", "block_k", "block_n", "interpret"))
+def gmm(lhs, rhs, block_expert, block_t=128, block_k=512, block_n=512, interpret=False):
+    """Grouped matmul ``out[i*bt:(i+1)*bt] = lhs[i*bt:(i+1)*bt] @
+    rhs[block_expert[i]]``.
+
+    lhs: [T, K] block-aligned expert-sorted rows; rhs: [E, K, N] stacked
+    expert weights; block_expert: [T//block_t] int32 (non-decreasing).
+    Returns [T, N] in lhs.dtype; fp32 accumulation.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, K = lhs.shape
+    E, K2, N = rhs.shape
+    assert K == K2, f"contraction mismatch {K} vs {K2}"
+    # block_t is a CONTRACT with the dispatcher (block_expert's shape is tied
+    # to it) — never refit it; K/N tiles are free to shrink to fit
+    bt = block_t
+    assert T % bt == 0, f"T={T} must be a multiple of block_t={bt} (block-aligned dispatch)"
+    bk = _fit_block(K, block_k)
+    bn = _fit_block(N, block_n)
+    nt, nk, nn = T // bt, K // bk, N // bn
+    assert block_expert.shape == (nt, ), \
+        f"block_expert must be [{nt}] for T={T}, block_t={bt}, got {block_expert.shape}"
+
+    def kernel(be_ref, x_ref, w_ref, o_ref, acc_ref):
+        k = pl.program_id(2)
+
+        @pl.when(k == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += jax.lax.dot(x_ref[...].astype(jnp.float32),
+                                  w_ref[0].astype(jnp.float32),
+                                  preferred_element_type=jnp.float32)
+
+        @pl.when(k == nk - 1)
+        def _store():
+            o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt, nn, nk),
+        in_specs=[
+            pl.BlockSpec((bt, bk), lambda i, j, k, be: (i, k)),
+            pl.BlockSpec((1, bk, bn), lambda i, j, k, be: (be[i], k, j)),
+        ],
+        out_specs=pl.BlockSpec((bt, bn), lambda i, j, k, be: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bt, bn), jnp.float32)],
+    )
+    return pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=jax.ShapeDtypeStruct((T, N), lhs.dtype),
+                          interpret=interpret)(block_expert, lhs, rhs)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_experts", "block_t", "block_k", "block_n", "interpret"))
+def tgmm(lhs, dy, block_expert, num_experts, block_t=128, block_k=512, block_n=512,
+         interpret=False):
+    """Per-expert weight gradient ``out[e] = sum_{i: be[i]=e}
+    lhs_block_i^T @ dy_block_i`` → [E, K, N] (fp32).
+
+    ``block_expert`` must be non-decreasing AND cover every expert in
+    [0, num_experts) at least once (block-aligned dispatch guarantees both);
+    otherwise an absent expert's output block would never be written.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, K = lhs.shape
+    T2, N = dy.shape
+    assert T == T2, f"row mismatch {T} vs {T2}"
+    bt = block_t  # dispatcher contract, same as gmm
+    assert T % bt == 0, f"T={T} must be a multiple of block_t={bt} (block-aligned dispatch)"
+    bk = _fit_block(K, block_k)
+    bn = _fit_block(N, block_n)
+    nt, nk, nn = T // bt, K // bk, N // bn
+    assert block_expert.shape == (nt, ), \
+        f"block_expert must be [{nt}] for T={T}, block_t={bt}, got {block_expert.shape}"
+
+    def kernel(be_ref, x_ref, dy_ref, o_ref, acc_ref):
+        t = pl.program_id(2)
+        e = be_ref[t]
+        # group boundaries: zero the accumulator on the first block of each
+        # expert's run, write back on the last (out block changes there)
+        first = jnp.logical_or(t == 0, be_ref[jnp.maximum(t - 1, 0)] != e)
+        last = jnp.logical_or(t == nt - 1, be_ref[jnp.minimum(t + 1, nt - 1)] != e)
+
+        @pl.when(first)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += jax.lax.dot_general(
+            x_ref[...].astype(jnp.float32), dy_ref[...].astype(jnp.float32),
+            dimension_numbers=(((0, ), (0, )), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(last)
+        def _store():
+            o_ref[0] = acc_ref[:]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nk, nn, nt),
+        in_specs=[
+            pl.BlockSpec((bt, bk), lambda i, j, t, be: (t, i)),
+            pl.BlockSpec((bt, bn), lambda i, j, t, be: (t, j)),
+        ],
+        out_specs=pl.BlockSpec((1, bk, bn), lambda i, j, t, be: (be[t], i, j)),
+        scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
+    )
+    return pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=jax.ShapeDtypeStruct((num_experts, K, N), jnp.float32),
+                          interpret=interpret)(block_expert, lhs, dy)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, ))
+def _gm(lhs, rhs, block_expert, opts):
+    bt, bk, bn, interpret = opts
+    return gmm(lhs, rhs, block_expert, bt, bk, bn, interpret)
+
+
+def _gm_fwd(lhs, rhs, block_expert, opts):
+    return _gm(lhs, rhs, block_expert, opts), (lhs, rhs, block_expert)
+
+
+def _gm_bwd(opts, res, dy):
+    import numpy as np
+
+    lhs, rhs, block_expert = res
+    bt, bk, bn, interpret = opts
+    dy = dy.astype(lhs.dtype)
+    dx = gmm(dy, rhs.transpose(0, 2, 1), block_expert, bt, bk, bn, interpret)
+    dw = tgmm(lhs, dy, block_expert, rhs.shape[0], bt, bk, bn, interpret).astype(rhs.dtype)
+    # block_expert is integer routing metadata: float0 cotangent
+    return dx, dw, np.zeros(block_expert.shape, dtype=jax.dtypes.float0)
+
+
+_gm.defvjp(_gm_fwd, _gm_bwd)
+
+
+def grouped_matmul(lhs, rhs, block_expert, block_t=128, block_k=512, block_n=512,
+                   interpret=False):
+    """Differentiable grouped matmul: gmm forward; backward dx via gmm
+    against the transposed expert weights, dw via tgmm. ``block_expert`` is
+    an explicit primal (not a closure capture) so the VJP stays valid inside
+    scans/jits where the table is itself a traced value."""
+    return _gm(lhs, rhs, block_expert, (block_t, block_k, block_n, interpret))

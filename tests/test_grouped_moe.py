@@ -1,0 +1,228 @@
+"""Grouped-GEMM MoE (Pallas ragged matmul, interpret mode on CPU) vs the
+one-hot einsum dispatch — reference counterpart: cutlass_ops moe_gemm
+(VERDICT r4 missing #5, SURVEY §2.3 'megablocks-style ragged matmul')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.moe.grouped import block_align_dispatch, grouped_moe_ffn
+from deepspeed_tpu.ops.pallas.grouped_matmul import gmm, grouped_matmul, tgmm
+
+
+def _ref_gmm(lhs, rhs, block_expert, bt):
+    out = np.zeros((lhs.shape[0], rhs.shape[2]), np.float32)
+    for i, e in enumerate(np.asarray(block_expert)):
+        out[i * bt:(i + 1) * bt] = np.asarray(lhs[i * bt:(i + 1) * bt], np.float32) @ \
+            np.asarray(rhs[e], np.float32)
+    return out
+
+
+def test_gmm_matches_per_block_reference():
+    rng = np.random.default_rng(0)
+    T, K, N, E, bt = 64, 32, 48, 3, 8
+    lhs = jnp.asarray(rng.normal(size=(T, K)), jnp.float32)
+    rhs = jnp.asarray(rng.normal(size=(E, K, N)), jnp.float32)
+    be = jnp.asarray(rng.integers(0, E, size=T // bt).astype(np.int32))
+    be = jnp.sort(be)  # kernel contract: non-decreasing
+    out = gmm(lhs, rhs, be, block_t=bt, block_k=16, block_n=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), _ref_gmm(lhs, rhs, be, bt),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tgmm_matches_per_expert_reference():
+    rng = np.random.default_rng(1)
+    T, K, N, E, bt = 64, 32, 16, 4, 8
+    lhs = jnp.asarray(rng.normal(size=(T, K)), jnp.float32)
+    dy = jnp.asarray(rng.normal(size=(T, N)), jnp.float32)
+    # every expert owns >=1 block (the kernel contract)
+    be = jnp.asarray(np.sort(np.concatenate([np.arange(E),
+                                             rng.integers(0, E, size=T // bt - E)])
+                             ).astype(np.int32))
+    out = tgmm(lhs, dy, be, E, block_t=bt, block_k=16, block_n=16, interpret=True)
+    ref = np.zeros((E, K, N), np.float32)
+    for i, e in enumerate(np.asarray(be)):
+        ref[e] += np.asarray(lhs[i * bt:(i + 1) * bt]).T @ np.asarray(dy[i * bt:(i + 1) * bt])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_matmul_gradients():
+    """custom VJP: dx and dw must match autodiff through the dense oracle."""
+    rng = np.random.default_rng(2)
+    T, K, N, E, bt = 32, 16, 24, 2, 8
+    lhs = jnp.asarray(rng.normal(size=(T, K)), jnp.float32)
+    rhs = jnp.asarray(rng.normal(size=(E, K, N)), jnp.float32)
+    be = jnp.asarray(np.sort(np.concatenate([np.arange(E), [0, 1]])).astype(np.int32))
+
+    def loss_grouped(lhs, rhs):
+        return jnp.sum(grouped_matmul(lhs, rhs, be, block_t=bt, block_k=8, block_n=8,
+                                      interpret=True) ** 2)
+
+    def loss_dense(lhs, rhs):
+        w_rows = rhs[be]  # [nt, K, N]
+        x_blocks = lhs.reshape(-1, bt, K)
+        out = jnp.einsum("tbk,tkn->tbn", x_blocks, w_rows).reshape(T, N)
+        return jnp.sum(out ** 2)
+
+    gx, gw = jax.grad(loss_grouped, argnums=(0, 1))(lhs, rhs)
+    rx, rw = jax.grad(loss_dense, argnums=(0, 1))(lhs, rhs)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=1e-4, atol=1e-4)
+
+
+def test_block_align_dispatch_structure():
+    w_se = jnp.asarray([[0.7, 0.0, 0.3],
+                        [0.0, 1.0, 0.0],
+                        [0.2, 0.8, 0.0],
+                        [0.0, 0.0, 0.0]], jnp.float32)  # last token dropped
+    tok, w_slot, dest, block_expert, T_pad = block_align_dispatch(w_se, top_k=2,
+                                                                 block_rows=4)
+    assert T_pad % 4 == 0 and block_expert.shape == (T_pad // 4, )
+    # non-decreasing block table covering every expert at least once
+    beh = np.asarray(block_expert)
+    assert (np.diff(beh) >= 0).all()
+    assert set(range(3)) <= set(beh.tolist())
+    # destinations are unique and live inside SOME expert's block-aligned
+    # group whose block table row matches that expert
+    assert len(set(np.asarray(dest).tolist())) == dest.shape[0]
+    # recompute the routing the dispatcher used (top-2 over w_se) and check
+    # each slot's dest row falls in a block owned by its routed expert
+    wv, idx = jax.lax.top_k(w_se, 2)
+    routed = np.asarray(idx).reshape(-1)
+    order = np.argsort(routed, kind="stable")
+    for slot_expert, d in zip(routed[order], np.asarray(dest)):
+        assert beh[d // 4] == slot_expert, (slot_expert, int(d))
+
+
+@pytest.mark.parametrize("top_k,mlp", [(1, "gelu"), (2, "gelu"), (2, "swiglu")])
+def test_grouped_ffn_matches_einsum_dispatch(top_k, mlp):
+    """End-to-end parity: same gate outputs → grouped path == the [S,E,C]
+    one-hot dispatch/combine einsum, including dropped tokens."""
+    from deepspeed_tpu.moe.sharded_moe import top1gating, top2gating
+
+    rng = np.random.default_rng(3)
+    S, M, F, E = 32, 16, 24, 4
+    x = jnp.asarray(rng.normal(size=(S, M)), jnp.float32)
+    logits = jnp.asarray(rng.normal(size=(S, E)), jnp.float32)
+    gate_fn = top1gating if top_k == 1 else top2gating
+    _, combine, dispatch, capacity = gate_fn(logits, 1.0, 4)
+    wi = jnp.asarray(rng.normal(size=(E, M, F)), jnp.float32) / np.sqrt(M)
+    wo = jnp.asarray(rng.normal(size=(E, F, M)), jnp.float32) / np.sqrt(F)
+    wg = jnp.asarray(rng.normal(size=(E, M, F)), jnp.float32) / np.sqrt(M) \
+        if mlp == "swiglu" else None
+
+    def act(up, gate):
+        return jax.nn.silu(gate) * up if gate is not None else jax.nn.gelu(up)
+
+    # einsum path (sharded_moe formulation)
+    dispatched = jnp.einsum("sec,sm->ecm", dispatch, x)
+    up = jnp.einsum("ecm,emf->ecf", dispatched, wi)
+    g = jnp.einsum("ecm,emf->ecf", dispatched, wg) if wg is not None else None
+    mid = act(up, g)
+    eo = jnp.einsum("ecf,efm->ecm", mid, wo)
+    y_ref = jnp.einsum("sec,ecm->sm", combine, eo)
+
+    w_se = combine.sum(axis=2)  # [S, E] per-token kept weights
+    y = grouped_moe_ffn(x, w_se, wi, wo, top_k=top_k, wg=wg, activation=act,
+                        block_rows=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=2e-4, atol=2e-4)
+
+
+def test_grouped_ffn_is_differentiable():
+    rng = np.random.default_rng(5)
+    S, M, F, E = 16, 8, 16, 2
+    x = jnp.asarray(rng.normal(size=(S, M)), jnp.float32)
+    w_se = jax.nn.softmax(jnp.asarray(rng.normal(size=(S, E)), jnp.float32))
+    wi = jnp.asarray(rng.normal(size=(E, M, F)), jnp.float32)
+    wo = jnp.asarray(rng.normal(size=(E, F, M)), jnp.float32)
+
+    def loss(wi, wo, w_se):
+        return jnp.sum(grouped_moe_ffn(x, w_se, wi, wo, top_k=1, block_rows=8,
+                                       interpret=True) ** 2)
+
+    gwi, gwo, gse = jax.grad(loss, argnums=(0, 1, 2))(wi, wo, w_se)
+    assert np.isfinite(np.asarray(gwi)).all() and np.abs(np.asarray(gwi)).max() > 0
+    assert np.isfinite(np.asarray(gwo)).all() and np.abs(np.asarray(gwo)).max() > 0
+    assert np.isfinite(np.asarray(gse)).all()
+
+
+def test_moelayer_grouped_impl_matches_einsum():
+    """MOELayer(moe_impl='grouped') == MOELayer(moe_impl='einsum') on the
+    same params/tokens (identical gating, different dispatch mechanism)."""
+    from deepspeed_tpu.moe.sharded_moe import MOELayer, TopKGate
+
+    rng = np.random.default_rng(7)
+    S, M, F, E = 24, 16, 32, 4
+    gate = TopKGate(M, E, k=2)
+    einsum_layer = MOELayer(gate, M, F, num_local_experts=E)
+    grouped_layer = MOELayer(gate, M, F, num_local_experts=E, moe_impl="grouped")
+    params = einsum_layer.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(rng.normal(size=(S, M)), jnp.float32)
+    y_e, aux_e = einsum_layer(params, x, train=False)
+    y_g, aux_g = grouped_layer(params, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_g), np.asarray(y_e), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(aux_g), float(aux_e), rtol=1e-6)
+    # EP + grouped is rejected loudly, not silently wrong
+    with pytest.raises(NotImplementedError, match="expert parallelism"):
+        MOELayer(gate, M, F, num_local_experts=2, ep_axis="data", ep_size=2,
+                 moe_impl="grouped")
+    with pytest.raises(ValueError, match="moe_impl"):
+        MOELayer(gate, M, F, num_local_experts=E, moe_impl="banana")
+
+
+def test_transformer_moe_impl_grouped_forward_and_grad():
+    """cfg.moe_impl='grouped' : same loss as einsum dispatch, and the fused
+    train path stays differentiable through the custom-VJP kernels."""
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.models.transformer import forward_with_aux, init_params
+
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, 64, size=(2, 16)).astype(np.int32)
+
+    def run(impl):
+        cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                                intermediate_size=32, max_seq_len=32, dtype=jnp.float32,
+                                attention_impl="reference", moe_num_experts=4,
+                                moe_top_k=2, moe_impl=impl)
+        params = init_params(cfg, jax.random.PRNGKey(1))
+
+        def loss(p):
+            logits, aux = forward_with_aux(cfg, p, jnp.asarray(ids))
+            return jnp.mean(logits ** 2) + aux
+
+        val, grads = jax.value_and_grad(loss)(params)
+        return float(val), grads
+
+    v_e, g_e = run("einsum")
+    v_g, g_g = run("grouped")
+    np.testing.assert_allclose(v_g, v_e, rtol=5e-4)
+    ge = np.asarray(g_e["blocks"]["moe_wi"])
+    gg = np.asarray(g_g["blocks"]["moe_wi"])
+    np.testing.assert_allclose(gg, ge, rtol=5e-3, atol=1e-5)
+
+
+def test_v2_grouped_gemm_moe_matches_dense_dispatch_module():
+    """Registry-selected grouped_gemm_moe == top_k_gated_moe on the same
+    weights (the serving dense-dispatch oracle)."""
+    from deepspeed_tpu.inference.v2.modules import DSMoERegistry
+    from deepspeed_tpu.inference.v2.modules.configs import DSMoEConfig
+    from deepspeed_tpu.inference.v2.modules.module_registry import ConfigBundle
+
+    T, H, F, E, K = 12, 16, 32, 4, 2
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(T, H)), jnp.float32)
+    gate_w = jnp.asarray(rng.normal(size=(H, E)), jnp.float32)
+    up = jnp.asarray(rng.normal(size=(E, H, F)) * 0.1, jnp.float32)
+    gt = jnp.asarray(rng.normal(size=(E, H, F)) * 0.1, jnp.float32)
+    down = jnp.asarray(rng.normal(size=(E, F, H)) * 0.1, jnp.float32)
+
+    def build(name):
+        return DSMoERegistry.instantiate_config(ConfigBundle(
+            name=name, config=DSMoEConfig(n_experts=E, top_k=K, activation="swiglu",
+                                          dtype=jnp.float32)))
+
+    dense = build("top_k_gated_moe")(x, gate_w, up, gt, down)
+    grouped = build("grouped_gemm_moe")(x, gate_w, up, gt, down)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)

@@ -19,7 +19,7 @@ class _Cfg:
     num_kv_heads = 2
     rotary_dim = 4
     tie_embeddings = False
-    qkv_bias = False
+    qkv_bias_enabled = False  # what the forward (and so the predicate) consults
 
 
 def test_spec_tables_cover_all_v2_families():

@@ -339,3 +339,49 @@ def test_v2_engine_serving_on_chip_bf16_and_int8():
     # greedy agreement for a short horizon (int8 quantization noise may
     # eventually diverge a long rollout; the first steps must match)
     assert outs["bf16"][:4] == outs["int8"][:4], outs
+
+
+def test_grouped_matmul_on_chip():
+    """Grouped ragged matmul (MoE expert GEMM) compiled by Mosaic: gmm
+    forward + tgmm weight-grad vs the per-block numpy oracle in bf16, and
+    the dispatcher end-to-end vs the einsum MoE path."""
+    from deepspeed_tpu.moe.grouped import grouped_moe_ffn
+    from deepspeed_tpu.moe.sharded_moe import top2gating
+    from deepspeed_tpu.ops.pallas.grouped_matmul import gmm, tgmm
+
+    rng = np.random.default_rng(0)
+    T, K, N, E, bt = 1024, 512, 512, 8, 128
+    lhs = jnp.asarray(rng.normal(size=(T, K)), jnp.bfloat16)
+    rhs = jnp.asarray(rng.normal(size=(E, K, N)), jnp.bfloat16)
+    be = jnp.asarray(np.sort(np.concatenate(
+        [np.arange(E), rng.integers(0, E, size=T // bt - E)])).astype(np.int32))
+    out = np.asarray(gmm(lhs, rhs, be, block_t=bt)).astype(np.float32)
+    ref = np.zeros((T, N), np.float32)
+    lf, rf = np.asarray(lhs, np.float32), np.asarray(rhs, np.float32)
+    for i, e in enumerate(np.asarray(be)):
+        ref[i * bt:(i + 1) * bt] = lf[i * bt:(i + 1) * bt] @ rf[e]
+    np.testing.assert_allclose(out, ref, rtol=5e-2, atol=5e-1)
+
+    dy = jnp.asarray(rng.normal(size=(T, N)), jnp.bfloat16)
+    dw = np.asarray(tgmm(lhs, dy, be, E, block_t=bt))
+    dwr = np.zeros((E, K, N), np.float32)
+    dyf = np.asarray(dy, np.float32)
+    for i, e in enumerate(np.asarray(be)):
+        dwr[e] += lf[i * bt:(i + 1) * bt].T @ dyf[i * bt:(i + 1) * bt]
+    np.testing.assert_allclose(dw, dwr, rtol=5e-2, atol=2.0)
+
+    # dispatcher end-to-end vs the einsum formulation, bf16 on chip
+    S, M, F, Ee = 512, 256, 512, 8
+    x = jnp.asarray(rng.normal(size=(S, M)), jnp.bfloat16)
+    logits = jnp.asarray(rng.normal(size=(S, Ee)), jnp.float32)
+    _, combine, dispatch, _ = top2gating(logits, 1.0, 4)
+    wi = jnp.asarray(rng.normal(size=(Ee, M, F)) / np.sqrt(M), jnp.bfloat16)
+    wo = jnp.asarray(rng.normal(size=(Ee, F, M)) / np.sqrt(F), jnp.bfloat16)
+    disp = jnp.einsum("sec,sm->ecm", dispatch.astype(x.dtype), x)
+    mid = jax.nn.gelu(jnp.einsum("ecm,emf->ecf", disp, wi))
+    y_ref = jnp.einsum("sec,ecm->sm", combine.astype(x.dtype),
+                       jnp.einsum("ecf,efm->ecm", mid, wo))
+    y = grouped_moe_ffn(x, combine.sum(axis=2).astype(x.dtype), wi, wo, top_k=2,
+                        activation=lambda up, g: jax.nn.gelu(up), block_rows=128)
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(y_ref, np.float32),
+                               rtol=1e-1, atol=2e-1)
