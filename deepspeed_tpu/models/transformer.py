@@ -890,16 +890,28 @@ def _ce_loss(logits, aux, use_onehot=False):
     return -token_ll.mean()
 
 
-def _stage_scan_fn(cfg: TransformerConfig):
+def _stage_scan_fn(cfg: TransformerConfig, with_aux: bool = False):
     """One pipeline stage: scan this stage's contiguous layer slice (shared
-    by the GPipe and 1F1B executors so the schedules cannot diverge)."""
+    by the GPipe and 1F1B executors so the schedules cannot diverge).
+    ``with_aux`` (MoE models): also return the stage's summed load-balancing
+    loss so the executors can thread it into the total loss."""
+    if cfg.moe_num_experts > 0 and cfg.moe_noisy_gate_policy:
+        # the stage fn runs gating with rng=None, which would silently turn
+        # Jitter/RSample off — pipeline and serial runs would optimize
+        # different objectives (same stance as PLD+pipeline, engine.py)
+        raise NotImplementedError(
+            f"moe_noisy_gate_policy={cfg.moe_noisy_gate_policy!r} does not compose with "
+            "pipeline parallelism yet (stage executors run gating without an rng); "
+            "disable the noisy gate or run without the pipe axis")
 
     def stage_fn(blocks_local, xb, sin, cos):
         def body(carry, layer):
-            y, _aux = _block(cfg, carry, layer, sin, cos, None, constrain=False)
-            return y, None
+            y, aux = _block(cfg, carry, layer, sin, cos, None, constrain=False)
+            return y, jnp.asarray(aux, jnp.float32)
 
-        y, _ = lax.scan(body, xb, blocks_local)
+        y, auxs = lax.scan(body, xb, blocks_local)
+        if with_aux:
+            return y, jnp.sum(auxs)
         return y
 
     return stage_fn
@@ -977,7 +989,7 @@ def pipeline_loss_fn(cfg: TransformerConfig, params, batches, rng=None, *, mesh,
     dt = cfg.dtype
     assert cfg.num_layers % num_stages == 0, (
         f"num_layers {cfg.num_layers} must divide evenly into {num_stages} pipeline stages")
-    assert cfg.moe_num_experts == 0, "MoE+pipeline composition not supported yet"
+    moe = cfg.moe_num_experts > 0
 
     x = params["embed"]["embedding"].astype(dt)[ids]  # [M, B, S, H]
     if cfg.positions == "learned":
@@ -985,8 +997,13 @@ def pipeline_loss_fn(cfg: TransformerConfig, params, batches, rng=None, *, mesh,
     sin, cos = rope_table(cfg, jnp.arange(S)) if cfg.positions == "rotary" else (
         jnp.zeros((S, 1)), jnp.zeros((S, 1)))
 
-    outs = pipeline_apply(_stage_scan_fn(cfg), params["blocks"], x, sin, cos, mesh=mesh, num_stages=num_stages,
-                          remat=True)  # [M, B, S, H]
+    outs = pipeline_apply(_stage_scan_fn(cfg, with_aux=moe), params["blocks"], x, sin, cos,
+                          mesh=mesh, num_stages=num_stages,
+                          remat=True, with_aux=moe)  # [M, B, S, H]
+    moe_aux = jnp.zeros([], jnp.float32)
+    if moe:
+        outs, aux_total = outs
+        moe_aux = cfg.moe_aux_loss_coef * aux_total / M  # mean over microbatches
     h = _norm(outs, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     if cfg.tie_embeddings:
         logits = jnp.einsum("mbsh,vh->mbsv", h, params["embed"]["embedding"].astype(dt))
@@ -1005,8 +1022,8 @@ def pipeline_loss_fn(cfg: TransformerConfig, params, batches, rng=None, *, mesh,
         # enabling pipe does not change the training objective
         mask = batches["loss_mask"][:, :, :token_ll.shape[2]].astype(jnp.float32)
         per_mb = -(token_ll * mask).sum(axis=(1, 2)) / jnp.maximum(mask.sum(axis=(1, 2)), 1.0)
-        return per_mb.mean()
-    return -token_ll.mean()
+        return per_mb.mean() + moe_aux
+    return -token_ll.mean() + moe_aux
 
 
 def pipeline_loss_fn_1f1b(cfg: TransformerConfig, params, batches, rng=None, *, mesh, num_stages: int):
@@ -1028,7 +1045,7 @@ def pipeline_loss_fn_1f1b(cfg: TransformerConfig, params, batches, rng=None, *, 
     dt = cfg.dtype
     assert cfg.num_layers % num_stages == 0, (
         f"num_layers {cfg.num_layers} must divide evenly into {num_stages} pipeline stages")
-    assert cfg.moe_num_experts == 0, "MoE+pipeline composition not supported yet"
+    moe = cfg.moe_num_experts > 0
 
     sin, cos = rope_table(cfg, jnp.arange(S)) if cfg.positions == "rotary" else (
         jnp.zeros((S, 1)), jnp.zeros((S, 1)))
@@ -1054,8 +1071,9 @@ def pipeline_loss_fn_1f1b(cfg: TransformerConfig, params, batches, rng=None, *, 
         xs, embed_vjp = jax.vjp(embed_fn, params)
         head_params = {k: params[k] for k in head_keys}
         loss, g_blocks, g_head, d_xs = pipeline_1f1b(
-            _stage_scan_fn(cfg), head_fn, params["blocks"], head_params, xs, aux, sin, cos,
-            mesh=mesh, num_stages=num_stages)
+            _stage_scan_fn(cfg, with_aux=moe), head_fn, params["blocks"], head_params, xs, aux,
+            sin, cos, mesh=mesh, num_stages=num_stages,
+            with_aux=moe, aux_weight=cfg.moe_aux_loss_coef)
         (grads, ) = embed_vjp(d_xs)  # full-tree cotangent (embedding only)
         grads = dict(grads)
         grads["blocks"] = g_blocks

@@ -39,7 +39,8 @@ def pipeline_apply(stage_fn: Callable,
                    pipe_axis: str = PIPE_AXIS,
                    data_axis: str = DATA_AXIS,
                    param_specs=None,
-                   remat: bool = True):
+                   remat: bool = True,
+                   with_aux: bool = False):
     """Run ``microbatches`` [M, b, ...] through a pipeline of ``num_stages``.
 
     ``stage_params``: pytree whose leaves have a leading layer dim divisible
@@ -49,6 +50,14 @@ def pipeline_apply(stage_fn: Callable,
     slice; ``consts`` are replicated side inputs (e.g. rope tables).
     Returns outputs [M, b, ...] (as produced by the last stage, broadcast to
     all stages for the head/loss computation).
+
+    ``with_aux``: the stage fn returns ``(y, aux_scalar)`` (e.g. the MoE
+    load-balancing loss summed over the stage's layers — the reference
+    accumulates it via ``MoE`` module attributes walked by the engine;
+    here it is an explicit dataflow value). Ticks where a stage holds no
+    real microbatch (fill/drain bubbles) are masked out. Returns
+    ``(outputs, aux_total)`` with ``aux_total`` summed over all stages and
+    microbatches; gradients flow through it under ``jax.grad``.
     """
     M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     if param_specs is None:
@@ -74,13 +83,20 @@ def pipeline_apply(stage_fn: Callable,
         outputs = jax.tree_util.tree_map(lambda x: _pipe_varying(jnp.zeros_like(x)), xs)
 
         def tick(carry, t):
-            recv, outputs = carry
+            recv, outputs, aux_acc = carry
             # stage 0 ingests microbatch t (clamped; masked-out after M)
             idx = jnp.clip(t, 0, M - 1)
             inject = jax.tree_util.tree_map(lambda x: x[idx], xs)
             x_in = jax.tree_util.tree_map(
                 lambda i, r: jnp.where(stage == 0, i, r), inject, recv)
-            y = fn(params_local, x_in, *consts)
+            if with_aux:
+                y, aux = fn(params_local, x_in, *consts)
+                # this stage is working on microbatch t-stage: mask bubbles
+                mf = t - stage
+                live = jnp.logical_and(mf >= 0, mf < M).astype(aux.dtype)
+                aux_acc = aux_acc + aux * live
+            else:
+                y = fn(params_local, x_in, *consts)
             # last stage writes its result for microbatch t-(S-1)
             out_idx = jnp.clip(t - (num_stages - 1), 0, M - 1)
             valid = jnp.logical_and(stage == num_stages - 1, t >= num_stages - 1)
@@ -95,20 +111,33 @@ def pipeline_apply(stage_fn: Callable,
             # is ignored by stage 0's inject select)
             perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
             recv = jax.tree_util.tree_map(lambda v: lax.ppermute(v, pipe_axis, perm), y)
-            return (recv, outputs), None
+            return (recv, outputs, aux_acc), None
 
-        (recv, outputs), _ = lax.scan(tick, (x0, outputs), jnp.arange(n_ticks))
+        aux0 = jnp.zeros([], jnp.float32)
+        try:
+            # aux is (pipe, data)-varying: params are pipe-sharded, x data-sharded
+            aux0 = lax.pcast(aux0, (pipe_axis, data_axis), to="varying")
+        except (AttributeError, TypeError):
+            pass
+        (recv, outputs, aux_acc), _ = lax.scan(
+            tick, (x0, outputs, aux0), jnp.arange(n_ticks))
         # broadcast last stage's outputs to every stage (head/loss is
         # computed replicated over pipe)
         outputs = jax.tree_util.tree_map(
             lambda o: lax.psum(jnp.where(stage == num_stages - 1, o, jnp.zeros_like(o)), pipe_axis), outputs)
+        if with_aux:
+            # each data shard computed the aux mean over ITS batch rows:
+            # pmean over data = the global batch mean (serial semantics);
+            # psum over pipe totals the per-stage layer sums
+            return outputs, lax.psum(lax.pmean(aux_acc, data_axis), pipe_axis)
         return outputs
 
     x_spec = jax.tree_util.tree_map(lambda _: P(None, data_axis), microbatches)
     const_specs = tuple(jax.tree_util.tree_map(lambda _: P(), c) for c in consts)
+    out_specs = (x_spec, P()) if with_aux else x_spec
     shard_fn = jax.shard_map(pipelined, mesh=mesh,
                              in_specs=(param_specs, x_spec) + const_specs,
-                             out_specs=x_spec)
+                             out_specs=out_specs)
     return shard_fn(stage_params, microbatches, *consts)
 
 
@@ -121,7 +150,9 @@ def pipeline_1f1b(stage_fn: Callable,
                   *consts,
                   mesh,
                   num_stages: int,
-                  pipe_axis: str = PIPE_AXIS):
+                  pipe_axis: str = PIPE_AXIS,
+                  with_aux: bool = False,
+                  aux_weight: float = 0.0):
     """Compiled 1F1B pipeline with hand-rolled per-tick VJPs.
 
     The reference's steady-state 1F1B (``runtime/pipe/schedule.py:189``
@@ -151,6 +182,14 @@ def pipeline_1f1b(stage_fn: Callable,
     ``stage_fn(stage_params_local, x, *consts) -> y`` applies one stage's
     contiguous layer slice. ``head_fn(head_params, y, aux_mb) -> scalar`` is
     the per-microbatch loss head (executed at the last stage).
+
+    ``with_aux``: the stage fn returns ``(y, aux_scalar)`` (MoE load-balance
+    loss summed over the stage's layers). The returned loss then includes
+    ``aux_weight * mean_over_microbatches(sum_over_stages(aux))`` and the
+    backward VJP seeds the aux cotangent with ``aux_weight / M`` so gate
+    gradients flow into the stage grads — the pipelined analog of
+    ``loss = ce + coef * moe_aux`` in the non-pipelined loss_fn.
+
     Returns ``(mean_loss, stage_grads, head_grads, d_microbatches)`` where
     ``stage_grads`` stays sharded over ``pipe`` (each stage owns its slice)
     and ``d_microbatches`` is the cotangent of the injected activations (for
@@ -174,7 +213,7 @@ def pipeline_1f1b(stage_fn: Callable,
         dxs0 = tree(jnp.zeros_like, xs)
 
         def tick(carry, t):
-            fwd_recv, bwd_recv, buf, g_params, g_head, d_xs, loss_acc = carry
+            fwd_recv, bwd_recv, buf, g_params, g_head, d_xs, loss_acc, aux_acc = carry
             mf = t - stage
             mb = t - (2 * last - stage)
             valid_f = jnp.logical_and(mf >= 0, mf < M)
@@ -184,7 +223,11 @@ def pipeline_1f1b(stage_fn: Callable,
             idx_f = jnp.clip(mf, 0, M - 1)
             inject = tree(lambda x: x[idx_f], xs)
             x_in = tree(lambda i, r: jnp.where(stage == 0, i, r), inject, fwd_recv)
-            y = stage_fn(params_local, x_in, *consts)
+            if with_aux:
+                y, aux_f = stage_fn(params_local, x_in, *consts)
+                aux_acc = aux_acc + aux_f.astype(jnp.float32) * valid_f.astype(jnp.float32)
+            else:
+                y = stage_fn(params_local, x_in, *consts)
             slot_f = idx_f % n_buf
             buf = tree(lambda b, v: b.at[slot_f].set(jnp.where(valid_f, v, b[slot_f])), buf, x_in)
 
@@ -216,7 +259,14 @@ def pipeline_1f1b(stage_fn: Callable,
             x_b = tree(lambda b: b[idx_b % n_buf], buf)
             g_in = tree(lambda d, r: jnp.where(stage == last, d, r), dy, bwd_recv)
             _, stage_vjp = jax.vjp(lambda pl, xx: stage_fn(pl, xx, *consts), params_local, x_b)
-            dparams, dx = stage_vjp(g_in)
+            if with_aux:
+                # cotangent of (y, aux): the aux term enters the total loss as
+                # aux_weight * aux / M; invalid ticks are masked by use_b below
+                # (dparams) and by the upstream stage's own mask (dx), exactly
+                # as the CE cotangent is
+                dparams, dx = stage_vjp((g_in, jnp.asarray(aux_weight / M, jnp.float32)))
+            else:
+                dparams, dx = stage_vjp(g_in)
             use_b = valid_b.astype(jnp.float32)
             g_params = tree(lambda a, g: a + g.astype(jnp.float32) * use_b, g_params, dparams)
             d_xs = tree(
@@ -229,15 +279,19 @@ def pipeline_1f1b(stage_fn: Callable,
             up = [(i, (i - 1) % S) for i in range(S)]
             fwd_recv = tree(lambda v: lax.ppermute(v, pipe_axis, down), y)
             bwd_recv = tree(lambda v: lax.ppermute(v, pipe_axis, up), dx)
-            return (fwd_recv, bwd_recv, buf, g_params, g_head, d_xs, loss_acc), None
+            return (fwd_recv, bwd_recv, buf, g_params, g_head, d_xs, loss_acc, aux_acc), None
 
-        carry0 = (x0, x0, buf0, gp0, gh0, dxs0, jnp.zeros([], jnp.float32))
-        (fwd_recv, bwd_recv, buf, g_params, g_head, d_xs, loss_acc), _ = lax.scan(
+        carry0 = (x0, x0, buf0, gp0, gh0, dxs0, jnp.zeros([], jnp.float32),
+                  jnp.zeros([], jnp.float32))
+        (fwd_recv, bwd_recv, buf, g_params, g_head, d_xs, loss_acc, aux_acc), _ = lax.scan(
             tick, carry0, jnp.arange(n_ticks))
 
         # loss / head grads accumulated only at the last stage, d_xs only at
         # stage 0 (zeros elsewhere): psum over pipe replicates them
         loss = lax.psum(loss_acc, pipe_axis) / M
+        if with_aux:
+            # every stage accumulated its own layers' aux: psum = model total
+            loss = loss + aux_weight * lax.psum(aux_acc, pipe_axis) / M
         g_head = tree(lambda g: lax.psum(g, pipe_axis), g_head)
         d_xs = tree(lambda d: lax.psum(jnp.where(stage == 0, d, jnp.zeros_like(d)), pipe_axis), d_xs)
         return loss, g_params, g_head, d_xs
