@@ -240,3 +240,44 @@ def test_reshape_meg_2d_rank_map():
     assert tp_g[0] == [0, 1] and tp_g[-1] == [6, 7]
     assert [0, 2] in dp_g and [5, 7] in dp_g
     assert [0, 4] in pp_g and [3, 7] in pp_g
+
+
+def test_elastic_remesh_resume_8_to_4(tmp_path):
+    """Elastic re-mesh resume (reference elasticity/elastic_agent restart
+    semantics + tests/unit/common.py:262 DistributedFixture asymmetric
+    world-size pattern): train on an 8-device mesh, save, resume on a
+    4-device mesh with the same global batch — the next step's loss must be
+    bit-identical to the uninterrupted 8-device run (orbax reshards the
+    ZeRO-partitioned states onto the new mesh at load)."""
+    from deepspeed_tpu.parallel import MeshConfig
+
+    def make_engine(n_dev, micro):
+        groups.reset()
+        groups.initialize_mesh(MeshConfig(data=n_dev), devices=jax.devices()[:n_dev])
+        conf = {
+            "train_batch_size": 16,
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "tpu": {"mesh": {"data": n_dev}},
+        }
+        return deepspeed_tpu.initialize(model=_model(), config=conf)[0]
+
+    rng = np.random.default_rng(11)
+    batches = [{"input_ids": rng.integers(0, 128, size=(16, 32), dtype=np.int32)}
+               for _ in range(3)]
+    eng8 = make_engine(8, 2)
+    for b in batches[:2]:
+        eng8.train_batch(b)
+    eng8.save_checkpoint(str(tmp_path), tag="elastic")
+    loss_ref = float(eng8.train_batch(batches[2]))
+
+    eng4 = make_engine(4, 4)
+    eng4.load_checkpoint(str(tmp_path), tag="elastic")
+    assert eng4.global_steps == 2
+    loss_resumed = float(eng4.train_batch(batches[2]))
+    # different mesh layouts may pick different reduction trees: tolerance,
+    # not bit-equality (it IS bit-exact on the CPU sim, but that's not the claim)
+    np.testing.assert_allclose(loss_resumed, loss_ref, rtol=1e-5, atol=1e-6)
+    groups.reset()
