@@ -5,4 +5,5 @@ metric analysis + the Megatron mmap indexed-dataset container."""
 from ..data_sampler import DeepSpeedDataSampler  # noqa: F401 — reference location alias
 from .data_analyzer import (DataAnalyzer, load_metric_to_sample,  # noqa: F401
                             load_sample_to_metric)
-from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder  # noqa: F401
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder,  # noqa: F401
+                              best_fitting_dtype, make_builder)

@@ -13,6 +13,7 @@ sequence count u64 · document count u64 · sizes i32[n] · pointers i64[n]
 """
 
 import os
+import shutil
 import struct
 from typing import Optional
 
@@ -20,6 +21,25 @@ import numpy as np
 
 _MAGIC = b"MMIDIDX\x00\x00"
 _VERSION = 1
+
+
+def best_fitting_dtype(vocab_size: Optional[int] = None) -> np.dtype:
+    """Smallest token dtype for a vocab (reference ``__best_fitting_dtype``
+    indexed_dataset.py:42): uint16 when ids fit, else int32."""
+    if vocab_size is not None and vocab_size < 65500:
+        return np.dtype(np.uint16)
+    return np.dtype(np.int32)
+
+
+def make_builder(out_file: str, impl: str = "mmap", vocab_size: Optional[int] = None, dtype=None):
+    """Builder factory (reference ``make_builder`` indexed_dataset.py:60).
+    ``impl`` is accepted for API compatibility; the mmap format is the only
+    implementation here (the legacy 'cached'/'lazy' formats are read paths
+    for pre-2020 corpora the TPU data layer does not ingest)."""
+    if impl not in ("mmap", "infer"):
+        raise ValueError(f"unsupported indexed-dataset impl {impl!r}: only 'mmap' is written")
+    return MMapIndexedDatasetBuilder(out_file, dtype=dtype if dtype is not None
+                                     else best_fitting_dtype(vocab_size))
 
 # dtype codes of the public format
 _CODE_TO_DTYPE = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
@@ -53,6 +73,27 @@ class MMapIndexedDatasetBuilder:
 
     def end_document(self) -> None:
         self._doc_idx.append(len(self._sizes))
+
+    def merge_file_(self, another_file: str) -> None:
+        """Append an already-finalized shard ``<another_file>.bin/.idx``
+        (reference ``MMapIndexedDatasetBuilder.merge_file_``
+        indexed_dataset.py:597) — the multi-shard assembly step of Megatron
+        preprocessing pipelines (each worker tokenizes a shard, rank 0 merges).
+        Sample data is streamed bin-to-bin; index entries are rebased."""
+        shard = MMapIndexedDataset(another_file)
+        assert shard._dtype == self._dtype, (
+            f"dtype mismatch merging {another_file}: shard {shard._dtype} vs builder {self._dtype}")
+        if self._sizes and len(self._doc_idx) == 1:
+            # locally-added items without end_document(): make the implicit
+            # one-doc-per-item boundaries explicit BEFORE rebasing the
+            # shard's doc offsets (finalize's fallback would misfire after)
+            self._doc_idx = list(range(len(self._sizes) + 1))
+        offset = len(self._sizes)
+        self._sizes.extend(int(s) for s in shard.sizes)
+        doc_idx = shard.doc_idx if len(shard.doc_idx) else np.asarray([0, len(shard.sizes)])
+        self._doc_idx.extend(int(offset + d) for d in doc_idx[1:])
+        with open(data_file_path(another_file), "rb") as f:
+            shutil.copyfileobj(f, self._bin)
 
     def finalize(self, index_file: str) -> None:
         self._bin.close()

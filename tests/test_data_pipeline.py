@@ -311,6 +311,66 @@ def test_indexed_dataset_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(ds.sizes), [5, 2, 3])
 
 
+def test_indexed_dataset_merge_shards(tmp_path):
+    """Multi-shard merge (reference ``MMapIndexedDatasetBuilder.merge_file_``
+    indexed_dataset.py:597): 3 worker-written shards assembled by a rank-0
+    builder — samples, sizes, and rebased document boundaries must round-trip
+    identically to a single-writer corpus."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampling import (MMapIndexedDataset,
+                                                                   best_fitting_dtype,
+                                                                   make_builder)
+
+    rng = np.random.default_rng(0)
+    shard_samples = []
+    for w in range(3):
+        prefix = str(tmp_path / f"shard{w}")
+        b = make_builder(prefix + ".bin", impl="mmap", vocab_size=50000)
+        docs = []
+        for d in range(w + 1):  # uneven shards: 1, 2, 3 docs
+            doc = [rng.integers(0, 50000, size=rng.integers(2, 9)).astype(np.uint16)
+                   for _ in range(2)]
+            for s in doc:
+                b.add_item(s)
+            b.end_document()
+            docs.append(doc)
+        b.finalize(prefix + ".idx")
+        shard_samples.append(docs)
+
+    # rank-0 assembly: local items first (implicit docs), then the 3 shards
+    merged = str(tmp_path / "merged")
+    mb = make_builder(merged + ".bin", vocab_size=50000)
+    head = np.asarray([1, 2, 3], np.uint16)
+    mb.add_item(head)
+    for w in range(3):
+        mb.merge_file_(str(tmp_path / f"shard{w}"))
+    mb.finalize(merged + ".idx")
+
+    ds = MMapIndexedDataset(merged)
+    flat = [head] + [s for docs in shard_samples for doc in docs for s in doc]
+    assert len(ds) == len(flat)
+    assert ds._dtype == best_fitting_dtype(50000)
+    for i, want in enumerate(flat):
+        np.testing.assert_array_equal(np.asarray(ds[i]), want)
+    # doc boundaries: [0, 1] for the local item, then each shard's docs
+    # rebased — shard w contributed w+1 docs of 2 samples each
+    want_docs = [0, 1]
+    pos = 1
+    for w in range(3):
+        for _ in range(w + 1):
+            pos += 2
+            want_docs.append(pos)
+    np.testing.assert_array_equal(np.asarray(ds.doc_idx), want_docs)
+
+    # dtype mismatch must refuse loudly
+    other = str(tmp_path / "other")
+    ob = make_builder(other + ".bin", vocab_size=100000)  # int32
+    ob.add_item(np.asarray([5], np.int32))
+    ob.finalize(other + ".idx")
+    bad = make_builder(str(tmp_path / "bad") + ".bin", vocab_size=50000)
+    with pytest.raises(AssertionError, match="dtype mismatch"):
+        bad.merge_file_(other)
+
+
 def test_data_analyzer_map_reduce_feeds_sampler(tmp_path):
     """DataAnalyzer (reference data_sampling/data_analyzer.py): 2-worker
     map-reduce over a toy corpus -> sample_to_metric + metric_to_sample
