@@ -152,6 +152,12 @@ class TPUConfig(DeepSpeedConfigModel):
     # default ('auto' == 'never' today); 'always' forces it (interpret mode
     # off-TPU) for experimentation and tests.
     pallas_fused_adam: Literal["auto", "always", "never"] = "auto"
+    # compile-only validation mode: state stays abstract (ShapeDtypeStructs
+    # with shardings — nothing materializes), so pod-scale configs (7B/70B on
+    # a 128-device mesh) can be AOT-lowered/compiled on hosts that could
+    # never hold the weights. train_batch() is unusable in this mode; use
+    # aot_lower_train_step() (tools/pod_validate.py)
+    abstract_init: bool = False
 
     def mesh_config(self) -> MeshConfig:
         known = {k: v for k, v in self.mesh.items() if k in ("data", "model", "pipe", "seq", "expert")}

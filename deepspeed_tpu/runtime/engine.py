@@ -497,10 +497,18 @@ class DeepSpeedEngine:
                     params)
             return state
 
-        with self.mesh:
-            state = init_fn(init_rng)
+        if self.config.tpu_config.abstract_init:
+            # compile-only validation: the state is the SHAPE of the state
+            state = jax.eval_shape(init_fn, init_rng)
+            state = jax.tree_util.tree_map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+                state, state_shardings)
+        else:
+            with self.mesh:
+                state = init_fn(init_rng)
         n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(state["params"]))
-        log_dist(f"initialized {n_params/1e6:.2f}M params sharded over mesh", ranks=[0])
+        log_dist(f"initialized {n_params/1e6:.2f}M params sharded over mesh"
+                 + (" (abstract)" if self.config.tpu_config.abstract_init else ""), ranks=[0])
         return state
 
     # ------------------------------------------------------------------
@@ -1003,6 +1011,28 @@ class DeepSpeedEngine:
         self._record_metrics(metrics)
         self._maybe_flops_profile(batch)
         return metrics["loss"]
+
+    def aot_lower_train_step(self, seq_len: int):
+        """AOT-lower the FULL fused train step with abstract inputs — no
+        state or batch ever materializes. The compile-only validation path
+        for pod-scale configs (BASELINE.md Llama-2-7B/70B on v5p-128):
+        ``.lower(...)`` proves the program + shardings trace/build;
+        ``.compile()`` on the result additionally runs GSPMD partitioning
+        and yields XLA's per-device memory analysis. Usable with or without
+        ``tpu.abstract_init`` (the state template is shapes either way)."""
+        gas = self.config.gradient_accumulation_steps
+        rows = self.train_batch_size() // gas
+        spec = [None, BATCH_AXES] + [SEQ_AXIS if self.seq_world_size > 1 else None]
+        batch_abs = {"input_ids": jax.ShapeDtypeStruct(
+            (gas, rows, seq_len), jnp.int32,
+            sharding=NamedSharding(self.mesh, P(*spec)))}
+        state_abs = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), self.state)
+        rng_abs = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        step = self._build_train_step(gas)
+        with self.mesh:
+            return step.lower(state_abs, batch_abs,
+                              jax.ShapeDtypeStruct(rng_abs.shape, rng_abs.dtype))
 
     def _maybe_flops_profile(self, batch):
         """Reference engine flops-profiler hook (``engine.py`` around
