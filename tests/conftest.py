@@ -27,6 +27,27 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption("--smoke", action="store_true", default=False,
+                     help="run only the ~5-minute smoke subset (tests/smoke.txt): "
+                          "one fast representative per subsystem, for quick CI "
+                          "iteration — the full suite remains the merge gate")
+
+
+def pytest_collection_modifyitems(config, items):
+    if not config.getoption("--smoke"):
+        return
+    smoke_file = os.path.join(os.path.dirname(__file__), "smoke.txt")
+    pats = [ln.strip() for ln in open(smoke_file)
+            if ln.strip() and not ln.startswith("#")]
+    keep, drop = [], []
+    for item in items:
+        (keep if any(p in item.nodeid for p in pats) else drop).append(item)
+    assert keep, "smoke.txt matched no tests — stale patterns?"
+    config.hook.pytest_deselected(items=drop)
+    items[:] = keep
+
+
 @pytest.fixture(autouse=True)
 def _reset_groups():
     from deepspeed_tpu.parallel import groups
