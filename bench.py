@@ -422,9 +422,45 @@ def _tpu_holder_diagnostics():
     return notes
 
 
+def _relay_port_check():
+    """Instant tunnel diagnostic learned in round 5: the axon PJRT plugin
+    rides a local stdio relay whose listeners die permanently when the
+    tunnel wedges (two concurrent clients, or remote-side failure). A TCP
+    connect to the relay ports distinguishes 'tunnel down' (refused — skip
+    the 60s jax probe entirely; jax HANGS rather than fails on a half-dead
+    tunnel) from 'relay up' in milliseconds. Best-effort: unknown layouts
+    return None (no judgement)."""
+    import socket
+
+    if "axon" not in os.environ.get("JAX_PLATFORMS", ""):
+        # not the relay layout (e.g. a direct-attached TPU VM): refused
+        # ports mean nothing here — let the real jax probe decide
+        return None, "axon relay not configured"
+    ports = (8082, 8083, 8087)
+    refused = 0
+    for port in ports:
+        s = socket.socket()
+        s.settimeout(2)
+        try:
+            s.connect(("127.0.0.1", port))
+            return True, f"relay port {port} accepting"
+        except ConnectionRefusedError:
+            refused += 1
+        except OSError:
+            pass
+        finally:
+            s.close()
+    if refused == len(ports):
+        return False, f"axon relay ports {ports} all refused connection (tunnel listeners dead)"
+    return None, "relay port state inconclusive"
+
+
 def _probe_tpu(probe_timeout):
     """One cheap subprocess probe. Returns (ok, reason) where reason carries
     the actual PJRT stderr excerpt, not just 'timed out'."""
+    relay_ok, relay_note = _relay_port_check()
+    if relay_ok is False:
+        return False, relay_note
     probe_src = ("import jax, json; d = jax.devices(); "
                  "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))")
     try:

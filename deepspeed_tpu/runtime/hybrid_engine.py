@@ -73,7 +73,9 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                                               eos_token_id=eos_token_id, **kwargs)
         np.asarray(out)  # sync for honest latency accounting
         self._generate_timer.stop()
-        self._latency.append(self._generate_timer.elapsed() / 1000.0)
+        # Timer.elapsed() returns SECONDS (unlike the reference's CUDA-event
+        # ms) — no conversion
+        self._latency.append(self._generate_timer.elapsed())
         if was_training:
             self.train()
         return out
