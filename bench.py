@@ -70,52 +70,84 @@ def bench_serving(on_tpu: bool):
                                 num_heads=16, num_kv_heads=16, intermediate_size=5632,
                                 max_seq_len=2048, norm="rmsnorm", positions="rotary",
                                 mlp="swiglu", dtype=jnp.bfloat16, attention_impl="flash")
-        n_seqs, prompt_len, decode_steps, block_size = 32, 512, 192, 128
-        n_blocks = n_seqs * (-(-(prompt_len + decode_steps + block_size) // block_size)) + 8
+        # int8 KV halves the pool: 64 tracked sequences fit where bf16 fit 32,
+        # and the bigger decode batch amortizes the 1.5 GB/step weight stream —
+        # the dominant serving-roofline term. DS_TPU_BENCH_NSEQS pins it; the
+        # ladder below falls back 64 -> 32 on OOM so a tight chip still
+        # produces a number instead of forfeiting the serving line.
+        n_seqs = int(os.environ.get("DS_TPU_BENCH_NSEQS", "64"))
+        prompt_len, decode_steps, block_size = 512, 192, 128
     else:  # CPU smoke
         cfg = TransformerConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
                                 intermediate_size=256, max_seq_len=512, dtype=jnp.float32,
                                 attention_impl="reference")
         n_seqs, prompt_len, decode_steps, block_size = 4, 64, 4, 64
-        n_blocks = 4 * 3 + 4
 
     model = TransformerLM(cfg)
-    icfg = RaggedInferenceEngineConfig()
-    icfg.kv_block_size = block_size
-    icfg.num_kv_blocks = n_blocks
-    # int8 KV (FastGen quantized-KV analog) halves the decode KV stream —
-    # the serving default on TPU, where the on-chip kernel suite has already
-    # validated the int8 paged kernel before this bench runs.
-    # DS_TPU_BENCH_KV=bf16 reverts.
-    kv_int8 = on_tpu and os.environ.get("DS_TPU_BENCH_KV", "int8") == "int8"
-    icfg.kv_dtype = "int8" if kv_int8 else cfg.dtype
-    icfg.state_manager.max_tracked_sequences = n_seqs
-    icfg.state_manager.max_ragged_sequence_count = n_seqs
-    icfg.state_manager.max_ragged_batch_size = max(prompt_len, n_seqs)
-    icfg.state_manager.max_context = prompt_len + decode_steps + block_size
-    engine = InferenceEngineV2(model, icfg)
-
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len, dtype=np.int32) for _ in range(n_seqs)]
+    warm_prompt = rng.integers(0, cfg.vocab_size, size=prompt_len, dtype=np.int32)
 
+    def build(ns, k8):
+        icfg = RaggedInferenceEngineConfig()
+        icfg.kv_block_size = block_size
+        icfg.num_kv_blocks = ns * (-(-(prompt_len + decode_steps + block_size) // block_size)) + 8
+        icfg.kv_dtype = "int8" if k8 else cfg.dtype
+        icfg.state_manager.max_tracked_sequences = ns
+        icfg.state_manager.max_ragged_sequence_count = ns
+        icfg.state_manager.max_ragged_batch_size = max(prompt_len, ns)
+        icfg.state_manager.max_context = prompt_len + decode_steps + block_size
+        return InferenceEngineV2(model, icfg)
+
+    # int8 KV (FastGen quantized-KV analog) halves the decode KV stream —
+    # the serving default on TPU (the on-chip kernel suite validates the int8
+    # paged kernel before this bench runs; DS_TPU_BENCH_KV=bf16 reverts).
+    # Fallback ladder: batch 64 -> 32, int8 -> bf16 — an OOM or a kernel
+    # failure costs one rung, never the serving number (r3 lesson). 64+bf16
+    # is omitted: by the sizing model above it cannot fit where 64+int8
+    # didn't. Each rung warms the FULL memory-heavy program set (all-seqs
+    # prefill + the widest decode scan) so a late OOM can't escape the
+    # ladder, and failed rungs drop their tracebacks + collect before the
+    # next build so dead buffers don't cascade-OOM the rungs that would fit.
+    horizon = 64 if on_tpu else 2
+    kv_int8 = on_tpu and os.environ.get("DS_TPU_BENCH_KV", "int8") == "int8"
+    ladder = [(n_seqs, kv_int8)]
+    if on_tpu and n_seqs > 32:
+        ladder.append((32, kv_int8))
+    if kv_int8:
+        ladder += [(ns, False) for ns, _ in ladder if ns <= 32] or [(32, False)]
+
+    def warm_rung(ns, k8):
+        eng = build(ns, k8)
+        first = eng.put([0], [warm_prompt], sample="greedy")  # compile prefill bucket
+        for uid in range(1, ns):  # full-batch KV residency
+            eng.put([uid], [warm_prompt], sample="greedy")
+        tok = [np.asarray([int(first[0])], np.int32)] * ns
+        eng.decode(list(range(ns)), tok, horizon)  # compile the widest decode scan
+        for uid in range(ns):
+            eng.flush(uid)
+        return eng
+
+    engine, last_err = None, None
+    for ns, k8 in ladder:
+        try:
+            engine = warm_rung(ns, k8)
+            n_seqs, kv_int8 = ns, k8
+            break
+        except Exception as e:
+            print(f"# WARNING: serving config n_seqs={ns} kv={'int8' if k8 else 'bf16'} failed "
+                  f"({type(e).__name__}: {str(e)[:200]}); trying next rung", flush=True)
+            last_err = e.with_traceback(None)  # frames pin device buffers
+            if engine is not None:
+                _free_engine(engine, "state_manager", "params")
+                engine = None
+            gc.collect()
+    if engine is None:
+        raise last_err
+
+    prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len, dtype=np.int32) for _ in range(n_seqs)]
     # --- prefill / TTFT: one prompt per put (the FastGen TTFT definition:
     # time from request admission to its first generated token on host;
     # on-device greedy sampling so the transfer is the token, not the logits) ---
-    try:
-        engine.put([0], [prompts[0]], sample="greedy")  # compile prefill bucket
-    except Exception as e:
-        if not kv_int8:
-            raise
-        # int8-KV compile/run failure must not cost the serving number:
-        # disclose, fall back to the proven bf16 cache
-        print(f"# WARNING: int8 KV serving path failed ({type(e).__name__}: {str(e)[:200]}); "
-              "falling back to bf16 KV", flush=True)
-        kv_int8 = False
-        _free_engine(engine, "state_manager", "params")
-        icfg.kv_dtype = cfg.dtype
-        engine = InferenceEngineV2(model, icfg)
-        engine.put([0], [prompts[0]], sample="greedy")
-    engine.flush(0)
     ttfts = []
     first_tok = None
     for uid in range(n_seqs):
@@ -129,11 +161,9 @@ def bench_serving(on_tpu: bool):
     # horizon instead of per token, the serving loop's steady-state shape ---
     uids = list(range(n_seqs))
     step_tok = [np.asarray([int(first_tok[0])], np.int32) for _ in uids]
-    # horizon 64: each decode() call pays one host round-trip (~50ms on the
-    # axon relay) regardless of length — the steady-state number should
-    # measure the device, not the tunnel
-    horizon = 64 if on_tpu else 2
-    engine.decode(uids, step_tok, horizon)  # compile the scan
+    # horizon 64 (set at the rung ladder, where the scan was pre-compiled):
+    # each decode() call pays one host round-trip (~50ms on the axon relay)
+    # regardless of length — the steady-state number measures the device
     n_rounds = max(1, (decode_steps - horizon) // horizon)
     last = [np.asarray([int(t)], np.int32) for t in np.asarray(engine.put(
         uids, step_tok, sample="greedy"))]

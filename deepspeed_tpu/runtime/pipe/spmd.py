@@ -30,6 +30,19 @@ from jax.sharding import PartitionSpec as P
 from ...parallel.mesh import PIPE_AXIS, DATA_AXIS
 
 
+def _psum(v, axis):
+    """psum that survives non-native-bf16 backends. On CPU, XLA's float
+    normalization rewrites a bf16 all-reduce's reduction computation into
+    add+copy, and the all-reduce-promotion pass then CHECK-fails on the
+    copy root (``Invalid binary instruction opcode copy``,
+    hlo_instruction.cc) — found compile-validating bf16 pipelines on the
+    virtual mesh (round 5). TPU has native bf16: no rewrite, no upcast —
+    the collective stays half-width there."""
+    if v.dtype == jnp.bfloat16 and jax.default_backend() != "tpu":
+        return lax.psum(v.astype(jnp.float32), axis).astype(jnp.bfloat16)
+    return lax.psum(v, axis)
+
+
 def pipeline_apply(stage_fn: Callable,
                    stage_params,
                    microbatches,
@@ -124,7 +137,7 @@ def pipeline_apply(stage_fn: Callable,
         # broadcast last stage's outputs to every stage (head/loss is
         # computed replicated over pipe)
         outputs = jax.tree_util.tree_map(
-            lambda o: lax.psum(jnp.where(stage == num_stages - 1, o, jnp.zeros_like(o)), pipe_axis), outputs)
+            lambda o: _psum(jnp.where(stage == num_stages - 1, o, jnp.zeros_like(o)), pipe_axis), outputs)
         if with_aux:
             # each data shard computed the aux mean over ITS batch rows:
             # pmean over data = the global batch mean (serial semantics);
@@ -293,7 +306,7 @@ def pipeline_1f1b(stage_fn: Callable,
             # every stage accumulated its own layers' aux: psum = model total
             loss = loss + aux_weight * lax.psum(aux_acc, pipe_axis) / M
         g_head = tree(lambda g: lax.psum(g, pipe_axis), g_head)
-        d_xs = tree(lambda d: lax.psum(jnp.where(stage == 0, d, jnp.zeros_like(d)), pipe_axis), d_xs)
+        d_xs = tree(lambda d: _psum(jnp.where(stage == 0, d, jnp.zeros_like(d)), pipe_axis), d_xs)
         return loss, g_params, g_head, d_xs
 
     rep = lambda t_: jax.tree_util.tree_map(lambda _: P(), t_)

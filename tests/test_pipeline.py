@@ -281,3 +281,27 @@ def test_pipeline_moe_engine_trains(eight_devices):
     engine, _, _, _ = deepspeed_tpu.initialize(model=_pp_model(moe_num_experts=2), config=config)
     losses = [float(engine.train_batch(tiny_batch(8, 32, seed=i % 2))) for i in range(5)]
     assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_bf16_trains(eight_devices):
+    """bf16 pipeline training compiles and trains (regression: XLA's CPU
+    float-normalization rewrites a bf16 psum's reduction computation to
+    add+copy and all-reduce-promotion CHECK-fails on the copy root — every
+    prior PP test was f32, so bf16+PP had NEVER compiled; spmd.py::_psum
+    upcasts the collective on non-native-bf16 backends)."""
+    config = {
+        "train_batch_size": 4,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "pipeline": {"schedule": "1f1b"},
+        "tpu": {"mesh": {"data": 2, "pipe": 2, "model": 2}},
+        "steps_per_print": 100,
+    }
+    m = _pp_model(num_layers=4, dtype=jnp.bfloat16)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=m, config=config)
+    losses = [float(engine.train_batch(tiny_batch(4, 32, seed=i % 2))) for i in range(5)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
