@@ -369,7 +369,7 @@ def run_bench():
         # single-chip proxy disclosure (round-2 advisor): the 7B/70B-class
         # BASELINE workloads need a pod; this measures MFU on the largest
         # llama-arch model one v5e chip fits, against the same 54% bar
-        "workload": f"{n_params/1e6:.0f}M llama-arch, seq {seq}, ZeRO-3, single v5e chip",
+        "workload": f"{n_params/1e6:.1f}M llama-arch, seq {seq}, ZeRO-3, single v5e chip",
         "serving": {k: serving[k] for k in ("value", "ttft_p50_ms", "vs_baseline")},
         "on_tpu": on_tpu,
     }
