@@ -175,16 +175,15 @@ class DeepSpeedEngine:
             except (TypeError, ValueError):
                 model_takes_schedule = False
             self._model_takes_schedule = model_takes_schedule
-            # 1F1B's shard_map is manual over 'pipe' only, so TP/DP compose by
-            # GSPMD propagation (reference PipeModelDataParallelTopology,
-            # pipe/topology.py:244); the GPipe runner is fully-manual and
-            # remains pipe x data only. A model that does not accept the
-            # schedule kwarg runs its own (legacy, GPipe-era) pipeline and
-            # gets no TP allowance.
-            if self._pipe_schedule != "1f1b" or not model_takes_schedule:
+            # both pipeline executors' shard_maps are manual over 'pipe' only
+            # (since r5 for GPipe), so TP/DP compose by GSPMD propagation
+            # (reference PipeModelDataParallelTopology, pipe/topology.py:244).
+            # A model whose pipeline_loss does not accept the schedule kwarg
+            # runs its own (legacy) pipeline and gets no TP allowance.
+            if not model_takes_schedule:
                 assert self.mp_world_size == 1, \
-                    "pipeline + tensor parallel needs the 1f1b schedule (pipeline.schedule='1f1b') " \
-                    "and a model whose pipeline_loss accepts the schedule kwarg"
+                    "pipeline + tensor parallel needs a model whose pipeline_loss accepts " \
+                    "the schedule kwarg (both built-in schedules support TP)"
 
         # --- precision policy ---
         self.compute_dtype = (jnp.bfloat16 if config.bfloat16_enabled else

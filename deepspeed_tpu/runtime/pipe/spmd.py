@@ -11,12 +11,13 @@ the next tick's compute). ``jax.grad`` through the loop generates the reverse
 schedule — backward ppermutes run in the transposed direction — so the
 training step needs no hand-written BackwardPass/SendGrad handlers.
 
-Memory behavior is GPipe-style fill-drain with per-stage rematerialization
+``pipeline_apply`` is GPipe-style fill-drain with per-stage rematerialization
 (wrap ``stage_fn`` in ``jax.checkpoint``): boundary activations per microbatch
 are kept, interior activations recomputed — equivalent to the reference's
-activation-checkpointing-between-stages configuration. (A true interleaved
-1F1B with hand-scheduled backward ticks is a later optimization; the compute
-cost is identical, the difference is peak activation memory M vs stages.)
+activation-checkpointing-between-stages configuration. ``pipeline_1f1b``
+interleaves backward ticks into the forward loop (the reference
+``TrainSchedule``), bounding live activations to ~num_stages microbatches —
+the default schedule; see ``test_1f1b_bounded_live_activations``.
 """
 
 from functools import partial
@@ -27,7 +28,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ...parallel.mesh import PIPE_AXIS, DATA_AXIS
+from ...parallel.mesh import PIPE_AXIS
+
+
+def _replicated_specs(tree):
+    """P() for every leaf — replicated over the manual (pipe) axis; auto
+    axes flow through by GSPMD propagation (shared by both executors)."""
+    return jax.tree_util.tree_map(lambda _: P(), tree)
 
 
 def _psum(v, axis):
@@ -50,7 +57,6 @@ def pipeline_apply(stage_fn: Callable,
                    mesh,
                    num_stages: int,
                    pipe_axis: str = PIPE_AXIS,
-                   data_axis: str = DATA_AXIS,
                    param_specs=None,
                    remat: bool = True,
                    with_aux: bool = False):
@@ -126,12 +132,7 @@ def pipeline_apply(stage_fn: Callable,
             recv = jax.tree_util.tree_map(lambda v: lax.ppermute(v, pipe_axis, perm), y)
             return (recv, outputs, aux_acc), None
 
-        aux0 = jnp.zeros([], jnp.float32)
-        try:
-            # aux is (pipe, data)-varying: params are pipe-sharded, x data-sharded
-            aux0 = lax.pcast(aux0, (pipe_axis, data_axis), to="varying")
-        except (AttributeError, TypeError):
-            pass
+        aux0 = _pipe_varying(jnp.zeros([], jnp.float32))
         (recv, outputs, aux_acc), _ = lax.scan(
             tick, (x0, outputs, aux0), jnp.arange(n_ticks))
         # broadcast last stage's outputs to every stage (head/loss is
@@ -139,18 +140,25 @@ def pipeline_apply(stage_fn: Callable,
         outputs = jax.tree_util.tree_map(
             lambda o: _psum(jnp.where(stage == num_stages - 1, o, jnp.zeros_like(o)), pipe_axis), outputs)
         if with_aux:
-            # each data shard computed the aux mean over ITS batch rows:
-            # pmean over data = the global batch mean (serial semantics);
-            # psum over pipe totals the per-stage layer sums
-            return outputs, lax.psum(lax.pmean(aux_acc, data_axis), pipe_axis)
+            # manual over pipe ONLY: data/model/seq are GSPMD-auto inside,
+            # so aux is already the global batch mean — psum totals the
+            # per-stage layer sums (same aggregation as 1f1b)
+            return outputs, lax.psum(aux_acc, pipe_axis)
         return outputs
 
-    x_spec = jax.tree_util.tree_map(lambda _: P(None, data_axis), microbatches)
-    const_specs = tuple(jax.tree_util.tree_map(lambda _: P(), c) for c in consts)
+    # manual over 'pipe' ONLY (same contract as pipeline_1f1b below): the
+    # data/model/seq axes stay GSPMD-auto inside the body, so TP shards the
+    # per-stage einsums instead of replicating them on every model shard —
+    # the manual-over-all-axes form this replaced computed each stage's full
+    # matmuls redundantly under tensor parallelism
+    x_spec = _replicated_specs(microbatches)
+    const_specs = tuple(_replicated_specs(c) for c in consts)
     out_specs = (x_spec, P()) if with_aux else x_spec
     shard_fn = jax.shard_map(pipelined, mesh=mesh,
                              in_specs=(param_specs, x_spec) + const_specs,
-                             out_specs=out_specs)
+                             out_specs=out_specs,
+                             axis_names=frozenset({pipe_axis}),
+                             check_vma=False)
     return shard_fn(stage_params, microbatches, *consts)
 
 
@@ -309,7 +317,7 @@ def pipeline_1f1b(stage_fn: Callable,
         d_xs = tree(lambda d: _psum(jnp.where(stage == 0, d, jnp.zeros_like(d)), pipe_axis), d_xs)
         return loss, g_params, g_head, d_xs
 
-    rep = lambda t_: jax.tree_util.tree_map(lambda _: P(), t_)
+    rep = _replicated_specs
     shard_fn = jax.shard_map(
         pipelined, mesh=mesh,
         in_specs=(param_specs, rep(head_params), rep(microbatches), rep(head_aux))
