@@ -312,23 +312,16 @@ def test_bf16_feature_paths_train(feature, eight_devices):
         zero = {"stage": 3, "zero_hpz_partition_size": 4,
                 "zero_quantized_weights": True, "zero_quantized_gradients": True}
     elif feature == "moe_ep":
-        cfg_kw = dict(moe_num_experts=4)
+        cfg_kw = dict(moe_num_experts=8)  # 8 over data:8 — real EP sharding
     elif feature == "mics":
         zero = {"stage": 2, "mics_shard_size": 4}
-    m = TransformerLM(TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
-                                        num_heads=4, max_seq_len=128, intermediate_size=128,
-                                        attention_impl="reference", dtype=jnp.bfloat16,
-                                        **cfg_kw))
-    conf = {
-        "train_batch_size": bsz,
-        "train_micro_batch_size_per_gpu": 1,
-        "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-        "zero_optimization": zero,
-        "bf16": {"enabled": True},
-        "tpu": {"mesh": mesh},
-    }
+    m = tiny_model(dtype=jnp.bfloat16, max_seq_len=128, **cfg_kw)
+    conf = ds_config(train_batch_size=bsz, train_micro_batch_size_per_gpu=1,
+                     zero_optimization=zero, bf16={"enabled": True},
+                     tpu={"mesh": mesh})
     engine, _, _, _ = deepspeed_tpu.initialize(model=m, config=conf)
+    if feature == "moe_ep":  # experts must actually shard over the data axis
+        assert "data" in str(engine.state["params"]["blocks"]["moe_wi"].sharding.spec)
     rng = np.random.default_rng(0)
     loss = engine.train_batch({"input_ids": rng.integers(0, 128, size=(bsz, seq), dtype=np.int32)})
     assert np.isfinite(float(loss)), (feature, loss)
