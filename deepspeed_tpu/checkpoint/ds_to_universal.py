@@ -63,44 +63,55 @@ def ds_to_universal(checkpoint_dir, output_dir, tag=None):
     os.makedirs(zero_dir, exist_ok=True)
 
     flat = _flat_paths(module)
-    mu_leaves = nu_leaves = None
-    masters = {}
-    if "optimizer" in tree and tree["optimizer"]:
-        mu_leaves, nu_leaves = _extract_adam_moments(tree["optimizer"], module)
-        if mu_leaves is None:
-            logger.warning("optimizer state present but not adam-shaped; universal ckpt will carry weights only")
-    if mu_leaves is None and tree.get("host_optimizer"):
-        # ZeRO-Offload: the device-side optimizer state is empty; the Adam
-        # moments (and fp32 masters) live in the host_optimizer subtree
-        # (engine.py save_checkpoint), keyed by '::'-escaped param paths.
-        host = tree["host_optimizer"]
-        try:
-            mu_leaves, nu_leaves, masters = [], [], {}
-            for key, leaf in flat:
-                ek = key.replace("/", "::")
+    # Per-key Adam moments may come from TWO sources: the host_optimizer
+    # subtree (ZeRO-Offload — full offload owns every key; twin-flow
+    # `offload_optimizer.ratio` < 1 owns only its slice) and the device
+    # optax state (normal training, or twin-flow's device slice). Merge:
+    # host keys first, then match the device state against the REMAINING
+    # leaves (engine.py twin-flow keeps the device opt over the pruned tree).
+    mu_by_key, nu_by_key, masters = {}, {}, {}
+    host = tree.get("host_optimizer") or {}
+    if host:
+        for key, leaf in flat:
+            ek = key.replace("/", "::")
+            # all three subtrees must carry the key (a partially-written
+            # host save degrades that key to the device source / weights-only
+            # instead of crashing the conversion)
+            if all(ek in host.get(f, {}) for f in ("exp_avg", "exp_avg_sq", "masters")):
                 shape = np.shape(leaf)
-                mu_leaves.append(np.asarray(host["exp_avg"][ek], np.float32).reshape(shape))
-                nu_leaves.append(np.asarray(host["exp_avg_sq"][ek], np.float32).reshape(shape))
+                mu_by_key[key] = np.asarray(host["exp_avg"][ek], np.float32).reshape(shape)
+                nu_by_key[key] = np.asarray(host["exp_avg_sq"][ek], np.float32).reshape(shape)
                 masters[key] = np.asarray(host["masters"][ek], np.float32).reshape(shape)
-            logger.info("using host_optimizer (ZeRO-Offload) state for universal checkpoint")
-        except KeyError as e:
-            logger.warning(f"host_optimizer subtree incomplete ({e}); universal ckpt will carry weights only")
-            mu_leaves = nu_leaves = None
-            masters = {}
+        if mu_by_key:
+            logger.info(f"host_optimizer (ZeRO-Offload) state covers {len(mu_by_key)}/{len(flat)} params")
+    remaining = [(key, leaf) for key, leaf in flat if key not in mu_by_key]
+    if remaining and tree.get("optimizer"):
+        mu, nu = _extract_adam_moments(tree["optimizer"], [leaf for _, leaf in remaining])
+        if mu is not None:
+            for (key, _), m, v in zip(remaining, mu, nu):
+                mu_by_key[key] = np.asarray(jax.device_get(m), np.float32)
+                nu_by_key[key] = np.asarray(jax.device_get(v), np.float32)
+        else:
+            logger.warning("device optimizer state present but not adam-shaped for the "
+                           f"{len(remaining)} non-host params")
+    has_optimizer = len(mu_by_key) == len(flat)
+    if not has_optimizer:
+        logger.warning(f"optimizer moments found for {len(mu_by_key)}/{len(flat)} params; "
+                       "universal ckpt will carry weights only")
 
-    for i, (key, leaf) in enumerate(flat):
+    for key, leaf in flat:
         pdir = os.path.join(zero_dir, key.replace("/", "."))
         os.makedirs(pdir, exist_ok=True)
         fp32 = masters[key] if key in masters else np.asarray(jax.device_get(leaf), np.float32)
         np.save(os.path.join(pdir, "fp32.npy"), fp32)
-        if mu_leaves is not None:
-            np.save(os.path.join(pdir, "exp_avg.npy"), np.asarray(jax.device_get(mu_leaves[i]), np.float32))
-            np.save(os.path.join(pdir, "exp_avg_sq.npy"), np.asarray(jax.device_get(nu_leaves[i]), np.float32))
+        if has_optimizer:
+            np.save(os.path.join(pdir, "exp_avg.npy"), mu_by_key[key])
+            np.save(os.path.join(pdir, "exp_avg_sq.npy"), nu_by_key[key])
 
     meta = {
         "universal_layout_version": UNIVERSAL_LAYOUT_VERSION,
         "param_paths": [k for k, _ in flat],
-        "has_optimizer": mu_leaves is not None,
+        "has_optimizer": has_optimizer,
     }
     scalars = tree.get("scalars", {})
     for k in ("step", "loss_scale", "good_steps"):
@@ -117,7 +128,7 @@ def ds_to_universal(checkpoint_dir, output_dir, tag=None):
     with open(os.path.join(output_dir, "universal_meta.pkl"), "wb") as f:
         pickle.dump(meta, f)
     logger.info(f"universal checkpoint: {len(flat)} params -> {output_dir} "
-                f"(optimizer={'yes' if mu_leaves is not None else 'no'})")
+                f"(optimizer={'yes' if has_optimizer else 'no'})")
     return len(flat)
 
 

@@ -234,7 +234,40 @@ class DeepSpeedEngine:
         offload_cfg = config.zero_config.offload_optimizer
         self._offload_enabled = (offload_cfg is not None
                                  and str(config.zero_config.offload_optimizer_device) != "none")
+        # Twin-flow partial offload (reference ZeRO-Offload++ `ratio`,
+        # blogs/deepspeed-offloadpp): ratio < 1 keeps (1-ratio) of the
+        # optimizer-state bytes on device — that slice updates in HBM,
+        # overlapping the host C++ Adam on the rest (zero/offload.py)
+        self._offload_ratio = float(offload_cfg.ratio) if self._offload_enabled else 1.0
+        self._twin_mask = None  # set in _init_state when ratio < 1
+        if self._offload_enabled and self._offload_ratio <= 0.0:
+            logger.warning("offload_optimizer.ratio=0: nothing to offload — "
+                           "running the plain device optimizer")
+            self._offload_enabled = False
+            self._offload_ratio = 1.0
         self.optimizer = self._configure_optimizer(optimizer)
+        # twin-flow device-slice optimizer: the bare tx WITHOUT the optax
+        # clip link — clipping must use the GLOBAL grad norm (host-computed
+        # over all leaves), folded into the scale factor at update time; the
+        # chain's clip link would re-clip by the device-subtree norm
+        self._twin_tx = None
+        if self._offload_enabled and self._offload_ratio < 1.0:
+            from .constants import ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER
+
+            name = (self.config.optimizer_name or ADAMW_OPTIMIZER).lower()
+            if optimizer is not None or name not in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER,
+                                                     FUSED_ADAM_OPTIMIZER):
+                # the host slice always runs the fused CPU Adam; a different
+                # device-slice rule would train halves of the model under
+                # different optimizers — reject rather than silently diverge
+                raise ValueError(
+                    "offload_optimizer.ratio < 1 (twin-flow) requires an Adam/AdamW config "
+                    f"optimizer (both slices must share the update rule); got "
+                    f"{'a client optimizer object' if optimizer is not None else repr(name)}. "
+                    "Use ratio=1.0 (full offload) or switch the optimizer.")
+            p = dict(self.config.optimizer_params or {})
+            lr = self.lr_schedule_fn if self.lr_schedule_fn is not None else p.get("lr", 1e-3)
+            self._twin_tx = build_optimizer(self.config.optimizer_name, p, lr=lr)
 
         # 1-bit optimizers: compressed gradient exchange after freeze_step
         # (reference runtime/fp16/onebit/* + comm/nccl.py compressed_allreduce)
@@ -425,7 +458,15 @@ class DeepSpeedEngine:
         nvme = offload_cfg.nvme_path if str(offload_cfg.device) == "nvme" else None
         if str(offload_cfg.device) == "nvme":
             assert nvme, "offload_optimizer.device=nvme requires nvme_path"
-        return HostOffloadOptimizer(self.state["params"],
+        host_params = self.state["params"]
+        block_shardings = self.zero_policy.grad_shardings(self.state["params"])
+        if self._twin_mask is not None:
+            # twin-flow: the host optimizer owns only its slice of the tree
+            from .zero.offload import prune_tree
+
+            host_params = prune_tree(host_params, self._twin_mask, keep=True)
+            block_shardings = prune_tree(block_shardings, self._twin_mask, keep=True)
+        return HostOffloadOptimizer(host_params,
                                     lr=params.get("lr", 1e-3),
                                     betas=tuple(params.get("betas", (0.9, 0.999))),
                                     eps=params.get("eps", 1e-8),
@@ -435,7 +476,7 @@ class DeepSpeedEngine:
                                     pipeline_read=offload_cfg.pipeline_read,
                                     pipeline_write=offload_cfg.pipeline_write,
                                     grad_clip=self.config.gradient_clipping or 0.0,
-                                    block_shardings=self.zero_policy.grad_shardings(self.state["params"]))
+                                    block_shardings=block_shardings)
 
     # ------------------------------------------------------------------
     # state init
@@ -444,7 +485,21 @@ class DeepSpeedEngine:
         init_rng, self._rng = jax.random.split(self._rng)
         param_shapes = jax.eval_shape(lambda r: self.module.init(r, example_batch), init_rng)
         param_shardings = self.zero_policy.param_shardings(param_shapes)
-        if self._offload_enabled:
+        if self._offload_enabled and self._offload_ratio < 1.0:
+            # twin-flow: the device slice keeps a normal optax state in HBM
+            from .zero.offload import partition_leaves_by_ratio, prune_tree
+
+            self._twin_mask = partition_leaves_by_ratio(param_shapes, self._offload_ratio)
+            n_host = sum(jax.tree_util.tree_leaves(self._twin_mask))
+            n_all = len(jax.tree_util.tree_leaves(param_shapes))
+            log_dist(f"twin-flow offload: ratio={self._offload_ratio} -> {n_host}/{n_all} "
+                     f"param leaves' optimizer state on host, rest on device", ranks=[0])
+            dev_shapes = prune_tree(param_shapes, self._twin_mask, keep=False)
+            opt_init = lambda params: self._twin_tx.init(prune_tree(params, self._twin_mask, False))
+            opt_shapes = jax.eval_shape(self._twin_tx.init, dev_shapes)
+            opt_shardings = self.zero_policy.opt_state_shardings(
+                opt_shapes, dev_shapes)
+        elif self._offload_enabled:
             # ZeRO-Offload: moments live on host/NVMe — nothing in HBM
             opt_init = lambda params: {}
             opt_shardings = {}
@@ -652,26 +707,90 @@ class DeepSpeedEngine:
 
         return jax.jit(grads_fn)
 
+    def _host_slice(self, tree):
+        """The host optimizer's slice of a params-shaped tree (identity
+        outside twin-flow)."""
+        if self._twin_mask is None:
+            return tree
+        from .zero.offload import prune_tree
+
+        return prune_tree(tree, self._twin_mask, keep=True)
+
+    def _build_twin_device_update(self):
+        """Compiled update for the twin-flow DEVICE slice: pre-scaled grads
+        (unscale + global clip folded into ``scale``) through the bare tx.
+        Dispatched async BEFORE the host C++ Adam runs — the two updates
+        overlap, the point of the reference's Twin-Flow design."""
+
+        def dev_update(dev_params, opt_state, dev_grads, scale):
+            g = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32) * scale, dev_grads)
+            updates, new_opt = self._twin_tx.update(g, opt_state, dev_params)
+            new_params = optax.apply_updates(dev_params, updates)
+            new_params = jax.tree_util.tree_map(lambda n, p: n.astype(p.dtype), new_params, dev_params)
+            return new_params, new_opt
+
+        from .zero.offload import prune_tree
+
+        dev_shardings = prune_tree(self._state_shardings["params"], self._twin_mask, keep=False)
+        return jax.jit(dev_update, donate_argnums=(0, 1),
+                       out_shardings=(dev_shardings, self._state_shardings["opt_state"]))
+
     def _host_apply_update(self, grads, scaled_gnorm=None):
         """Shared host-offload tail: fused C++ Adam on the masters, then
         upload of the new params into their shardings. Returns
         (grad_norm, overflow, lr). ``scaled_gnorm``: device-computed global
-        norm of the (loss-scaled) grads — required in multi-host shard mode."""
+        norm of the (loss-scaled) grads — required in multi-host shard mode.
+
+        Twin-flow (``offload_optimizer.ratio`` < 1): the device slice's
+        compiled update is dispatched (async) before the host loop starts,
+        so HBM-side Adam runs concurrently with the host C++ Adam; the two
+        halves are merged afterwards. Clip/overflow decisions use the ONE
+        global norm for both."""
+        from .zero.offload import merge_by_mask, prune_tree
+
+        twin = self._twin_mask is not None
         step_no = int(self.state["step"]) + 1
         lr = (float(self.lr_schedule_fn(step_no - 1)) if self.lr_schedule_fn is not None else
               (self.config.optimizer_params or {}).get("lr", 1e-3))
         scale = float(self.state["loss_scale"])
         gnorm = None if scaled_gnorm is None else float(scaled_gnorm) / scale
+
+        dev_future = None
+        if twin:
+            assert gnorm is not None, "twin-flow needs the device-computed global norm"
+            if np.isfinite(gnorm):
+                # dispatch the device slice NOW; it overlaps the host loop
+                clip = self.config.gradient_clipping or 0.0
+                factor = (1.0 / scale) * (clip / (gnorm + 1e-6) if clip and gnorm > clip else 1.0)
+                if "twin_dev_update" not in self._compiled:
+                    self._compiled["twin_dev_update"] = self._build_twin_device_update()
+                with self.mesh:
+                    dev_future = self._compiled["twin_dev_update"](
+                        prune_tree(self.state["params"], self._twin_mask, keep=False),
+                        self.state["opt_state"],
+                        prune_tree(grads, self._twin_mask, keep=False),
+                        jnp.asarray(factor, jnp.float32))
+            grads = prune_tree(grads, self._twin_mask, keep=True)
+
         new_params, grad_norm, overflow = self.host_optimizer.step(step_no, grads, lr=lr, loss_scale=scale,
                                                                    grad_norm=gnorm)
         if not overflow:
+            param_shardings = self._state_shardings["params"]
             dtypes = jax.tree_util.tree_map(lambda p: p.dtype, self.state["params"])
+            if twin:
+                param_shardings = prune_tree(param_shardings, self._twin_mask, keep=True)
+                dtypes = prune_tree(dtypes, self._twin_mask, keep=True)
             if self.host_optimizer.shard_mode:
-                self.state["params"] = self.host_optimizer.rebuild_device_params(
-                    self._state_shardings["params"], dtypes)
+                host_params = self.host_optimizer.rebuild_device_params(param_shardings, dtypes)
             else:
                 cast = jax.tree_util.tree_map(lambda a, dt: np.asarray(a, dtype=dt), new_params, dtypes)
-                self.state["params"] = jax.device_put(cast, self._state_shardings["params"])
+                host_params = jax.device_put(cast, param_shardings)
+            if twin:
+                dev_params, self.state["opt_state"] = dev_future
+                self.state["params"] = merge_by_mask(self.state["params"], self._twin_mask,
+                                                     host_params, dev_params)
+            else:
+                self.state["params"] = host_params
             self.state["step"] = self.state["step"] + 1
         else:
             self.skipped_steps += 1
@@ -1430,7 +1549,7 @@ class DeepSpeedEngine:
             else:
                 # masters must follow the loaded weights, else the next host
                 # step would resurrect the pre-load params
-                self.host_optimizer.reset_masters(self.state["params"])
+                self.host_optimizer.reset_masters(self._host_slice(self.state["params"]))
         client_state = {k: v for k, v in loaded.items()
                         if k not in ("module", "optimizer", "scalars", "global_steps", "global_samples",
                                      "skipped_steps", "lr_scheduler", "curriculum_scheduler",
@@ -1488,7 +1607,7 @@ class DeepSpeedEngine:
                                    state_dict, self.state["params"]), shardings)
         self.state = {**self.state, "params": placed}
         if self.host_optimizer is not None:
-            self.host_optimizer.reset_masters(placed)
+            self.host_optimizer.reset_masters(self._host_slice(placed))
         return self
 
     def set_train_batch_size(self, train_batch_size: int):

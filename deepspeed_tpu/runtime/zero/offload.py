@@ -38,6 +38,57 @@ def _leaf_paths(tree):
     return [(path_str(kp), leaf) for kp, leaf in flat]
 
 
+# ---------------------------------------------------------------------------
+# Twin-flow partial offload (reference ZeRO-Offload++ `offload_optimizer.ratio`,
+# blogs/deepspeed-offloadpp: a configurable fraction of the optimizer state
+# stays on the accelerator and updates there, overlapping the host update).
+# TPU form: a leaf-granularity split of the param pytree — the host set is
+# chosen greedily by size until it holds >= ratio of the total bytes; the
+# device set keeps a normal optax state in HBM and its update overlaps the
+# host C++ Adam via jax async dispatch.
+# ---------------------------------------------------------------------------
+def partition_leaves_by_ratio(param_shapes, ratio: float):
+    """Boolean mask pytree (True = host-offloaded leaf). Greedy subset-sum
+    approximation: largest-first but skipping any leaf that would overshoot
+    the target byte share, then one minimal top-up if still short — so a
+    single huge leaf (e.g. the embedding at ratio=0.1) cannot blow the host
+    share far past the configured ratio."""
+    flat, treedef = jax.tree_util.tree_flatten(param_shapes)
+    sizes = [int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize for l in flat]
+    target = ratio * float(sum(sizes))
+    order = sorted(range(len(flat)), key=lambda i: -sizes[i])
+    host, acc = set(), 0.0
+    for i in order:
+        if acc + sizes[i] <= target:
+            host.add(i)
+            acc += sizes[i]
+    if acc < target and len(host) < len(flat):
+        # every remaining leaf overshoots: add the smallest (least overshoot)
+        j = min((i for i in range(len(flat)) if i not in host), key=lambda i: sizes[i])
+        host.add(j)
+    return jax.tree_util.tree_unflatten(treedef, [i in host for i in range(len(flat))])
+
+
+def prune_tree(tree, mask, keep: bool):
+    """Drop leaves where mask != keep (None-elision keeps the remaining
+    leaves' key paths identical to the full tree's — checkpoint keys and
+    sharding lookups stay stable)."""
+    return jax.tree_util.tree_map(lambda x, m: x if m is keep else None, tree, mask)
+
+
+def merge_by_mask(full_template, mask, host_tree, dev_tree):
+    """Reassemble the full pytree from the two pruned halves."""
+    from .partition import path_str
+
+    host = {p: l for p, l in _leaf_paths(host_tree)}
+    dev = {p: l for p, l in _leaf_paths(dev_tree)}
+    flat, treedef = jax.tree_util.tree_flatten_with_path(full_template)
+    mask_leaves = jax.tree_util.tree_leaves(mask)
+    leaves = [host[path_str(kp)] if m else dev[path_str(kp)]
+              for (kp, _), m in zip(flat, mask_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def _unique_shards(arr):
     """Addressable shards of a jax array, one per distinct index (replicas
     within the process are dropped). Returns [(block_key, index, np_data)],

@@ -209,8 +209,8 @@ def _tiny_model():
                                            attention_impl="reference"))
 
 
-def _batch(bsz=8, seq=32):
-    rng = np.random.default_rng(0)
+def _batch(bsz=8, seq=32, seed=0):
+    rng = np.random.default_rng(seed)
     return {"input_ids": rng.integers(0, 128, size=(bsz, seq), dtype=np.int32)}
 
 
@@ -380,3 +380,112 @@ def test_offload_shard_mode_zero3(monkeypatch, eight_devices):
     engine, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=cfg)
     losses = [float(engine.train_batch(tiny_batch(16, 32, seed=i % 2))) for i in range(4)]
     assert losses[-1] < losses[0], losses
+
+
+# ---------------------------------------------------------------------------
+# Twin-flow partial offload (reference ZeRO-Offload++ `offload_optimizer.ratio`)
+# ---------------------------------------------------------------------------
+def _twin_config(ratio, **over):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu", "ratio": ratio}},
+        "tpu": {"mesh": {"data": 8}},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_twin_flow_split_and_trains():
+    """ratio=0.5: roughly half the optimizer-state bytes stay on device
+    (real optax state in HBM), the rest on host; training converges."""
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=_twin_config(0.5))
+    assert engine.host_optimizer is not None
+    assert engine._twin_mask is not None
+    assert engine.state["opt_state"] != {}  # device slice holds real moments
+    mask = jax.tree_util.tree_leaves(engine._twin_mask)
+    assert any(mask) and not all(mask), "split must put leaves on BOTH sides"
+    # host byte share within leaf-granularity slack of the ratio
+    sizes = [int(np.prod(l.shape)) * l.dtype.itemsize
+             for l in jax.tree_util.tree_leaves(engine.state["params"])]
+    host_bytes = sum(s for s, m in zip(sizes, mask) if m)
+    assert 0.3 <= host_bytes / sum(sizes) <= 0.9, host_bytes / sum(sizes)
+    losses = [float(engine.train_batch(_batch(16))) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+    assert int(engine.state["step"]) == 5
+
+
+def test_twin_flow_matches_full_device_optimizer():
+    """Twin-flow must optimize the SAME objective: after 3 identical steps,
+    params match a plain (no-offload) AdamW engine within numeric slack
+    (host C++ Adam vs optax — bitwise equality is not expected, direction
+    and magnitude are)."""
+    from deepspeed_tpu.parallel import groups
+
+    batches = [_batch(16, seed=i) for i in range(3)]
+
+    def run(config):
+        groups.reset()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=config)
+        for b in batches:
+            engine.train_batch(b)
+        return jax.device_get(engine.state["params"])
+
+    plain_cfg = _twin_config(0.5)
+    del plain_cfg["zero_optimization"]["offload_optimizer"]
+    p_twin = run(_twin_config(0.5))
+    p_plain = run(plain_cfg)
+    for (kp, a), (_, b) in zip(jax.tree_util.tree_flatten_with_path(p_twin)[0],
+                               jax.tree_util.tree_flatten_with_path(p_plain)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                                   err_msg=jax.tree_util.keystr(kp))
+
+
+def test_twin_flow_checkpoint_roundtrip_and_universal(tmp_path):
+    """Save/load with ratio<1: host masters AND the device optax slice both
+    round-trip; ds_to_universal merges the two sources so every param gets
+    Adam moments."""
+    from deepspeed_tpu.checkpoint import ds_to_universal, read_universal_checkpoint
+    from deepspeed_tpu.parallel import groups
+
+    config = _twin_config(0.5, train_batch_size=8, gradient_accumulation_steps=1)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=config)
+    for i in range(2):
+        engine.train_batch(_batch(seed=i))
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    ref = jax.device_get(engine.state["params"])
+    masters_before = {k: v.copy() for k, v in engine.host_optimizer.masters.items()}
+    opt_before = jax.device_get(engine.state["opt_state"])
+
+    groups.reset()
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=config)
+    engine2.load_checkpoint(str(tmp_path / "ck"))
+    for k in masters_before:
+        np.testing.assert_allclose(engine2.host_optimizer.masters[k], masters_before[k], rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(engine2.state["opt_state"])),
+                    jax.tree_util.tree_leaves(opt_before)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    # training continues finitely after resume
+    assert np.isfinite(float(engine2.train_batch(_batch(seed=9))))
+
+    # universal conversion: moments for EVERY param from the merged sources
+    n = ds_to_universal(str(tmp_path / "ck"), str(tmp_path / "uni"))
+    assert n == len(jax.tree_util.tree_leaves(ref))
+    sd, meta = read_universal_checkpoint(str(tmp_path / "uni"))
+    assert meta["has_optimizer"], "twin-flow universal ckpt must carry merged moments"
+    assert all("exp_avg" in v for v in sd.values())
+    groups.reset()
+
+
+def test_twin_flow_rejects_non_adam():
+    """ratio<1 with a non-Adam optimizer must reject loudly (the host slice
+    always runs fused CPU Adam; silently training halves of the model under
+    different rules would be worse)."""
+    cfg = _twin_config(0.5)
+    cfg["optimizer"] = {"type": "Lion", "params": {"lr": 1e-4}}
+    with pytest.raises(ValueError, match="twin-flow"):
+        deepspeed_tpu.initialize(model=_tiny_model(), config=cfg)
