@@ -63,9 +63,13 @@ def partition_leaves_by_ratio(param_shapes, ratio: float):
             host.add(i)
             acc += sizes[i]
     if acc < target and len(host) < len(flat):
-        # every remaining leaf overshoots: add the smallest (least overshoot)
+        # every remaining leaf overshoots: add the smallest, but only when
+        # that lands CLOSER to the target than stopping short does (a
+        # dominant leaf must not flip the whole tree onto the host and
+        # silently degenerate twin-flow to full offload)
         j = min((i for i in range(len(flat)) if i not in host), key=lambda i: sizes[i])
-        host.add(j)
+        if abs((acc + sizes[j]) - target) < abs(acc - target):
+            host.add(j)
     return jax.tree_util.tree_unflatten(treedef, [i in host for i in range(len(flat))])
 
 

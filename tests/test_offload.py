@@ -489,3 +489,24 @@ def test_twin_flow_rejects_non_adam():
     cfg["optimizer"] = {"type": "Lion", "params": {"lr": 1e-4}}
     with pytest.raises(ValueError, match="twin-flow"):
         deepspeed_tpu.initialize(model=_tiny_model(), config=cfg)
+
+
+def test_twin_flow_shard_mode_nvme(monkeypatch, tmp_path):
+    """Twin-flow composes with the pod-path machinery: shard-mode host
+    optimizer (per-host master blocks) + NVMe moments for the HOST slice,
+    device optax slice in HBM. The dryrun's offload rung runs this shape."""
+    from deepspeed_tpu.parallel import groups
+
+    monkeypatch.setenv("DS_TPU_OFFLOAD_SHARD_MODE", "1")
+    groups.reset()
+    config = _twin_config(0.5)
+    config["zero_optimization"]["offload_optimizer"].update(
+        {"device": "nvme", "nvme_path": str(tmp_path)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_tiny_model(), config=config)
+    assert engine.host_optimizer.shard_mode
+    assert engine.host_optimizer.swapper is not None
+    assert engine._twin_mask is not None
+    losses = [float(engine.train_batch(_batch(16, seed=i))) for i in range(4)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+    groups.reset()
