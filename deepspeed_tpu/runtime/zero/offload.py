@@ -66,9 +66,12 @@ def partition_leaves_by_ratio(param_shapes, ratio: float):
         # every remaining leaf overshoots: add the smallest, but only when
         # that lands CLOSER to the target than stopping short does (a
         # dominant leaf must not flip the whole tree onto the host and
-        # silently degenerate twin-flow to full offload)
+        # silently degenerate twin-flow to full offload) — UNLESS the host
+        # set would be empty, which must never happen for ratio > 0 (the
+        # NVMe host path requires >= 1 block, and 'offload nothing' would
+        # betray a user who sized the ratio to fit HBM)
         j = min((i for i in range(len(flat)) if i not in host), key=lambda i: sizes[i])
-        if abs((acc + sizes[j]) - target) < abs(acc - target):
+        if not host or abs((acc + sizes[j]) - target) < abs(acc - target):
             host.add(j)
     return jax.tree_util.tree_unflatten(treedef, [i in host for i in range(len(flat))])
 
