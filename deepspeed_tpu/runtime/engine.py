@@ -245,6 +245,11 @@ class DeepSpeedEngine:
                            "running the plain device optimizer")
             self._offload_enabled = False
             self._offload_ratio = 1.0
+        if self._offload_enabled and config.tpu_config.abstract_init:
+            # the host optimizer materializes masters from real device arrays
+            raise ValueError("tpu.abstract_init (compile-only validation) does not compose "
+                             "with offload_optimizer: the host optimizer needs materialized "
+                             "params. Validate the non-offload shape of the config instead.")
         self.optimizer = self._configure_optimizer(optimizer)
         # twin-flow device-slice optimizer: the bare tx WITHOUT the optax
         # clip link — clipping must use the GLOBAL grad norm (host-computed
