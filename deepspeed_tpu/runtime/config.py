@@ -14,7 +14,7 @@ import json
 import copy
 from typing import Literal, Optional, List, Union, Any
 
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from .config_utils import DeepSpeedConfigModel, get_scalar_param, dict_raise_error_on_duplicate_keys
 from .constants import *  # noqa: F401,F403
@@ -135,6 +135,31 @@ class PipelineConfig(DeepSpeedConfigModel):
     schedule: Literal["1f1b", "gpipe"] = "1f1b"
 
 
+class ProfilerTraceConfig(DeepSpeedConfigModel):
+    """``tpu.profiler_trace`` block — typed so key typos and bad values fail
+    at ``initialize()``, not mid-training (same pattern as the fp16 block).
+    Enabled by presence: an empty block stays off."""
+    trace_dir: str = "/tmp/dstpu_trace"
+    start_step: int = Field(0, ge=0)
+    num_steps: int = Field(1, ge=1)
+    enabled: bool = False
+
+    @model_validator(mode="after")
+    def enable_when_configured(self):
+        # the base model tolerates unknown keys (reference parity) — but a
+        # typo here silently traces the wrong step; warn loudly
+        unknown = set(self.model_fields_set) - set(type(self).model_fields)
+        if unknown:
+            from ..utils.logging import logger
+
+            logger.warning(f"profiler_trace: unknown keys {sorted(unknown)} ignored "
+                           f"(valid: trace_dir, start_step, num_steps, enabled)")
+        # {"trace_dir": ...} or {"start_step": N} implies the user wants it
+        if self.model_fields_set and "enabled" not in self.model_fields_set:
+            self.enabled = True
+        return self
+
+
 class TPUConfig(DeepSpeedConfigModel):
     """TPU-native section: the mesh is the single source of truth for every
     parallel dimension (SURVEY.md §7 design stance)."""
@@ -158,6 +183,13 @@ class TPUConfig(DeepSpeedConfigModel):
     # never hold the weights. train_batch() is unusable in this mode; use
     # aot_lower_train_step() (tools/pod_validate.py)
     abstract_init: bool = False
+    # device trace capture (the TPU analog of the reference's torch-profiler
+    # hooks): captures a perfetto/XPlane trace of global steps
+    # [start_step, start_step+num_steps) via jax.profiler — the artifact the
+    # "profile, iterate" loop reads in xprof/perfetto. A window ending at the
+    # final step is flushed by engine.destroy();
+    # engine.start_device_trace()/stop_device_trace() drive it manually.
+    profiler_trace: "ProfilerTraceConfig" = {}
 
     def mesh_config(self) -> MeshConfig:
         known = {k: v for k, v in self.mesh.items() if k in ("data", "model", "pipe", "seq", "expert")}

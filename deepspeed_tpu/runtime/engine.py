@@ -334,6 +334,7 @@ class DeepSpeedEngine:
 
         # --- aux subsystems ---
         self.monitor = MonitorMaster(config.monitor_config)
+        self._tracing = False  # device trace capture state (start/stop_device_trace)
         self.engine_timers = EngineTimers(enable_micro_timers=config.wall_clock_breakdown,
                                           enable_global_timers=config.wall_clock_breakdown)
         self.tput_timer = ThroughputTimer(config=None, batch_size=self.train_batch_size(),
@@ -1103,6 +1104,7 @@ class DeepSpeedEngine:
         else:
             batch = jax.tree_util.tree_map(lambda x: np.asarray(x).reshape(gas, -1, *np.shape(x)[1:]), batch)
 
+        self._maybe_device_trace()
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
         if self.random_ltd_scheduler is not None:
@@ -1156,6 +1158,49 @@ class DeepSpeedEngine:
         with self.mesh:
             return step.lower(state_abs, batch_abs,
                               jax.ShapeDtypeStruct(rng_abs.shape, rng_abs.dtype))
+
+    # ------------------------------------------------------------------
+    # device trace capture (TPU analog of the reference's torch-profiler
+    # hooks; `tpu.profiler_trace` config block or the manual pair below)
+    # ------------------------------------------------------------------
+    def start_device_trace(self, trace_dir: str):
+        """Begin a jax.profiler capture (perfetto/XPlane): device timelines,
+        XLA op spans, and every `nvtx`/TraceAnnotation-annotated region."""
+        if self._tracing:
+            logger.warning("device trace already running; ignoring start_device_trace")
+            return
+        jax.profiler.start_trace(trace_dir)
+        self._tracing = True
+        log_dist(f"device trace capturing to {trace_dir}", ranks=[0])
+
+    def stop_device_trace(self):
+        if not self._tracing:
+            return
+        try:
+            # drain in-flight async work so the trace holds whole steps
+            # (skipped post-destroy / under abstract_init — nothing to drain)
+            if self.state is not None:
+                leaves = jax.tree_util.tree_leaves(self.state["params"])
+                if leaves and isinstance(leaves[0], jax.Array):
+                    jax.block_until_ready(leaves[0])
+        finally:
+            jax.profiler.stop_trace()  # this is what writes the artifact
+            self._tracing = False
+        log_dist("device trace stopped", ranks=[0])
+
+    def _maybe_device_trace(self):
+        cfg = self.config.tpu_config.profiler_trace
+        if not cfg.enabled:
+            return
+        try:  # profiling must never kill a training step
+            if self.global_steps == cfg.start_step and not self._tracing:
+                self.start_device_trace(cfg.trace_dir)
+            elif self.global_steps >= cfg.start_step + cfg.num_steps and self._tracing:
+                self.stop_device_trace()
+        except Exception as e:
+            logger.warning(f"device trace hook failed ({type(e).__name__}: {e}); "
+                           "continuing without trace")
+            self._tracing = False
 
     def _maybe_flops_profile(self, batch):
         """Reference engine flops-profiler hook (``engine.py`` around
@@ -1299,6 +1344,7 @@ class DeepSpeedEngine:
         gas = self.config.gradient_accumulation_steps
         if self.micro_steps % gas != 0:
             return  # mid-accumulation micro-step, nothing to do
+        self._maybe_device_trace()  # eager 3-call path traces too
         assert self._grad_acc_buffer is not None, "step() called with no accumulated gradients"
         if self.host_optimizer is not None:
             grads = jax.tree_util.tree_map(lambda g: g / gas, self._grad_acc_buffer)
@@ -1660,6 +1706,10 @@ class DeepSpeedEngine:
         """Release compiled executables, device state, accumulated grads and
         host optimizer masters (reference ``destroy`` — lets a process build
         a fresh engine without holding two copies in HBM/host RAM)."""
+        if self._tracing:
+            # a trace window reaching the final step has no later train_batch
+            # to close it — flush the artifact before tearing state down
+            self.stop_device_trace()
         self._compiled = {}
         self.state = None
         self._grad_acc_buffer = None
