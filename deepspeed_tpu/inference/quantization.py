@@ -69,14 +69,83 @@ def quantize_weight_int8(w) -> QuantizedWeight:
     return QuantizedWeight(q, s)
 
 
+class QuantizedWeight4:
+    """Packed int4 weight (two nibbles per uint8 along the contraction axis)
+    + asymmetric per-output-channel scale/min — the reference's INT4 path
+    (``deepspeed/inference/quantization/utils.py:66`` uint8→uint4 packing,
+    asymmetric groups). HBM streams 4 bits/weight; the unpack (shift/mask)
+    and dequant (q/scale + min) fuse into the matmul operand read under XLA.
+    """
+
+    __slots__ = ("q", "scale", "zero")
+
+    def __init__(self, q, scale, zero):
+        self.q = q          # uint8 [..., K/2, N] — hi nibble row 2i, lo 2i+1
+        self.scale = scale  # fp32 [..., 1, N]: (max - min) / 15 — MULTIPLY to
+        #                     dequantize, same semantics as QuantizedWeight
+        self.zero = zero    # fp32 [..., 1, N]: min value
+
+    @property
+    def shape(self):
+        s = list(self.q.shape)
+        s[-2] *= 2
+        return tuple(s)
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def astype(self, dt):
+        hi = (self.q >> 4).astype(jnp.uint8)
+        lo = (self.q & 0xF).astype(jnp.uint8)
+        packed = jnp.stack((hi, lo), axis=-2)          # [..., K/2, 2, N]
+        k2, n = self.q.shape[-2], self.q.shape[-1]
+        unpacked = packed.reshape(*self.q.shape[:-2], 2 * k2, n)
+        return (unpacked.astype(jnp.float32) * self.scale + self.zero).astype(dt)
+
+    def __getitem__(self, idx):
+        # leading-dim slicing (the scan's per-layer view of stacked blocks)
+        return QuantizedWeight4(self.q[idx], self.scale[idx], self.zero[idx])
+
+    def __repr__(self):
+        return f"QuantizedWeight4(q={self.q.shape}, scale={self.scale.shape})"
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedWeight4,
+    lambda w: ((w.q, w.scale, w.zero), None),
+    lambda _, children: QuantizedWeight4(*children),
+)
+
+
+def quantize_weight_int4(w) -> QuantizedWeight4:
+    """Asymmetric per-output-channel int4 over the contraction (-2) axis,
+    packed two nibbles per byte (reference ``Quantizer._quantize_int8`` with
+    q_range=15 + ``_compress_uint8_to_uint4``)."""
+    wf = jnp.asarray(w, jnp.float32)
+    assert wf.shape[-2] % 2 == 0, f"int4 packing needs an even contraction dim, got {wf.shape}"
+    mn = wf.min(axis=-2, keepdims=True)
+    mx = wf.max(axis=-2, keepdims=True)
+    step = jnp.maximum(mx - mn, 1e-8) / 15.0
+    q = jnp.clip(jnp.round((wf - mn) / step), 0, 15).astype(jnp.uint8)
+    packed = ((q[..., 0::2, :] << 4) | q[..., 1::2, :]).astype(jnp.uint8)
+    # store the MULTIPLICATIVE step so the hot-path dequant is q*scale+zero
+    # (a fused multiply-add at the matmul operand read, not a division)
+    return QuantizedWeight4(packed, step, mn)
+
+
 def quantize_params_for_inference(params: Dict[str, Any], num_bits: int = 8) -> Dict[str, Any]:
     """Quantize the bandwidth-dominant weights of a transformer param tree:
     every >=2-D block weight (``w*``) and the untied ``lm_head`` kernel.
     Embeddings, biases and norm scales stay in their original dtype (the
     embedding gather is cheap and tied unembedding wants full precision).
+    ``num_bits``: 8 (symmetric per-channel) or 4 (asymmetric packed,
+    reference INT4 parity).
     """
-    if num_bits != 8:
-        raise NotImplementedError(f"weight-only quantization supports num_bits=8, got {num_bits}")
+    if num_bits not in (4, 8):
+        raise NotImplementedError(f"weight-only quantization supports num_bits in (4, 8), got {num_bits}")
+    quantize_fn = quantize_weight_int8 if num_bits == 8 else quantize_weight_int4
+    _quantized = (QuantizedWeight, QuantizedWeight4)
     out = dict(params)
     if "blocks" in params:
         blocks = dict(params["blocks"])
@@ -87,12 +156,12 @@ def quantize_params_for_inference(params: Dict[str, Any], num_bits: int = 8) -> 
             # Idempotent: already-quantized leaves pass through (the engine
             # and replace_transformer_layer may both apply the same config)
             if (name.startswith("w") or name.startswith("moe_w")) \
-                    and not isinstance(w, QuantizedWeight) and getattr(w, "ndim", 0) >= 2:
-                blocks[name] = quantize_weight_int8(w)
+                    and not isinstance(w, _quantized) and getattr(w, "ndim", 0) >= 2:
+                blocks[name] = quantize_fn(w)
         out["blocks"] = blocks
     if "lm_head" in params and "kernel" in params["lm_head"]:
         head = dict(params["lm_head"])
-        if not isinstance(head["kernel"], QuantizedWeight):
-            head["kernel"] = quantize_weight_int8(head["kernel"])
+        if not isinstance(head["kernel"], _quantized):
+            head["kernel"] = quantize_fn(head["kernel"])
         out["lm_head"] = head
     return out

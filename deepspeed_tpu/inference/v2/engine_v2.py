@@ -337,11 +337,12 @@ class InferenceEngineV2:
 
         eng = OrbaxCheckpointEngine()
         eng.save({"module": self.params}, save_path)
-        from ..quantization import QuantizedWeight
+        from ..quantization import QuantizedWeight, QuantizedWeight4
 
+        _q = (QuantizedWeight, QuantizedWeight4)
         mc = self.model_config
-        quantized = any(isinstance(x, QuantizedWeight) for x in jax.tree_util.tree_leaves(
-            self.params, is_leaf=lambda x: isinstance(x, QuantizedWeight)))
+        quantized = any(isinstance(x, _q) for x in jax.tree_util.tree_leaves(
+            self.params, is_leaf=lambda x: isinstance(x, _q)))
         meta = {"model_config": dataclasses.asdict(mc) if dataclasses.is_dataclass(mc)
                 else dict(getattr(mc, "__dict__", {})),
                 "quantized": quantized,  # from the params themselves, not an impl name

@@ -42,22 +42,26 @@ class AutoTP:
         per-output-channel ``scale`` (one block spanning the whole
         contraction axis) replicates along that axis — a row-parallel ``q``
         slice still dequantizes correctly with the full-axis scale."""
-        from ..inference.quantization import QuantizedWeight
+        from ..inference.quantization import QuantizedWeight, QuantizedWeight4
 
         def spec(kp, leaf):
             path = path_str(kp)
-            quant = isinstance(leaf, QuantizedWeight)
+            quant = isinstance(leaf, (QuantizedWeight, QuantizedWeight4))
             nd = np.ndim(leaf.q) if quant else np.ndim(leaf)
             s = self.policy.spec_for(path, nd)
             s = s if s is not None else P(*([None] * nd))
             if quant:
                 sc = list(s)
                 sc[-2] = None
+                if isinstance(leaf, QuantizedWeight4):
+                    # int4: scale AND zero replicate along the (packed)
+                    # contraction axis, exactly like the int8 scale
+                    return QuantizedWeight4(s, P(*sc), P(*sc))
                 return QuantizedWeight(s, P(*sc))
             return s
 
         return jax.tree_util.tree_map_with_path(
-            spec, params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+            spec, params, is_leaf=lambda x: isinstance(x, (QuantizedWeight, QuantizedWeight4)))
 
     def shard(self, params, mesh):
         """Annotate params with TP shardings over ``mesh`` (in-memory path)."""

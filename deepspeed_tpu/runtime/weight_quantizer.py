@@ -24,12 +24,18 @@ class WeightQuantization:
         self.scales: List = []
 
     def quantize_data(self, data, quantize_bits: int = 8, groups: int = 1, key=None):
-        """Quantize one matrix; returns (QuantizedWeight, scale). ``groups``
+        """Quantize one matrix; returns (QuantizedWeight[4], scale). ``groups``
         beyond 1 is subsumed by the blockwise kernel's per-output-channel
         scales (finer than the reference's row groups)."""
-        if quantize_bits != 8:
-            raise NotImplementedError(f"int{quantize_bits} weight quantization not supported (int8 only)")
-        qw = quantize_weight_int8(data)
+        if quantize_bits not in (4, 8):
+            raise NotImplementedError(
+                f"int{quantize_bits} weight quantization not supported (int4/int8 only)")
+        if quantize_bits == 4:
+            from ..inference.quantization import quantize_weight_int4
+
+            qw = quantize_weight_int4(data)
+        else:
+            qw = quantize_weight_int8(data)
         self.scales.append(qw.scale)
         return qw, qw.scale
 
@@ -42,7 +48,9 @@ class WeightQuantization:
         return quantize_params_for_inference(params, quantize_bits)
 
     def is_quantized(self, leaf) -> bool:
-        return isinstance(leaf, QuantizedWeight)
+        from ..inference.quantization import QuantizedWeight4
+
+        return isinstance(leaf, (QuantizedWeight, QuantizedWeight4))
 
     def sd_quantize_megatron(self, sd, quantize_bits: int = 8, groups: int = 1):
         """Quantize every >=2-D array in a flat state dict (megatron-style

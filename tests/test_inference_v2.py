@@ -680,3 +680,39 @@ def test_engine_churn_invariants():
         engine.flush(uid)
     assert engine.state_manager.free_blocks == total
     assert engine.state_manager.n_tracked_sequences == 0
+
+
+def test_v1_engine_int4_weights_close_to_fp():
+    """INT4 weight-only path (reference deepspeed/inference/quantization
+    utils.py:66 — asymmetric groups, uint8->uint4 packing): quant.num_bits=4
+    packs two nibbles per byte along the contraction axis, and the engine's
+    logits stay close to fp (looser than int8: 15 levels/channel)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.quantization import QuantizedWeight4
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    model = llama2("tiny", num_layers=2, hidden_size=64, num_heads=4, num_kv_heads=2,
+                   intermediate_size=128, vocab_size=128, max_seq_len=64, dtype=jnp.float32,
+                   attention_impl="reference")
+    fp = InferenceEngine(model, DeepSpeedInferenceConfig(dtype="float32"))
+    q4 = InferenceEngine(model, DeepSpeedInferenceConfig(
+        dtype="float32", quant={"enabled": True, "num_bits": 4}), params=fp.params)
+    w = q4.params["blocks"]["wq"]
+    assert isinstance(w, QuantizedWeight4)
+    assert w.q.dtype == jnp.uint8
+    # HALF the int8 bytes: packed contraction dim
+    assert w.q.shape[-2] * 2 == fp.params["blocks"]["wq"].shape[-2]
+    ids = np.random.default_rng(3).integers(0, 128, size=(1, 12)).astype(np.int32)
+    lf = np.asarray(fp.forward(ids))
+    lq = np.asarray(q4.forward(ids))
+    scale = np.abs(lf).max()
+    assert np.isfinite(lq).all()
+    assert np.abs(lq - lf).max() / scale < 0.25, np.abs(lq - lf).max() / scale
+    # int8 must stay tighter than int4 on the same weights
+    q8 = InferenceEngine(model, DeepSpeedInferenceConfig(
+        dtype="float32", quant={"enabled": True, "num_bits": 8}), params=fp.params)
+    l8 = np.asarray(q8.forward(ids))
+    assert np.abs(l8 - lf).max() <= np.abs(lq - lf).max()
+    groups.reset()

@@ -348,3 +348,43 @@ def test_module_inject_layers_functional(eight_devices):
     r = rms_normalize(x, jnp.ones(16))
     assert r.shape == x.shape
     groups.reset()
+
+
+def test_int4_quantize_then_shard_tp_placement():
+    """INT4 + TP: QuantizedWeight4 leaves are unit-specced by AutoTP (q takes
+    the weight's TP rule; scale AND zero replicate along the packed
+    contraction axis), and the sharded int4 forward matches the unsharded
+    quantized forward."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.quantization import (QuantizedWeight4,
+                                                      quantize_params_for_inference)
+
+    groups.reset()
+    mesh = groups.initialize_mesh(MeshConfig(data=2, model=4))
+    model = TransformerLM(TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                                            intermediate_size=64, max_seq_len=16, dtype=jnp.float32,
+                                            attention_impl="reference"))
+    params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
+    cfg = DeepSpeedInferenceConfig(dtype="float32", quant={"enabled": True, "num_bits": 4})
+    _, qparams = replace_transformer_layer(model=model, params=params, model_type="llama",
+                                           mesh=mesh, config=cfg)
+
+    leaves = jax.tree_util.tree_leaves(qparams, is_leaf=lambda x: isinstance(x, QuantizedWeight4))
+    q4 = [x for x in leaves if isinstance(x, QuantizedWeight4)]
+    assert q4, "num_bits=4 produced no QuantizedWeight4 leaves"
+    assert any(any(ax is not None for ax in w.q.sharding.spec) for w in q4), \
+        "no int4 weight carries a model-axis sharding"
+    for w in q4:
+        for aux in (w.scale, w.zero):
+            spec = aux.sharding.spec
+            assert len(spec) < 2 or spec[-2] is None, spec
+
+    from deepspeed_tpu.models.transformer import forward
+
+    ids = np.random.default_rng(0).integers(0, 64, size=(2, 16), dtype=np.int32)
+    ref_q = quantize_params_for_inference(jax.device_get(params), num_bits=4)
+    want = forward(model.config, ref_q, ids)
+    with mesh:
+        got = forward(model.config, qparams, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4)
+    groups.reset()
