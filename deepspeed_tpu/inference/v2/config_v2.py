@@ -5,6 +5,7 @@ with ``state_manager: DSStateManagerConfig`` and tensor-parallel settings).
 """
 
 from dataclasses import dataclass, field
+from typing import Union
 
 import jax.numpy as jnp
 
@@ -48,6 +49,8 @@ class RaggedInferenceEngineConfig:
     use_pallas_kernels: str = "auto"  # 'auto' | 'never' | 'always'
     # weight-only int8 (per-output-channel scales): halves the decode weight
     # stream, which is the bandwidth-bound term at serving batch sizes
-    quantize_weights: bool = False
+    # weight-only quantization for the serving weight stream:
+    # False | True (int8) | 8 | 4 (packed nibbles — quarter the bf16 bytes)
+    quantize_weights: Union[bool, int] = False
     # pluggable module layer: which implementation serves each op slot
     modules: ModulesConfig = field(default_factory=ModulesConfig)

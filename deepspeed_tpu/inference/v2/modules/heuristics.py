@@ -45,7 +45,10 @@ def instantiate_attention(attention_config: DSSelfAttentionConfig, engine_config
 
 def instantiate_linear(linear_config: DSLinearConfig, engine_config):
     choice = getattr(engine_config.modules, "linear", "auto")
-    default = ("int8_blockwise_linear" if getattr(engine_config, "quantize_weights", False)
+    qw = getattr(engine_config, "quantize_weights", False)
+    # quantize_weights: False | True (-> int8) | 4 | 8
+    default = ("int4_blockwise_linear" if qw == 4
+               else "int8_blockwise_linear" if qw
                else "blas_fp_linear")
     return DSLinearRegistry.instantiate_config(_bundle(choice, default, linear_config))
 

@@ -63,3 +63,27 @@ class Int8BlockwiseLinear(DSLinearBase):
 
     def __call__(self, x, w, b=None):
         return _matmul(x, w, b, self.config.dtype)
+
+
+@DSLinearRegistry.register_module
+class Int4BlockwiseLinear(DSLinearBase):
+    """INT4 weight-only (reference ``quantize_intX``/mixed_gemm int4 path):
+    asymmetric per-output-channel groups packed two nibbles per byte — the
+    decode weight stream QUARTERS vs bf16; unpack+dequant fuse into the
+    dot's operand read (``QuantizedWeight4.astype``)."""
+
+    @staticmethod
+    def name() -> str:
+        return "int4_blockwise_linear"
+
+    @staticmethod
+    def supports_config(config: DSLinearConfig) -> bool:
+        return True
+
+    def transform_params(self, params):
+        from ....quantization import quantize_params_for_inference
+
+        return quantize_params_for_inference(params, num_bits=4)
+
+    def __call__(self, x, w, b=None):
+        return _matmul(x, w, b, self.config.dtype)

@@ -716,3 +716,37 @@ def test_v1_engine_int4_weights_close_to_fp():
     l8 = np.asarray(q8.forward(ids))
     assert np.abs(l8 - lf).max() <= np.abs(lq - lf).max()
     groups.reset()
+
+
+def test_v2_engine_int4_weights_close_to_fp():
+    """v2 serving with quantize_weights=4 routes to int4_blockwise_linear:
+    the weight stream quarters (packed nibbles) and prefill logits stay
+    close to fp; int8 stays tighter than int4 on the same model."""
+    from deepspeed_tpu.inference.quantization import QuantizedWeight, QuantizedWeight4
+
+    model = llama2("tiny", num_layers=2, hidden_size=64, num_heads=4, num_kv_heads=2,
+                   intermediate_size=128, vocab_size=128, max_seq_len=256, dtype=jnp.float32,
+                   attention_impl="reference")
+    params = jax.jit(lambda r: model.init(r, None))(jax.random.PRNGKey(0))
+    sm = DSStateManagerConfig(max_tracked_sequences=8, max_ragged_batch_size=64,
+                              max_ragged_sequence_count=4, max_context=64)
+    mk = lambda quant: InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(kv_block_size=8, num_kv_blocks=32,
+                                           kv_dtype=jnp.float32, state_manager=sm,
+                                           use_pallas_kernels="never",
+                                           quantize_weights=quant), params=params)
+    fp, q8, q4 = mk(False), mk(True), mk(4)
+    assert isinstance(q4.params["blocks"]["wq"], QuantizedWeight4)
+    assert isinstance(q8.params["blocks"]["wq"], QuantizedWeight)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, 128, size=17).astype(np.int32)
+    lf = np.asarray(fp.put([1], [prompt]))
+    l8 = np.asarray(q8.put([1], [prompt]))
+    l4 = np.asarray(q4.put([1], [prompt]))
+    scale = np.abs(lf).max()
+    assert np.isfinite(l4).all()
+    # random N(0,1) weights are the worst case for 15-level asymmetric quant
+    # (real pretrained weights quantize far tighter); the ORDERING is the
+    # meaningful invariant: int8 must be tighter than int4 on the same model
+    assert np.abs(l4 - lf).max() / scale < 0.5
+    assert np.abs(l8 - lf).max() <= np.abs(l4 - lf).max()
