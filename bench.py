@@ -268,15 +268,29 @@ def run_bench():
             print("# WARNING: tests_tpu/ missing — on-TPU kernel numerics gate SKIPPED", flush=True)
         else:
             env = dict(os.environ)
-            env["JAX_COMPILATION_CACHE_DIR"] = cache_dir  # child reuses the warm cache
+            env["JAX_COMPILATION_CACHE_DIR"] = cache_dir  # children share the warm cache
+
+            def run_pytest(args, timeout):
+                return subprocess.run([sys.executable, "-m", "pytest", suite, "-q"] + args,
+                                      capture_output=True, text=True, timeout=timeout, env=env)
+
+            # STAGE 1 — bench-critical kernels only. Runs first so a slow
+            # cold compile of a kernel the bench never executes (evoformer's
+            # 4-pass bwd, block-sparse) can't eat the whole gate budget
+            # (a cold cache blew the single 900s pytest run this replaced).
+            kexpr = " or ".join(critical)
             try:
-                proc = subprocess.run([sys.executable, "-m", "pytest", suite, "-q"],
-                                      capture_output=True, text=True, timeout=900, env=env)
+                proc = run_pytest(["-k", kexpr], timeout=1200)
             except subprocess.TimeoutExpired as e:
-                raise RuntimeError(f"on-TPU kernel validation timed out after {e.timeout}s") from e
-            failed = re.findall(r"FAILED (\S+)", proc.stdout)
+                raise RuntimeError(f"on-TPU CRITICAL kernel validation timed out after "
+                                   f"{e.timeout}s") from e
+            failed1 = re.findall(r"FAILED (\S+)", proc.stdout)
+            # criticality is judged on the FUNCTION name, not on -k's sweep
+            # (-k also matches module/class keywords, so a future
+            # tests_tpu/test_quant_*.py FILE would ride in — the r4
+            # false-abort class): only a genuinely critical-named test aborts
             crit_failed = [
-                f for f in failed
+                f for f in failed1
                 if any(c in f.split("::")[-1] for c in critical)
                 and not any(m in f for m in noncritical_markers)
             ]
@@ -284,17 +298,38 @@ def run_bench():
                 raise RuntimeError("on-TPU kernel validation FAILED on bench-critical kernels "
                                    f"{crit_failed}:\n" + proc.stdout[-3000:] + "\n"
                                    + proc.stderr[-2000:])
-            if failed:
-                gate_note = f"non-critical on-chip kernel tests FAILED: {failed}"
+            if failed1:
+                gate_note = f"non-critical on-chip kernel tests FAILED: {failed1}"
                 print(f"# WARNING: {gate_note} — bench paths unaffected, continuing", flush=True)
             if " passed" not in proc.stdout:
                 # e.g. a locked single-process TPU: the child saw no device
-                # and skipped everything — say so rather than claim coverage
-                print("# WARNING: on-TPU kernel suite ran NO tests (device not visible to "
-                      "subprocess?) — numerics gate ineffective", flush=True)
+                # and skipped everything — disclose in the JSON too, not
+                # just stdout (coverage must not be claimed silently)
+                gate_note = "critical kernel stage ran NO tests — numerics gate ineffective"
+                print(f"# WARNING: on-TPU {gate_note} (device not visible to subprocess?)",
+                      flush=True)
             else:
                 tail = proc.stdout.strip().splitlines()
-                print(f"# on-TPU kernel suite: {tail[-1] if tail else 'ok'}", flush=True)
+                print(f"# on-TPU critical kernels: {tail[-1] if tail else 'ok'}", flush=True)
+
+            # STAGE 2 — everything else (evoformer, sparse, grouped, ...):
+            # disclose-only. A failure OR timeout here never forfeits the
+            # perf number (r3 lesson); it lands in the JSON as a warning.
+            def add_note(note):
+                nonlocal_note = f"{gate_note}; {note}" if gate_note else note
+                print(f"# WARNING: {note} — bench paths unaffected, continuing", flush=True)
+                return nonlocal_note
+
+            try:
+                proc2 = run_pytest(["-k", f"not ({kexpr})"], timeout=900)
+                failed2 = re.findall(r"FAILED (\S+)", proc2.stdout)
+                if failed2:
+                    gate_note = add_note(f"non-critical on-chip kernel tests FAILED: {failed2}")
+                else:
+                    tail2 = proc2.stdout.strip().splitlines()
+                    print(f"# on-TPU non-critical kernels: {tail2[-1] if tail2 else 'ok'}", flush=True)
+            except subprocess.TimeoutExpired:
+                gate_note = add_note("non-critical on-chip kernel stage timed out (cold compiles?)")
 
     serving = bench_serving(on_tpu)
     print(json.dumps(serving))
