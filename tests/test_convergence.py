@@ -19,7 +19,9 @@ import sys
 
 if __name__ == "__main__":
     # standalone --regen must see the same 8-virtual-device CPU backend the
-    # pytest run gets from conftest.py — set up BEFORE any jax import
+    # pytest run gets from conftest.py — set up BEFORE any jax import; the
+    # repo root goes on sys.path too (script invocation only adds tests/)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
