@@ -316,9 +316,9 @@ def run_bench():
             # disclose-only. A failure OR timeout here never forfeits the
             # perf number (r3 lesson); it lands in the JSON as a warning.
             def add_note(note):
-                nonlocal_note = f"{gate_note}; {note}" if gate_note else note
+                combined = f"{gate_note}; {note}" if gate_note else note
                 print(f"# WARNING: {note} — bench paths unaffected, continuing", flush=True)
-                return nonlocal_note
+                return combined
 
             try:
                 proc2 = run_pytest(["-k", f"not ({kexpr})"], timeout=900)

@@ -464,14 +464,9 @@ class DeepSpeedEngine:
         nvme = offload_cfg.nvme_path if str(offload_cfg.device) == "nvme" else None
         if str(offload_cfg.device) == "nvme":
             assert nvme, "offload_optimizer.device=nvme requires nvme_path"
-        host_params = self.state["params"]
-        block_shardings = self.zero_policy.grad_shardings(self.state["params"])
-        if self._twin_mask is not None:
-            # twin-flow: the host optimizer owns only its slice of the tree
-            from .zero.offload import prune_tree
-
-            host_params = prune_tree(host_params, self._twin_mask, keep=True)
-            block_shardings = prune_tree(block_shardings, self._twin_mask, keep=True)
+        # twin-flow: the host optimizer owns only its slice of the tree
+        host_params = self._host_slice(self.state["params"])
+        block_shardings = self._host_slice(self.zero_policy.grad_shardings(self.state["params"]))
         return HostOffloadOptimizer(host_params,
                                     lr=params.get("lr", 1e-3),
                                     betas=tuple(params.get("betas", (0.9, 0.999))),
@@ -493,18 +488,17 @@ class DeepSpeedEngine:
         param_shardings = self.zero_policy.param_shardings(param_shapes)
         if self._offload_enabled and self._offload_ratio < 1.0:
             # twin-flow: the device slice keeps a normal optax state in HBM
-            from .zero.offload import partition_leaves_by_ratio, prune_tree
+            from .zero.offload import partition_leaves_by_ratio
 
             self._twin_mask = partition_leaves_by_ratio(param_shapes, self._offload_ratio)
             n_host = sum(jax.tree_util.tree_leaves(self._twin_mask))
             n_all = len(jax.tree_util.tree_leaves(param_shapes))
             log_dist(f"twin-flow offload: ratio={self._offload_ratio} -> {n_host}/{n_all} "
                      f"param leaves' optimizer state on host, rest on device", ranks=[0])
-            dev_shapes = prune_tree(param_shapes, self._twin_mask, keep=False)
-            opt_init = lambda params: self._twin_tx.init(prune_tree(params, self._twin_mask, False))
+            dev_shapes = self._dev_slice(param_shapes)
+            opt_init = lambda params: self._twin_tx.init(self._dev_slice(params))
             opt_shapes = jax.eval_shape(self._twin_tx.init, dev_shapes)
-            opt_shardings = self.zero_policy.opt_state_shardings(
-                opt_shapes, dev_shapes)
+            opt_shardings = self.zero_policy.opt_state_shardings(opt_shapes, dev_shapes)
         elif self._offload_enabled:
             # ZeRO-Offload: moments live on host/NVMe — nothing in HBM
             opt_init = lambda params: {}
@@ -722,6 +716,13 @@ class DeepSpeedEngine:
 
         return prune_tree(tree, self._twin_mask, keep=True)
 
+    def _dev_slice(self, tree):
+        """The device (HBM) optimizer slice — twin-flow only."""
+        assert self._twin_mask is not None, "_dev_slice outside twin-flow"
+        from .zero.offload import prune_tree
+
+        return prune_tree(tree, self._twin_mask, keep=False)
+
     def _build_twin_device_update(self):
         """Compiled update for the twin-flow DEVICE slice: pre-scaled grads
         (unscale + global clip folded into ``scale``) through the bare tx.
@@ -735,9 +736,7 @@ class DeepSpeedEngine:
             new_params = jax.tree_util.tree_map(lambda n, p: n.astype(p.dtype), new_params, dev_params)
             return new_params, new_opt
 
-        from .zero.offload import prune_tree
-
-        dev_shardings = prune_tree(self._state_shardings["params"], self._twin_mask, keep=False)
+        dev_shardings = self._dev_slice(self._state_shardings["params"])
         return jax.jit(dev_update, donate_argnums=(0, 1),
                        out_shardings=(dev_shardings, self._state_shardings["opt_state"]))
 
@@ -752,7 +751,7 @@ class DeepSpeedEngine:
         so HBM-side Adam runs concurrently with the host C++ Adam; the two
         halves are merged afterwards. Clip/overflow decisions use the ONE
         global norm for both."""
-        from .zero.offload import merge_by_mask, prune_tree
+        from .zero.offload import merge_by_mask
 
         twin = self._twin_mask is not None
         step_no = int(self.state["step"]) + 1
@@ -772,11 +771,11 @@ class DeepSpeedEngine:
                     self._compiled["twin_dev_update"] = self._build_twin_device_update()
                 with self.mesh:
                     dev_future = self._compiled["twin_dev_update"](
-                        prune_tree(self.state["params"], self._twin_mask, keep=False),
+                        self._dev_slice(self.state["params"]),
                         self.state["opt_state"],
-                        prune_tree(grads, self._twin_mask, keep=False),
+                        self._dev_slice(grads),
                         jnp.asarray(factor, jnp.float32))
-            grads = prune_tree(grads, self._twin_mask, keep=True)
+            grads = self._host_slice(grads)
 
         new_params, grad_norm, overflow = self.host_optimizer.step(step_no, grads, lr=lr, loss_scale=scale,
                                                                    grad_norm=gnorm)
@@ -784,8 +783,8 @@ class DeepSpeedEngine:
             param_shardings = self._state_shardings["params"]
             dtypes = jax.tree_util.tree_map(lambda p: p.dtype, self.state["params"])
             if twin:
-                param_shardings = prune_tree(param_shardings, self._twin_mask, keep=True)
-                dtypes = prune_tree(dtypes, self._twin_mask, keep=True)
+                param_shardings = self._host_slice(param_shardings)
+                dtypes = self._host_slice(dtypes)
             if self.host_optimizer.shard_mode:
                 host_params = self.host_optimizer.rebuild_device_params(param_shardings, dtypes)
             else:
