@@ -203,6 +203,49 @@ def bench_serving(on_tpu: bool):
     return out
 
 
+def trace_demo(seq=128, micro=2):
+    """Drive the eager 3-call engine API and one eager collective under the
+    live tracer: the fwd/bwd/step phase spans only exist as separate host
+    calls on this path (the fused train_batch is ONE compiled program and is
+    traced as its own span), and the eager all_reduce exercises @timed_op's
+    wall-timed regime with real payload bytes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu import dist
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset()
+    cfg = TransformerConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+                            intermediate_size=256, max_seq_len=seq, dtype=jnp.float32,
+                            attention_impl="reference")
+    model = TransformerLM(cfg)
+    n_chips = len(jax.devices())
+    config = {
+        "train_batch_size": micro * n_chips,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 10**9,
+        "tpu": {"mesh": {"data": n_chips}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, size=(micro * n_chips, seq),
+                                       dtype=np.int32)}
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    engine.step()
+    x = np.ones((256, 1024), np.float32)  # 1 MiB payload
+    # the first call compiles the eager executable; timed_op tags that span
+    # `compiled` and keeps it out of the comms bandwidth stats automatically
+    for _ in range(4):
+        dist.all_reduce(x)
+    _free_engine(engine, "state")
+
+
 def run_bench():
     import jax
 
@@ -223,6 +266,23 @@ def run_bench():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+
+    # --trace OUT.jsonl: enable the unified observability bus (monitor/trace.py)
+    # BEFORE any compile so jax_compile events land in the artifact; the same
+    # switch turns on the metrics registry and real comms byte accounting
+    trace_path = os.environ.get("DS_TPU_BENCH_TRACE")
+    if trace_path:
+        from deepspeed_tpu.monitor.trace import configure_tracer
+        from deepspeed_tpu.monitor.metrics import configure_metrics
+        from deepspeed_tpu.comm import comm as _dist
+
+        try:  # fresh artifact per child (TPU/CPU children share the path)
+            os.remove(trace_path)
+        except OSError:
+            pass
+        configure_tracer(enabled=True, path=trace_path)
+        configure_metrics(enabled=True)
+        _dist.configure(enabled=True, prof_all=True)
 
     try:
         on_tpu = any(d.platform == "tpu" for d in jax.devices())
@@ -386,10 +446,21 @@ def run_bench():
     # chain must stay near the HBM roofline, not hide behind gas=16
     gas4_tps, _ = train_tps(cfg, micro, 4 if on_tpu else 1, seq, 3 * steps if on_tpu else 2, 2)
 
+    if trace_path:
+        # eager 3-call path demo: genuine fwd/bwd/step spans plus an eager
+        # device collective (comm/all_reduce span with real bytes + bandwidth)
+        try:
+            trace_demo(seq=128)
+        except Exception as e:
+            print(f"# WARNING: trace demo failed ({type(e).__name__}: {e}); "
+                  "trace keeps the train_batch/serving/compile spans", flush=True)
+
     n_params = model.num_params()
     # fwd+bwd ≈ 6 FLOPs/param/token + attention term (PaLM MFU convention)
-    attn_flops_per_token = 12 * cfg.num_layers * cfg.hidden_size * seq
-    flops_per_token = 6 * n_params + attn_flops_per_token
+    from deepspeed_tpu.profiling.flops_profiler import training_flops_per_token
+
+    flops_per_token = training_flops_per_token(n_params, num_layers=cfg.num_layers,
+                                               hidden_size=cfg.hidden_size, seq_len=seq)
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
     mfu = tok_per_sec_per_chip * flops_per_token / peak
     mfu4 = gas4_tps * flops_per_token / peak
@@ -406,12 +477,23 @@ def run_bench():
         # llama-arch model one v5e chip fits, against the same 54% bar
         "workload": f"{n_params/1e6:.1f}M llama-arch, seq {seq}, ZeRO-3, single v5e chip",
         "serving": {k: serving[k] for k in ("value", "ttft_p50_ms", "vs_baseline")},
+        # achieved MFU fraction (null on the CPU fallback — the v5e-peak
+        # denominator would read as a 99.9% regression, the VERDICT r4 trap)
+        "mfu": round(mfu, 4) if on_tpu else None,
         "on_tpu": on_tpu,
     }
     if not on_tpu:
         line["tpu_unavailable_reason"] = tpu_error or "no TPU device visible"
     if gate_note:
         line["kernel_gate_warning"] = gate_note
+    if trace_path:
+        from deepspeed_tpu.comm.comm import comms_logger
+        from deepspeed_tpu.monitor.trace import get_tracer
+
+        if comms_logger.comms_dict:
+            line["comms"] = comms_logger.summary()
+        line["trace"] = trace_path
+        get_tracer().close()
     print(json.dumps(line))
 
 
@@ -626,6 +708,15 @@ def supervise():
 
 
 if __name__ == "__main__":
+    # --trace OUT.jsonl: Chrome-trace/Perfetto JSONL artifact (README
+    # "Observability"). Parsed in both supervisor and child mode; the
+    # supervisor forwards it to children through the environment.
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        if i + 1 >= len(sys.argv):
+            print("usage: bench.py [--trace OUT.jsonl]", file=sys.stderr)
+            sys.exit(2)
+        os.environ["DS_TPU_BENCH_TRACE"] = os.path.abspath(sys.argv[i + 1])
     if os.environ.get("DS_TPU_BENCH_CHILD") == "1":
         run_bench()
     else:

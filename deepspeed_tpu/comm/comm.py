@@ -13,17 +13,17 @@ Analog of the reference ``deepspeed/comm/comm.py`` (contract stated at lines
 The global backend handle is ``cdb`` — same name as reference ``comm.py:41``.
 """
 
+import inspect
 import os
 import time
 import functools
 
 from .backend import XlaBackend
-from .functional import (  # noqa: F401 — traced-plane re-exports
-    ReduceOp, all_reduce, inference_all_reduce, all_gather, all_gather_into_tensor, reduce_scatter,
-    reduce_scatter_tensor, all_to_all_single, broadcast, ppermute, send_recv_next, send_recv_prev, axis_index,
-    axis_size)
+from . import functional as _functional
+from .functional import ReduceOp, axis_index, axis_size  # noqa: F401 — pure helpers, no comm payload
+from ..monitor.trace import get_tracer
 from ..utils.logging import logger, log_dist
-from ..utils.comms_logging import CommsLogger
+from ..utils.comms_logging import CommsLogger, calc_bw_log
 
 cdb = None
 comms_logger = CommsLogger()
@@ -34,19 +34,251 @@ class CommException(Exception):
     pass
 
 
+# ---------------------------------------------------------------------------
+# instrumentation: real message sizes, wall times, trace spans
+# ---------------------------------------------------------------------------
+def _leaf_nbytes(x):
+    """Bytes carried by one pytree leaf: concrete arrays via ``nbytes``,
+    tracers via their aval shape/dtype, non-tensor leaves count zero."""
+    import numpy as np
+
+    nb = getattr(x, "nbytes", None)
+    if isinstance(nb, (int, np.integer)):
+        return int(nb)
+    aval = getattr(x, "aval", None)
+    if aval is not None and hasattr(aval, "shape") and hasattr(aval, "dtype"):
+        try:
+            return int(np.prod(aval.shape)) * np.dtype(aval.dtype).itemsize
+        except Exception:
+            return 0
+    return 0
+
+
+def _msg_bytes(args, kwargs):
+    """Pytree-aware payload size: the nbytes sum over every tensor leaf in
+    the call (the reference sizes ``tensor.element_size() * tensor.nelement()``;
+    here a collective may carry a whole tree)."""
+    import jax
+
+    return sum(_leaf_nbytes(l) for l in jax.tree_util.tree_leaves((args, kwargs)))
+
+
+def _has_tracer(args, kwargs):
+    import jax
+
+    return any(isinstance(l, jax.core.Tracer) for l in jax.tree_util.tree_leaves((args, kwargs)))
+
+
+def _group_degree(group):
+    """Participant count of a collective over ``group`` — the ``n`` in the
+    algbw/busbw formulas. Mesh-axis groups use the axis extent (devices);
+    rank-list groups their length; fallback is the process world size."""
+    try:
+        from ..parallel import groups as pgroups
+
+        if pgroups.is_initialized():
+            mesh = pgroups.get_mesh()
+            if group is None:
+                return max(1, mesh.size)
+            names = group if isinstance(group, (list, tuple)) else (group, )
+            if all(isinstance(a, str) and a in mesh.shape for a in names):
+                d = 1
+                for a in names:
+                    d *= mesh.shape[a]
+                return max(1, d)
+    except Exception:
+        pass
+    if isinstance(group, (list, tuple)) and group and all(isinstance(r, int) for r in group):
+        return len(group)
+    if cdb is not None:
+        return max(1, cdb.get_world_size())
+    return 1
+
+
+def _block_on(result):
+    """Drain async dispatch so the wall time covers the transfer, giving the
+    same 'device work up to here is done' point CUDA events give the
+    reference's timed_op."""
+    try:
+        import jax
+
+        jax.block_until_ready(result)
+    except Exception:
+        pass
+    return result
+
+
 def timed_op(func):
-    """Reference ``comm.py:101`` @timed_op — wall-times host-plane ops."""
+    """Reference ``comm.py:101`` @timed_op — wall-times collectives with REAL
+    payload bytes (pytree nbytes sum, not the old hardcoded 0).
+
+    Three regimes:
+      * profiling off (default): straight call — zero overhead;
+      * under jit (tracer args): the collective compiles into the step
+        program, so host wall time is meaningless — record an instant trace
+        event carrying the traced payload size;
+      * eager concrete call: wall-time around a ``block_until_ready`` and
+        feed latency + bytes through ``calc_bw_log`` (comms logger + a
+        ``comm/<op>`` trace span with algo/bus bandwidth).
+    """
+    name = func.__name__
+    try:
+        sig = inspect.signature(func)
+        group_default = sig.parameters["group"].default if "group" in sig.parameters else None
+    except (TypeError, ValueError):
+        sig, group_default = None, None
+
+    def _call_group(args, kwargs):
+        """The group actually in effect — positional, keyword or default."""
+        if sig is not None:
+            try:
+                return sig.bind(*args, **kwargs).arguments.get("group", group_default)
+            except TypeError:
+                pass
+        return kwargs.get("group", group_default)
 
     @functools.wraps(func)
     def wrapper(*args, **kwargs):
-        if comms_logger.enabled and (comms_logger.prof_all or func.__name__ in comms_logger.prof_ops):
-            t0 = time.time()
-            result = func(*args, **kwargs)
-            comms_logger.append(func.__name__, func.__name__, time.time() - t0, 0)
-            return result
+        tracer = get_tracer()
+        prof = comms_logger.enabled and (comms_logger.prof_all or name in comms_logger.prof_ops)
+        if not (prof or tracer.enabled):
+            return func(*args, **kwargs)
+        msg_size = _msg_bytes(args, kwargs)
+        if _has_tracer(args, kwargs):
+            if tracer.enabled:
+                tracer.instant(f"comm/{name}", tid="comm", msg_size=msg_size, traced=True)
+            return func(*args, **kwargs)
+        n = _group_degree(_call_group(args, kwargs))
+        _eager_state["compiled"] = False
+        t0 = time.perf_counter()
+        result = _block_on(func(*args, **kwargs))
+        duration = time.perf_counter() - t0
+        compiled = _eager_state["compiled"]
+        if prof and not compiled:
+            # a call that just compiled its eager executable is not a
+            # steady-state sample — keep it out of the bandwidth stats
+            comms_logger.append(name, name, duration, msg_size, n=n)
+        if tracer.enabled:
+            algbw, busbw, _ = calc_bw_log(name, msg_size, duration, n=n)
+            span_args = {"msg_size": msg_size, "algbw_gbps": round(algbw, 4),
+                         "busbw_gbps": round(busbw, 4), "n": n}
+            if compiled:
+                span_args["compiled"] = True  # disclosed, excluded from stats
+            tracer.complete(f"comm/{name}", t0, duration, tid="comm", args=span_args)
+        return result
+
+    return wrapper
+
+
+# eager-executable subset: replicated-operand semantics are well defined for
+# these (the result every participant agrees on); all_to_all and the ring/p2p
+# ops have inherently per-participant results and stay jit-only
+_EAGER_OK = frozenset({
+    "all_reduce", "inference_all_reduce", "all_gather", "reduce_scatter", "broadcast"
+})
+
+# signal from _eagerize to timed_op: the call it just serviced compiled a new
+# executable, so its wall time is NOT a steady-state comm sample
+_eager_state = {"compiled": False}
+_EAGER_CACHE_MAX = 64  # per-op bound; entries pin their mesh + executable
+
+
+def _eager_out_spec(name, axes, bound_args):
+    from jax.sharding import PartitionSpec as P
+
+    if name == "reduce_scatter":
+        dim = bound_args.get("scatter_dimension", 0)
+        return P(*([None] * dim + [tuple(axes) if len(axes) > 1 else axes[0]]))
+    return P()
+
+
+def _eagerize(func):
+    """Let a traced-plane collective run with CONCRETE arrays outside jit:
+    the call is wrapped in a one-off ``shard_map`` over the current mesh
+    (operands replicated), jitted, executed and cached by shape — the
+    torch.distributed ergonomics, and what lets ``timed_op`` wall-time a real
+    device collective (``bench.py --trace``'s comm spans). Inside jit, or
+    with no mesh initialized, the call passes through untouched.
+
+    Caveat: the FIRST eager call per (op, shape, dtype, group) includes the
+    jit compile in its wall time — discard or warm past that sample when
+    deriving steady-state bandwidth (bench.py does)."""
+    name = func.__name__
+    sig = inspect.signature(func)
+    cache = {}
+
+    tensor_param = next(iter(sig.parameters))  # the payload is always first
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        if name in _EAGER_OK and (args or kwargs) and not _has_tracer(args, kwargs):
+            try:
+                from ..parallel import groups as pgroups
+
+                eligible = pgroups.is_initialized()
+                rest = tensor_val = None
+                if eligible:
+                    try:
+                        bound = sig.bind(*args, **kwargs)
+                    except TypeError:
+                        eligible = False  # malformed call: let func raise its own error
+                if eligible:
+                    bound.apply_defaults()
+                    rest = dict(bound.arguments)
+                    tensor_val = rest.pop(tensor_param, None)
+                    group = rest.get("group")
+                    mesh = pgroups.get_mesh()
+                    axes = group if isinstance(group, (list, tuple)) else (group, )
+                    eligible = tensor_val is not None and \
+                        all(isinstance(a, str) and a in mesh.shape for a in axes)
+                if eligible:
+                    import jax
+                    import jax.numpy as jnp
+                    from jax.sharding import PartitionSpec as P
+
+                    tensor = jnp.asarray(tensor_val)
+                    key = (name, tensor.shape, str(tensor.dtype), tuple(axes),
+                           repr(sorted((k, repr(v)) for k, v in rest.items())), id(mesh))
+                    fn = cache.get(key)
+                    if fn is None:
+                        from ..parallel.mesh import shard_map_compat
+
+                        out_spec = _eager_out_spec(name, tuple(axes), bound.arguments)
+                        inner = lambda x, _rest=rest: func(x, **_rest)
+                        fn = jax.jit(shard_map_compat(inner, mesh, P(), out_spec))
+                        while len(cache) >= _EAGER_CACHE_MAX:  # FIFO bound:
+                            cache.pop(next(iter(cache)))  # entries pin meshes
+                        cache[key] = fn
+                        _eager_state["compiled"] = True
+                    with mesh:
+                        return fn(tensor)
+            except Exception as e:
+                raise CommException(
+                    f"eager {name} over mesh failed ({type(e).__name__}: {e}); call it inside "
+                    "jit/shard_map over the target axis for full control") from e
+        # in-jit, no mesh, or non-eagerable op: the traced plane as before
         return func(*args, **kwargs)
 
     return wrapper
+
+
+# ---------------------------------------------------------------------------
+# public traced-plane surface: EVERY collective rides @timed_op (the static
+# check tools/check_timed_ops.py keeps this from rotting)
+# ---------------------------------------------------------------------------
+all_reduce = timed_op(_eagerize(_functional.all_reduce))
+inference_all_reduce = timed_op(_eagerize(_functional.inference_all_reduce))
+all_gather = timed_op(_eagerize(_functional.all_gather))
+all_gather_into_tensor = all_gather  # alias parity with the functional plane
+reduce_scatter = timed_op(_eagerize(_functional.reduce_scatter))
+reduce_scatter_tensor = reduce_scatter
+all_to_all_single = timed_op(_eagerize(_functional.all_to_all_single))
+broadcast = timed_op(_eagerize(_functional.broadcast))
+ppermute = timed_op(_functional.ppermute)
+send_recv_next = timed_op(_functional.send_recv_next)
+send_recv_prev = timed_op(_functional.send_recv_prev)
+send = timed_op(_functional.send)
+recv = timed_op(_functional.recv)
 
 
 def init_distributed(dist_backend="xla",

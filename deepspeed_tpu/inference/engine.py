@@ -110,7 +110,11 @@ class InferenceEngine:
         Returns [B, S_prompt + max_new_tokens].
         """
         from ..models.transformer import init_kv_cache, forward_with_cache
+        from ..monitor.metrics import get_metrics
+        from ..monitor.trace import get_tracer
 
+        observing = get_tracer().enabled or get_metrics().enabled
+        t0 = time.perf_counter() if observing else 0.0
         cfg = self.model_config
         input_ids = np.asarray(input_ids)
         B, S = input_ids.shape
@@ -146,6 +150,13 @@ class InferenceEngine:
                 hits = np.where(out[b] == eos_token_id)[0]
                 if hits.size:
                     out[b, hits[0] + 1:] = eos_token_id
+        if observing:
+            from ..monitor.trace import observe_latency
+
+            observe_latency(t0, "serving/generate", hist_name="serving/generate_ms",
+                            gauges={"serving/generate_tokens_per_sec":
+                                    lambda dt: B * max_new_tokens / max(dt, 1e-9)},
+                            span_args={"batch": int(B), "new_tokens": int(max_new_tokens)})
         return np.concatenate([input_ids, out], axis=1)
 
     # ------------------------------------------------------------------

@@ -9,12 +9,15 @@ donated through, so steady-state decode reuses a single compiled program and
 the only host→device traffic is the packed batch descriptor arrays.
 """
 
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...monitor.metrics import get_metrics
+from ...monitor.trace import get_tracer, observe_latency
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
 from .model_implementations.flat_model import ragged_forward
@@ -172,6 +175,8 @@ class InferenceEngineV2:
         scheduler that doesn't need the values (e.g. speculative admission,
         or a benchmark on a high-latency relay) can pipeline several steps
         into the device queue."""
+        observing = get_tracer().enabled or get_metrics().enabled
+        t0 = time.perf_counter() if observing else 0.0
         batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
         if any(t.size == 0 for t in batch_tokens):
             # an empty chunk would alias the PREVIOUS row's last_idx in the
@@ -202,7 +207,18 @@ class InferenceEngineV2:
         for seq in descs:
             seq.post_forward()
         out = out[:rb.n_seqs]  # slice ON DEVICE: the host fetch moves
-        return out if not block else np.asarray(out)  # n_seqs rows, not the padded bucket
+        out = out if not block else np.asarray(out)  # n_seqs rows, not the padded bucket
+        if observing:
+            # prefill (multi-token chunks) latency IS TTFT when block=True
+            # (admission -> first token on host, the FastGen definition);
+            # block=False measures only async dispatch, so no latency sample
+            kind = "prefill" if any(t.size > 1 for t in batch_tokens) else "decode_step"
+            hist = ("serving/ttft_ms" if kind == "prefill" else "serving/decode_step_ms") if block else None
+            observe_latency(t0, f"serving/{kind}", hist_name=hist,
+                            span_args={"seqs": len(batch_uids),
+                                       "tokens": int(sum(t.size for t in batch_tokens)),
+                                       "blocked": bool(block)})
+        return out
 
     # ------------------------------------------------------------------
     def decode(self, batch_uids: List[int], first_tokens, n_steps: int, block: bool = True) -> np.ndarray:
@@ -217,6 +233,8 @@ class InferenceEngineV2:
         refuses if the pool can't cover it). Returns token ids
         [len(batch_uids), n_steps].
         """
+        observing = get_tracer().enabled or get_metrics().enabled
+        t0 = time.perf_counter() if observing else 0.0
         uids = list(batch_uids)
         S = len(uids)
         if len(set(uids)) != len(uids):
@@ -267,7 +285,16 @@ class InferenceEngineV2:
         for seq in seqs:
             seq.post_forward()
         toks = toks[:S]  # on-device slice before any host fetch
-        return toks if not block else np.asarray(toks)
+        toks = toks if not block else np.asarray(toks)
+        if observing:
+            # as with put(): without the host fetch the wall time is dispatch
+            # only — emit the span (blocked flag disclosed), skip the samples
+            observe_latency(t0, "serving/decode",
+                            hist_name="serving/decode_ms" if block else None,
+                            gauges=({"serving/decode_tokens_per_sec":
+                                     lambda dt: S * n_steps / max(dt, 1e-9)} if block else None),
+                            span_args={"seqs": S, "steps": int(n_steps), "blocked": bool(block)})
+        return toks
 
     def _ragged_step(self, params, packed, pools, t_bucket, s_bucket):
         """One ragged forward over the pool tuple (2 = bf16 pools, 4 = int8

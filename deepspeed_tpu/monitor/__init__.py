@@ -1,0 +1,13 @@
+"""Observability package: metric sinks (``monitor.py``), the span/event
+trace bus (``trace.py``) and the metrics registry (``metrics.py``).
+
+Only the import-light trace/metrics surface is re-exported here:
+``monitor.monitor`` imports the comm package (rank gating) and is imported
+directly by its consumers (``runtime/engine.py``) to keep package bootstrap
+cycle-free.
+"""
+
+from .trace import get_tracer, configure_tracer, to_chrome_trace, NULL_SPAN  # noqa: F401
+from .metrics import (  # noqa: F401
+    get_metrics, configure_metrics, compute_mfu, peak_flops_per_chip, CHIP_PEAK_FLOPS,
+    DEFAULT_LATENCY_BUCKETS_MS)

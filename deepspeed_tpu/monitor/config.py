@@ -1,12 +1,20 @@
-"""Monitor config (reference ``deepspeed/monitor/config.py``)."""
+"""Monitor config (reference ``deepspeed/monitor/config.py``) + the
+TPU-native ``trace`` block gating the span/metrics bus (``monitor/trace.py``)."""
 
 from typing import Optional
+
+from pydantic import Field, model_validator
 
 from ..runtime.config_utils import DeepSpeedConfigModel
 
 
 def get_monitor_config(param_dict):
-    monitor_dict = {key: param_dict.get(key, {}) for key in ("tensorboard", "wandb", "csv_monitor", "comet")}
+    monitor_dict = {key: param_dict.get(key, {})
+                    for key in ("tensorboard", "wandb", "csv_monitor", "comet", "trace")}
+    # presence-enables: an EMPTY {"trace": {}} block in the config means "on
+    # with defaults" (the validator can only see set fields, not presence)
+    if "trace" in param_dict and not monitor_dict["trace"]:
+        monitor_dict["trace"] = {"enabled": True}
     return DeepSpeedMonitorConfig(**monitor_dict)
 
 
@@ -41,12 +49,32 @@ class CometConfig(DeepSpeedConfigModel):
     mode: Optional[str] = None
 
 
+class TraceConfig(DeepSpeedConfigModel):
+    """``monitor.trace`` block — the Chrome-trace/Perfetto JSONL span bus and
+    metrics registry (``monitor/trace.py`` / ``monitor/metrics.py``). Enabled
+    by presence (same contract as ``tpu.profiler_trace``): configuring any
+    field turns it on unless ``enabled`` is set explicitly. Off by default —
+    the step loop then makes zero trace-related allocations."""
+    enabled: bool = False
+    output_path: str = "/tmp/dstpu_trace.jsonl"
+    flush_every: int = Field(256, ge=1)
+
+    @model_validator(mode="after")
+    def enable_when_configured(self):
+        if self.model_fields_set and "enabled" not in self.model_fields_set:
+            self.enabled = True
+        return self
+
+
 class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
     tensorboard: TensorBoardConfig = {}
     wandb: WandbConfig = {}
     csv_monitor: CSVConfig = {}
     comet: CometConfig = {}
+    trace: TraceConfig = {}
 
     @property
     def enabled(self):
+        """Sink fan-out gate (rank-0 write_events). The trace bus is gated
+        separately by ``trace.enabled`` — it has its own writer."""
         return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled or self.comet.enabled

@@ -1,0 +1,270 @@
+"""Lightweight metrics registry: counters, gauges, fixed-bucket histograms,
+and the chip peak-FLOPs table behind MFU reporting.
+
+The registry is the quantitative half of the observability layer (the trace
+bus in ``trace.py`` is the temporal half): the engine step loop and the
+serving path record into it, and ``MonitorMaster.write_events`` drains
+``registry.events(step)`` each logging interval alongside derived throughput
+and MFU.
+
+Zero overhead when disabled: every accessor returns the same shared no-op
+metric object (no per-step allocations), verified by ``tests/test_monitor_trace.py``.
+
+Import-light by design (no package-internal imports at module level): pulled
+in during package bootstrap via the comm/monitor wiring.
+"""
+
+import bisect
+import math
+import threading
+from collections import deque
+
+# ---------------------------------------------------------------------------
+# metric primitives
+# ---------------------------------------------------------------------------
+
+# Prometheus-style latency buckets (upper bounds, ms); +inf is implicit.
+DEFAULT_LATENCY_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+                              1000.0, 2000.0, 5000.0, 10000.0)
+
+
+class Counter:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0.0
+
+    def inc(self, n=1.0):
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v):
+        self.value = float(v)
+
+
+class Histogram:
+    """Fixed-bucket histogram with exact percentiles over a bounded window.
+
+    Bucket counts are the cheap always-on export (cumulative, Prometheus
+    layout); the bounded raw window (last ``window`` observations) makes
+    ``percentile`` exact for any run shorter than the window — the serving
+    TTFT/decode distributions this was built for."""
+
+    __slots__ = ("name", "buckets", "bucket_counts", "count", "total", "_raw", "window")
+
+    def __init__(self, name, buckets=None, window=4096):
+        self.name = name
+        self.buckets = tuple(sorted(buckets or DEFAULT_LATENCY_BUCKETS_MS))
+        self.bucket_counts = [0] * (len(self.buckets) + 1)  # last = +inf
+        self.count = 0
+        self.total = 0.0
+        self.window = window
+        self._raw = deque(maxlen=window)  # O(1) eviction at the window edge
+
+    def observe(self, v):
+        v = float(v)
+        self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.count += 1
+        self.total += v
+        self._raw.append(v)
+
+    def percentile(self, p, _sorted=None):
+        """Exact p-th percentile (0..100) over the retained window (nearest-
+        rank method, so every returned value is an actual observation)."""
+        data = _sorted if _sorted is not None else sorted(self._raw)
+        if not data:
+            return 0.0
+        rank = min(len(data), max(1, math.ceil(p / 100.0 * len(data))))
+        return data[rank - 1]
+
+    def mean(self):
+        return self.total / self.count if self.count else 0.0
+
+    def summary(self):
+        data = sorted(self._raw)  # one sort shared by every quantile
+        return {"count": self.count, "mean": self.mean(),
+                "p50": self.percentile(50, data), "p90": self.percentile(90, data),
+                "p99": self.percentile(99, data)}
+
+
+class _NullMetric:
+    """Shared disabled-mode stand-in for all three metric kinds."""
+
+    __slots__ = ()
+    name = "<disabled>"
+    value = 0.0
+    count = 0
+    total = 0.0
+
+    def inc(self, n=1.0):
+        ...
+
+    def set(self, v):
+        ...
+
+    def observe(self, v):
+        ...
+
+    def percentile(self, p):
+        return 0.0
+
+    def mean(self):
+        return 0.0
+
+    def summary(self):
+        return {"count": 0, "mean": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
+
+
+NULL_METRIC = _NullMetric()
+
+
+class MetricsRegistry:
+
+    def __init__(self, enabled=False):
+        self.enabled = enabled
+        self._lock = threading.RLock()
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+
+    # -- lifecycle ------------------------------------------------------
+    def enable(self):
+        if not self.enabled:
+            self.enabled = True
+            from .trace import _install_compile_listener
+
+            _install_compile_listener()  # compile counters ride the listener
+        return self
+
+    def disable(self):
+        self.enabled = False
+        return self
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+    # -- accessors ------------------------------------------------------
+    def counter(self, name) -> Counter:
+        if not self.enabled:
+            return NULL_METRIC
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name) -> Gauge:
+        if not self.enabled:
+            return NULL_METRIC
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name, buckets=None, window=4096) -> Histogram:
+        if not self.enabled:
+            return NULL_METRIC
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, buckets=buckets, window=window)
+            return h
+
+    # -- export ---------------------------------------------------------
+    def events(self, step):
+        """Flatten to ``(name, value, step)`` tuples — the exact shape
+        ``MonitorMaster.write_events`` consumes."""
+        if not self.enabled:
+            return []
+        out = []
+        with self._lock:
+            for c in self._counters.values():
+                out.append((c.name, c.value, step))
+            for g in self._gauges.values():
+                out.append((g.name, g.value, step))
+            for h in self._histograms.values():
+                s = h.summary()
+                for k in ("count", "mean", "p50", "p90", "p99"):
+                    out.append((f"{h.name}/{k}", s[k], step))
+        return out
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "counters": {c.name: c.value for c in self._counters.values()},
+                "gauges": {g.name: g.value for g in self._gauges.values()},
+                "histograms": {h.name: h.summary() for h in self._histograms.values()},
+            }
+
+
+_registry = MetricsRegistry(enabled=False)
+
+
+def get_metrics() -> MetricsRegistry:
+    return _registry
+
+
+def configure_metrics(enabled=None) -> MetricsRegistry:
+    if enabled is not None:
+        _registry.enable() if enabled else _registry.disable()
+    return _registry
+
+
+# ---------------------------------------------------------------------------
+# MFU: chip peak-FLOPs table + derivation helpers
+# ---------------------------------------------------------------------------
+
+# dense bf16 peak FLOP/s per chip (published TPU specs)
+CHIP_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+}
+
+# jax ``device_kind`` strings -> table keys (v5e reports as "TPU v5 lite")
+_DEVICE_KIND_ALIASES = (
+    ("v5 lite", "v5e"), ("v5litepod", "v5e"), ("v5e", "v5e"),
+    ("v5p", "v5p"),
+    ("v4", "v4"),
+)
+
+
+def peak_flops_per_chip(device_kind=None):
+    """bf16 peak FLOP/s for ``device_kind`` (defaults to the local device).
+    Returns None when the chip is unknown (CPU fallback) — callers report
+    MFU as null rather than a misleading number."""
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    kind = str(device_kind).lower()
+    for marker, key in _DEVICE_KIND_ALIASES:
+        if marker in kind:
+            return CHIP_PEAK_FLOPS[key]
+    return None
+
+
+def compute_mfu(model_flops_per_step, step_time_s, n_chips=1, peak_flops=None):
+    """Model FLOPs utilization: achieved model FLOP/s over the slice's peak.
+    ``peak_flops`` overrides the per-chip table lookup (CPU tests, custom
+    rooflines). Returns None when the peak is unknown."""
+    if peak_flops is None:
+        peak_flops = peak_flops_per_chip()
+    if not peak_flops or step_time_s <= 0 or n_chips <= 0:
+        return None
+    return model_flops_per_step / step_time_s / (peak_flops * n_chips)

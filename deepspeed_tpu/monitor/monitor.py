@@ -10,6 +10,7 @@ import os
 import csv as _csv
 
 from ..comm import get_rank
+from ..utils.logging import logger
 
 
 class Monitor:
@@ -22,6 +23,24 @@ class Monitor:
         raise NotImplementedError
 
 
+def _import_summary_writer():
+    """Prefer ``tensorboardX`` (torch-free, matches this JAX repo); fall back
+    to ``torch.utils.tensorboard`` for environments that ship torch anyway.
+    Returns (SummaryWriter, provider_name) or raises ImportError naming both."""
+    try:
+        from tensorboardX import SummaryWriter
+
+        return SummaryWriter, "tensorboardX"
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter, "torch.utils.tensorboard"
+    except ImportError:
+        raise ImportError("neither 'tensorboardX' nor 'torch.utils.tensorboard' is installed")
+
+
 class TensorBoardMonitor(Monitor):
 
     def __init__(self, tensorboard_config):
@@ -30,11 +49,13 @@ class TensorBoardMonitor(Monitor):
         self.summary_writer = None
         if self.enabled:
             try:
-                from torch.utils.tensorboard import SummaryWriter
-
+                SummaryWriter, provider = _import_summary_writer()
                 log_dir = os.path.join(tensorboard_config.output_path or "./runs", tensorboard_config.job_name)
                 self.summary_writer = SummaryWriter(log_dir=log_dir)
-            except Exception:
+            except Exception as e:
+                # one loud warning instead of the old silent self-disable: a
+                # run that asked for tensorboard must say WHY nothing appears
+                logger.warning(f"TensorBoardMonitor disabled: {type(e).__name__}: {e}")
                 self.enabled = False
 
     def write_events(self, event_list, flush=True):
@@ -70,29 +91,58 @@ class WandbMonitor(Monitor):
 
 
 class csvMonitor(Monitor):
+    """CSV sink with persistent file handles: one open file per metric for
+    the life of the monitor (the old open/append/close per EVENT paid an
+    open+close syscall pair per scalar per step on long runs). ``flush()``
+    pushes buffered rows to disk; ``close()`` releases the handles."""
 
     def __init__(self, csv_config):
         super().__init__(csv_config)
         self.filenames = {}
+        self._files = {}  # metric name -> (file handle, csv writer)
         self.enabled = csv_config.enabled and get_rank() == 0
         self.output_path = csv_config.output_path or "./csv_monitor"
         self.job_name = csv_config.job_name
         if self.enabled:
             os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
 
+    def _writer(self, safe_name):
+        entry = self._files.get(safe_name)
+        if entry is None:
+            path = os.path.join(self.output_path, self.job_name, f"{safe_name}.csv")
+            new = not os.path.exists(path)
+            self.filenames[safe_name] = path
+            fh = open(path, "a", newline="")
+            w = _csv.writer(fh)
+            if new:
+                w.writerow(["step", safe_name])
+            entry = self._files[safe_name] = (fh, w)
+        return entry[1]
+
     def write_events(self, event_list):
         if not self.enabled:
             return
         for name, value, step in event_list:
             safe = name.replace("/", "_")
-            path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
-            new = safe not in self.filenames
-            self.filenames[safe] = path
-            with open(path, "a", newline="") as f:
-                w = _csv.writer(f)
-                if new:
-                    w.writerow(["step", safe])
-                w.writerow([int(step), float(value)])
+            self._writer(safe).writerow([int(step), float(value)])
+
+    def flush(self):
+        for fh, _ in self._files.values():
+            fh.flush()
+
+    def close(self):
+        for fh, _ in self._files.values():
+            try:
+                fh.close()
+            except OSError:
+                pass
+        self._files.clear()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class MonitorMaster(Monitor):
@@ -116,3 +166,8 @@ class MonitorMaster(Monitor):
             for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
                 if m is not None:
                     m.write_events(event_list)
+
+    def flush(self):
+        for m in (self.tb_monitor, self.csv_monitor):
+            if m is not None and hasattr(m, "flush"):
+                m.flush()

@@ -13,6 +13,17 @@ import jax
 from ..utils.logging import log_dist
 
 
+def training_flops_per_token(n_params, num_layers=None, hidden_size=None, seq_len=None):
+    """Model training FLOPs per token, PaLM convention: 6 FLOPs per parameter
+    (fwd 2 + bwd 4) plus the attention score/context term when the
+    architecture is known. The numerator of every MFU this repo reports
+    (``monitor/metrics.py::compute_mfu``, engine step telemetry, bench.py)."""
+    flops = 6.0 * float(n_params)
+    if num_layers and hidden_size and seq_len:
+        flops += 12.0 * num_layers * hidden_size * seq_len
+    return flops
+
+
 def analyze_fn(fn, *example_args, **example_kwargs):
     """Compile ``fn`` and return {'flops': float, 'bytes accessed': float, ...}."""
     lowered = jax.jit(fn).lower(*example_args, **example_kwargs)

@@ -38,8 +38,11 @@ from .zero.partition import ZeroShardingPolicy, PartitionRules, constrain
 from ..accelerator import get_accelerator
 from ..comm import comm as dist
 from ..monitor.monitor import MonitorMaster
+from ..monitor.trace import configure_tracer, get_tracer
+from ..monitor.metrics import get_metrics, compute_mfu
 from ..parallel import groups
-from ..parallel.mesh import BATCH_AXES, DATA_AXIS, DATA_REPL_AXIS, SEQ_AXIS, MeshConfig, build_mesh
+from ..parallel.mesh import (BATCH_AXES, DATA_AXIS, DATA_REPL_AXIS, SEQ_AXIS, MeshConfig, build_mesh,
+                             shard_map_compat)
 from ..utils.logging import logger, log_dist
 from ..utils.timer import (SynchronizedWallClockTimer, NoopTimer, ThroughputTimer, FORWARD_GLOBAL_TIMER,
                            BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER)
@@ -334,6 +337,15 @@ class DeepSpeedEngine:
 
         # --- aux subsystems ---
         self.monitor = MonitorMaster(config.monitor_config)
+        # unified span/metrics bus (monitor/trace.py + monitor/metrics.py):
+        # config-gated; with the block absent the step loop pays one boolean
+        # check and makes zero trace-related allocations
+        if config.monitor_config.trace.enabled:
+            configure_tracer(config=config.monitor_config.trace)
+        self._tracer = get_tracer()
+        self._metrics = get_metrics()
+        if (self.monitor.enabled or config.monitor_config.trace.enabled) and not self._metrics.enabled:
+            self._metrics.enable()
         self._tracing = False  # device trace capture state (start/stop_device_trace)
         self.engine_timers = EngineTimers(enable_micro_timers=config.wall_clock_breakdown,
                                           enable_global_timers=config.wall_clock_breakdown)
@@ -561,6 +573,7 @@ class DeepSpeedEngine:
             with self.mesh:
                 state = init_fn(init_rng)
         n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(state["params"]))
+        self._n_params = n_params  # MFU derivation (monitor/metrics.py)
         log_dist(f"initialized {n_params/1e6:.2f}M params sharded over mesh"
                  + (" (abstract)" if self.config.tpu_config.abstract_init else ""), ranks=[0])
         return state
@@ -903,11 +916,10 @@ class DeepSpeedEngine:
         replicated = jax.tree_util.tree_map(lambda _: P(), self.state["params"])
         err_spec = jax.tree_util.tree_map(lambda _: P(DATA_AXIS), self.state["params"])
         batch_specs = jax.tree_util.tree_map(batch_spec, self._last_batch_struct)
-        sharded = jax.shard_map(
-            local_fn, mesh=self.mesh,
+        sharded = shard_map_compat(
+            local_fn, self.mesh,
             in_specs=(replicated, batch_specs, P(), P(), P(), err_spec, err_spec),
-            out_specs=(replicated, err_spec, err_spec, P()),
-            check_vma=False)
+            out_specs=(replicated, err_spec, err_spec, P()))
 
         def train_step(state, batches, rng):
             reduced, new_ew, new_es, mean_loss = sharded(state["params"], batches, rng, state["loss_scale"],
@@ -1030,11 +1042,10 @@ class DeepSpeedEngine:
             mean_loss = jax.lax.pmean(jnp.mean(losses), DATA_REPL_AXIS)
             return grads, mean_loss
 
-        sharded = jax.shard_map(local_fn, mesh=self.mesh,
-                                in_specs=(param_manual, batch_manual, P(), P()),
-                                out_specs=(param_manual, P()),
-                                axis_names=frozenset({DATA_REPL_AXIS}),
-                                check_vma=False)
+        sharded = shard_map_compat(local_fn, self.mesh,
+                                   in_specs=(param_manual, batch_manual, P(), P()),
+                                   out_specs=(param_manual, P()),
+                                   axis_names=frozenset({DATA_REPL_AXIS}))
 
         def train_step(state, batches, rng):
             grads, mean_loss = sharded(state["params"], batches, rng, state["loss_scale"])
@@ -1117,6 +1128,13 @@ class DeepSpeedEngine:
                                                    np.float32)}
         step_rng, self._rng = jax.random.split(self._rng)
         self.tput_timer.start()
+        # observe every step while tracing (profiling mode: the block that
+        # makes spans honest is intended); in sink-only mode sample at the
+        # steps_per_print boundary, where _record_metrics pays the host sync
+        # anyway — plain telemetry must not serialize the async step pipeline
+        observing = self._tracer.enabled or (
+            self._metrics.enabled and (self.global_steps + 1) % self.config.steps_per_print == 0)
+        t_step = time.perf_counter() if observing else 0.0
         if self.host_optimizer is not None:
             metrics = self._offload_train_batch(batch, step_rng)
         else:
@@ -1130,6 +1148,8 @@ class DeepSpeedEngine:
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
         self.tput_timer.stop(global_step=True)
+        if observing:
+            self._observe_step(t_step, batch, metrics)
         if self.host_optimizer is None and self.fp16_enabled and bool(metrics["overflow"]):
             self.skipped_steps += 1  # offload path counts inside _host_apply_update
         self._record_metrics(metrics)
@@ -1296,11 +1316,14 @@ class DeepSpeedEngine:
                 batch = {"input_ids": batch}
             batch = {**batch, "pld_theta": np.float32(self.progressive_layer_drop.get_theta())}
         fwd_rng, self._rng = jax.random.split(self._rng)
+        t0 = time.perf_counter() if self._tracer.enabled else 0.0
         if not self._train_mode:  # eval: loss only, no grads
             if "loss" not in self._compiled:
                 self._compiled["loss"] = jax.jit(lambda p, b, r: self._loss_fn(p, b, r)[0])
             with self.mesh:
-                return self._compiled["loss"](self.state["params"], self._shard_batch(batch), fwd_rng)
+                loss = self._compiled["loss"](self.state["params"], self._shard_batch(batch), fwd_rng)
+            self._emit_phase("fwd", t0, loss)
+            return loss
         if "grads" not in self._compiled:
 
             def gfn(params, batch, rng, scale):
@@ -1310,6 +1333,7 @@ class DeepSpeedEngine:
         with self.mesh:
             batch = self._shard_batch(batch)
             grads, loss = self._compiled["grads"](self.state["params"], batch, fwd_rng, self.state["loss_scale"])
+        self._emit_phase("fwd", t0, loss)
         self._pending_batches.append(grads)
         return loss
 
@@ -1320,6 +1344,7 @@ class DeepSpeedEngine:
         ``backward:1950``). The sharded accumulation buffer realizes ZeRO-2:
         with stage>=2 each device holds only its gradient shard."""
         assert self._pending_batches, "backward() called without a prior forward()"
+        t0 = time.perf_counter() if self._tracer.enabled else 0.0
         grads = self._pending_batches.pop(0)
         with self.mesh:
             if self._grad_acc_buffer is None:
@@ -1329,8 +1354,24 @@ class DeepSpeedEngine:
                     self._compiled["grad_add"] = jax.jit(
                         lambda a, b: jax.tree_util.tree_map(jnp.add, a, b), donate_argnums=(0, ))
                 self._grad_acc_buffer = self._compiled["grad_add"](self._grad_acc_buffer, grads)
+        self._emit_phase("bwd", t0, self._grad_acc_buffer)
         self.micro_steps += 1
         return loss
+
+    def _emit_phase(self, name, t0, block_on=None):
+        """Emit one engine-phase duration event (fwd/bwd/step). No-op unless
+        the trace bus is live; blocking on ``block_on`` then is what makes
+        the span cover the device work, not just the async dispatch."""
+        if not self._tracer.enabled:
+            return
+        if block_on is not None:
+            try:
+                jax.block_until_ready(block_on)
+            except Exception:
+                pass
+        tid = "checkpoint" if name.startswith("checkpoint/") else "engine"
+        self._tracer.complete(name, t0, time.perf_counter() - t0, tid=tid,
+                              args={"step": self.global_steps})
 
     def is_gradient_accumulation_boundary(self):
         """Reference ``engine.py`` same name: true when the next step() will
@@ -1345,6 +1386,7 @@ class DeepSpeedEngine:
             return  # mid-accumulation micro-step, nothing to do
         self._maybe_device_trace()  # eager 3-call path traces too
         assert self._grad_acc_buffer is not None, "step() called with no accumulated gradients"
+        t0 = time.perf_counter() if self._tracer.enabled else 0.0
         if self.host_optimizer is not None:
             grads = jax.tree_util.tree_map(lambda g: g / gas, self._grad_acc_buffer)
             if "gnorm" not in self._compiled:
@@ -1357,6 +1399,7 @@ class DeepSpeedEngine:
             self.global_samples += self.train_batch_size()
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+            self._emit_phase("step", t0)
             return
         if "apply" not in self._compiled:
 
@@ -1376,6 +1419,7 @@ class DeepSpeedEngine:
             self.skipped_steps += 1
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
+        self._emit_phase("step", t0)
 
     # ------------------------------------------------------------------
     # introspection (reference engine getters)
@@ -1412,6 +1456,42 @@ class DeepSpeedEngine:
     def get_batch_info(self):
         return (self.train_batch_size(), self.train_micro_batch_size_per_gpu(), self.gradient_accumulation_steps())
 
+    def _observe_step(self, t0, batch, metrics):
+        """Trace span + derived throughput/MFU for one fused train step.
+        Only runs when the trace bus or metrics registry is live (observing
+        implies profiling mode, so blocking on the step result is intended —
+        it is what makes the recorded wall time honest)."""
+        jax.block_until_ready(metrics["loss"])
+        dt = max(time.perf_counter() - t0, 1e-9)
+        leaves = jax.tree_util.tree_leaves(batch)
+        # (gas, rows, seq, ...) leaves carry a token dim; scalar tracks don't
+        seq = int(np.shape(leaves[0])[-1]) if leaves and np.ndim(leaves[0]) >= 3 else None
+        tokens = self.train_batch_size() * (seq or 1)
+        mfu = None
+        if seq is not None:
+            from ..profiling.flops_profiler import training_flops_per_token
+
+            mcfg = getattr(self.module, "config", None)
+            fpt = training_flops_per_token(self._n_params,
+                                           num_layers=getattr(mcfg, "num_layers", None),
+                                           hidden_size=getattr(mcfg, "hidden_size", None),
+                                           seq_len=seq)
+            mfu = compute_mfu(fpt * tokens, dt, n_chips=self.mesh.size)
+        reg = self._metrics
+        if reg.enabled:
+            reg.counter("train/steps").inc()
+            reg.counter("train/tokens").inc(tokens)
+            reg.histogram("train/step_time_ms").observe(dt * 1e3)
+            reg.gauge("train/tokens_per_sec").set(tokens / dt)
+            reg.gauge("train/samples_per_sec").set(self.train_batch_size() / dt)
+            if mfu is not None:
+                reg.gauge("train/mfu").set(mfu)
+        if self._tracer.enabled:
+            args = {"step": self.global_steps, "tokens": tokens}
+            if mfu is not None:
+                args["mfu"] = round(mfu, 4)
+            self._tracer.complete("train_batch", t0, dt, tid="engine", args=args)
+
     def _record_metrics(self, metrics):
         self._step_metrics = {k: v for k, v in metrics.items()}
         if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
@@ -1419,7 +1499,12 @@ class DeepSpeedEngine:
                       ("Train/Samples/lr", float(metrics["lr"]), self.global_samples)]
             if self.fp16_enabled:
                 events.append(("Train/Samples/loss_scale", self.loss_scale, self.global_samples))
+            # drain the metrics registry (throughput, MFU, latency histograms,
+            # compile counters) into the same sink fan-out, then flush so the
+            # persistent-handle CSV sink is crash-safe and tail-able
+            events += self._metrics.events(self.global_samples)
             self.monitor.write_events(events)
+            self.monitor.flush()
         if self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(metrics['loss']):.4f} "
                      f"lr={float(metrics['lr']):.3e} gnorm={float(metrics['grad_norm']):.3f}", ranks=[0])
@@ -1514,14 +1599,15 @@ class DeepSpeedEngine:
             tag = f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
         path = os.path.join(save_dir, str(tag))
-        self.checkpoint_engine.create(tag)
-        self.checkpoint_engine.save(self._ckpt_state(client_state), path)
-        self.checkpoint_engine.commit(tag)
-        if save_latest and dist.get_rank() == 0:
-            os.makedirs(save_dir, exist_ok=True)
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
-        dist.barrier()
+        with self._tracer.span("checkpoint/save", tid="checkpoint", tag=str(tag)):
+            self.checkpoint_engine.create(tag)
+            self.checkpoint_engine.save(self._ckpt_state(client_state), path)
+            self.checkpoint_engine.commit(tag)
+            if save_latest and dist.get_rank() == 0:
+                os.makedirs(save_dir, exist_ok=True)
+                with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                    f.write(str(tag))
+            dist.barrier()
         log_dist(f"saved checkpoint {path}", ranks=[0])
         return True
 
@@ -1540,6 +1626,7 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False, custom_load_fn=None):
+        t0 = time.perf_counter() if self._tracer.enabled else 0.0
         if tag is None:
             latest_path = os.path.join(load_dir, LATEST_FILE)
             if os.path.isfile(latest_path):
@@ -1605,6 +1692,7 @@ class DeepSpeedEngine:
                                      "skipped_steps", "lr_scheduler", "curriculum_scheduler",
                                      "random_ltd_scheduler", "host_optimizer", "onebit", "ds_config",
                                      "ds_version")}
+        self._emit_phase("checkpoint/load", t0)
         log_dist(f"loaded checkpoint {path}", ranks=[0])
         return path, client_state
 

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ...parallel.mesh import PIPE_AXIS
+from ...parallel.mesh import PIPE_AXIS, shard_map_compat
 
 
 def _replicated_specs(tree):
@@ -154,11 +154,10 @@ def pipeline_apply(stage_fn: Callable,
     x_spec = _replicated_specs(microbatches)
     const_specs = tuple(_replicated_specs(c) for c in consts)
     out_specs = (x_spec, P()) if with_aux else x_spec
-    shard_fn = jax.shard_map(pipelined, mesh=mesh,
-                             in_specs=(param_specs, x_spec) + const_specs,
-                             out_specs=out_specs,
-                             axis_names=frozenset({pipe_axis}),
-                             check_vma=False)
+    shard_fn = shard_map_compat(pipelined, mesh,
+                                in_specs=(param_specs, x_spec) + const_specs,
+                                out_specs=out_specs,
+                                axis_names=frozenset({pipe_axis}))
     return shard_fn(stage_params, microbatches, *consts)
 
 
@@ -318,11 +317,10 @@ def pipeline_1f1b(stage_fn: Callable,
         return loss, g_params, g_head, d_xs
 
     rep = _replicated_specs
-    shard_fn = jax.shard_map(
-        pipelined, mesh=mesh,
+    shard_fn = shard_map_compat(
+        pipelined, mesh,
         in_specs=(param_specs, rep(head_params), rep(microbatches), rep(head_aux))
         + tuple(rep(c) for c in consts),
         out_specs=(P(), param_specs, rep(head_params), rep(microbatches)),
-        axis_names=frozenset({pipe_axis}),
-        check_vma=False)
+        axis_names=frozenset({pipe_axis}))
     return shard_fn(stage_params, head_params, microbatches, head_aux, *consts)

@@ -36,7 +36,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax: experimental API; rep-checking there rejects
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    shard_map = _functools.partial(_shard_map, check_rep=False)
 
 from ..parallel.mesh import SEQ_AXIS, BATCH_AXES, MODEL_AXIS
 

@@ -18,9 +18,12 @@ def get_caller_func(frame=3):
     return sys._getframe(frame).f_code.co_name
 
 
-def calc_bw_log(comm_op, size, duration):
-    """algbw/busbw math, mirroring the reference implementation."""
-    n = 8  # mesh-degree placeholder when axis size unknown at log time
+def calc_bw_log(comm_op, size, duration, n=None):
+    """algbw/busbw math, mirroring the reference implementation. ``n`` is the
+    collective's participant count (mesh-axis degree); callers that know the
+    group pass it, legacy callers fall back to the historical placeholder."""
+    if n is None or n < 1:
+        n = 8  # mesh-degree placeholder when axis size unknown at log time
     duration = max(duration, 1e-9)
     if comm_op in ("all_to_all_single", "all_to_all"):
         tput = size / duration
@@ -71,8 +74,8 @@ class CommsLogger:
     def stop_profiling_op(self, op_name_list):
         self.prof_ops = [op for op in self.prof_ops if op not in op_name_list]
 
-    def append(self, raw_name, record_name, latency, msg_size):
-        algbw, busbw, duration_ms = calc_bw_log(raw_name, msg_size, latency)
+    def append(self, raw_name, record_name, latency, msg_size, n=None):
+        algbw, busbw, duration_ms = calc_bw_log(raw_name, msg_size, latency, n=n)
         if record_name in self.comms_dict:
             if msg_size in self.comms_dict[record_name]:
                 self.comms_dict[record_name][msg_size][0] += 1
@@ -108,6 +111,34 @@ class CommsLogger:
                         " ", convert_size(msg_size), count, f"{total_lat: .2f}", f"{avg_lat: .2f}",
                         f"{avg_algbw: .2f}"))
         return self.comms_dict
+
+    def summary(self):
+        """Aggregate view for machine consumers (bench JSON): per-op count,
+        total bytes and trimmed-mean algo/bus bandwidth, plus grand totals."""
+        from .timer import trim_mean
+
+        ops = {}
+        total_bytes = 0
+        total_count = 0
+        for record_name, by_size in self.comms_dict.items():
+            count = sum(v[0] for v in by_size.values())
+            op_bytes = sum(size * v[0] for size, v in by_size.items())
+            lats = [x for v in by_size.values() for x in v[1]]
+            algs = [x for v in by_size.values() for x in v[2]]
+            buses = [x for v in by_size.values() for x in v[3]]
+            ops[record_name] = {
+                "count": count,
+                "bytes": int(op_bytes),
+                "avg_latency_ms": trim_mean(list(lats), 0.1),
+                "avg_algbw_gbps": trim_mean(list(algs), 0.1),
+                "avg_busbw_gbps": trim_mean(list(buses), 0.1),
+            }
+            total_bytes += op_bytes
+            total_count += count
+        return {"ops": ops, "total_bytes": int(total_bytes), "total_count": total_count}
+
+    def reset(self):
+        self.comms_dict = {}
 
 
 def convert_size(size_bytes):

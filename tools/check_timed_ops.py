@@ -1,0 +1,102 @@
+"""Static check: every public collective in ``deepspeed_tpu/comm/comm.py``
+rides ``@timed_op``.
+
+The round-1..5 lesson behind this tool: instrumentation rots silently — the
+seed repo wrapped exactly ONE op (``barrier``) and logged ``msg_size=0``, so
+all bandwidth accounting was dead for five rounds without any test noticing.
+This AST walk (no imports of the package, so it runs anywhere) asserts the
+wrap, and a tier-1 test (``tests/test_monitor_trace.py``) runs it on every CI
+pass.
+
+Accepted instrumentation forms:
+
+  * ``@timed_op`` (possibly stacked with other decorators) on a ``def``;
+  * ``name = timed_op(...)`` assignment (the re-export wrap of the traced
+    plane), including nested wrappers like ``timed_op(_eagerize(fn))``;
+  * ``name = other`` aliasing where ``other`` is itself instrumented
+    (``all_gather_into_tensor = all_gather``).
+"""
+
+import ast
+import os
+import sys
+
+# the public collective surface of deepspeed_tpu.comm (torch.distributed
+# signature parity); extend this list when a new collective is exported
+PUBLIC_COLLECTIVES = (
+    "all_reduce",
+    "inference_all_reduce",
+    "all_gather",
+    "all_gather_into_tensor",
+    "reduce_scatter",
+    "reduce_scatter_tensor",
+    "all_to_all_single",
+    "broadcast",
+    "ppermute",
+    "send_recv_next",
+    "send_recv_prev",
+    "send",
+    "recv",
+    "barrier",
+)
+
+DEFAULT_COMM_PY = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                               "deepspeed_tpu", "comm", "comm.py")
+
+
+def _is_timed_call(node):
+    """True for ``timed_op(...)`` with the wrapped target anywhere inside."""
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "timed_op")
+
+
+def find_instrumented(path=DEFAULT_COMM_PY):
+    """Names bound (at module level) to a timed_op-wrapped callable."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    instrumented = set()
+    aliases = {}  # name -> aliased-to name, resolved after the walk
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if (isinstance(dec, ast.Name) and dec.id == "timed_op") or _is_timed_call(dec):
+                    instrumented.add(node.name)
+        elif isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not targets:
+                continue
+            if _is_timed_call(node.value):
+                instrumented.update(targets)
+            elif isinstance(node.value, ast.Name):
+                for t in targets:
+                    aliases[t] = node.value.id
+    # resolve alias chains (bounded: an alias cycle terminates the loop)
+    for name, target in aliases.items():
+        seen = set()
+        while target in aliases and target not in seen:
+            seen.add(target)
+            target = aliases[target]
+        if target in instrumented:
+            instrumented.add(name)
+    return instrumented
+
+
+def check(path=DEFAULT_COMM_PY, required=PUBLIC_COLLECTIVES):
+    """Return the list of public collectives NOT wrapped with @timed_op."""
+    instrumented = find_instrumented(path)
+    return [name for name in required if name not in instrumented]
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    path = argv[0] if argv else DEFAULT_COMM_PY
+    missing = check(path)
+    if missing:
+        print(f"check_timed_ops: NOT instrumented with @timed_op in {path}: {missing}")
+        return 1
+    print(f"check_timed_ops: all {len(PUBLIC_COLLECTIVES)} public collectives instrumented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
