@@ -1,0 +1,324 @@
+"""Process-local span/event bus — Chrome-trace/Perfetto JSONL emission.
+
+The observability spine the reference spreads over ``@timed_op`` wrappers,
+the flops profiler and the torch profiler hooks, unified here into one bus:
+
+  * ``get_tracer().span("fwd")`` — a context manager emitting a Chrome-trace
+    duration event (``ph:"X"``) with ``pid`` = this host process and ``tid`` =
+    a logical stream (engine / comm / compile / checkpoint / serving / data).
+  * ``complete``/``instant``/``counter`` — manual emission for call sites
+    that cannot use a ``with`` block (async dispatch, listener callbacks).
+  * JAX compile/recompile events are captured through
+    ``jax.monitoring.register_event_duration_secs_listener`` and emitted as
+    ``jax_compile`` duration events on the ``compile`` stream.
+
+Output is JSONL: one Chrome-trace event object per line, each independently
+``json.loads``-able (the acceptance format for ``bench.py --trace``). The
+``trace_viewer`` JSON-array form for chrome://tracing or Perfetto is one
+``to_chrome_trace`` call away.
+
+Zero overhead when disabled: ``span()`` returns a shared no-op singleton
+(``NULL_SPAN``), every other emitter early-returns on one attribute check, and
+the compile listener is only installed on first enable.
+
+This module must stay import-light (no package-internal imports): it is
+pulled in by ``comm.comm`` during package bootstrap.
+"""
+
+import json
+import os
+import threading
+import time
+
+# canonical logical streams -> stable Chrome-trace tid numbers
+STREAMS = ("engine", "comm", "compile", "checkpoint", "serving", "data")
+
+
+class _NullSpan:
+    """Shared no-op context manager returned while tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_args(self, **kwargs):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_tid", "_args", "_t0")
+
+    def __init__(self, tracer, name, tid, args):
+        self._tracer = tracer
+        self._name = name
+        self._tid = tid
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def set_args(self, **kwargs):
+        self._args.update(kwargs)
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._tracer.complete(self._name, self._t0, t1 - self._t0, tid=self._tid, args=self._args)
+        return False
+
+
+class Tracer:
+    """Buffered JSONL trace writer. One per process (see ``get_tracer``)."""
+
+    def __init__(self):
+        self.enabled = False
+        self._path = None
+        self._fh = None
+        self._buf = []
+        self._flush_every = 256
+        self._lock = threading.RLock()
+        self._origin = time.perf_counter()  # ts epoch: trace times are relative
+        self._pid = None
+        self._tids = {}
+        self._opened_paths = set()  # paths truncated once this process
+
+    # -- configuration --------------------------------------------------
+    def configure(self, enabled=None, path=None, flush_every=None, config=None):
+        """Enable/point the tracer. ``config`` may be a ``TraceConfig`` block
+        (``monitor_config.trace``); explicit kwargs win over it."""
+        if config is not None:
+            if enabled is None:
+                enabled = getattr(config, "enabled", None)
+            if path is None:
+                path = getattr(config, "output_path", None) or None
+            if flush_every is None:
+                flush_every = getattr(config, "flush_every", None)
+        with self._lock:
+            if path is not None and path != self._path:
+                self._close_fh()
+                self._path = path
+            if flush_every is not None:
+                self._flush_every = max(1, int(flush_every))
+            if enabled is not None:
+                enabled = bool(enabled)
+                if enabled and not self.enabled:
+                    self._pid = _process_id()
+                    _install_compile_listener()
+                    self.enabled = True
+                    self._emit({"name": "process_name", "ph": "M", "ts": 0, "pid": self._pid,
+                                "tid": 0, "args": {"name": "deepspeed_tpu"}})
+                elif not enabled and self.enabled:
+                    self.flush()
+                    self.enabled = False
+        return self
+
+    # -- emission -------------------------------------------------------
+    def span(self, name, tid="engine", **args):
+        """Context manager for a duration event. Allocation-free no-op
+        (the shared ``NULL_SPAN`` object) while disabled."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _Span(self, name, tid, args)
+
+    def complete(self, name, t0, duration, tid="engine", args=None):
+        """Emit a ``ph:"X"`` duration event. ``t0`` is a ``time.perf_counter``
+        reading; ``duration`` is in seconds."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "X", "ts": round((t0 - self._origin) * 1e6, 3),
+              "dur": round(duration * 1e6, 3), "pid": self._pid, "tid": self._tid(tid)}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def instant(self, name, tid="engine", **args):
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "i", "s": "t", "ts": self._now_us(), "dur": 0,
+              "pid": self._pid, "tid": self._tid(tid)}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def counter(self, name, value, tid="engine"):
+        if not self.enabled:
+            return
+        self._emit({"name": name, "ph": "C", "ts": self._now_us(), "dur": 0, "pid": self._pid,
+                    "tid": self._tid(tid), "args": {"value": float(value)}})
+
+    # -- plumbing -------------------------------------------------------
+    def _now_us(self):
+        return round((time.perf_counter() - self._origin) * 1e6, 3)
+
+    def _tid(self, stream):
+        # under the (reentrant) lock: the jax compile listener can fire from
+        # a background thread concurrently with engine-thread spans
+        with self._lock:
+            tid = self._tids.get(stream)
+            if tid is None:
+                tid = STREAMS.index(stream) + 1 if stream in STREAMS else len(STREAMS) + 1 + len(self._tids)
+                self._tids[stream] = tid
+                self._emit({"name": "thread_name", "ph": "M", "ts": 0, "pid": self._pid, "tid": tid,
+                            "args": {"name": stream}})
+            return tid
+
+    def _emit(self, ev):
+        with self._lock:
+            self._buf.append(ev)
+            if self._path is None:
+                # buffer-only mode: trim lazily at 2x the cap so the per-event
+                # cost stays amortized O(1) instead of an O(cap) slice each time
+                if len(self._buf) > 2 * self.MAX_BUFFERED:
+                    del self._buf[:len(self._buf) - self.MAX_BUFFERED]
+            elif len(self._buf) >= self._flush_every:
+                self._flush_locked()
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()
+
+    # pathless-tracer memory bound: keep at most this many buffered events
+    # (drain()/a later path picks them up; beyond it, oldest are dropped)
+    MAX_BUFFERED = 65536
+
+    def _flush_locked(self):
+        if not self._buf:
+            return
+        events, self._buf = self._buf, []
+        if self._path is None:
+            if len(events) > self.MAX_BUFFERED:
+                events = events[len(events) - self.MAX_BUFFERED:]
+            self._buf = events  # nowhere to write yet; keep for a later path
+            return
+        if self._fh is None:
+            d = os.path.dirname(os.path.abspath(self._path))
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # truncate on this process's FIRST open of a path: a stale trace
+            # from a previous run would interleave near ts=0 (ts is relative
+            # to each process's clock origin) and corrupt the artifact;
+            # within-process reopen (flush/close cycles) appends
+            mode = "a" if self._path in self._opened_paths else "w"
+            self._opened_paths.add(self._path)
+            self._fh = open(self._path, mode)
+        for ev in events:
+            self._fh.write(json.dumps(ev) + "\n")
+        self._fh.flush()
+
+    def _close_fh(self):
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None
+
+    def close(self):
+        with self._lock:
+            self._flush_locked()
+            self._close_fh()
+
+    def drain(self):
+        """Return (and clear) the buffered, not-yet-written events — the
+        in-memory read path for tests and programmatic consumers."""
+        with self._lock:
+            events, self._buf = self._buf, []
+        return events
+
+
+def _process_id():
+    """pid for trace events: the jax process index when distributed is up
+    (stable across hosts of one job), else the OS pid."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return os.getpid()
+
+
+# ---------------------------------------------------------------------------
+# module singleton + compile-event capture
+# ---------------------------------------------------------------------------
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def configure_tracer(config=None, **kwargs) -> Tracer:
+    return _tracer.configure(config=config, **kwargs)
+
+
+_COMPILE_LISTENER = {"installed": False}
+
+
+def _install_compile_listener():
+    """Capture XLA compile/lower durations as ``jax_compile`` trace events and
+    ``compile/*`` metrics. Installed once, fires only while tracing/metrics
+    are enabled (one attribute check per event otherwise)."""
+    if _COMPILE_LISTENER["installed"]:
+        return
+    try:
+        import jax.monitoring as jmon
+
+        def _on_event_duration(event, duration, **kwargs):
+            if "compile" not in event and "lower" not in event:
+                return
+            tr = _tracer
+            if tr.enabled:
+                now = time.perf_counter()
+                tr.complete("jax_compile", now - duration, duration, tid="compile",
+                            args={"source": event})
+            from .metrics import get_metrics
+
+            reg = get_metrics()
+            if reg.enabled:
+                reg.counter("compile/events").inc()
+                reg.counter("compile/total_seconds").inc(duration)
+
+        jmon.register_event_duration_secs_listener(_on_event_duration)
+        _COMPILE_LISTENER["installed"] = True
+    except Exception:  # tracing must never break program startup
+        pass
+
+
+def observe_latency(t0, span_name, hist_name=None, tid="serving", span_args=None, gauges=None):
+    """Shared tail for instrumented latency call sites: optional histogram
+    observation (milliseconds), optional gauge sets, and one trace span.
+    ``gauges`` maps name -> value or callable(dt_seconds). Callers guard with
+    their own enabled check; returns dt in seconds."""
+    dt = time.perf_counter() - t0
+    from .metrics import get_metrics
+
+    reg = get_metrics()
+    if reg.enabled:
+        if hist_name:
+            reg.histogram(hist_name).observe(dt * 1e3)
+        for gname, gval in (gauges or {}).items():
+            reg.gauge(gname).set(gval(dt) if callable(gval) else gval)
+    if _tracer.enabled:
+        _tracer.complete(span_name, t0, dt, tid=tid, args=span_args or {})
+    return dt
+
+
+def to_chrome_trace(jsonl_path, out_path):
+    """Wrap a JSONL trace into the strict ``{"traceEvents": [...]}`` JSON the
+    chrome://tracing legacy loader expects (Perfetto loads either form)."""
+    events = []
+    with open(jsonl_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return out_path
