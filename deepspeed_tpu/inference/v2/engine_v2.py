@@ -22,7 +22,7 @@ from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
 from .model_implementations.flat_model import ragged_forward
 from .ragged.ragged_manager import DSStateManager
-from .ragged.ragged_wrapper import RaggedBatchWrapper
+from .ragged.ragged_wrapper import RaggedBatchWrapper, next_bucket
 from .scheduling_utils import SchedulingError, SchedulingResult
 
 
@@ -341,6 +341,56 @@ class InferenceEngineV2:
             self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
             log_dist(f"compiled multi-step decode bucket seqs={s_bucket} steps={n_steps}", ranks=[0])
         return self._compiled[key]
+
+    def warmup(self, seq_buckets: Iterable[int], decode_steps) -> List[dict]:
+        """Pre-compile the lazy multi-step decode buckets at startup so the
+        first real request does not pay the XLA compile inside its TTFT.
+
+        ``seq_buckets``: sequence counts, each rounded UP to the wrapper's
+        static bucket (the same rounding ``decode`` applies); ``decode_steps``:
+        one scan horizon or an iterable of them. Each distinct
+        (bucket, horizon) program is traced, compiled, and executed once on an
+        all-zero descriptor against the real (donated-through) KV pools, so
+        the jit executable cache holds exactly the signature real traffic
+        hits. The zero descriptor scribbles into pool block 0, which is
+        harmless before any sequence exists but NOT after — warmup therefore
+        refuses to run once sequences are tracked. Each compile is recorded
+        as a ``jax_compile`` event on the trace bus (``args.source``
+        = "warmup"). Returns ``[{"seqs", "steps", "seconds", "cached"}, ...]``.
+        """
+        if self.state_manager.n_tracked_sequences:
+            raise RuntimeError("warmup() must run before serving traffic: its zero descriptor "
+                               "writes into KV block 0, which live sequences may own")
+        # materialize: a one-shot iterable would be exhausted by the first
+        # seq bucket, silently leaving later buckets un-warmed
+        decode_steps = (decode_steps, ) if isinstance(decode_steps, int) else tuple(decode_steps)
+        tracer = get_tracer()
+        kv = self.state_manager.kv_cache
+        max_blocks = self._max_blocks_per_seq
+        results = []
+        for want in seq_buckets:
+            s_bucket = next_bucket(int(want), self.batch.seq_buckets)
+            for n_steps in decode_steps:
+                n_steps = int(n_steps)
+                key = ("decode", s_bucket, n_steps)
+                if key in self._compiled:
+                    results.append({"seqs": s_bucket, "steps": n_steps, "seconds": 0.0, "cached": True})
+                    continue
+                fn = self._get_compiled_decode(s_bucket, n_steps)
+                # packed layout [T ids][T idx][T pos][T valid][S*max_blocks][S last]
+                # with T == S on the decode path
+                packed = jnp.zeros(s_bucket * (5 + max_blocks), jnp.int32)
+                t0 = time.perf_counter()
+                toks, pools = fn(self.params, packed, kv.pools())
+                jax.block_until_ready(toks)
+                kv.update(*pools)
+                dt = time.perf_counter() - t0
+                tracer.complete("jax_compile", t0, dt, tid="compile",
+                                args={"source": "warmup", "seqs": s_bucket, "steps": n_steps})
+                log_dist(f"warmup compiled decode bucket seqs={s_bucket} steps={n_steps} "
+                         f"in {dt:.2f}s", ranks=[0])
+                results.append({"seqs": s_bucket, "steps": n_steps, "seconds": dt, "cached": False})
+        return results
 
     # ------------------------------------------------------------------
     def query(self, uid: Optional[int] = None):

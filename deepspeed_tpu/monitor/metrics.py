@@ -58,7 +58,7 @@ class Histogram:
     ``percentile`` exact for any run shorter than the window — the serving
     TTFT/decode distributions this was built for."""
 
-    __slots__ = ("name", "buckets", "bucket_counts", "count", "total", "_raw", "window")
+    __slots__ = ("name", "buckets", "bucket_counts", "count", "total", "_raw", "window", "_lock")
 
     def __init__(self, name, buckets=None, window=4096):
         self.name = name
@@ -68,18 +68,28 @@ class Histogram:
         self.total = 0.0
         self.window = window
         self._raw = deque(maxlen=window)  # O(1) eviction at the window edge
+        # histograms take observations from background threads (the data
+        # prefetch worker) while the main thread drains events(): sorting a
+        # deque mid-append raises RuntimeError, so observe/read serialize on
+        # a per-histogram lock (uncontended acquire ~100ns, noise vs a step)
+        self._lock = threading.Lock()
 
     def observe(self, v):
         v = float(v)
-        self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
-        self.count += 1
-        self.total += v
-        self._raw.append(v)
+        with self._lock:
+            self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+            self.count += 1
+            self.total += v
+            self._raw.append(v)
 
     def percentile(self, p, _sorted=None):
         """Exact p-th percentile (0..100) over the retained window (nearest-
         rank method, so every returned value is an actual observation)."""
-        data = _sorted if _sorted is not None else sorted(self._raw)
+        if _sorted is not None:
+            data = _sorted
+        else:
+            with self._lock:
+                data = sorted(self._raw)
         if not data:
             return 0.0
         rank = min(len(data), max(1, math.ceil(p / 100.0 * len(data))))
@@ -89,8 +99,10 @@ class Histogram:
         return self.total / self.count if self.count else 0.0
 
     def summary(self):
-        data = sorted(self._raw)  # one sort shared by every quantile
-        return {"count": self.count, "mean": self.mean(),
+        with self._lock:
+            data = sorted(self._raw)  # one sort shared by every quantile
+            count, mean = self.count, self.mean()
+        return {"count": count, "mean": mean,
                 "p50": self.percentile(50, data), "p90": self.percentile(90, data),
                 "p99": self.percentile(99, data)}
 

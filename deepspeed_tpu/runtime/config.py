@@ -306,9 +306,12 @@ class DeepSpeedConfig:
         comms_dict = pd.get(COMMS_LOGGER, {})
         self.comms_config = CommsConfig(comms_logger_enabled=bool(comms_dict.get("enabled", False)),
                                         comms_logger=CommsLoggerConfig(**comms_dict))
-        from .data_pipeline.config import DataEfficiencyConfig, CurriculumLearningConfig
+        from .data_pipeline.config import (DataEfficiencyConfig, CurriculumLearningConfig,
+                                           get_data_pipeline_config)
 
         self.data_efficiency_config = DataEfficiencyConfig(**pd.get(DATA_EFFICIENCY, {}))
+        # data_pipeline block: input-path perf knobs (async device prefetch)
+        self.data_pipeline_config = get_data_pipeline_config(pd)
         self.curriculum_learning_config = CurriculumLearningConfig(**pd.get(CURRICULUM_LEARNING_LEGACY, {}))
         ckpt_dict = pd.get(CHECKPOINT, {})
         self.checkpoint_config = CheckpointConfig(**ckpt_dict)

@@ -1,5 +1,7 @@
 from .config import (DataEfficiencyConfig, CurriculumLearningConfig, RandomLTDConfig,
-                     get_data_efficiency_config)
+                     DataPipelineConfig, PrefetchConfig, get_data_efficiency_config,
+                     get_data_pipeline_config)
 from .curriculum_scheduler import CurriculumScheduler
 from .data_sampler import DeepSpeedDataSampler
 from .data_routing import random_ltd
+from .prefetch import DeviceBatch, DevicePrefetchIterator, LazyPrefetchingLoader

@@ -69,3 +69,22 @@ class DataEfficiencyConfig(DeepSpeedConfigModel):
 
 def get_data_efficiency_config(param_dict: dict) -> DataEfficiencyConfig:
     return DataEfficiencyConfig(**param_dict.get("data_efficiency", {}))
+
+
+class PrefetchConfig(DeepSpeedConfigModel):
+    """``data_pipeline.prefetch`` block: the async device-prefetching input
+    pipeline (``data_pipeline/prefetch.py``). ``depth`` bounds how many fully
+    assembled+placed batches the background worker may run ahead (each one
+    holds a full global batch in HBM)."""
+    enabled: bool = False
+    depth: int = Field(2, ge=1)
+
+
+class DataPipelineConfig(DeepSpeedConfigModel):
+    """Top-level ``data_pipeline`` block (input-path performance knobs — the
+    data-efficiency arms keep their own reference-schema blocks)."""
+    prefetch: PrefetchConfig = Field(default_factory=PrefetchConfig)
+
+
+def get_data_pipeline_config(param_dict: dict) -> DataPipelineConfig:
+    return DataPipelineConfig(**param_dict.get("data_pipeline", {}))

@@ -89,16 +89,22 @@ class CurriculumScheduler:
                 return diff
         return sc["difficulty"][-1]
 
-    def update_difficulty(self, global_steps):
+    def difficulty_at(self, global_steps):
+        """Side-effect-free difficulty for ``global_steps`` — every schedule
+        is a pure function of the step. The prefetch worker thread uses this
+        (mutating the checkpointed ``state`` from a background thread would
+        race the main thread's ``update_difficulty``)."""
         st = self.config.schedule_type
         if st == CURRICULUM_LEARNING_SCHEDULE_FIXED_LINEAR:
-            diff = self.__fixed_linear(global_steps)
-        elif st == CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT:
-            diff = self.__fixed_root(global_steps)
-        elif st == CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE:
-            diff = self.__fixed_discrete(global_steps)
-        else:
-            diff = self.schedule_config["difficulty_fn"](global_steps)
+            return self.__fixed_linear(global_steps)
+        if st == CURRICULUM_LEARNING_SCHEDULE_FIXED_ROOT:
+            return self.__fixed_root(global_steps)
+        if st == CURRICULUM_LEARNING_SCHEDULE_FIXED_DISCRETE:
+            return self.__fixed_discrete(global_steps)
+        return self.schedule_config["difficulty_fn"](global_steps)
+
+    def update_difficulty(self, global_steps):
+        diff = self.difficulty_at(global_steps)
         if diff != self.state["current_difficulty"]:
             logger.info(f"curriculum difficulty -> {diff} at step {global_steps}")
         self.state["current_difficulty"] = diff

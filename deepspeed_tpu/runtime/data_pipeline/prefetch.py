@@ -1,0 +1,280 @@
+"""Async device-prefetching input pipeline.
+
+The training input path is the one part of a TPU step the XLA scheduler
+cannot overlap for us: pulling microbatches from the loader, collating and
+``gas``-major stacking them, running the data-efficiency hooks, and
+dispatching ``jax.make_array_from_process_local_data`` all happen on the
+host, inline in ``train_batch`` — so the host idles during device compute
+and the device idles during host work. The reference's
+``DeepSpeedDataLoader`` never needed to solve this because torch's
+DataLoader workers + pinned-memory H2D copies did it for CUDA; this module
+is the TPU-native equivalent: a background thread that runs the WHOLE
+host side of batch ``i+1``..``i+k`` (bounded depth ``k``) while the device
+chews on batch ``i``, handing ``train_batch`` batches that are already
+sharded device arrays.
+
+Contract:
+
+  * the worker pulls ``gas`` microbatches per item from the wrapped loader
+    (the ``train_batch(data_iter=...)`` contract), runs ``prepare_fn(mbs,
+    step)`` — the engine's single host-work helper (post-process, stack,
+    curriculum, PLD) — then ``place_fn`` (shard + H2D dispatch), and queues
+    the result as a :class:`DeviceBatch`;
+  * the queue is bounded (``depth`` items) so the worker can run at most
+    ``depth`` batches ahead (plus the one in its hands) — backpressure, not
+    unbounded HBM growth;
+  * a worker exception is re-raised at the consumer's matching ``next()``
+    call, AFTER the already-queued good batches drain (ordering preserved);
+  * ``close()`` (also via context manager / interpreter exit) stops the
+    worker promptly even when it is blocked on a full queue; the thread is
+    a daemon and holds no reference to this iterator, so dropping the
+    iterator can never wedge interpreter shutdown or leak it forever.
+
+``step`` numbering: item ``i`` is prepared with ``step = start_step + i``,
+matching the ``engine.global_steps`` value at which the consumer will feed
+it — curriculum difficulty and PLD theta are therefore computed for the
+step the batch is USED at, not the step it was produced at, which is what
+makes prefetched and synchronous runs bit-identical on a fixed seed
+(test-enforced in ``tests/test_prefetch.py``).
+"""
+
+import queue
+import threading
+import time
+
+from ...monitor.metrics import get_metrics
+from ...monitor.trace import get_tracer
+
+_END = object()  # worker sentinel: wrapped loader exhausted
+
+
+class DeviceBatch:
+    """A batch that already went through host assembly AND device placement.
+
+    ``train_batch`` detects this wrapper and skips the inline
+    stack/post-process/shard path entirely (the prefetch fast path); ``data``
+    is the ``(gas, micro, ...)`` pytree of sharded ``jax.Array`` leaves and
+    ``step`` the global step the batch was prepared for.
+    """
+
+    __slots__ = ("data", "step")
+
+    def __init__(self, data, step=None):
+        self.data = data
+        self.step = step
+
+
+class _WorkerFailure:
+    __slots__ = ("exc", )
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _worker(loader, prepare_fn, place_fn, gas, start_step, out_q, stop, name):
+    """Worker body — a module function on purpose: it must NOT hold a
+    reference to the DevicePrefetchIterator, or the iterator could never be
+    garbage-collected while the thread runs (the GC-safety half of the
+    shutdown contract)."""
+
+    def put(item):
+        # bounded-wait put so a consumer that vanished (close()/GC) cannot
+        # strand the worker on a full queue forever
+        while not stop.is_set():
+            try:
+                out_q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    step = start_step
+    try:
+        it = iter(loader)
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                mbs = [next(it) for _ in range(gas)]
+            except StopIteration:
+                put(_END)
+                return
+            batch = prepare_fn(mbs, step) if prepare_fn is not None else \
+                (mbs[0] if gas == 1 else mbs)
+            placed = place_fn(batch) if place_fn is not None else batch
+            reg = get_metrics()
+            if reg.enabled:
+                reg.histogram("data/prefetch_assemble_ms").observe((time.perf_counter() - t0) * 1e3)
+            tr = get_tracer()
+            if tr.enabled:
+                tr.complete(f"{name}/assemble", t0, time.perf_counter() - t0, tid="data",
+                            args={"step": step})
+            if not put(DeviceBatch(placed, step)):
+                return
+            step += 1
+    except BaseException as e:  # noqa: BLE001 — every failure must reach the consumer
+        put(_WorkerFailure(e))
+
+
+class DevicePrefetchIterator:
+    """Iterator of :class:`DeviceBatch` items assembled+placed ahead of time
+    by a background thread. Build through ``engine.prefetching_loader`` for
+    the engine-wired version; direct construction takes any microbatch
+    iterable plus optional ``prepare_fn(mbs, step)`` / ``place_fn(batch)``
+    callables. Plain-iterator semantics: one pass, then StopIteration
+    forever — multi-epoch loader semantics live in
+    :class:`LazyPrefetchingLoader`."""
+
+    def __init__(self, loader, prepare_fn=None, place_fn=None, gas=1, depth=2,
+                 start_step=0, name="prefetch"):
+        if gas < 1:
+            raise ValueError(f"gas must be >= 1, got {gas}")
+        self.depth = max(1, int(depth))
+        self.gas = gas
+        self.name = name
+        self._loader = loader
+        self._queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._closed = False
+        self._failure = None
+        self._thread = threading.Thread(
+            target=_worker, name=f"ds-tpu-{name}", daemon=True,
+            args=(loader, prepare_fn, place_fn, gas, start_step, self._queue, self._stop, name))
+        self._thread.start()
+
+    # -- iteration ------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> DeviceBatch:
+        if self._closed:
+            raise RuntimeError(f"{self.name}: iterator is closed")
+        if self._failure is not None:
+            raise self._failure
+        if self._done:
+            raise StopIteration
+        try:
+            # fast path: the whole point of prefetch is that an item is
+            # already waiting — skip the timed get's deadline bookkeeping
+            item = self._queue.get_nowait()
+        except queue.Empty:
+            item = None
+        while item is None:
+            try:
+                item = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # defensive: the worker always queues _END/_WorkerFailure
+                    # before exiting, so this means the thread was killed
+                    raise RuntimeError(f"{self.name}: worker thread died without a result")
+        if item is _END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _WorkerFailure):
+            self._failure = item.exc
+            raise item.exc
+        return item
+
+    # (no __len__: a raising __len__ would also break truthiness checks on
+    # the iterator; ask the wrapped loader for its length if you need one)
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self, timeout=5.0):
+        """Stop the worker and join it. Safe to call more than once, from
+        ``__exit__``, ``engine.destroy()``, or ``__del__``; queued batches
+        are dropped (their device buffers free with them)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # drain so a worker blocked on put() observes the stop event promptly
+        self._drain()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout)
+        # drain AGAIN after the join: a worker mid-put when stop was set can
+        # legally fill the slot the first drain freed — without this a fully
+        # placed global batch would stay pinned in HBM behind the closed
+        # iterator
+        self._drain()
+
+    def _drain(self):
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close(timeout=0.5)
+        except Exception:  # interpreter teardown: never raise from __del__
+            pass
+
+
+class LazyPrefetchingLoader:
+    """Loader-semantics wrapper around the prefetch pipeline, used by the
+    engine's config-driven auto-wrap. Two jobs:
+
+      * LAZY: the DevicePrefetchIterator (and its worker) is only built at
+        the first ``next()`` call, so post-``initialize`` configuration —
+        ``load_checkpoint`` advancing ``global_steps``,
+        ``set_data_post_process_func`` installing the data hook — is
+        captured before any batch is prepared (an eager wrap would prepare
+        the first ``depth+1`` batches with step 0 and no hook);
+      * RESTARTABLE: each ``iter()`` call starts a fresh epoch over the
+        wrapped loader, like the loader's own ``__iter__`` — a bare
+        DevicePrefetchIterator is one-shot (plain-iterator semantics), which
+        would silently end multi-epoch ``for batch in trainloader`` loops
+        after epoch 1.
+
+    Unknown attributes (``sampler``, ``dataset``, ...) delegate to the
+    wrapped loader; ``len()`` is in consumed items (``len(loader) // gas``).
+    ``factory`` is ``engine.prefetching_loader`` (or compatible); ``gas``
+    an int or callable returning the current accumulation steps."""
+
+    def __init__(self, factory, loader, gas=1):
+        self._factory = factory
+        self._loader = loader
+        self._gas = gas
+        self._pf = None
+
+    def __iter__(self):
+        # fresh epoch: drop any previous (possibly exhausted) worker; the
+        # next next() re-wraps the loader, whose __iter__ restarts it
+        if self._pf is not None:
+            self._pf.close()
+            self._pf = None
+        return self
+
+    def __next__(self) -> DeviceBatch:
+        if self._pf is None:
+            self._pf = self._factory(self._loader)
+        return next(self._pf)
+
+    def __len__(self):
+        gas = self._gas() if callable(self._gas) else self._gas
+        return len(self._loader) // max(1, int(gas))
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._loader, name)  # sampler, dataset, batch_size, ...
+
+    def close(self, timeout=5.0):
+        if self._pf is not None:
+            self._pf.close(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -14,10 +14,13 @@ import numpy as np
 
 class RepeatingLoader:
     """Reference class of the same name: wraps an iterator to restart on
-    StopIteration."""
+    StopIteration. On each restart the wrapped loader's sampler (when it
+    exposes one) is advanced via ``set_epoch`` — without it every epoch
+    replays the identical shuffle order, silently degrading training."""
 
     def __init__(self, loader):
         self.loader = loader
+        self.epoch = 0
         self.data_iter = iter(self.loader)
 
     def __iter__(self):
@@ -27,6 +30,13 @@ class RepeatingLoader:
         try:
             return next(self.data_iter)
         except StopIteration:
+            self.epoch += 1
+            sampler = getattr(self.loader, "sampler", None)
+            if sampler is not None and hasattr(sampler, "set_epoch"):
+                # advance the sampler's OWN epoch when it exposes one, so a
+                # resume's set_epoch(N) continues at N+1 instead of being
+                # clobbered back to this wrapper's local count
+                sampler.set_epoch(getattr(sampler, "epoch", self.epoch - 1) + 1)
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
 

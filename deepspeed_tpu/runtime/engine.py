@@ -32,6 +32,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .config import DeepSpeedConfig
+from .data_pipeline.prefetch import DeviceBatch
 from .lr_schedules import get_lr_schedule_fn, LRScheduler
 from .optimizers import build_optimizer
 from .zero.partition import ZeroShardingPolicy, PartitionRules, constrain
@@ -85,6 +86,8 @@ class DeepSpeedEngine:
         self._pending_batches = []
         self._compiled = {}
         self._train_mode = True
+        self._prefetchers = []  # DevicePrefetchIterators built by this engine
+        self._sharding_cache = {}  # (ndim, n_leading) -> NamedSharding (batch placement)
 
         # --- distributed bring-up (reference __init__.py:133 init_distributed) ---
         if not dist.is_initialized():
@@ -358,6 +361,17 @@ class DeepSpeedEngine:
             from ..profiling.flops_profiler import FlopsProfiler
 
             self.flops_profiler = FlopsProfiler(self)
+        # async input pipeline: with the config block on, the engine-built
+        # dataloader is wrapped LAZILY — the worker starts on first next(),
+        # so load_checkpoint / set_data_post_process_func calls between
+        # initialize() and the training loop are honored by every batch
+        if (self.training_dataloader is not None
+                and config.data_pipeline_config.prefetch.enabled):
+            from .data_pipeline.prefetch import LazyPrefetchingLoader
+
+            self.training_dataloader = LazyPrefetchingLoader(
+                self.prefetching_loader, self.training_dataloader,
+                gas=lambda: self.config.gradient_accumulation_steps)
         log_dist(
             f"DeepSpeedEngine ready: zero_stage={config.zero_optimization_stage} "
             f"dtype={self.compute_dtype.__name__} mesh={dict(self.mesh.shape)} "
@@ -816,12 +830,13 @@ class DeepSpeedEngine:
         return grad_norm, overflow, lr
 
     def _offload_train_batch(self, batch, step_rng):
-        """ZeRO-Offload step: compiled fwd+bwd on device, host Adam update."""
+        """ZeRO-Offload step: compiled fwd+bwd on device, host Adam update.
+        ``batch`` arrives ALREADY placed (``train_batch`` shards once for all
+        step paths; prefetched batches were placed by the worker)."""
         gas = self.config.gradient_accumulation_steps
         if "offload_grads" not in self._compiled:
             self._compiled["offload_grads"] = self._accumulate_grads_fn(gas)
         with self.mesh:
-            batch = self._shard_batch(batch, leading=("mb", ))
             grads, loss, gnorm = self._compiled["offload_grads"](self.state["params"], batch, step_rng,
                                                                  self.state["loss_scale"])
         grad_norm, overflow, lr = self._host_apply_update(grads, scaled_gnorm=gnorm)
@@ -1092,40 +1107,137 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # public API — fused path
     # ------------------------------------------------------------------
-    def train_batch(self, batch=None, data_iter=None):
-        """Run one full training step (all microbatches + optimizer update).
+    def _host_prepare_batch(self, batch=None, mbs=None, step=None):
+        """THE single host-side batch-assembly helper — every data-dependent
+        training path (inline ``train_batch``, the prefetch worker) routes
+        through here, enforced by ``tools/check_data_paths.py`` so a second
+        copy of the stack/post-process logic can never drift out of sync.
 
-        ``batch``: pytree with leading dim ``gas * micro_bsz`` (host local),
-        or ``data_iter`` yielding microbatches. Returns the mean loss.
-        This is the performant path (one compiled program per step), the
-        analog of PipelineEngine.train_batch (reference pipe/engine.py:348)
-        generalized to all parallel modes.
-        """
+        ``mbs``: list of ``gas`` microbatches (the ``data_iter`` contract) —
+        post-processed per microbatch then gas-major stacked; ``batch``: a
+        whole ``gas*micro``-row pytree — post-processed whole then reshaped.
+        ``step``: ONLY the prefetch worker passes it — the global step the
+        batch will be CONSUMED at, for which curriculum difficulty and PLD
+        theta are computed via their side-effect-free accessors (the worker
+        thread must not mutate shared scheduler state under the main
+        thread); the inline path (``step=None``) uses ``self.global_steps``
+        and advances the schedulers as before. Same numbers either way, so
+        prefetched and synchronous runs stay bit-identical. Returns the
+        host-side ``(gas, micro, ...)`` pytree, not yet placed on device."""
         gas = self.config.gradient_accumulation_steps
-        micro = self.config.train_micro_batch_size_per_gpu
-        if batch is not None and self._data_post_process_func is not None:
-            batch = self._data_post_process_func(batch)
-        if batch is None:
-            assert data_iter is not None
-            mbs = [next(data_iter) for _ in range(gas)]
+        if mbs is not None:
             if self._data_post_process_func is not None:
                 mbs = [self._data_post_process_func(mb) for mb in mbs]
             batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *mbs)
         else:
+            if self._data_post_process_func is not None:
+                batch = self._data_post_process_func(batch)
             batch = jax.tree_util.tree_map(lambda x: np.asarray(x).reshape(gas, -1, *np.shape(x)[1:]), batch)
-
-        self._maybe_device_trace()
         if self.curriculum_scheduler is not None:
-            batch = self._apply_curriculum(batch)
-        if self.random_ltd_scheduler is not None:
-            self.random_ltd_scheduler.update_seq(self.global_steps)
+            batch = self._apply_curriculum(batch, step=step)
         if self.progressive_layer_drop is not None:
             # traced scalar per microbatch: theta decays without recompiling
-            self.progressive_layer_drop.update_state(self.global_steps)
+            pld = self.progressive_layer_drop
+            if step is None:
+                pld.update_state(self.global_steps)
+                theta = pld.get_theta()
+            else:  # worker thread: pure read, no shared-state mutation
+                theta = pld.theta_at(step)
             if not isinstance(batch, dict):
                 batch = {"input_ids": batch}
-            batch = {**batch, "pld_theta": np.full((gas,), self.progressive_layer_drop.get_theta(),
-                                                   np.float32)}
+            batch = {**batch, "pld_theta": np.full((gas,), theta, np.float32)}
+        return batch
+
+    def prefetching_loader(self, loader, depth=None):
+        """Wrap ``loader`` (an iterable of microbatches — the ``data_iter``
+        contract) in a :class:`DevicePrefetchIterator`: a background thread
+        runs the whole host side (``_host_prepare_batch`` + shard placement)
+        up to ``depth`` batches ahead, and ``train_batch(data_iter=...)``
+        consumes the already-placed :class:`DeviceBatch` items through its
+        fast path. ``depth`` defaults to ``data_pipeline.prefetch.depth``.
+        Build it when ``engine.global_steps`` reflects the step the next
+        batch feeds (the worker numbers batches from there), and rebuild it
+        after ``set_train_batch_size`` (gas is baked in at wrap time)."""
+        from .data_pipeline.prefetch import DevicePrefetchIterator
+
+        if isinstance(loader, DevicePrefetchIterator):
+            return loader
+        if depth is None:
+            depth = self.config.data_pipeline_config.prefetch.depth
+
+        def prepare(mbs, step):
+            return self._host_prepare_batch(mbs=mbs, step=step)
+
+        def place(batch):
+            with self.mesh:
+                return self._shard_batch(batch, leading=("mb", ))
+
+        pf = DevicePrefetchIterator(loader, prepare_fn=prepare, place_fn=place,
+                                    gas=self.config.gradient_accumulation_steps,
+                                    depth=depth, start_step=self.global_steps)
+        # the auto-wrap builds one prefetcher per epoch: prune the closed
+        # ones so a long run doesn't accumulate dead threads/queues here
+        self._prefetchers = [p for p in self._prefetchers if not p._closed]
+        self._prefetchers.append(pf)
+        return pf
+
+    def train_batch(self, batch=None, data_iter=None):
+        """Run one full training step (all microbatches + optimizer update).
+
+        ``batch``: pytree with leading dim ``gas * micro_bsz`` (host local),
+        a :class:`DeviceBatch` from a prefetching loader, or ``data_iter``
+        yielding microbatches (or ``DeviceBatch`` items — see
+        :meth:`prefetching_loader`). Returns the mean loss. This is the
+        performant path (one compiled program per step), the analog of
+        PipelineEngine.train_batch (reference pipe/engine.py:348)
+        generalized to all parallel modes.
+
+        Already-placed ``DeviceBatch`` inputs take the fast path: the inline
+        stack/post-process/shard work is skipped entirely (it already ran in
+        the prefetch worker), so the step blocks on data only for as long as
+        the bounded prefetch queue is empty — measured every step as
+        ``train/input_wait_ms`` when metrics are on, plus an ``input_wait``
+        span on the ``data`` trace stream.
+        """
+        gas = self.config.gradient_accumulation_steps
+        wait_obs = self._tracer.enabled or self._metrics.enabled
+        t_in = time.perf_counter() if wait_obs else 0.0
+        prefetched = isinstance(batch, DeviceBatch)
+        if batch is None:
+            assert data_iter is not None
+            first = next(data_iter)
+            if isinstance(first, DeviceBatch):
+                batch, prefetched = first, True
+            else:
+                batch = self._host_prepare_batch(mbs=[first] + [next(data_iter) for _ in range(gas - 1)])
+        elif not prefetched:
+            batch = self._host_prepare_batch(batch=batch)
+        if prefetched:
+            placed = batch.data
+        else:
+            with self.mesh:
+                placed = self._shard_batch(batch, leading=("mb", ))
+        if wait_obs:
+            dt_in = time.perf_counter() - t_in
+            if self._metrics.enabled:
+                self._metrics.histogram("train/input_wait_ms").observe(dt_in * 1e3)
+            if self._tracer.enabled:
+                self._tracer.complete("input_wait", t_in, dt_in, tid="data",
+                                      args={"step": self.global_steps, "prefetched": prefetched})
+
+        self._maybe_device_trace()
+        if prefetched:
+            # scheduler housekeeping stays on the MAIN thread: the worker
+            # computed this batch's transforms with the side-effect-free
+            # accessors for this very step, so advancing the shared state
+            # here keeps checkpoints/introspection fresh without changing
+            # any batch content (and without cross-thread mutation)
+            if self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.update_difficulty(self.global_steps)
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self.global_steps)
+        if self.random_ltd_scheduler is not None:
+            self.random_ltd_scheduler.update_seq(self.global_steps)
         step_rng, self._rng = jax.random.split(self._rng)
         self.tput_timer.start()
         # observe every step while tracing (profiling mode: the block that
@@ -1136,24 +1248,23 @@ class DeepSpeedEngine:
             self._metrics.enabled and (self.global_steps + 1) % self.config.steps_per_print == 0)
         t_step = time.perf_counter() if observing else 0.0
         if self.host_optimizer is not None:
-            metrics = self._offload_train_batch(batch, step_rng)
+            metrics = self._offload_train_batch(placed, step_rng)
         else:
             if "train_step" not in self._compiled:
-                self._last_batch_struct = jax.tree_util.tree_map(lambda x: np.ndim(x), batch)
+                self._last_batch_struct = jax.tree_util.tree_map(lambda x: np.ndim(x), placed)
                 self._compiled["train_step"] = self._build_train_step(gas)
             with self.mesh:
-                batch = self._shard_batch(batch, leading=("mb", ))
-                self.state, metrics = self._compiled["train_step"](self.state, batch, step_rng)
+                self.state, metrics = self._compiled["train_step"](self.state, placed, step_rng)
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
         self.tput_timer.stop(global_step=True)
         if observing:
-            self._observe_step(t_step, batch, metrics)
+            self._observe_step(t_step, placed, metrics)
         if self.host_optimizer is None and self.fp16_enabled and bool(metrics["overflow"]):
             self.skipped_steps += 1  # offload path counts inside _host_apply_update
         self._record_metrics(metrics)
-        self._maybe_flops_profile(batch)
+        self._maybe_flops_profile(placed)
         return metrics["loss"]
 
     def aot_lower_train_step(self, seq_len: int):
@@ -1251,13 +1362,18 @@ class DeepSpeedEngine:
 
             logger.warning(f"flops profiler failed at step {self.global_steps}: {e}")
 
-    def _apply_curriculum(self, batch, seq_axis=2):
+    def _apply_curriculum(self, batch, seq_axis=2, step=None):
         """seqlen curriculum: truncate the sequence dim of (gas, bsz, seq…)
         leaves to the current difficulty (reference passes curriculum_seqlen
         into the model, engine.py:1848; truncation is the model-agnostic TPU
         equivalent — each difficulty bucket compiles once). ``seq_axis``: 2
-        on the fused path ((gas, bsz, seq)), 1 on the eager microbatch path."""
-        diff = int(self.curriculum_scheduler.update_difficulty(self.global_steps))
+        on the fused path ((gas, bsz, seq)), 1 on the eager microbatch path.
+        ``step``: set ONLY by the prefetch worker (the consuming global step)
+        — that path reads the schedule side-effect-free; the inline path
+        advances the shared scheduler state on the main thread."""
+        sched = self.curriculum_scheduler
+        diff = int(sched.difficulty_at(step) if step is not None
+                   else sched.update_difficulty(self.global_steps))
         if self.curriculum_scheduler.config.curriculum_type != "seqlen":
             return batch
         # sequence dim must stay divisible by the seq-parallel axis
@@ -1273,16 +1389,27 @@ class DeepSpeedEngine:
 
     def _shard_batch(self, batch, leading=()):
         """Place host batch onto the mesh: batch dim over data axes, sequence
-        dim over the seq axis when sequence parallelism is enabled."""
+        dim over the seq axis when sequence parallelism is enabled.
+
+        Idempotent: leaves that are already ``jax.Array``s sharded on THIS
+        mesh (a prefetched batch, or a repeated call) pass through untouched.
+        ``NamedSharding`` objects are cached by ``(ndim, n_leading)`` —
+        the spec depends on nothing else for a fixed engine — instead of
+        being rebuilt per leaf per step."""
+        nlead = len(leading)
+
         def place(x):
+            if isinstance(x, jax.Array) and getattr(x.sharding, "mesh", None) is self.mesh:
+                return x  # already placed by this engine — placement is idempotent
             x = np.asarray(x)
-            nlead = len(leading)
-            spec = [None] * x.ndim
-            if x.ndim > nlead:
-                spec[nlead] = BATCH_AXES  # (data_repl, data) — full DP extent
-            if self.seq_world_size > 1 and x.ndim > nlead + 1:
-                spec[nlead + 1] = SEQ_AXIS
-            s = NamedSharding(self.mesh, P(*spec))
+            s = self._sharding_cache.get((x.ndim, nlead))
+            if s is None:
+                spec = [None] * x.ndim
+                if x.ndim > nlead:
+                    spec[nlead] = BATCH_AXES  # (data_repl, data) — full DP extent
+                if self.seq_world_size > 1 and x.ndim > nlead + 1:
+                    spec[nlead + 1] = SEQ_AXIS
+                s = self._sharding_cache[(x.ndim, nlead)] = NamedSharding(self.mesh, P(*spec))
             return jax.make_array_from_process_local_data(s, x)
 
         return jax.tree_util.tree_map(place, batch)
@@ -1552,8 +1679,16 @@ class DeepSpeedEngine:
         from .dataloader import DeepSpeedDataLoader
 
         dp_rank, dp_world = self._process_dp_coord()
+        if batch_size is None:
+            # each PROCESS loads the shard of the global batch covering its
+            # addressable devices: micro_bsz per data coordinate, and this
+            # process owns batch_dp/dp_world of them (1 on one-device-per-
+            # process pods; all of them single-process) — so the loader's
+            # microbatches feed train_batch(data_iter=...) directly
+            batch_size = (self.config.train_micro_batch_size_per_gpu
+                          * max(1, self.batch_dp_world_size // dp_world))
         return DeepSpeedDataLoader(dataset,
-                                   batch_size=batch_size or self.config.train_micro_batch_size_per_gpu,
+                                   batch_size=batch_size,
                                    collate_fn=collate_fn,
                                    drop_last=self.config.dataloader_drop_last,
                                    data_parallel_rank=dp_rank,
@@ -1797,6 +1932,9 @@ class DeepSpeedEngine:
             # a trace window reaching the final step has no later train_batch
             # to close it — flush the artifact before tearing state down
             self.stop_device_trace()
+        for pf in self._prefetchers:
+            pf.close()  # stop workers + drop their queued device batches
+        self._prefetchers = []
         self._compiled = {}
         self.state = None
         self._grad_acc_buffer = None

@@ -32,8 +32,14 @@ class ProgressiveLayerDrop:
     def get_theta(self) -> float:
         return self.current_theta
 
+    def theta_at(self, global_step: int) -> float:
+        """Side-effect-free theta for ``global_step`` (the schedule is a pure
+        function of the step) — used by the prefetch worker thread, which
+        must not mutate ``current_theta`` under the main thread."""
+        return (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta
+
     def update_state(self, global_step: int) -> None:
-        self.current_theta = (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta
+        self.current_theta = self.theta_at(global_step)
 
 
 def layer_keep_probs(num_layers: int, theta):

@@ -1,0 +1,119 @@
+"""Static check: ``train_batch``'s data-dependent paths all route through the
+SINGLE host-work helper ``DeepSpeedEngine._host_prepare_batch``.
+
+Companion to ``check_timed_ops.py`` (same lesson: structural invariants rot
+silently unless CI asserts them). The prefetch subsystem
+(``runtime/data_pipeline/prefetch.py``) runs the host side of batch assembly
+— post-process, gas-major stacking, curriculum truncation, PLD theta — in a
+background worker; if a second copy of that logic ever grows back inside
+``train_batch`` / ``_offload_train_batch``, the prefetched and synchronous
+paths drift apart and losses stop being bit-identical. This AST walk (no
+package imports, runs anywhere) asserts:
+
+  * ``_host_prepare_batch`` exists and actually contains the assembly logic
+    (post-process + stack + curriculum calls);
+  * ``train_batch`` calls the helper and contains NO direct assembly calls;
+  * ``_offload_train_batch`` contains neither assembly calls nor a second
+    helper call (its batches arrive prepared AND placed);
+  * ``prefetching_loader`` wires the worker to the same helper.
+
+A tier-1 test (``tests/test_prefetch.py``) runs this on every CI pass.
+"""
+
+import ast
+import os
+import sys
+
+DEFAULT_ENGINE_PY = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                                 "deepspeed_tpu", "runtime", "engine.py")
+
+HOST_HELPER = "_host_prepare_batch"
+# call targets (attribute or bare name) that ARE the host assembly logic —
+# allowed only inside the helper (and the eager forward(), which handles one
+# microbatch at a time and is not a train_batch data path). Scheduler
+# STATE-ADVANCE calls (update_difficulty/update_state) are deliberately not
+# listed: train_batch runs them as main-thread housekeeping on the
+# prefetched path — they change no batch content
+ASSEMBLY_CALLS = ("_data_post_process_func", "_apply_curriculum", "stack")
+# train_batch data paths: must stay free of assembly logic
+DATA_PATHS = ("train_batch", "_offload_train_batch")
+
+
+def _called_names(fn_node):
+    """All call targets inside ``fn_node``: bare names and attribute names."""
+    out = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def _engine_methods(path):
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "DeepSpeedEngine":
+            return {n.name: n for n in node.body if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    return {}
+
+
+def check(path=DEFAULT_ENGINE_PY):
+    """Return a list of human-readable violations (empty == clean)."""
+    methods = _engine_methods(path)
+    violations = []
+    if not methods:
+        return [f"class DeepSpeedEngine not found in {path}"]
+
+    helper = methods.get(HOST_HELPER)
+    if helper is None:
+        return [f"{HOST_HELPER} missing from DeepSpeedEngine ({path})"]
+    helper_calls = _called_names(helper)
+    for required in ("_data_post_process_func", "stack", "_apply_curriculum"):
+        if required not in helper_calls:
+            violations.append(f"{HOST_HELPER} no longer calls {required!r} — the assembly "
+                              "logic moved; update this gate with it")
+
+    for name in DATA_PATHS:
+        fn = methods.get(name)
+        if fn is None:
+            violations.append(f"{name} missing from DeepSpeedEngine")
+            continue
+        leaked = sorted(_called_names(fn) & set(ASSEMBLY_CALLS))
+        if leaked:
+            violations.append(f"{name} calls {leaked} directly — host batch assembly must "
+                              f"route through {HOST_HELPER} (prefetch/sync parity)")
+    tb = methods.get("train_batch")
+    if tb is not None and HOST_HELPER not in _called_names(tb):
+        violations.append(f"train_batch does not call {HOST_HELPER} — the synchronous "
+                          "path must use the shared helper")
+    ob = methods.get("_offload_train_batch")
+    if ob is not None and HOST_HELPER in _called_names(ob):
+        violations.append(f"_offload_train_batch calls {HOST_HELPER} — its batches arrive "
+                          "prepared and placed; preparing twice double-applies hooks")
+    pl = methods.get("prefetching_loader")
+    if pl is None:
+        violations.append("prefetching_loader missing from DeepSpeedEngine")
+    elif HOST_HELPER not in _called_names(pl):
+        violations.append(f"prefetching_loader does not wire the worker to {HOST_HELPER}")
+    return violations
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    path = argv[0] if argv else DEFAULT_ENGINE_PY
+    violations = check(path)
+    if violations:
+        print("check_data_paths: FAILED")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print("check_data_paths: train_batch data paths route through the single host-work helper")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
