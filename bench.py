@@ -174,6 +174,63 @@ def bench_serving(on_tpu: bool):
     dt = time.time() - t0
     decode_tps = n_seqs * n_rounds * horizon / dt
 
+    # --- prefix-cache phase: hit-vs-miss TTFT on a shared-prefix stream.
+    # A separate small engine (params SHARED with the main one — no second
+    # HBM copy) with ragged.prefix_cache enabled: per shared system prompt,
+    # the first request pays full prefill (miss), repeats prefill only their
+    # unique suffix (radix hit) — the TTFT gap is the serving win ---
+    prefix_line = None
+    try:
+        from deepspeed_tpu.inference.v2 import PrefixCacheConfig
+
+        if on_tpu:
+            n_prefixes, repeats, shared_len, suffix_len = 4, 3, 384, 128
+        else:
+            n_prefixes, repeats, shared_len, suffix_len = 2, 2, 48, 16
+        per_seq = -(-(shared_len + suffix_len + 1) // block_size) + 1
+        picfg = RaggedInferenceEngineConfig()
+        picfg.kv_block_size = block_size
+        picfg.num_kv_blocks = (n_prefixes + 2) * per_seq + 8
+        picfg.kv_dtype = "int8" if kv_int8 else cfg.dtype
+        picfg.state_manager.max_tracked_sequences = 4
+        picfg.state_manager.max_ragged_sequence_count = 4
+        picfg.state_manager.max_ragged_batch_size = max(prompt_len, 4)
+        picfg.state_manager.max_context = shared_len + suffix_len + block_size
+        picfg.use_pallas_kernels = "never" if not on_tpu else "auto"
+        picfg.prefix_cache = PrefixCacheConfig(enabled=True)
+        peng = InferenceEngineV2(model, picfg, params=engine.params)
+        # compile the miss- and hit-shaped buckets before timing
+        wp = rng.integers(0, cfg.vocab_size, size=shared_len + suffix_len, dtype=np.int32)
+        peng.put([90_000], [wp], sample="greedy")
+        peng.put([90_001], [wp[-suffix_len:]], sample="greedy")
+        for u in (90_000, 90_001):
+            peng.flush(u)
+        peng.prefix_cache.clear()
+        peng.prefix_cache.stats.update({k: 0 for k in peng.prefix_cache.stats})
+        ttft_miss, ttft_hit = [], []
+        uid = 91_000
+        for p in range(n_prefixes):
+            shared = rng.integers(0, cfg.vocab_size, size=shared_len, dtype=np.int32)
+            for r in range(repeats + 1):
+                suffix = rng.integers(0, cfg.vocab_size, size=suffix_len, dtype=np.int32)
+                t0 = time.time()
+                peng.put([uid], [np.concatenate([shared, suffix])], sample="greedy")
+                (ttft_miss if r == 0 else ttft_hit).append((time.time() - t0) * 1000.0)
+                peng.flush(uid)
+                uid += 1
+        pc = peng.prefix_cache
+        prefix_line = {
+            "hit_rate": round(pc.hit_rate, 3),
+            "cached_tokens": int(pc.stats["cached_tokens"]),
+            "ttft_hit_p50_ms": round(float(np.percentile(ttft_hit, 50)), 1),
+            "ttft_miss_p50_ms": round(float(np.percentile(ttft_miss, 50)), 1),
+        }
+        _free_engine(peng, "state_manager")
+    except Exception as e:
+        # the headline serving numbers never forfeit to the prefix phase
+        print(f"# WARNING: prefix-cache bench phase failed "
+              f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+
     # --- HBM roofline for vs_baseline (decode is bandwidth-bound). The KV
     # term uses the bytes ACTUALLY streamed (int8 + fp32 scales in quantized
     # mode) so the ratio stays an honest fraction of the achievable bound ---
@@ -199,6 +256,8 @@ def bench_serving(on_tpu: bool):
         # "regression" — VERDICT r4), so it is null unless measured on-chip
         "vs_baseline": round(decode_tps / roofline_tps, 4) if on_tpu else None,
     }
+    if prefix_line is not None:
+        out["prefix_cache"] = prefix_line
     _free_engine(engine, "state_manager", "params")
     return out
 
@@ -544,7 +603,9 @@ def run_bench():
         # BASELINE workloads need a pod; this measures MFU on the largest
         # llama-arch model one v5e chip fits, against the same 54% bar
         "workload": f"{n_params/1e6:.1f}M llama-arch, seq {seq}, ZeRO-3, single v5e chip",
-        "serving": {k: serving[k] for k in ("value", "ttft_p50_ms", "vs_baseline")},
+        "serving": {k: serving[k] for k in ("value", "ttft_p50_ms", "vs_baseline")
+                    if k in serving} | ({"prefix_cache": serving["prefix_cache"]}
+                                       if "prefix_cache" in serving else {}),
         # achieved MFU fraction (null on the CPU fallback — the v5e-peak
         # denominator would read as a 99.9% regression, the VERDICT r4 trap)
         "mfu": round(mfu, 4) if on_tpu else None,

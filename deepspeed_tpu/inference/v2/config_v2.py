@@ -21,6 +21,21 @@ class DSStateManagerConfig:
 
 
 @dataclass
+class PrefixCacheConfig:
+    """``ragged.prefix_cache`` block: block-granular KV reuse across requests
+    (PagedAttention sharing + RadixAttention LRU tree). Off by default —
+    when enabled, identical outputs are guaranteed (greedy parity asserted
+    in ``tests/test_prefix_cache.py``) and shared-prefix workloads skip the
+    cached portion of prefill."""
+    enabled: bool = False
+    # leaf-eviction policy when the block pool runs dry ('lru' only for now)
+    eviction: str = "lru"
+    # minimum hit size (in blocks, COW tail included) worth taking: tiny
+    # hits fragment the pool for negligible prefill savings
+    min_hit_blocks: int = 1
+
+
+@dataclass
 class ModulesConfig:
     """Per-op implementation selection (reference ``modules/heuristics.py``
     config surface). Each slot is ``"auto"`` (heuristic pick), a registered
@@ -46,6 +61,8 @@ class RaggedInferenceEngineConfig:
     # fraction of post-params free HBM given to the KV pool in auto mode
     kv_memory_fraction: float = 0.8
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
+    # prefix-cache subsystem (refcounted COW block sharing + radix reuse)
+    prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     use_pallas_kernels: str = "auto"  # 'auto' | 'never' | 'always'
     # weight-only int8 (per-output-channel scales): halves the decode weight
     # stream, which is the bandwidth-bound term at serving batch sizes

@@ -75,7 +75,8 @@ class InferenceEngineV2:
         self.state_manager = DSStateManager(
             mc.num_layers, mc.num_kv_heads, mc.head_dim,
             max_tracked_sequences=ic.state_manager.max_tracked_sequences,
-            num_blocks=self.num_kv_blocks, block_size=bs, dtype=ic.kv_dtype)
+            num_blocks=self.num_kv_blocks, block_size=bs, dtype=ic.kv_dtype,
+            prefix_cache_config=ic.prefix_cache)
         self.batch = RaggedBatchWrapper(
             max_ragged_batch_size=ic.state_manager.max_ragged_batch_size,
             max_ragged_sequence_count=ic.state_manager.max_ragged_sequence_count,
@@ -156,7 +157,10 @@ class InferenceEngineV2:
             # blocks must not mask OTHER sequences' demand against the pool
             blocks_needed += max(0, -(-total // bs)
                                  - (seq.cur_allocated_blocks if seq is not None else 0))
-        if blocks_needed > self.state_manager.free_blocks:
+        # budget against free + evictable: a warm prefix cache keeps the free
+        # list near empty by design, and allocation evicts LRU tree-only
+        # blocks on demand
+        if blocks_needed > self.state_manager.available_blocks:
             return SchedulingResult.KVCacheLimitExceeded
         return SchedulingResult.Success
 
@@ -183,6 +187,10 @@ class InferenceEngineV2:
             # packed batch and silently return the wrong sequence's logits
             raise ValueError("put(): zero-length token chunk "
                              f"(uids {[u for u, t in zip(batch_uids, batch_tokens) if t.size == 0]})")
+        # classify prefill vs decode from the PRE-trim sizes: a cache hit can
+        # trim a repeat prompt down to one token, but its latency is still a
+        # TTFT sample (and the hit is exactly what makes it worth recording)
+        had_prefill = any(t.size > 1 for t in batch_tokens)
         if do_checks:
             result = self.can_schedule(batch_uids, [t.size for t in batch_tokens])
             if result is not SchedulingResult.Success:
@@ -190,8 +198,17 @@ class InferenceEngineV2:
 
         self.batch.clear()
         descs = []
-        for uid, toks in zip(batch_uids, batch_tokens):
-            seq = self.state_manager.get_or_create_sequence(uid)
+        for i, (uid, toks) in enumerate(zip(batch_uids, batch_tokens)):
+            seq = self.state_manager.get_sequence(uid)
+            if seq is None:
+                # cache-hit prefill path: a new sequence's first chunk is
+                # matched against the radix tree; the hit's blocks arrive
+                # shared (seen_tokens pre-seeded) and only the uncached
+                # suffix is actually fed/computed
+                seq, skip = self._create_with_prefix(uid, toks)
+                if skip:
+                    toks = batch_tokens[i] = toks[skip:]
+            self.state_manager.note_tokens(seq, toks)
             self.state_manager.allocate_blocks(seq, toks.size)
             seq.pre_forward(toks.size)
             self.batch.insert_sequence(seq, toks)
@@ -206,13 +223,14 @@ class InferenceEngineV2:
         kv.update(*pools)
         for seq in descs:
             seq.post_forward()
+            self.state_manager.publish_sequence(seq)  # completed full blocks → tree
         out = out[:rb.n_seqs]  # slice ON DEVICE: the host fetch moves
         out = out if not block else np.asarray(out)  # n_seqs rows, not the padded bucket
         if observing:
             # prefill (multi-token chunks) latency IS TTFT when block=True
             # (admission -> first token on host, the FastGen definition);
             # block=False measures only async dispatch, so no latency sample
-            kind = "prefill" if any(t.size > 1 for t in batch_tokens) else "decode_step"
+            kind = "prefill" if had_prefill else "decode_step"
             hist = ("serving/ttft_ms" if kind == "prefill" else "serving/decode_step_ms") if block else None
             observe_latency(t0, f"serving/{kind}", hist_name=hist,
                             span_args={"seqs": len(batch_uids),
@@ -256,7 +274,7 @@ class InferenceEngineV2:
                 raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
             seqs.append(seq)
         blocks_needed = sum(s.blocks_needed(n_steps) for s in seqs)
-        if blocks_needed > self.state_manager.free_blocks:
+        if blocks_needed > self.state_manager.available_blocks:
             raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
         if not hasattr(self, "_decode_batch"):
             # the scan packs exactly one token per sequence, so its wrapper
@@ -282,10 +300,22 @@ class InferenceEngineV2:
         # one token at its position) — no separate seq_start_len upload
         toks, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
         kv.update(*pools)
+        toks = toks[:S]  # on-device slice before any host fetch
+        pc = self.state_manager.prefix_cache
+        if block:
+            toks = np.asarray(toks)
+            if pc is not None:
+                # tokens materialized this burst: the fed first token plus
+                # every in-scan feedback token except the last output (whose
+                # KV is not written until it is fed back)
+                for seq, f, row in zip(seqs, first, toks):
+                    self.state_manager.note_tokens(seq, np.concatenate([f, row[:-1]]))
+        elif pc is not None:
+            for seq in seqs:
+                seq.history_valid = False  # generated ids never reached host
         for seq in seqs:
             seq.post_forward()
-        toks = toks[:S]  # on-device slice before any host fetch
-        toks = toks if not block else np.asarray(toks)
+            self.state_manager.publish_sequence(seq)
         if observing:
             # as with put(): without the host fetch the wall time is dispatch
             # only — emit the span (blocked flag disclosed), skip the samples
@@ -361,6 +391,12 @@ class InferenceEngineV2:
         if self.state_manager.n_tracked_sequences:
             raise RuntimeError("warmup() must run before serving traffic: its zero descriptor "
                                "writes into KV block 0, which live sequences may own")
+        pc = self.state_manager.prefix_cache
+        if pc is not None and pc.n_cached_blocks:
+            # flushed sequences leave their blocks in the radix tree — block 0
+            # may be cache-held, and the zero descriptor would scribble on its
+            # KV. Dropping the (re-computable) cache keeps warmup safe.
+            pc.clear()
         # materialize: a one-shot iterable would be exhausted by the first
         # seq bucket, silently leaving later buckets un-warmed
         decode_steps = (decode_steps, ) if isinstance(decode_steps, int) else tuple(decode_steps)
@@ -431,6 +467,63 @@ class InferenceEngineV2:
     @property
     def free_blocks(self) -> int:
         return self.state_manager.free_blocks
+
+    @property
+    def available_blocks(self) -> int:
+        """Free-list blocks plus what prefix-cache eviction could reclaim."""
+        return self.state_manager.available_blocks
+
+    @property
+    def prefix_cache(self):
+        """The :class:`PrefixKVCache` radix tree (None when disabled)."""
+        return self.state_manager.prefix_cache
+
+    def probe_prefix(self, prompt_tokens):
+        """PURE prefix lookup (no references taken, no LRU touch, no stats):
+        ``(n_cached_tokens, n_shared_full_blocks, n_tree_only, match)`` the
+        cache would serve for this prompt. Admission uses it for budget math
+        BEFORE committing — a refused request must leave the tree untouched.
+        ``n_tree_only`` counts the hit's shared blocks whose sole holder is
+        currently the tree: acquisition pins them, so they must come OFF the
+        evictable supply in any admission check that subtracts the hit from
+        the demand side (counting them on both sides over-admits)."""
+        pc = self.state_manager.prefix_cache
+        if pc is None:
+            return 0, 0, 0, None
+        m = pc.match(np.asarray(prompt_tokens, np.int32).reshape(-1))
+        tree_only = sum(1 for b in m.shared_blocks
+                        if self.state_manager.kv_cache.refcount(b) == 1)
+        return m.n_cached_tokens, len(m.shared_blocks), tree_only, m
+
+    def acquire_prefix(self, uid: int, prompt_tokens, match=None) -> Tuple[int, int]:
+        """Create the sequence for ``uid`` pre-populated from the prefix
+        cache (the scheduler's admission-side entry: it knows the FULL
+        prompt, so the match is not limited to the first SplitFuse chunk).
+        ``match`` — the object from :meth:`probe_prefix` — skips the
+        re-match (valid as long as nothing mutated the tree in between).
+        Returns ``(n_cached_tokens, n_shared_full_blocks)`` — the scheduler
+        feeds ``prompt[n_cached:]`` and charges only the uncached tokens.
+        Roll back an abandoned acquisition with ``flush(uid)``."""
+        seq, skip = self._create_with_prefix(
+            uid, np.asarray(prompt_tokens, np.int32).reshape(-1), match=match)
+        return skip, seq.shared_blocks
+
+    def _create_with_prefix(self, uid: int, prompt_tokens, match=None):
+        """Sequence creation + the monitor's view of the lookup: hit-rate
+        gauge, cached-token counters, and a ``prefix_hit`` trace span."""
+        seq, skip = self.state_manager.create_sequence_with_prefix(uid, prompt_tokens,
+                                                                   match=match)
+        pc = self.state_manager.prefix_cache
+        if pc is not None:
+            m = get_metrics()
+            m.counter("serving/prefix_lookups").inc()
+            m.gauge("serving/prefix_hit_rate").set(pc.hit_rate)
+            if skip:
+                m.counter("serving/prefix_hits").inc()
+                m.counter("serving/prefix_cached_tokens").inc(skip)
+                get_tracer().instant("prefix_hit", tid="serving", uid=int(uid),
+                                     tokens=int(skip), blocks=len(seq.kv_blocks))
+        return seq, skip
 
     # ------------------------------------------------------------------
     def _get_compiled(self, t_bucket: int, s_bucket: int, sample: Optional[str] = None):

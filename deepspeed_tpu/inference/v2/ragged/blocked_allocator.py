@@ -1,9 +1,20 @@
-"""Free-list KV block allocator.
+"""Free-list KV block allocator with per-block refcounts.
 
 Analog of the reference ``inference/v2/ragged/blocked_allocator.py:11``
 (``BlockedAllocator``: fixed pool of KV-cache blocks handed out to sequences
 and returned on release). Host-side bookkeeping only — the device never sees
 this object, just the block-table arrays it produces.
+
+Refcount semantics (the prefix-cache sharing substrate): ``allocate`` hands
+out blocks at refcount 1; every additional holder (another sequence sharing
+the block, or the prefix-cache radix tree itself) takes a reference with
+``incref``; ``release`` drops one reference and only relinks the block onto
+the free list when the count reaches zero. A block's contents are IMMUTABLE
+while its refcount exceeds one — writers must copy-on-write first
+(``BlockedKVCache.copy_block``). Releasing a free block, or a block id that
+was never allocated, raises ``ValueError`` loudly instead of silently
+corrupting the free list (the pre-refcount ``free`` relinked the id at the
+head and over-counted ``_free``).
 """
 
 from typing import Iterable, Union
@@ -22,6 +33,8 @@ class BlockedAllocator:
         self._next = np.arange(1, num_blocks + 1, dtype=np.int64)
         self._head = 0
         self._free = num_blocks
+        # holders per block: 0 = on the free list
+        self._refcount = np.zeros(num_blocks, dtype=np.int64)
 
     @property
     def free_blocks(self) -> int:
@@ -31,9 +44,16 @@ class BlockedAllocator:
     def total_blocks(self) -> int:
         return self._num_blocks
 
+    def refcount(self, block: int) -> int:
+        """Current holder count of ``block`` (0 = free)."""
+        b = int(block)
+        if not 0 <= b < self._num_blocks:
+            raise ValueError(f"invalid block id {b}")
+        return int(self._refcount[b])
+
     def allocate(self, num_blocks: int) -> np.ndarray:
-        """Pop ``num_blocks`` block ids; raises ValueError when exhausted
-        (reference ``blocked_allocator.py:50``)."""
+        """Pop ``num_blocks`` block ids at refcount 1; raises ValueError when
+        exhausted (reference ``blocked_allocator.py:50``)."""
         if num_blocks < 1:
             raise ValueError(f"must allocate at least 1 block, got {num_blocks}")
         if num_blocks > self._free:
@@ -43,15 +63,41 @@ class BlockedAllocator:
             out[i] = self._head
             self._head = self._next[self._head]
         self._free -= num_blocks
+        self._refcount[out] = 1
         return out
 
-    def free(self, blocks: Union[int, Iterable[int]]) -> None:
+    def incref(self, blocks: Union[int, Iterable[int]]) -> None:
+        """Register one more holder per block (sharing). Blocks must be live."""
+        for b in self._as_ids(blocks):
+            if self._refcount[b] == 0:
+                raise ValueError(f"incref on free block {b}: only allocated blocks can be shared")
+            self._refcount[b] += 1
+
+    def release(self, blocks: Union[int, Iterable[int]]) -> None:
+        """Drop one reference per block; a block returns to the free list only
+        at refcount zero. Releasing an already-free block (double free) or a
+        never-allocated id raises instead of corrupting the free list."""
+        for b in self._as_ids(blocks):
+            if self._refcount[b] == 0:
+                raise ValueError(f"double free of block {b}: block is already on the free list")
+            self._refcount[b] -= 1
+            if self._refcount[b] == 0:
+                self._next[b] = self._head
+                self._head = b
+                self._free += 1
+
+    # the historical name: one holder dropping its reference. Kept as an
+    # exact alias so pre-refcount callers get the loud double-free guard
+    # for free (ISSUE 3 satellite: silent free-list corruption fix).
+    free = release
+
+    def _as_ids(self, blocks):
         if isinstance(blocks, (int, np.integer)):
             blocks = [int(blocks)]
+        out = []
         for b in blocks:
             b = int(b)
             if not 0 <= b < self._num_blocks:
                 raise ValueError(f"invalid block id {b}")
-            self._next[b] = self._head
-            self._head = b
-            self._free += 1
+            out.append(b)
+        return out

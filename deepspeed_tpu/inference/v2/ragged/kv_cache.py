@@ -71,12 +71,49 @@ class BlockedKVCache:
     def free_blocks(self) -> int:
         return self._allocator.free_blocks
 
+    @property
+    def total_blocks(self) -> int:
+        return self._allocator.total_blocks
+
     def reserve(self, n_blocks: int) -> np.ndarray:
-        """Allocate ``n_blocks`` (reference ``kv_cache.py:147`` reserve)."""
+        """Allocate ``n_blocks`` at refcount 1 (reference ``kv_cache.py:147``)."""
         return self._allocator.allocate(n_blocks)
 
     def free(self, blocks) -> None:
         self._allocator.free(blocks)
+
+    # -- refcount-aware sharing surface (prefix cache) ---------------------
+    def incref(self, blocks) -> None:
+        """One more holder per block: the block contents become IMMUTABLE
+        until the count drops back to one (copy-on-write for mutation)."""
+        self._allocator.incref(blocks)
+
+    def release(self, blocks) -> None:
+        """Drop one reference per block; physical free happens at zero."""
+        self._allocator.release(blocks)
+
+    def refcount(self, block) -> int:
+        return self._allocator.refcount(block)
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """Device-side copy of one block's KV slots ``src`` → ``dst`` (the
+        copy-on-write primitive: a sequence that must write into a SHARED
+        block first duplicates it into a privately-held block). Eager jnp
+        ops — COW is rare (one copy per partial-tail prefix hit), so the
+        dispatch cost is noise next to the prefill it saves."""
+        bs = self.block_size
+        s, d = int(src) * bs, int(dst) * bs
+        self.k_pool = self.k_pool.at[:, d:d + bs].set(self.k_pool[:, s:s + bs])
+        self.v_pool = self.v_pool.at[:, d:d + bs].set(self.v_pool[:, s:s + bs])
+        if self.quantized:
+            # scale layout [nkv, L * NB * bs]: per-layer strided slots — copy
+            # through a [nkv, L, NB*bs] view so each layer's span moves
+            nkv = self.num_kv_heads
+            span = self.num_blocks * bs
+            for name in ("k_scale", "v_scale"):
+                sc = getattr(self, name).reshape(nkv, self.num_layers, span)
+                sc = sc.at[:, :, d:d + bs].set(sc[:, :, s:s + bs])
+                setattr(self, name, sc.reshape(nkv, -1))
 
     def pools(self):
         """The donated pool tuple the compiled forwards thread through:

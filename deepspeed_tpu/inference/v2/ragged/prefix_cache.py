@@ -1,0 +1,311 @@
+"""Prefix-cache subsystem: radix-tree reuse of refcounted KV blocks.
+
+Production request streams are dominated by shared prefixes (system prompts,
+few-shot templates, multi-turn histories). This module turns that overlap
+into skipped prefill: a radix tree keyed on BLOCK-ALIGNED token-id chunks
+maps a new prompt to its longest run of already-materialized KV blocks
+(PagedAttention block sharing, Kwon et al. SOSP'23; RadixAttention LRU tree,
+Zheng et al. 2023). The serving plane then starts prefill AFTER the hit —
+``DSSequenceDescriptor.seen_tokens`` pre-seeded, block table pre-populated.
+
+Invariants this subsystem threads through allocator / tree / state manager /
+scheduler / engine (asserted by ``tests/test_prefix_cache.py`` and the
+``test_engine_churn_invariants_prefix_cache`` fuzz):
+
+  * a block's contents are IMMUTABLE while shared (refcount > 1, or held by
+    the tree): sequences never write into full blocks, and a partial-tail
+    hit duplicates the block first (copy-on-write, ``kv_cache.copy_block``);
+  * every holder is counted: each sequence sharing a block and the tree
+    itself own exactly one reference; physical free happens only at zero;
+  * only FULL blocks enter the tree (a partial block's tail is still being
+    written by its owner), and eviction removes LRU LEAVES whose sole holder
+    is the tree — so eviction never yanks a block out from under a sequence.
+"""
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    """One radix-tree edge = one full KV block: ``chunk`` (block_size token
+    ids) → ``block`` (physical block id). Children keyed by their chunk."""
+
+    __slots__ = ("chunk", "block", "parent", "children", "last_access")
+
+    def __init__(self, chunk, block, parent):
+        self.chunk = chunk
+        self.block = int(block)
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.last_access = 0
+
+
+@dataclass
+class PrefixMatch:
+    """Result of a (pure) longest-prefix walk."""
+
+    n_cached_tokens: int = 0      # tokens of prompt covered (full + COW tail)
+    shared_blocks: List[int] = field(default_factory=list)  # full-block hits
+    cow_src: Optional[int] = None  # block to duplicate for a partial tail
+    cow_tokens: int = 0            # tokens of the COW block that are reusable
+
+    @property
+    def hit_blocks(self) -> int:
+        return len(self.shared_blocks) + (1 if self.cow_src is not None else 0)
+
+
+class PrefixKVCache:
+    """Radix tree over a :class:`BlockedKVCache`'s refcounted blocks.
+
+    ``acquire`` is the admission-side entry (match + take references + COW),
+    ``publish`` the exit side (insert a sequence's completed full blocks),
+    ``evict`` the allocator's pressure valve (LRU leaves, tree-only holders).
+    LRU ordering uses a monotonic access counter, not wall time, so eviction
+    is deterministic under test/bench replay.
+    """
+
+    def __init__(self, kv_cache, min_hit_blocks: int = 1, eviction: str = "lru"):
+        if eviction != "lru":
+            raise ValueError(f"unknown eviction policy {eviction!r}: 'lru'")
+        if min_hit_blocks < 1:
+            raise ValueError(f"min_hit_blocks must be >= 1, got {min_hit_blocks}")
+        self.kv_cache = kv_cache
+        self.block_size = kv_cache.block_size
+        self.min_hit_blocks = int(min_hit_blocks)
+        self.eviction = eviction
+        self._root = _Node(chunk=(), block=-1, parent=None)
+        self._n_nodes = 0
+        self._clock = 0  # monotonic LRU clock
+        self.stats = {"lookups": 0, "hits": 0, "cached_tokens": 0, "cow_copies": 0,
+                      "insertions": 0, "evictions": 0}
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def n_cached_blocks(self) -> int:
+        return self._n_nodes
+
+    @property
+    def hit_rate(self) -> float:
+        return self.stats["hits"] / self.stats["lookups"] if self.stats["lookups"] else 0.0
+
+    def cached_block_ids(self) -> List[int]:
+        """Block ids currently held by the tree (one tree reference each)."""
+        return [n.block for n in self._iter_nodes()]
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Blocks eviction could return to the free list RIGHT NOW: tree-held
+        blocks whose only reference is the tree's. Exact, not an upper bound:
+        a sequence holding a node always holds its whole ancestor path
+        (``acquire`` pins the matched run, ``publish`` descends only through
+        blocks the publisher holds), so a sole-owner node's entire subtree
+        is sole-owner too and repeated leaf eviction reaches all of it.
+        O(tree) per call — fine at the current pool scale; an incrementally
+        maintained counter needs refcount-transition hooks in the allocator
+        and is the first thing to add if admission ever shows up hot."""
+        return sum(1 for n in self._iter_nodes() if self.kv_cache.refcount(n.block) == 1)
+
+    # -- admission side ----------------------------------------------------
+    def match(self, tokens) -> PrefixMatch:
+        """PURE longest-prefix walk (no refs taken, no LRU touch): how much
+        of ``tokens`` the tree could serve. The usable prefix is capped at
+        ``len(tokens) - 1`` — the engine must always compute at least the
+        last prompt token to produce the first generated token."""
+        tokens = np.asarray(tokens, dtype=np.int64).reshape(-1)
+        m = PrefixMatch()
+        bs = self.block_size
+        usable = tokens.size - 1
+        if usable < 1:
+            return m
+        node = self._root
+        j = 0
+        while (j + 1) * bs <= usable:
+            child = node.children.get(tuple(int(t) for t in tokens[j * bs:(j + 1) * bs]))
+            if child is None:
+                break
+            m.shared_blocks.append(child.block)
+            node = child
+            j += 1
+        # partial tail: the longest common prefix between the remaining
+        # tokens and any child chunk is reusable via copy-on-write — this is
+        # the "shared prefix ends mid-block" case (and the exact-full-prompt
+        # hit, where the cap forbids sharing the final block outright)
+        rest = tokens[j * bs:]
+        # the tail can reuse at most the remaining usable tokens; a full-bs
+        # reuse is unreachable here (an exact-chunk child would have matched
+        # above unless the cap already stopped the walk)
+        cap = min(usable - j * bs, bs)
+        if cap >= 1 and node.children:
+            best, best_t = None, 0
+            for child in node.children.values():
+                key = np.asarray(child.chunk[:cap], dtype=np.int64)
+                neq = np.nonzero(rest[:key.size] != key)[0]
+                t = int(neq[0]) if neq.size else int(key.size)
+                if t > best_t:
+                    best, best_t = child, t
+            # a COW copy costs a block + a device copy: with no shared run in
+            # front (an accidental few-token overlap between unrelated
+            # prompts) demand it save at least half a block before paying
+            floor = 1 if m.shared_blocks else max(1, bs // 2)
+            if best is not None and best_t >= floor:
+                m.cow_src, m.cow_tokens = best.block, best_t
+        m.n_cached_tokens = j * bs + m.cow_tokens
+        if m.hit_blocks < self.min_hit_blocks:
+            return PrefixMatch()
+        return m
+
+    def acquire(self, tokens, match: Optional[PrefixMatch] = None) -> Tuple[List[int], int, int]:
+        """Match ``tokens`` and take ownership of the hit on behalf of a new
+        sequence: incref every shared full block, then (for a partial tail)
+        allocate + device-copy the COW block. ``match`` reuses the result of
+        a prior :meth:`match` on the same tokens (the admission path probes
+        first; single-threaded, so nothing moved in between). Returns
+        ``(block_ids, n_cached_tokens, n_shared_full_blocks)`` —
+        ``block_ids`` become the sequence's leading ``kv_blocks`` and
+        ``seen_tokens`` starts at ``n_cached_tokens``. A miss returns
+        ``([], 0, 0)``.
+
+        Order matters: shared blocks are pinned (incref) BEFORE the COW
+        allocation can trigger eviction, so eviction can never reclaim the
+        blocks this very hit depends on."""
+        tokens = np.asarray(tokens, dtype=np.int64).reshape(-1)
+        self.stats["lookups"] += 1
+        m = match if match is not None else self.match(tokens)
+        if m.n_cached_tokens == 0:
+            return [], 0, 0
+        # touch the matched path (LRU) and pin the shared run
+        node = self._root
+        bs = self.block_size
+        for i, b in enumerate(m.shared_blocks):
+            node = node.children[tuple(int(t) for t in np.asarray(tokens[i * bs:(i + 1) * bs]))]
+            self._touch(node)
+        if m.shared_blocks:
+            self.kv_cache.incref(m.shared_blocks)
+        blocks = list(m.shared_blocks)
+        n_cached = len(m.shared_blocks) * bs
+        if m.cow_src is not None:
+            try:
+                dst = int(self._reserve_with_eviction(1)[0])
+            except ValueError:
+                dst = None  # pool truly dry: fall back to the full-block hit
+            if dst is not None:
+                self.kv_cache.copy_block(m.cow_src, dst)
+                blocks.append(dst)
+                n_cached += m.cow_tokens
+                self.stats["cow_copies"] += 1
+        if n_cached == 0:
+            return [], 0, 0
+        self.stats["hits"] += 1
+        self.stats["cached_tokens"] += n_cached
+        return blocks, n_cached, len(m.shared_blocks)
+
+    # -- exit side ---------------------------------------------------------
+    def publish(self, seq) -> int:
+        """Insert ``seq``'s completed FULL blocks on the way out (after a
+        prefill chunk, a decode burst, or at flush). Idempotent root walk:
+        an existing node at a chunk keeps its block (first writer wins —
+        both copies hold identical KV, keeping one maximizes sharing); a
+        missing node takes one tree reference on the sequence's block.
+
+        The walk descends ONLY through nodes whose block this sequence
+        itself holds. If another sequence won the race for a chunk (same
+        tokens, different physical block), publishing stops there: inserting
+        deeper children under a path the publisher does not hold would
+        create interior tree-only nodes that leaf eviction can never reach —
+        breaking the exactness of :attr:`evictable_blocks` and letting
+        admission promise blocks eviction cannot free.
+
+        ``seq.published_blocks`` is the walked-up-to cursor: the common
+        steady-state call (a decode burst that completed no new full block)
+        returns after one integer compare instead of re-walking the whole
+        chain every forward. The cursor also forfeits re-publishing a chain
+        the tree evicted while the sequence lives — a coverage loss, not a
+        correctness one.
+
+        Returns the number of newly inserted blocks."""
+        bs = self.block_size
+        known = min(len(seq.token_history), seq.seen_tokens)
+        full = min(known // bs, len(seq.kv_blocks))
+        if full <= getattr(seq, "published_blocks", 0):
+            return 0
+        node = self._root
+        inserted = 0
+        for b in range(full):
+            chunk = tuple(int(t) for t in seq.token_history[b * bs:(b + 1) * bs])
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(chunk=chunk, block=seq.kv_blocks[b], parent=node)
+                self.kv_cache.incref(child.block)
+                node.children[chunk] = child
+                self._n_nodes += 1
+                self.stats["insertions"] += 1
+                self._touch(child)
+                inserted += 1
+            elif child.block != seq.kv_blocks[b]:
+                break  # a different writer owns this path from here down
+            node = child
+        seq.published_blocks = full
+        return inserted
+
+    # -- pressure valve ----------------------------------------------------
+    def evict(self, n_blocks: int) -> int:
+        """Release up to ``n_blocks`` tree-only blocks, LRU leaves first.
+        One pass builds a min-heap of evictable leaves; a removed leaf that
+        exposes its parent (now a leaf, tree-only) pushes the parent — no
+        per-block rescan of the whole tree.
+        Returns how many blocks actually went back to the free list."""
+        heap = [(n.last_access, id(n), n) for n in self._iter_leaves()
+                if self.kv_cache.refcount(n.block) == 1]
+        heapq.heapify(heap)
+        freed = 0
+        while heap and freed < n_blocks:
+            _, _, node = heapq.heappop(heap)
+            parent = node.parent
+            self._remove(node)
+            freed += 1
+            self.stats["evictions"] += 1
+            if (parent is not self._root and not parent.children
+                    and self.kv_cache.refcount(parent.block) == 1):
+                heapq.heappush(heap, (parent.last_access, id(parent), parent))
+        return freed
+
+    def clear(self) -> int:
+        """Release EVERY tree reference (eviction flush): blocks whose only
+        holder was the tree return to the free list; blocks still held by
+        live sequences merely lose the tree's reference."""
+        nodes = list(self._iter_nodes())
+        for node in nodes:
+            self.kv_cache.release(node.block)
+        self._root.children = {}
+        self._n_nodes = 0
+        return len(nodes)
+
+    def _reserve_with_eviction(self, n: int) -> np.ndarray:
+        short = n - self.kv_cache.free_blocks
+        if short > 0:
+            self.evict(short)
+        return self.kv_cache.reserve(n)
+
+    # -- internals ---------------------------------------------------------
+    def _touch(self, node) -> None:
+        self._clock += 1
+        node.last_access = self._clock
+
+    def _iter_nodes(self):
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n
+
+    def _iter_leaves(self):
+        return (n for n in self._iter_nodes() if not n.children)
+
+    def _remove(self, node) -> None:
+        assert not node.children, "only leaves are evictable"
+        del node.parent.children[node.chunk]
+        self.kv_cache.release(node.block)
+        self._n_nodes -= 1

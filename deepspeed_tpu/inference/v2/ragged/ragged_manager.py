@@ -2,27 +2,40 @@
 
 Analog of the reference ``inference/v2/ragged/ragged_manager.py:19``
 (``DSStateManager``: tracked sequences → KV block tables, owns the
-``BlockedKVCache``).
+``BlockedKVCache``). With ``prefix_cache`` enabled it also owns the
+:class:`PrefixKVCache` radix tree: sequence creation pre-populates the block
+table and ``seen_tokens`` from the longest cached prefix, completed full
+blocks are published back on the way out, and every block release routes
+through the refcount-aware path (``tools/check_kv_blocks.py`` gates raw
+``.free`` calls out of this plane).
 """
 
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from ...config import DeepSpeedInferenceConfig  # noqa: F401  (parity import)
 from .blocked_allocator import BlockedAllocator  # noqa: F401
 from .kv_cache import BlockedKVCache
+from .prefix_cache import PrefixKVCache
 from .sequence_descriptor import DSSequenceDescriptor
 
 
 class DSStateManager:
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int, *, max_tracked_sequences: int = 128,
-                 num_blocks: int = 256, block_size: int = 64, dtype=jnp.bfloat16, kv_sharding=None):
+                 num_blocks: int = 256, block_size: int = 64, dtype=jnp.bfloat16, kv_sharding=None,
+                 prefix_cache_config=None):
         self.max_tracked_sequences = max_tracked_sequences
         self.block_size = block_size
         self.kv_cache = BlockedKVCache(num_layers, num_kv_heads, head_dim, num_blocks, block_size, dtype=dtype,
                                        sharding=kv_sharding)
+        self.prefix_cache: Optional[PrefixKVCache] = None
+        if prefix_cache_config is not None and getattr(prefix_cache_config, "enabled", False):
+            self.prefix_cache = PrefixKVCache(self.kv_cache,
+                                              min_hit_blocks=prefix_cache_config.min_hit_blocks,
+                                              eviction=prefix_cache_config.eviction)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
 
     # -- queries -----------------------------------------------------------
@@ -34,11 +47,27 @@ class DSStateManager:
     def free_blocks(self) -> int:
         return self.kv_cache.free_blocks
 
+    @property
+    def available_blocks(self) -> int:
+        """Blocks a new allocation could actually obtain: the free list plus
+        what LRU eviction could reclaim from tree-only holders. Admission
+        must budget against THIS, not ``free_blocks`` — a warm cache keeps
+        the free list near empty by design."""
+        free = self.kv_cache.free_blocks
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.evictable_blocks
+        return free
+
     def query(self, uid: Optional[int] = None):
         """Reference ``engine_v2.query``-backing lookup: per-sequence state
         or the (tracked, free-block) summary."""
         if uid is None:
-            return {"tracked": self.n_tracked_sequences, "free_blocks": self.free_blocks}
+            out = {"tracked": self.n_tracked_sequences, "free_blocks": self.free_blocks}
+            if self.prefix_cache is not None:
+                out["prefix_cache"] = dict(self.prefix_cache.stats,
+                                           cached_blocks=self.prefix_cache.n_cached_blocks,
+                                           hit_rate=self.prefix_cache.hit_rate)
+            return out
         return self._seqs.get(uid)
 
     # -- lifecycle ---------------------------------------------------------
@@ -50,22 +79,70 @@ class DSStateManager:
         seq = self._seqs.get(uid)
         if seq is not None:
             return seq
+        return self.create_sequence_with_prefix(uid, None)[0]
+
+    def create_sequence_with_prefix(self, uid: int, prompt_tokens,
+                                    match=None) -> Tuple[DSSequenceDescriptor, int]:
+        """Create a FRESH sequence, pre-populated from the prefix cache when
+        ``prompt_tokens`` (the tokens about to be fed) hit the radix tree:
+        the block table starts with the shared run (plus a COW tail copy)
+        and ``seen_tokens`` at the hit length, so prefill starts AFTER the
+        hit. ``match`` (from a prior pure probe) skips the re-match.
+        Returns ``(seq, n_cached_tokens)`` — the caller must skip the
+        first ``n_cached_tokens`` of ``prompt_tokens`` when feeding."""
+        if uid in self._seqs:
+            raise ValueError(f"uid {uid} already tracked: prefix acquisition is create-only")
         if len(self._seqs) >= self.max_tracked_sequences:
             raise RuntimeError(f"already tracking {self.max_tracked_sequences} sequences")
         seq = DSSequenceDescriptor(uid=uid, block_size=self.block_size)
+        n_cached = 0
+        if self.prefix_cache is not None and prompt_tokens is not None:
+            prompt_tokens = np.asarray(prompt_tokens).reshape(-1)
+            blocks, n_cached, shared = self.prefix_cache.acquire(prompt_tokens, match=match)
+            if n_cached:
+                seq.kv_blocks = [int(b) for b in blocks]
+                seq.seen_tokens = n_cached
+                seq.shared_blocks = shared
+                seq.prefix_cached_tokens = n_cached
+                seq.token_history = [int(t) for t in prompt_tokens[:n_cached]]
         self._seqs[uid] = seq
-        return seq
+        return seq, n_cached
 
     def allocate_blocks(self, seq: DSSequenceDescriptor, new_tokens: int) -> None:
-        """Reference ``model.maybe_allocate_kv`` → ``BlockedKVCache.reserve``."""
+        """Reference ``model.maybe_allocate_kv`` → ``BlockedKVCache.reserve``,
+        with the prefix cache as the pressure valve: a dry free list evicts
+        LRU tree-only blocks before the reserve."""
         need = seq.blocks_needed(new_tokens)
         if need > 0:
+            if self.prefix_cache is not None and need > self.kv_cache.free_blocks:
+                self.prefix_cache.evict(need - self.kv_cache.free_blocks)
             seq.extend_blocks(self.kv_cache.reserve(need))
 
+    def note_tokens(self, seq: DSSequenceDescriptor, tokens) -> None:
+        """Record the token ids being materialized this forward (put chunk,
+        or the fetched results of a decode burst) so completed full blocks
+        can be published. Non-contiguous appends (a gap the host never saw)
+        permanently stop publishing for this sequence instead of guessing."""
+        if self.prefix_cache is None or not seq.history_valid:
+            return
+        if len(seq.token_history) != seq.seen_tokens:
+            seq.history_valid = False
+            return
+        seq.token_history.extend(int(t) for t in np.asarray(tokens).reshape(-1))
+
+    def publish_sequence(self, seq: DSSequenceDescriptor) -> None:
+        """Insert ``seq``'s completed full blocks into the radix tree."""
+        if self.prefix_cache is not None and seq.history_valid:
+            self.prefix_cache.publish(seq)
+
     def flush_sequence(self, uid: int) -> None:
-        """Release a finished sequence's blocks (reference ``flush:228``)."""
+        """Release a finished sequence's block references (reference
+        ``flush:228``): publish completed full blocks first (the tree takes
+        its own reference), then drop the sequence's — a block only goes
+        physically free when no sequence AND no tree node holds it."""
         seq = self._seqs.pop(uid, None)
         if seq is None:
             return
+        self.publish_sequence(seq)
         if seq.kv_blocks:
-            self.kv_cache.free(seq.kv_blocks)
+            self.kv_cache.release(seq.kv_blocks)

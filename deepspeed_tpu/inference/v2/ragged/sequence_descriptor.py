@@ -20,6 +20,18 @@ class DSSequenceDescriptor:
     seen_tokens: int = 0  # tokens whose KV is already materialized
     in_flight_tokens: int = 0  # tokens scheduled in the current forward
     kv_blocks: List[int] = field(default_factory=list)
+    # prefix-cache bookkeeping: the token ids behind the materialized KV (so
+    # completed full blocks can be published into the radix tree), how many
+    # leading blocks arrived SHARED from the tree (immutable for this
+    # sequence), and how many prompt tokens the cache let prefill skip.
+    # ``history_valid`` drops to False when generated tokens were never
+    # fetched to host (decode(block=False)) — publishing then stops at the
+    # last known-token boundary forever, never guesses.
+    token_history: List[int] = field(default_factory=list)
+    history_valid: bool = True
+    shared_blocks: int = 0
+    prefix_cached_tokens: int = 0
+    published_blocks: int = 0  # publish() walk cursor: full blocks already walked
 
     @property
     def cur_allocated_blocks(self) -> int:

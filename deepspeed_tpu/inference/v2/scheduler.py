@@ -27,7 +27,8 @@ from .scheduling_utils import SchedulingResult
 
 
 class _Request:
-    __slots__ = ("uid", "prompt", "max_new_tokens", "eos_token_id", "fed", "generated", "done")
+    __slots__ = ("uid", "prompt", "max_new_tokens", "eos_token_id", "fed", "generated", "done",
+                 "charged_blocks", "shared_blocks")
 
     def __init__(self, uid, prompt, max_new_tokens, eos_token_id):
         self.uid = uid
@@ -37,6 +38,8 @@ class _Request:
         self.fed = 0          # prompt tokens already given to the engine
         self.generated: List[int] = []
         self.done = False
+        self.charged_blocks = 0  # lifetime KV reservation charged at admission
+        self.shared_blocks = 0   # blocks arriving shared from the prefix cache
 
     @property
     def prefilling(self) -> bool:
@@ -71,6 +74,10 @@ class DynamicSplitFuseScheduler:
         self._active: Dict[int, _Request] = {}
         self._results: Dict[int, List[int]] = {}
         self._reserved_blocks = 0  # KV blocks promised to active requests
+        # serving-plane accounting the prefix-cache A/B reads: prompt tokens
+        # actually computed vs skipped via radix hits (exact — counted at the
+        # feed site, not inferred from latency)
+        self.stats = {"prefill_tokens_fed": 0, "prefill_tokens_skipped": 0}
 
     def submit(self, uid: int, prompt, max_new_tokens: int = 32, eos_token_id=None):
         if uid in self._active or any(r.uid == uid for r in self._pending):
@@ -112,7 +119,7 @@ class DynamicSplitFuseScheduler:
     def _finish(self, req: _Request):
         req.done = True
         self.engine.flush(req.uid)
-        self._reserved_blocks -= self._blocks_for(req.total_tokens)
+        self._reserved_blocks -= req.charged_blocks
         self._active.pop(req.uid, None)
         self._results[req.uid] = req.generated
 
@@ -123,26 +130,51 @@ class DynamicSplitFuseScheduler:
         to completion regardless of later arrivals. Validation is CUMULATIVE
         — the engine sees the whole batch composed so far plus this request,
         so a combination that passes here can never be rejected by the
-        final ``put(do_checks=True)`` after state was already mutated."""
+        final ``put(do_checks=True)`` after state was already mutated.
+
+        Prefix-cache admission order: PROBE first (a pure lookup — a refused
+        request must leave the tree, its LRU clock, and the hit stats
+        untouched, and must not burn a COW copy), budget-check against only
+        the UNCACHED remainder — cached prompt tokens hit neither the token
+        budget (the first chunk starts after the hit) nor the block budget
+        (shared blocks are already resident) — then ACQUIRE once admission
+        is certain. Nothing mutates between probe and acquire (single
+        thread), so the acquisition realizes exactly the probed hit."""
         if len(batch_uids) >= self.max_seqs:
             return False
-        need = self._blocks_for(req.total_tokens)
-        if self._reserved_blocks + need > self.engine.free_blocks + self._used_blocks():
-            return False
-        first = min(budget, req.prompt.size)
+        sm = self.engine.config.state_manager
+        if self.engine.state_manager.n_tracked_sequences >= sm.max_tracked_sequences:
+            return False  # acquisition would raise, not refuse
+        n_cached, shared, tree_only, match = self.engine.probe_prefix(req.prompt)
+        need = self._blocks_for(req.total_tokens) - shared
+        first = min(budget, req.prompt.size - n_cached)
         if first <= 0:
+            return False
+        # supply side: the hit's tree-only shared blocks stop being evictable
+        # the moment acquisition pins them — counting them as reclaimable
+        # WHILE ALSO subtracting them from demand (`need`) would credit the
+        # same blocks twice and over-admit by up to `shared`
+        supply = self.engine.available_blocks - tree_only + self._owned_blocks()
+        if self._reserved_blocks + need > supply:
             return False
         if self.engine.can_schedule(batch_uids + [req.uid],
                                     batch_lengths + [first]) is not SchedulingResult.Success:
             return False
-        self._reserved_blocks += need
+        n_cached, shared = self.engine.acquire_prefix(req.uid, req.prompt, match=match)
+        req.fed = n_cached
+        req.charged_blocks = self._blocks_for(req.total_tokens) - shared
+        req.shared_blocks = shared
+        self._reserved_blocks += req.charged_blocks
+        self.stats["prefill_tokens_skipped"] += n_cached
         self._active[req.uid] = req
         return True
 
-    def _used_blocks(self) -> int:
+    def _owned_blocks(self) -> int:
+        """Blocks active sequences allocated THEMSELVES (shared radix-tree
+        blocks excluded: they were never charged against the reservation)."""
         sm = self.engine.state_manager
-        return sum(s.cur_allocated_blocks for s in (sm.get_sequence(u) for u in self._active)
-                   if s is not None)
+        return sum(max(0, s.cur_allocated_blocks - s.shared_blocks)
+                   for s in (sm.get_sequence(u) for u in self._active) if s is not None)
 
     def _append_token(self, req: _Request, tok: int) -> None:
         req.generated.append(tok)
@@ -196,6 +228,7 @@ class DynamicSplitFuseScheduler:
             chunks.append(req.prompt[req.fed:req.fed + take])
             req.fed += take
             budget -= take
+            self.stats["prefill_tokens_fed"] += take
             return True
 
         for req in prefilling:

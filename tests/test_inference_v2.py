@@ -682,6 +682,93 @@ def test_engine_churn_invariants():
     assert engine.state_manager.n_tracked_sequences == 0
 
 
+def test_engine_churn_invariants_prefix_cache():
+    """Serving-plane lifecycle fuzz EXTENDED to refcounted/COW shared blocks
+    (ISSUE 3 satellite): with ``ragged.prefix_cache`` on and prompts drawn
+    from a shared-prefix pool, arbitrary submit/decode/flush churn must keep
+    (a) every block's refcount equal to its live holder count (sequences
+    whose table carries it + the radix tree), (b) the free list consistent
+    (free + distinct-held == total at every step), and (c) after flushing
+    all sequences AND the eviction flush (``prefix_cache.clear()``), the
+    pool returns to pristine."""
+    from deepspeed_tpu.inference.v2 import PrefixCacheConfig
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    rng = np.random.default_rng(1)
+    cfg = TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                            max_seq_len=256, intermediate_size=128, dtype=jnp.float32,
+                            attention_impl="reference")
+    model = TransformerLM(cfg)
+    icfg = RaggedInferenceEngineConfig()
+    icfg.kv_block_size = 16
+    icfg.num_kv_blocks = 40
+    icfg.state_manager.max_tracked_sequences = 4
+    icfg.state_manager.max_ragged_sequence_count = 4
+    icfg.state_manager.max_ragged_batch_size = 128
+    icfg.state_manager.max_context = 160
+    icfg.use_pallas_kernels = "never"
+    icfg.prefix_cache = PrefixCacheConfig(enabled=True)
+    engine = InferenceEngineV2(model, icfg)
+    alloc = engine.state_manager.kv_cache._allocator
+    total = engine.state_manager.free_blocks
+    pc = engine.prefix_cache
+    # shared-prefix pool: radix hits + COW tails actually happen under churn
+    pool = [rng.integers(0, 256, size=48, dtype=np.int32) for _ in range(3)]
+
+    live = {}
+    next_uid = 0
+    for step in range(60):
+        op = rng.choice(["put", "decode", "flush"], p=[0.4, 0.4, 0.2])
+        grown = [u for u in live if engine.query(u).seen_tokens > 140]
+        for u in grown:
+            engine.flush(u)
+            del live[u]
+        if op == "put" and len(live) < 4:
+            uid = next_uid; next_uid += 1
+            prefix = pool[int(rng.integers(0, 3))]
+            cut = int(rng.integers(8, 49))  # mid-block cuts exercise COW
+            suffix = rng.integers(0, 256, size=int(rng.integers(4, 30)), dtype=np.int32)
+            prompt = np.concatenate([prefix[:cut], suffix])
+            tok = engine.put([uid], [prompt], sample="greedy")
+            live[uid] = [int(tok[0])]
+        elif op == "decode" and live:
+            uids = sorted(live)
+            last = [np.asarray([live[u][-1]], np.int32) for u in uids]
+            out = np.asarray(engine.decode(uids, last, 8))
+            for u, row in zip(uids, out):
+                live[u].extend(int(t) for t in row)
+        elif op == "flush" and live:
+            uid = sorted(live)[int(rng.integers(0, len(live)))]
+            engine.flush(uid)
+            del live[uid]
+        # (a) exact holder accounting: refcount == #sequences carrying the
+        # block + 1 if the radix tree holds it — for EVERY block id
+        holders = {}
+        for u in live:
+            for b in engine.query(u).kv_blocks:
+                holders[b] = holders.get(b, 0) + 1
+        for b in pc.cached_block_ids():
+            holders[b] = holders.get(b, 0) + 1
+        for b in range(total):
+            assert alloc.refcount(b) == holders.get(b, 0), \
+                (f"step {step}: block {b} refcount {alloc.refcount(b)} != "
+                 f"{holders.get(b, 0)} live holders")
+        # (b) free-list consistency against DISTINCT held blocks
+        assert engine.state_manager.free_blocks + len(holders) == total, \
+            f"step {step}: free={engine.state_manager.free_blocks} held={len(holders)}"
+    assert pc.stats["hits"] > 0 and pc.stats["cow_copies"] > 0, \
+        "fuzz schedule never exercised sharing/COW — weak run"
+    assert pc.stats["evictions"] > 0, "pool never came under eviction pressure"
+
+    # (c) pristine after full flush + eviction flush
+    for uid in sorted(live):
+        engine.flush(uid)
+    pc.clear()
+    assert engine.state_manager.free_blocks == total
+    assert engine.state_manager.n_tracked_sequences == 0
+    assert all(alloc.refcount(b) == 0 for b in range(total))
+
+
 def test_v1_engine_int4_weights_close_to_fp():
     """INT4 weight-only path (reference deepspeed/inference/quantization
     utils.py:66 — asymmetric groups, uint8->uint4 packing): quant.num_bits=4

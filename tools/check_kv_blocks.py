@@ -1,0 +1,85 @@
+"""Static check: every KV-block release site in ``inference/v2/`` routes
+through the refcount-aware path.
+
+Companion to ``check_timed_ops.py`` / ``check_data_paths.py`` (same lesson:
+structural invariants rot silently unless CI asserts them). The prefix-cache
+subsystem shares blocks between sequences and the radix tree via per-block
+refcounts — a raw ``allocator.free`` / ``kv_cache.free`` call anywhere else
+in the serving plane would return a block to the free list while other
+holders still reference it, resurrecting exactly the silent free-list
+corruption the refcount layer exists to prevent. This AST walk (no package
+imports, runs anywhere) asserts that ``.free(...)`` calls appear ONLY inside
+the allocator/cache modules themselves; everything else must use
+``release`` / ``incref`` / ``flush_sequence``.
+
+A tier-1 test (``tests/test_prefix_cache.py``) runs this on every CI pass.
+"""
+
+import ast
+import os
+import sys
+
+DEFAULT_V2_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                              "deepspeed_tpu", "inference", "v2")
+
+# the only modules allowed to touch the raw free path: the allocator itself,
+# the device pool fronting it, and the prefix cache (which owns the
+# refcount-aware release/evict logic)
+ALLOWED_FILES = (
+    os.path.join("ragged", "blocked_allocator.py"),
+    os.path.join("ragged", "kv_cache.py"),
+    os.path.join("ragged", "prefix_cache.py"),
+)
+
+# call names that bypass the refcount-aware release path
+RAW_RELEASE_CALLS = ("free",)
+
+
+def find_violations(v2_dir=DEFAULT_V2_DIR):
+    """[(relpath, lineno, snippet)] for every raw block-free call outside the
+    allowlisted allocator/cache modules."""
+    violations = []
+    for root, _dirs, files in os.walk(v2_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, v2_dir)
+            if rel in ALLOWED_FILES:
+                continue
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+            lines = src.splitlines()
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f_ = node.func
+                name = f_.attr if isinstance(f_, ast.Attribute) else (
+                    f_.id if isinstance(f_, ast.Name) else None)
+                if name in RAW_RELEASE_CALLS:
+                    snippet = lines[node.lineno - 1].strip() if node.lineno <= len(lines) else ""
+                    violations.append((rel, node.lineno, snippet))
+    return violations
+
+
+def check(v2_dir=DEFAULT_V2_DIR):
+    """Return the violation list (empty = every release site is routed)."""
+    return find_violations(v2_dir)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    v2_dir = argv[0] if argv else DEFAULT_V2_DIR
+    bad = check(v2_dir)
+    if bad:
+        print(f"check_kv_blocks: raw block-free calls outside the allocator/cache modules in {v2_dir}:")
+        for rel, lineno, snippet in bad:
+            print(f"  {rel}:{lineno}: {snippet}")
+        return 1
+    print("check_kv_blocks: all block-release sites route through the refcount-aware path")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
