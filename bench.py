@@ -573,6 +573,63 @@ def run_bench():
             # so the CPU fallback understates the throughput side of overlap
             prefetch_line["note"] = "CPU fallback: device compute shares host cores"
 
+    # --ckpt: checkpoint-plane A/B — per-save step-loop blocked time, sync
+    # full-write vs async (host-snapshot + background writer). The async
+    # number should collapse toward the snapshot cost while the durable
+    # write overlaps the next training steps (runtime/resilience/).
+    ckpt_line = None
+    if os.environ.get("DS_TPU_BENCH_CKPT") == "1":
+        import shutil
+        import tempfile
+        from deepspeed_tpu.parallel import groups
+        from deepspeed_tpu.monitor.metrics import configure_metrics, get_metrics
+
+        n_saves = 4
+        ckpt_line = {"n_saves": n_saves}
+        for mode in ("sync", "async"):
+            groups.reset()
+            configure_metrics(enabled=True)
+            get_metrics().reset()
+            n_chips = len(jax.devices())
+            ck_config = {
+                "train_batch_size": micro * n_chips,
+                "train_micro_batch_size_per_gpu": micro,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.0}},
+                "zero_optimization": {"stage": 3 if on_tpu else 0},
+                "bf16": {"enabled": bool(on_tpu)},
+                "steps_per_print": 10**9,
+                "tpu": {"mesh": {"data": n_chips}},
+                "checkpoint": {"async_save": mode == "async"},
+            }
+            ck_engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(cfg),
+                                                          config=ck_config)
+            ck_rng = np.random.default_rng(0)
+            ck_batch = {"input_ids": ck_rng.integers(0, cfg.vocab_size,
+                                                     size=(ck_config["train_batch_size"], seq),
+                                                     dtype=np.int32)}
+            ck_engine.train_batch(ck_batch)  # compile outside the timed window
+            ck_dir = tempfile.mkdtemp(prefix=f"ds_bench_ckpt_{mode}_")
+            try:
+                for i in range(n_saves):
+                    ck_engine.save_checkpoint(ck_dir, tag=f"bench_save{i}")
+                    # the async writer persists while these steps run — the
+                    # overlap the sync arm cannot have
+                    ck_engine.train_batch(ck_batch)
+                    ck_engine.train_batch(ck_batch)
+                ck_engine.flush_checkpoints()
+                reg = get_metrics()
+                ckpt_line[f"ckpt_blocked_ms_p50_{mode}"] = round(
+                    reg.histogram("train/ckpt_blocked_ms").percentile(50), 3)
+                ckpt_line[f"write_ms_p50_{mode}"] = round(
+                    reg.histogram("checkpoint/write_ms").percentile(50), 3)
+            finally:
+                shutil.rmtree(ck_dir, ignore_errors=True)
+                ck_engine.destroy()
+        if ckpt_line.get("ckpt_blocked_ms_p50_sync"):
+            ckpt_line["blocked_ratio_async_vs_sync"] = round(
+                ckpt_line["ckpt_blocked_ms_p50_async"] / ckpt_line["ckpt_blocked_ms_p50_sync"], 4)
+
     if trace_path:
         # eager 3-call path demo: genuine fwd/bwd/step spans plus an eager
         # device collective (comm/all_reduce span with real bytes + bandwidth)
@@ -616,6 +673,8 @@ def run_bench():
     }
     if prefetch_line is not None:
         line["prefetch"] = prefetch_line
+    if ckpt_line is not None:
+        line["checkpoint"] = ckpt_line
     if not on_tpu:
         line["tpu_unavailable_reason"] = tpu_error or "no TPU device visible"
     if gate_note:
@@ -855,6 +914,10 @@ if __name__ == "__main__":
     # wait + throughput) to the final JSON; forwarded to children via env
     if "--prefetch" in sys.argv:
         os.environ["DS_TPU_BENCH_PREFETCH"] = "1"
+    # --ckpt: add the checkpoint-plane A/B (per-save blocked ms, sync full
+    # write vs async host-snapshot + background writer) to the final JSON
+    if "--ckpt" in sys.argv:
+        os.environ["DS_TPU_BENCH_CKPT"] = "1"
     if os.environ.get("DS_TPU_BENCH_CHILD") == "1":
         run_bench()
     else:

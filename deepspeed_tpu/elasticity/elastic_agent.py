@@ -22,10 +22,14 @@ from ..utils.logging import logger
 
 class ElasticAgent:
 
-    def __init__(self, ds_config: dict, max_restarts: int = 3, restart_delay_s: float = 5.0):
+    def __init__(self, ds_config: dict, max_restarts: int = 3, restart_delay_s: float = 5.0,
+                 backoff_factor: float = 1.0):
         self.ds_config = ds_config
         self.max_restarts = max_restarts
         self.restart_delay_s = restart_delay_s
+        # exponential restart backoff (delay * factor**(restart-1)): a
+        # re-crashing worker on a sick host shouldn't hot-loop the fleet
+        self.backoff_factor = backoff_factor
         self.restart_count = 0
 
     def resolve_batch_config(self, world_size: int):
@@ -66,6 +70,6 @@ class ElasticAgent:
                 if self.restart_count > self.max_restarts:
                     logger.error(f"elastic agent: exceeded {self.max_restarts} restarts; giving up")
                     raise
-                logger.warning(f"elastic agent: worker failure ({e}); re-resolving in "
-                               f"{self.restart_delay_s}s")
-                time.sleep(self.restart_delay_s)
+                delay = self.restart_delay_s * self.backoff_factor**(self.restart_count - 1)
+                logger.warning(f"elastic agent: worker failure ({e}); re-resolving in {delay:.1f}s")
+                time.sleep(delay)

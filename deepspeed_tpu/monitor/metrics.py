@@ -10,6 +10,14 @@ and MFU.
 Zero overhead when disabled: every accessor returns the same shared no-op
 metric object (no per-step allocations), verified by ``tests/test_monitor_trace.py``.
 
+Well-known checkpoint-plane names (recorded by ``runtime/resilience/`` and
+the engine; drained like every other metric): ``train/ckpt_blocked_ms``
+(step-loop time lost to a save — the host-snapshot cost under async save,
+the full write under sync), ``checkpoint/write_ms``,
+``checkpoint/saves_committed`` / ``checkpoint/saves_failed``,
+``checkpoint/bytes_written``; the matching temporal record is the
+``checkpoint/async_write`` span on the trace bus's ``checkpoint`` stream.
+
 Import-light by design (no package-internal imports at module level): pulled
 in during package bootstrap via the comm/monitor wiring.
 """

@@ -2,10 +2,14 @@
 
 Reference ``deepspeed/nebula/config.py`` — the block that turns on
 Microsoft's asynchronous tiered checkpoint service. The TPU-native
-mechanism behind the same contract (training never blocks on persistence)
-is orbax's AsyncCheckpointer: enabling nebula flips the engine's checkpoint
-engine into async-save mode; retention/interval knobs are recorded for
-API compatibility.
+mechanism behind the same contract (training never blocks on persistence;
+only fully persisted versions are ever advertised) is the resilience plane
+(``runtime/resilience/``) over orbax's AsyncCheckpointer. Enabling nebula
+flips the engine into async-save mode AND arms the service knobs:
+``num_of_version_in_retention`` drives retention GC,
+``persistent_time_interval`` the wall-clock auto-save cadence, and
+``persistent_storage_path`` the auto/preemption save target (SIGTERM →
+final checkpoint → clean exit). See README "Resilience & checkpointing".
 """
 
 from dataclasses import dataclass

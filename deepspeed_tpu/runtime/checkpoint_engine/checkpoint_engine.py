@@ -3,8 +3,16 @@
 Mirrors the reference ``runtime/checkpoint_engine/checkpoint_engine.py:9``
 (``CheckpointEngine`` with create/save/load/commit). Implementations:
 ``OrbaxCheckpointEngine`` (sharded tensorstore layout — the TPU analog of
-``TorchCheckpointEngine``) and room for async engines (the reference's
-``NebulaCheckpointEngine`` analog is orbax async save).
+``TorchCheckpointEngine``; with ``async_save`` it is the
+``NebulaCheckpointEngine`` analog: ``save`` returns after the snapshot,
+``commit`` joins the background write). Contract refinements the resilience
+plane (``runtime/resilience/``) depends on:
+
+* ``commit(tag)`` returns True ONLY when the tag is durably on disk — a
+  failed/aborted save must yield False, and callers must not advertise the
+  tag (``latest`` pointer, retention protection) on any other evidence;
+* ``load`` raises ``resilience.CheckpointCorruptError`` on a missing or
+  partial payload instead of silently returning whatever merged.
 """
 
 

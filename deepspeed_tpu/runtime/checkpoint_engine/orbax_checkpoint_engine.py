@@ -13,8 +13,10 @@ import os
 import pickle
 
 import jax
+import numpy as np
 
 from .checkpoint_engine import CheckpointEngine
+from ..resilience.errors import CheckpointCorruptError
 from ...utils.logging import logger
 
 
@@ -26,6 +28,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
 
         self._ocp = ocp
         self._async = async_save
+        self._save_error = None  # failed save must never commit (nebula contract)
         self._ckptr = ocp.StandardCheckpointer() if not async_save else ocp.AsyncCheckpointer(
             ocp.StandardCheckpointHandler())
 
@@ -34,20 +37,28 @@ class OrbaxCheckpointEngine(CheckpointEngine):
 
     def save(self, state_dict, path: str):
         """Arrays go to tensorstore; non-array client state to a pickle
-        sidecar (host 0 only)."""
+        sidecar (host 0 only). In async mode this returns as soon as orbax
+        has snapshotted the arrays — durability is only claimed by a later
+        ``commit()`` returning True (the caller must NOT advertise the tag,
+        e.g. via a ``latest`` write, on any other evidence)."""
+        self._save_error = None
         arrays, meta = _split_state(state_dict)
         path = os.path.abspath(path)
-        if arrays:
-            self._ckptr.save(os.path.join(path, "arrays"), arrays, force=True)
-            if not self._async and hasattr(self._ckptr, "wait_until_finished"):
-                # StandardCheckpointer finalizes in a background thread since
-                # orbax 0.11 — a synchronous save contract must block here,
-                # else an immediate offline read sees arrays.orbax-checkpoint-tmp
-                self._ckptr.wait_until_finished()
-        if jax.process_index() == 0:
-            os.makedirs(path, exist_ok=True)
-            with open(os.path.join(path, "meta.pkl"), "wb") as f:
-                pickle.dump(meta, f)
+        try:
+            if arrays:
+                self._ckptr.save(os.path.join(path, "arrays"), arrays, force=True)
+                if not self._async and hasattr(self._ckptr, "wait_until_finished"):
+                    # StandardCheckpointer finalizes in a background thread since
+                    # orbax 0.11 — a synchronous save contract must block here,
+                    # else an immediate offline read sees arrays.orbax-checkpoint-tmp
+                    self._ckptr.wait_until_finished()
+            if jax.process_index() == 0:
+                os.makedirs(path, exist_ok=True)
+                with open(os.path.join(path, "meta.pkl"), "wb") as f:
+                    pickle.dump(meta, f)
+        except Exception as e:
+            self._save_error = e
+            raise
         return None
 
     def load(self, path: str, map_location=None, template=None):
@@ -62,27 +73,92 @@ class OrbaxCheckpointEngine(CheckpointEngine):
                 meta = pickle.load(f)
         arrays = {}
         arrays_path = os.path.join(path, "arrays")
-        if os.path.exists(arrays_path):
-            if template is not None:
-                # partial restore: the template may cover a subset of the
-                # on-disk tree (e.g. load_optimizer_states=False skips the
-                # host optimizer subtree)
-                arr_template, _ = _split_state(template)
-                restore_args = self._ocp.checkpoint_utils.construct_restore_args(arr_template)
-                with self._ocp.Checkpointer(self._ocp.PyTreeCheckpointHandler()) as ckptr:
-                    arrays = ckptr.restore(
-                        arrays_path,
-                        args=self._ocp.args.PyTreeRestore(item=arr_template, restore_args=restore_args,
-                                                          partial_restore=True))
-            else:
-                arrays = self._ckptr.restore(arrays_path)
+        expects_arrays = template is None or bool(_split_state(template)[0])
+        if not os.path.exists(arrays_path):
+            if expects_arrays and not meta:
+                # neither payload half exists: a torn/never-committed dir (or
+                # a bad path) — a silent empty merge here hands the caller a
+                # half-tree that trains from garbage
+                raise CheckpointCorruptError(f"{path}: no 'arrays' tree and no meta sidecar")
+            if expects_arrays:
+                raise CheckpointCorruptError(
+                    f"{path}: 'arrays' tree missing but meta.pkl present — partial checkpoint "
+                    f"(crash mid-write?); refusing to return a half-tree")
+        else:
+            try:
+                if template is not None:
+                    # partial restore, emulated against the on-disk metadata
+                    # (orbax < 0.11 has no partial_restore kwarg and rejects
+                    # any item tree that is not the exact saved structure):
+                    # template∩disk restores through the template's
+                    # ShapeDtypeStructs (sharded placement), disk-only
+                    # subtrees restore as host numpy, template-only subtrees
+                    # come back as their ShapeDtypeStruct placeholders (the
+                    # ``_fully_restored`` contract — e.g. a non-offload
+                    # checkpoint loaded into an offload engine)
+                    arr_template, _ = _split_state(template)
+                    with self._ocp.Checkpointer(self._ocp.PyTreeCheckpointHandler()) as ckptr:
+                        item, restore_args = self._merge_item(ckptr.metadata(arrays_path),
+                                                             arr_template)
+                        arrays = ckptr.restore(
+                            arrays_path,
+                            args=self._ocp.args.PyTreeRestore(item=item, restore_args=restore_args))
+                    arrays = _graft_missing(arrays, arr_template)
+                else:
+                    arrays = self._ckptr.restore(arrays_path)
+            except CheckpointCorruptError:
+                raise
+            except Exception as e:
+                # tensorstore surfaces torn shard files as a zoo of backend
+                # errors; normalize so the fallback path has ONE type to catch
+                raise CheckpointCorruptError(f"{arrays_path}: restore failed: {e}") from e
         return _merge_state(arrays, meta)
 
+    def _merge_item(self, metadata, template):
+        """Full-structure restore item + args: the saved tree's shape, with
+        template leaves (and their shardings) where the template covers it."""
+        item, args = {}, {}
+        for k, mv in metadata.items():
+            tv = template.get(k) if isinstance(template, dict) else None
+            if isinstance(mv, dict):
+                item[k], args[k] = self._merge_item(mv, tv if isinstance(tv, dict) else {})
+            elif tv is not None and not isinstance(tv, dict):
+                item[k] = tv
+                args[k] = self._ocp.checkpoint_utils.construct_restore_args(tv)
+            else:
+                item[k] = jax.ShapeDtypeStruct(tuple(mv.shape), mv.dtype)
+                args[k] = self._ocp.RestoreArgs(restore_type=np.ndarray, dtype=mv.dtype)
+        return item, args
+
     def commit(self, tag):
+        """True only when the tag is durably on disk. Async mode joins the
+        background write here (decoupled from ``save``, so the step loop
+        that called save already moved on); any recorded save failure makes
+        this False — the caller keeps ``latest`` on the previous tag."""
         if self._async:
-            self._ckptr.wait_until_finished()
+            try:
+                self._ckptr.wait_until_finished()
+            except Exception as e:
+                self._save_error = self._save_error or e
+        if self._save_error is not None:
+            logger.error(f"[OrbaxCheckpointEngine] Checkpoint {tag} FAILED: {self._save_error!r}")
+            return False
         logger.info(f"[OrbaxCheckpointEngine] Checkpoint {tag} is ready now!")
         return True
+
+
+def _graft_missing(arrays, template):
+    """Graft template-only subtrees (absent on disk) into the restored tree
+    as their ShapeDtypeStruct placeholders."""
+    if not isinstance(template, dict):
+        return arrays
+    out = dict(arrays) if isinstance(arrays, dict) else {}
+    for k, tv in template.items():
+        if k not in out:
+            out[k] = tv
+        elif isinstance(tv, dict) and isinstance(out[k], dict):
+            out[k] = _graft_missing(out[k], tv)
+    return out
 
 
 def _is_array(x):

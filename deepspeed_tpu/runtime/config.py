@@ -120,6 +120,22 @@ class CheckpointConfig(DeepSpeedConfigModel):
     parallel_write: dict = {}
     # TPU-native: use orbax/tensorstore OCDBT layout under the hood
     async_save: bool = False
+    # --- resilience plane (runtime/resilience/) ---
+    # auto-save every N engine steps (0 = off); nebula.persistent_time_interval
+    # adds the wall-clock cadence when the nebula block is enabled
+    save_interval_steps: int = 0
+    # retention GC: keep the newest N committed tags (0 = keep everything);
+    # mirrored from nebula.num_of_version_in_retention when nebula is on
+    num_of_version_in_retention: int = 0
+    # archival knob: tags whose step is a multiple of N survive retention
+    keep_every_n_steps: int = 0
+    # trap SIGTERM -> final checkpoint at the next step boundary -> clean
+    # exit (auto-enabled when nebula provides a persistent_storage_path)
+    preemption_save: bool = False
+    # default directory for auto/preemption saves (nebula's
+    # persistent_storage_path wins when set); engine.set_checkpoint_dir()
+    # overrides at runtime
+    auto_save_dir: Optional[str] = None
 
 
 class PipelineConfig(DeepSpeedConfigModel):
@@ -320,8 +336,18 @@ class DeepSpeedConfig:
         self.nebula_config = DeepSpeedNebulaConfig.from_param_dict(pd)
         if self.nebula_config.enabled:
             # nebula's contract = training never blocks on persistence; the
-            # TPU mechanism is orbax async save
+            # TPU mechanism is orbax async save + the resilience plane
+            # (runtime/resilience/): mirror the service knobs onto the
+            # checkpoint block so retention/auto-save/preemption are live,
+            # not parsed-and-dead (explicit checkpoint-block values win)
             self.checkpoint_config.async_save = True
+            if self.checkpoint_config.num_of_version_in_retention == 0:
+                self.checkpoint_config.num_of_version_in_retention = \
+                    self.nebula_config.num_of_version_in_retention
+            if self.checkpoint_config.auto_save_dir is None:
+                self.checkpoint_config.auto_save_dir = self.nebula_config.persistent_storage_path
+            if self.checkpoint_config.auto_save_dir:
+                self.checkpoint_config.preemption_save = True
         self.checkpoint_tag_validation_enabled = self.checkpoint_config.tag_validation != "Ignore"
         self.checkpoint_tag_validation_fail = self.checkpoint_config.tag_validation == "Fail"
         self.load_universal_checkpoint = self.checkpoint_config.load_universal

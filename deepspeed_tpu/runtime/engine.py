@@ -48,7 +48,9 @@ from ..utils.logging import logger, log_dist
 from ..utils.timer import (SynchronizedWallClockTimer, NoopTimer, ThroughputTimer, FORWARD_GLOBAL_TIMER,
                            BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER)
 
-LATEST_FILE = "latest"  # reference `latest` tag file semantics
+# reference `latest` tag file semantics; the pointer itself is only ever
+# WRITTEN by the resilience saver (tools/check_ckpt_commit.py gate)
+from .resilience.saver import LATEST_FILE  # noqa: E402
 
 
 class EngineTimers:
@@ -357,6 +359,29 @@ class DeepSpeedEngine:
         from .checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
 
         self.checkpoint_engine = OrbaxCheckpointEngine(async_save=config.checkpoint_config.async_save)
+        # resilience plane: bounded background writer + manifest-gated
+        # `latest`, retention GC, auto-save cadence, preemption trap
+        from .resilience import AutoSaveTrigger, PreemptionHandler, ResilientSaver
+
+        ckpt_cfg = config.checkpoint_config
+        self._ckpt_saver = ResilientSaver(self.checkpoint_engine,
+                                          retention=ckpt_cfg.num_of_version_in_retention,
+                                          keep_every_n_steps=ckpt_cfg.keep_every_n_steps,
+                                          is_lead=dist.get_rank() == 0)
+        self._auto_save = AutoSaveTrigger(
+            save_interval_steps=ckpt_cfg.save_interval_steps,
+            persistent_time_interval=(config.nebula_config.persistent_time_interval
+                                      if config.nebula_config.enabled else 0))
+        self._ckpt_save_dir = ckpt_cfg.auto_save_dir
+        self._preemption = None
+        if ckpt_cfg.preemption_save:
+            try:
+                self._preemption = PreemptionHandler().install()
+            except ValueError:
+                # signal.signal off the main thread — run preemption-less
+                logger.warning("preemption_save: not on the main thread, SIGTERM trap disabled")
+        self._resilience_active = (self._preemption is not None
+                                   or (self._auto_save.enabled and self._ckpt_save_dir is not None))
         if config.flops_profiler_config.enabled:
             from ..profiling.flops_profiler import FlopsProfiler
 
@@ -1265,6 +1290,8 @@ class DeepSpeedEngine:
             self.skipped_steps += 1  # offload path counts inside _host_apply_update
         self._record_metrics(metrics)
         self._maybe_flops_profile(placed)
+        if self._resilience_active:
+            self._poll_resilience()
         return metrics["loss"]
 
     def aot_lower_train_step(self, seq_len: int):
@@ -1729,22 +1756,124 @@ class DeepSpeedEngine:
             **(client_state or {}),
         }
 
-    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
+                        exclude_frozen_parameters=False, blocking=None):
+        """Save a durable checkpoint version.
+
+        ``blocking=None`` follows ``checkpoint.async_save`` (nebula flips it
+        on). The non-blocking path pays only the host-snapshot cost in the
+        step loop (measured as ``train/ckpt_blocked_ms``): the tree is handed
+        to the bounded background writer, which persists the payload, commits
+        a ``manifest.json`` (the durability point — see
+        ``runtime/resilience/saver.py``), and only then flips ``latest``; a
+        crash mid-write leaves ``latest`` on the previous durable tag. A
+        subsequent save/:meth:`flush_checkpoints`/:meth:`destroy` joins the
+        in-flight write. Returns False (and leaves ``latest`` untouched) when
+        the engine refuses commit on the blocking path.
+        """
+        if blocking is None:
+            blocking = not self.config.checkpoint_config.async_save
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
         path = os.path.join(save_dir, str(tag))
-        with self._tracer.span("checkpoint/save", tid="checkpoint", tag=str(tag)):
-            self.checkpoint_engine.create(tag)
-            self.checkpoint_engine.save(self._ckpt_state(client_state), path)
-            self.checkpoint_engine.commit(tag)
-            if save_latest and dist.get_rank() == 0:
-                os.makedirs(save_dir, exist_ok=True)
-                with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                    f.write(str(tag))
-            dist.barrier()
-        log_dist(f"saved checkpoint {path}", ranks=[0])
-        return True
+        t0 = time.perf_counter()
+        with self._tracer.span("checkpoint/save", tid="checkpoint", tag=str(tag),
+                               blocking=bool(blocking)):
+            state = self._ckpt_state(client_state)
+            if blocking:
+                ok = self._ckpt_saver.save(state, save_dir, str(tag), blocking=True,
+                                           save_latest=save_latest)
+                dist.barrier()
+            else:
+                # step-boundary host snapshot: after this, training may
+                # mutate engine state freely while the writer persists the
+                # snapshot (single-process only — multi-host arrays are not
+                # fully addressable, and orbax snapshots them itself)
+                if jax.process_count() == 1:
+                    state = self._host_snapshot(state)
+                ok = self._ckpt_saver.save(state, save_dir, str(tag), blocking=False,
+                                           save_latest=save_latest)
+        if self._metrics.enabled:
+            self._metrics.histogram("train/ckpt_blocked_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+        if ok:
+            # a refused commit must NOT reset the auto-save cadence — the
+            # next retry should come promptly, not a full interval away
+            self._auto_save.mark_saved(self.global_steps)
+            log_dist(f"saved checkpoint {path} (blocking={bool(blocking)})", ranks=[0])
+        else:
+            logger.error(f"checkpoint {path} NOT committed; 'latest' untouched")
+        return ok
+
+    def _host_snapshot(self, state):
+        """Copy array leaves to host numpy so the background writer holds no
+        device references (the only step-loop-blocking cost of async save)."""
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x, state)
+
+    def flush_checkpoints(self, raise_on_error=False):
+        """Join any in-flight async checkpoint write; returns True when the
+        last write committed cleanly."""
+        return self._ckpt_saver.flush(raise_on_error=raise_on_error)
+
+    def set_checkpoint_dir(self, save_dir):
+        """Arm auto-save/preemption saves to target ``save_dir`` (the
+        runtime override of ``checkpoint.auto_save_dir`` /
+        ``nebula.persistent_storage_path``). Multi-host: call on every
+        process — the triggered save runs collectives."""
+        self._ckpt_save_dir = save_dir
+        self._resilience_active = (self._preemption is not None
+                                   or (self._auto_save.enabled and self._ckpt_save_dir is not None))
+        return self
+
+    def _poll_resilience(self):
+        """Step-boundary resilience poll (one boolean when inactive).
+
+        Preemption wins over cadence: the final save is BLOCKING (the grace
+        window is for durability, not overlap), then the in-flight writer is
+        joined and :class:`~.resilience.TrainingPreempted` (a clean
+        ``SystemExit(0)``) unwinds the step loop. Cadence saves follow the
+        configured async/sync mode."""
+        from .resilience import TrainingPreempted
+
+        preempt = self._preemption is not None and self._preemption.requested
+        due = (self._auto_save.enabled and self._ckpt_save_dir is not None
+               and (self._auto_save.should_save(self.global_steps)
+                    # an async commit that failed AFTER the cadence reset must
+                    # retry promptly, not a full interval later (last_error is
+                    # cleared when the retry save is submitted)
+                    or (self._ckpt_saver.last_error is not None
+                        and not self._ckpt_saver.in_flight)))
+        if jax.process_count() > 1:
+            # signal delivery timing, the wall clock, and a failed writer are
+            # all process-local: a rank acting on a local decision enters the
+            # save path's collectives (tag validation all-gather, barrier)
+            # while the others continue training, and the job deadlocks. OR
+            # the votes so every process takes the same branch at the same
+            # step (one small host all-gather per step, only while the
+            # resilience plane is active at all).
+            votes = dist.all_gather_host((bool(preempt), bool(due)))
+            preempt = any(v[0] for v in votes)
+            due = any(v[1] for v in votes)
+        if preempt:
+            tag = None
+            if self._ckpt_save_dir is not None:
+                tag = f"global_step{self.global_steps}"
+                if not self.save_checkpoint(self._ckpt_save_dir, tag=tag, blocking=True):
+                    tag = None  # never advertise a refused commit as the resume point
+            self.flush_checkpoints()
+            if self._tracer.enabled:
+                self._tracer.instant("preemption_exit", tid="checkpoint")
+            if tag is not None:
+                log_dist(f"preemption: final checkpoint {tag} committed, exiting cleanly",
+                         ranks=[0])
+            else:
+                logger.error("preemption: final checkpoint did NOT commit; exiting cleanly — "
+                             "resume will use the previous durable tag")
+            raise TrainingPreempted(tag)
+        if due and self._ckpt_save_dir is not None:
+            self.save_checkpoint(self._ckpt_save_dir)
 
     def _checkpoint_tag_validation(self, tag):
         """All ranks must agree on the tag (reference ``engine.py:3052``)."""
@@ -1760,8 +1889,15 @@ class DeepSpeedEngine:
             logger.warning(msg)
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True, load_optimizer_states=True,
-                        load_lr_scheduler_states=True, load_module_only=False, custom_load_fn=None):
+                        load_lr_scheduler_states=True, load_module_only=False, custom_load_fn=None,
+                        fallback_to_valid=True):
+        """Restore from ``load_dir``. The resolved tag is validated against
+        its commit manifest (when one exists); on corruption — torn payload,
+        digest/size mismatch, missing ``arrays`` tree — the load falls back
+        to the newest *valid* tag (``fallback_to_valid=False`` raises
+        :class:`~.resilience.CheckpointCorruptError` instead)."""
         t0 = time.perf_counter() if self._tracer.enabled else 0.0
+        self.flush_checkpoints()  # never race a restore against our own writer
         if tag is None:
             latest_path = os.path.join(load_dir, LATEST_FILE)
             if os.path.isfile(latest_path):
@@ -1789,7 +1925,7 @@ class DeepSpeedEngine:
                        for i, l in enumerate(jax.tree_util.tree_leaves(self.state[state_key]))}
                 for kind, state_key in (("err_w", "onebit_err_w"), ("err_s", "onebit_err_s"))
             }
-        loaded = self.checkpoint_engine.load(path, template=template)
+        loaded, path, tag = self._load_verified(load_dir, tag, path, template, fallback_to_valid)
         params = loaded["module"]
         state = dict(self.state)
         state["params"] = params
@@ -1827,9 +1963,48 @@ class DeepSpeedEngine:
                                      "skipped_steps", "lr_scheduler", "curriculum_scheduler",
                                      "random_ltd_scheduler", "host_optimizer", "onebit", "ds_config",
                                      "ds_version")}
+        # the restored state IS a fresh save for cadence purposes — without
+        # this, a resume at a high step sees (step - 0) >= interval and
+        # immediately re-writes a checkpoint nearly identical to the one it
+        # just loaded (and, with retention on, evicts a real older version)
+        self._auto_save.mark_saved(self.global_steps)
         self._emit_phase("checkpoint/load", t0)
         log_dist(f"loaded checkpoint {path}", ranks=[0])
         return path, client_state
+
+    def _load_verified(self, load_dir, tag, path, template, fallback):
+        """Manifest-verify + restore, walking back to the newest valid tag
+        on corruption (the self-healing half of the commit protocol)."""
+        from .resilience import CheckpointCorruptError
+        from .resilience.manifest import is_committed, MANIFEST_FILE, verify_manifest
+        from .resilience.saver import list_tags, tag_order_key
+
+        tried = set()
+        while True:
+            try:
+                if os.path.isfile(os.path.join(path, MANIFEST_FILE)):
+                    # size/existence pass on every load; legacy dirs without
+                    # a manifest skip to the engine's own payload checks
+                    verify_manifest(path, deep=False)
+                return self.checkpoint_engine.load(path, template=template), path, tag
+            except CheckpointCorruptError as e:
+                tried.add(os.path.abspath(path))
+                logger.error(f"checkpoint {path} failed validation: {e}")
+                if not fallback:
+                    raise
+                nxt = None
+                for cand in sorted(list_tags(load_dir), key=lambda t: tag_order_key(load_dir, t),
+                                   reverse=True):
+                    cand_path = os.path.join(load_dir, cand)
+                    if os.path.abspath(cand_path) in tried:
+                        continue
+                    if is_committed(cand_path):
+                        nxt = (cand, cand_path)
+                        break
+                if nxt is None:
+                    raise
+                tag, path = nxt
+                logger.warning(f"falling back to newest valid checkpoint tag '{tag}'")
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin", exclude_frozen_parameters=False):
         """Gather full (unsharded) bf16 weights for export (reference
@@ -1932,6 +2107,12 @@ class DeepSpeedEngine:
             # a trace window reaching the final step has no later train_batch
             # to close it — flush the artifact before tearing state down
             self.stop_device_trace()
+        # join any in-flight async checkpoint write: tearing down state under
+        # a live writer would hand tensorstore a half-freed tree
+        self.flush_checkpoints()
+        if self._preemption is not None:
+            self._preemption.uninstall()
+            self._preemption = None
         for pf in self._prefetchers:
             pf.close()  # stop workers + drop their queued device batches
         self._prefetchers = []

@@ -1,0 +1,26 @@
+"""Resilience subsystem: truly-async crash-consistent checkpointing,
+retention/GC, preemption handling, and auto-resume.
+
+The robustness layer the reference gets from the Nebula service
+(``deepspeed/nebula``) plus torch-elastic restarts, rebuilt TPU-native:
+
+* :mod:`saver` — bounded background writer + manifest-gated ``latest``
+  pointer (the ONLY code allowed to flip it or delete tags);
+* :mod:`manifest` — per-checkpoint commit marker with byte counts and
+  sha256 digests (torn writes are detectable, never loadable);
+* :mod:`preemption` / :mod:`triggers` — SIGTERM → final save → clean exit,
+  plus step/wall-clock auto-save cadence;
+* :mod:`runner` — ``run_resilient`` wraps :class:`ElasticAgent` with
+  resume-from-newest-valid-tag;
+* :mod:`fault_injection` — the test harness that drives crash-mid-write,
+  torn-manifest, and killed-writer scenarios.
+"""
+
+from .errors import CheckpointCorruptError, TrainingPreempted  # noqa: F401
+from .manifest import (build_manifest, is_committed, read_manifest, verify_manifest,  # noqa: F401
+                       write_manifest, MANIFEST_FILE)
+from .preemption import PreemptionHandler  # noqa: F401
+from .runner import run_resilient  # noqa: F401
+from .saver import (apply_retention, find_latest_valid, list_tags, read_latest,  # noqa: F401
+                    ResilientSaver, write_latest, LATEST_FILE)
+from .triggers import AutoSaveTrigger  # noqa: F401

@@ -1,0 +1,24 @@
+"""Resilience-plane error types.
+
+Kept dependency-free so both the checkpoint engines (which raise) and the
+engine/runner fallback paths (which catch) can import them without cycles.
+"""
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory failed validation: missing/partial ``arrays``
+    tree, torn or absent manifest, or a per-file digest mismatch. Callers on
+    the auto-resume path catch this and fall back to the newest valid tag;
+    everything else should treat it as data loss, not a soft miss."""
+
+
+class TrainingPreempted(SystemExit):
+    """Raised out of the step loop after a preemption-requested final
+    checkpoint has committed. Subclasses ``SystemExit(0)`` so an unhandled
+    escape is a *clean* process exit (the maintenance event contract), while
+    still being catchable by ``run_resilient``/user loops that want to
+    shut down gracefully themselves."""
+
+    def __init__(self, tag=None):
+        super().__init__(0)
+        self.tag = tag

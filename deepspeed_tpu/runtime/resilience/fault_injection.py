@@ -1,0 +1,52 @@
+"""Fault-injection harness for the checkpoint commit path.
+
+The saver calls :func:`fire` at each stage boundary of a save; tests
+register hooks to simulate the real failure modes a TPU fleet produces:
+
+* ``after_arrays``  — writer dies after the tensorstore payload, before the
+  manifest (crash mid-write: directory exists, never committed);
+* ``before_manifest`` / ``after_manifest`` — torn commit windows;
+* ``before_latest`` — durable checkpoint whose pointer flip never happened
+  (the benign window: next save supersedes it).
+
+Hooks run *in the writer thread*, so raising :class:`InjectedCrash` is
+exactly a killed writer as far as the foreground step loop can tell. A hook
+may also block (e.g. on a ``threading.Event``) to hold a save in flight
+while a test asserts non-blocking behavior.
+"""
+
+import threading
+
+POINTS = ("before_arrays", "after_arrays", "before_manifest", "after_manifest", "before_latest")
+
+_lock = threading.Lock()
+_hooks = {}
+
+
+class InjectedCrash(RuntimeError):
+    """Simulated writer death."""
+
+
+def inject(point, hook):
+    """Register ``hook(ctx)`` to run when the saver reaches ``point``."""
+    if point not in POINTS:
+        raise ValueError(f"unknown injection point {point!r}; valid: {POINTS}")
+    with _lock:
+        _hooks.setdefault(point, []).append(hook)
+
+
+def crash_at(point):
+    """Convenience: kill the writer at ``point``."""
+    inject(point, lambda ctx: (_ for _ in ()).throw(InjectedCrash(f"injected crash at {point}")))
+
+
+def clear():
+    with _lock:
+        _hooks.clear()
+
+
+def fire(point, ctx=None):
+    with _lock:
+        hooks = list(_hooks.get(point, ()))
+    for hook in hooks:
+        hook(ctx)

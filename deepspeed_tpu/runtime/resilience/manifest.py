@@ -1,0 +1,136 @@
+"""Per-checkpoint commit manifest.
+
+A checkpoint directory is *durable* iff ``manifest.json`` inside it parses
+and every file it names is present with the recorded byte count (and, on a
+deep verify, the recorded sha256). The manifest is written tmp+fsync+rename
+as the LAST step of a save, so its presence is the commit marker: a crash at
+any earlier point leaves a directory that ``verify_manifest`` rejects and
+the ``latest`` pointer never references (reference semantics: Nebula's
+tiered service only advertises fully persisted versions).
+"""
+
+import hashlib
+import json
+import os
+import time
+
+MANIFEST_FILE = "manifest.json"
+MANIFEST_VERSION = 1
+
+from .errors import CheckpointCorruptError
+
+
+def _iter_files(ckpt_path):
+    """Relative (posix) paths of every payload file under the checkpoint
+    dir, manifest excluded."""
+    for root, _dirs, files in os.walk(ckpt_path):
+        for fname in sorted(files):
+            rel = os.path.relpath(os.path.join(root, fname), ckpt_path)
+            rel = rel.replace(os.sep, "/")
+            if rel == MANIFEST_FILE or rel.endswith(".tmp"):
+                continue
+            yield rel
+
+
+def _sha256(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def tree_spec(state):
+    """Flattened ``path -> {shape, dtype}`` for the array leaves of a nested
+    dict checkpoint state (non-array client state is listed by type only) —
+    the restore-side schema half of the crash-consistency contract."""
+    spec = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}/{k}" if prefix else str(k))
+        elif hasattr(node, "shape") and hasattr(node, "dtype"):
+            spec[prefix] = {"shape": [int(d) for d in node.shape], "dtype": str(node.dtype)}
+        else:
+            spec[prefix] = {"type": type(node).__name__}
+
+    walk(state, "")
+    return spec
+
+
+def build_manifest(ckpt_path, tag, state=None):
+    """Hash every payload file already on disk under ``ckpt_path``."""
+    files = {}
+    total = 0
+    for rel in _iter_files(ckpt_path):
+        full = os.path.join(ckpt_path, rel)
+        n = os.path.getsize(full)
+        files[rel] = {"bytes": n, "sha256": _sha256(full)}
+        total += n
+    return {
+        "version": MANIFEST_VERSION,
+        "tag": str(tag),
+        "created_unix": time.time(),
+        "total_bytes": total,
+        "files": files,
+        "tree": tree_spec(state) if state is not None else None,
+    }
+
+
+def write_manifest(ckpt_path, manifest):
+    """Durable (tmp + fsync + rename) manifest write — the commit point."""
+    final = os.path.join(ckpt_path, MANIFEST_FILE)
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    return final
+
+
+def read_manifest(ckpt_path):
+    """Parse the manifest or raise :class:`CheckpointCorruptError` (absent
+    manifest == uncommitted checkpoint == corrupt for the resilient plane)."""
+    path = os.path.join(ckpt_path, MANIFEST_FILE)
+    if not os.path.isfile(path):
+        raise CheckpointCorruptError(f"no manifest at {path}: checkpoint never committed")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (ValueError, OSError) as e:
+        raise CheckpointCorruptError(f"torn manifest at {path}: {e}")
+
+
+def verify_manifest(ckpt_path, deep=True):
+    """Validate a checkpoint dir against its manifest; returns the manifest.
+
+    ``deep=False`` checks existence + byte counts only (cheap, used when
+    scanning many tags for the newest valid one); ``deep=True`` also
+    re-digests every file, catching silent bit-rot and partial overwrites.
+    """
+    man = read_manifest(ckpt_path)
+    for rel, meta in (man.get("files") or {}).items():
+        full = os.path.join(ckpt_path, rel)
+        if not os.path.isfile(full):
+            raise CheckpointCorruptError(f"{ckpt_path}: missing payload file {rel}")
+        size = os.path.getsize(full)
+        if size != meta.get("bytes"):
+            raise CheckpointCorruptError(
+                f"{ckpt_path}: {rel} is {size}B, manifest says {meta.get('bytes')}B")
+        if deep and _sha256(full) != meta.get("sha256"):
+            raise CheckpointCorruptError(f"{ckpt_path}: digest mismatch on {rel}")
+    return man
+
+
+def is_committed(ckpt_path, deep=False):
+    """True iff the directory verifies against its manifest."""
+    try:
+        verify_manifest(ckpt_path, deep=deep)
+        return True
+    except CheckpointCorruptError:
+        return False

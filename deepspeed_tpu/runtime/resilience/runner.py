@@ -1,0 +1,49 @@
+"""``run_resilient`` — auto-resume harness around :class:`ElasticAgent`.
+
+The reference's restart story is torch-elastic re-rendezvous + user resume
+code; here the agent already re-resolves the elastic batch config per
+attempt, and this wrapper adds the missing half: every (re)start receives
+the newest *valid* checkpoint tag (manifest-verified, torn tags skipped),
+so an injected worker failure or a preemption exit resumes exactly where
+the last durable version left off.
+"""
+
+from typing import Callable, Optional
+
+from .errors import TrainingPreempted
+from .saver import find_latest_valid
+from ...utils.logging import logger
+
+
+def run_resilient(train_fn: Callable, ds_config: dict, save_dir: Optional[str] = None,
+                  max_restarts: int = 3, restart_delay_s: float = 5.0, backoff_factor: float = 2.0,
+                  world_size_fn: Optional[Callable[[], int]] = None, deep_verify: bool = False):
+    """Run ``train_fn(batch_config, resume_from)`` under elastic restarts.
+
+    ``batch_config`` is the re-resolved elastic batch triad for the current
+    world size; ``resume_from`` is ``(tag, path)`` of the newest valid
+    checkpoint under ``save_dir`` (``(None, None)`` on a cold start) —
+    re-evaluated at every attempt, so a restart picks up checkpoints the
+    failed attempt committed. A :class:`TrainingPreempted` escape is a clean
+    shutdown, not a failure: it is returned (not re-raised) so supervising
+    code can requeue the job.
+    """
+    from ...elasticity import ElasticAgent
+
+    agent = ElasticAgent(ds_config, max_restarts=max_restarts, restart_delay_s=restart_delay_s,
+                         backoff_factor=backoff_factor)
+
+    def attempt(batch_config):
+        resume = (None, None)
+        if save_dir is not None:
+            resume = find_latest_valid(save_dir, deep=deep_verify)
+            if resume[0] is not None:
+                logger.info(f"run_resilient: resuming from valid tag {resume[0]} "
+                            f"(restart {agent.restart_count}/{max_restarts})")
+        return train_fn(batch_config, resume)
+
+    try:
+        return agent.run(attempt, world_size_fn=world_size_fn)
+    except TrainingPreempted as e:
+        logger.warning(f"run_resilient: clean preemption exit (final tag {e.tag})")
+        return e

@@ -1,0 +1,295 @@
+"""Crash-consistent checkpoint writer: the ONLY module allowed to move the
+``latest`` pointer or delete checkpoint tags (``tools/check_ckpt_commit.py``
+enforces this statically, the way ``check_timed_ops.py`` pins collectives to
+``@timed_op``).
+
+Commit protocol per save (all stages in the writer thread on the async
+path; :mod:`fault_injection` points mark the stage boundaries)::
+
+    payload (engine.save -> arrays/ + meta.pkl)     [crash here: no manifest]
+    engine.commit()  -> must return True            [False: save aborted]
+    manifest.json    (tmp + fsync + rename)         <- durability point
+    latest           (tmp + fsync + rename)         [crash here: next save heals]
+    retention GC     (superseded tags only)
+
+A crash at ANY point leaves ``latest`` referencing the previous durable
+tag — the step loop never has to trust a torn directory. This is the Nebula
+contract (``deepspeed/nebula``: training never blocks on persistence, only
+fully-persisted versions are advertised) rebuilt on orbax + manifests.
+"""
+
+import os
+import re
+import shutil
+import threading
+import time
+
+from . import fault_injection
+from .errors import CheckpointCorruptError
+from .manifest import build_manifest, is_committed, read_manifest, write_manifest, MANIFEST_FILE
+from ...monitor.metrics import get_metrics
+from ...monitor.trace import get_tracer
+from ...utils.logging import logger
+
+LATEST_FILE = "latest"  # reference `latest` tag file semantics
+_STEP_RE = re.compile(r"(\d+)\s*$")
+
+
+def read_latest(save_dir):
+    """Tag named by the ``latest`` pointer, or None."""
+    path = os.path.join(save_dir, LATEST_FILE)
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        tag = f.read().strip()
+    return tag or None
+
+
+def list_tags(save_dir):
+    """Checkpoint tag directories under ``save_dir``, unordered."""
+    if not os.path.isdir(save_dir):
+        return []
+    return [d for d in os.listdir(save_dir)
+            if os.path.isdir(os.path.join(save_dir, d))]
+
+
+def tag_step(save_dir, tag):
+    """Trailing integer of a step-style tag (``global_step12`` -> 12), or
+    None for non-numeric tags (``best``) — used only by the
+    ``keep_every_n_steps`` archival rule."""
+    m = _STEP_RE.search(str(tag))
+    return int(m.group(1)) if m else None
+
+
+# (path -> (manifest mtime, key)): retention sorts, the newest-valid scan,
+# and load fallback all call tag_order_key repeatedly per tag, and for a big
+# model the manifest (full digest table + tree spec) is hundreds of KB — one
+# parse per committed manifest, not one per comparison
+_ORDER_KEY_CACHE = {}
+
+
+def tag_order_key(save_dir, tag):
+    """Recency key for a tag: manifest commit time for committed dirs, dir
+    mtime for torn/in-flight ones (same unix-seconds unit, so the two order
+    consistently — a trailing step number would put a committed ``best``
+    tag in a different key space and permanently out-sort every
+    ``global_stepN``)."""
+    path = os.path.join(save_dir, str(tag))
+    try:
+        man_mtime = os.path.getmtime(os.path.join(path, MANIFEST_FILE))
+    except OSError:
+        man_mtime = None
+    if man_mtime is not None:
+        hit = _ORDER_KEY_CACHE.get(path)
+        if hit is not None and hit[0] == man_mtime:
+            return hit[1]
+    try:
+        key = float(read_manifest(path).get("created_unix", -1.0))
+    except CheckpointCorruptError:
+        try:
+            return os.path.getmtime(path)
+        except OSError:
+            return -1.0
+    if man_mtime is not None:
+        if len(_ORDER_KEY_CACHE) > 1024:  # GC'd tags leave entries behind
+            _ORDER_KEY_CACHE.clear()
+        _ORDER_KEY_CACHE[path] = (man_mtime, key)
+    return key
+
+
+def find_latest_valid(save_dir, deep=False):
+    """Newest tag whose directory verifies against its manifest, preferring
+    the ``latest`` pointer; returns (tag, path) or (None, None).
+
+    This is the load-side half of crash consistency: a torn directory (or a
+    corrupted manifest) is skipped, not surfaced, and the scan falls back
+    through older tags newest-first.
+    """
+    candidates = []
+    pointed = read_latest(save_dir)
+    if pointed is not None:
+        candidates.append(pointed)
+    for tag in sorted(list_tags(save_dir), key=lambda t: tag_order_key(save_dir, t), reverse=True):
+        if tag not in candidates:
+            candidates.append(tag)
+    for tag in candidates:
+        path = os.path.join(save_dir, tag)
+        if os.path.isdir(path) and is_committed(path, deep=deep):
+            return tag, path
+    return None, None
+
+
+def write_latest(save_dir, tag):
+    """Atomically flip the ``latest`` pointer (tmp + fsync + rename)."""
+    os.makedirs(save_dir, exist_ok=True)
+    final = os.path.join(save_dir, LATEST_FILE)
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(tag))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+
+
+def apply_retention(save_dir, keep, keep_every_n_steps=0, protect=()):
+    """Delete superseded tags, honoring ``nebula.num_of_version_in_retention``.
+
+    Keeps: the newest ``keep`` committed step-style tags, every committed
+    tag whose step is a multiple of ``keep_every_n_steps`` (the archival
+    knob), every committed NON-step tag (a user-named ``best``/``release``
+    checkpoint is an explicit decision — cadence GC has no business deleting
+    it), and anything in ``protect`` (the just-committed tag + the
+    ``latest`` target). Uncommitted directories older than the newest
+    committed tag are crash garbage and are removed too. ``keep <= 0``
+    disables GC entirely. Returns the list of deleted tags.
+    """
+    if keep <= 0:
+        return []
+    protect = {str(t) for t in protect if t is not None}
+    pointed = read_latest(save_dir)
+    if pointed:
+        protect.add(pointed)
+    committed, torn = [], []
+    for tag in list_tags(save_dir):
+        (committed if is_committed(os.path.join(save_dir, tag)) else torn).append(tag)
+    committed.sort(key=lambda t: tag_order_key(save_dir, t), reverse=True)
+    # only step-style tags compete for the newest-N window; named tags are
+    # kept unconditionally (and don't shrink the window for real versions)
+    step_tags = [t for t in committed if tag_step(save_dir, t) is not None]
+    keep_set = set(step_tags[:keep]) | protect
+    keep_set.update(t for t in committed if tag_step(save_dir, t) is None)
+    if keep_every_n_steps > 0:
+        for tag in step_tags:
+            if tag_step(save_dir, tag) % keep_every_n_steps == 0:
+                keep_set.add(tag)
+    newest_key = tag_order_key(save_dir, committed[0]) if committed else None
+    deleted = []
+    for tag in committed:
+        if tag not in keep_set:
+            shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+            deleted.append(tag)
+    for tag in torn:
+        # only sweep torn dirs strictly older than the newest durable tag's
+        # commit time: a *newer* uncommitted dir could be another process's
+        # in-flight save (defense in depth — within this process the saver
+        # lock serializes writers, so our own in-flight dir can't be here)
+        if (tag not in protect and newest_key is not None
+                and tag_order_key(save_dir, tag) < newest_key):
+            shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+            deleted.append(tag)
+    if deleted:
+        logger.info(f"checkpoint retention: deleted superseded tags {sorted(deleted)}")
+    return deleted
+
+
+class ResilientSaver:
+    """Bounded background checkpoint writer (depth 1: a new submit joins the
+    in-flight save first, so at most one write is ever outstanding and HBM
+    holds at most one extra host snapshot)."""
+
+    def __init__(self, checkpoint_engine, retention=0, keep_every_n_steps=0, is_lead=True):
+        self.checkpoint_engine = checkpoint_engine
+        self.retention = int(retention)
+        self.keep_every_n_steps = int(keep_every_n_steps)
+        self.is_lead = is_lead
+        self._thread = None
+        self._lock = threading.Lock()
+        self.last_error = None
+        self.saves_committed = 0
+        self.saves_failed = 0
+
+    # ------------------------------------------------------------------
+    def save(self, state, save_dir, tag, blocking=True, save_latest=True):
+        """Write ``state`` under ``save_dir/tag``. Blocking mode returns the
+        commit result; async mode returns True immediately after handing the
+        (already host-resident) tree to the writer thread. The lock
+        serializes concurrent submitters (depth-1 bound: join the in-flight
+        writer first, exactly one thread ever owns a write)."""
+        with self._lock:
+            self._join_locked()
+            self.last_error = None  # status tracks the save being started
+            if blocking:
+                return self._write_and_commit(state, save_dir, tag, save_latest)
+            self._thread = threading.Thread(target=self._background_write,
+                                            args=(state, save_dir, tag, save_latest),
+                                            name=f"ckpt-writer-{tag}", daemon=True)
+            self._thread.start()
+            return True
+
+    def flush(self, raise_on_error=False):
+        """Join the in-flight save (no-op when idle); True iff the most
+        recently submitted save committed cleanly. With ``raise_on_error``
+        that save's stored exception is re-raised."""
+        with self._lock:
+            self._join_locked()
+            if raise_on_error and self.last_error is not None:
+                raise self.last_error
+            return self.last_error is None
+
+    def _join_locked(self):
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+
+    @property
+    def in_flight(self):
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    # ------------------------------------------------------------------
+    def _background_write(self, state, save_dir, tag, save_latest):
+        tracer = get_tracer()
+        t0 = time.perf_counter()
+        try:
+            ok = self._write_and_commit(state, save_dir, tag, save_latest)
+            if tracer.enabled:
+                tracer.complete("checkpoint/async_write", t0, time.perf_counter() - t0,
+                                tid="checkpoint", args={"tag": str(tag), "committed": bool(ok)})
+        except BaseException as e:  # noqa: BLE001 — a dead writer must never kill training
+            self.last_error = e  # failure counters already bumped in _write_and_commit
+            if tracer.enabled:
+                tracer.complete("checkpoint/async_write", t0, time.perf_counter() - t0,
+                                tid="checkpoint", args={"tag": str(tag), "error": repr(e)})
+            logger.error(f"async checkpoint writer died for tag {tag}: {e!r}; "
+                         f"'latest' still references the previous durable tag")
+
+    def _write_and_commit(self, state, save_dir, tag, save_latest):
+        """The one commit path (see module docstring for the protocol)."""
+        path = os.path.join(save_dir, str(tag))
+        ctx = {"path": path, "tag": str(tag)}
+        metrics = get_metrics()
+        t0 = time.perf_counter()
+        try:
+            fault_injection.fire("before_arrays", ctx)
+            self.checkpoint_engine.create(tag)
+            self.checkpoint_engine.save(state, path)
+            fault_injection.fire("after_arrays", ctx)
+            ok = self.checkpoint_engine.commit(tag)
+            if not ok:
+                self.saves_failed += 1
+                self.last_error = RuntimeError(
+                    f"checkpoint engine refused commit for tag {tag}")
+                metrics.counter("checkpoint/saves_failed").inc()
+                logger.error(f"checkpoint engine refused commit for tag {tag}; "
+                             f"'latest' left untouched")
+                return False
+            if self.is_lead:
+                fault_injection.fire("before_manifest", ctx)
+                man = build_manifest(path, tag, state=state)
+                write_manifest(path, man)
+                fault_injection.fire("after_manifest", ctx)
+                metrics.counter("checkpoint/bytes_written").inc(man["total_bytes"])
+                if save_latest:
+                    fault_injection.fire("before_latest", ctx)
+                    write_latest(save_dir, tag)
+                apply_retention(save_dir, self.retention, self.keep_every_n_steps,
+                                protect=(str(tag), ))
+        except Exception:
+            self.saves_failed += 1
+            metrics.counter("checkpoint/saves_failed").inc()
+            raise
+        self.saves_committed += 1
+        metrics.counter("checkpoint/saves_committed").inc()
+        metrics.histogram("checkpoint/write_ms").observe((time.perf_counter() - t0) * 1e3)
+        return True

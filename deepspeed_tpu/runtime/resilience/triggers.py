@@ -1,0 +1,36 @@
+"""Auto-save triggers: step-count and wall-clock cadence.
+
+``nebula.persistent_time_interval`` (seconds between durable versions — the
+reference knob our port previously parsed and ignored) and the new
+``checkpoint.save_interval_steps`` both feed one trigger; whichever fires
+first wins and firing resets both cadences (a save is a save).
+"""
+
+import time
+
+
+class AutoSaveTrigger:
+
+    def __init__(self, save_interval_steps=0, persistent_time_interval=0, clock=time.monotonic):
+        self.save_interval_steps = int(save_interval_steps or 0)
+        self.persistent_time_interval = float(persistent_time_interval or 0)
+        self._clock = clock
+        self._last_step = 0
+        self._last_time = clock()
+
+    @property
+    def enabled(self):
+        return self.save_interval_steps > 0 or self.persistent_time_interval > 0
+
+    def should_save(self, step):
+        if self.save_interval_steps > 0 and step - self._last_step >= self.save_interval_steps:
+            return True
+        if (self.persistent_time_interval > 0
+                and self._clock() - self._last_time >= self.persistent_time_interval):
+            return True
+        return False
+
+    def mark_saved(self, step):
+        """Reset both cadences — call after ANY save (auto or user)."""
+        self._last_step = step
+        self._last_time = self._clock()

@@ -1,0 +1,478 @@
+"""Resilience subsystem fault-injection suite (`runtime/resilience/`).
+
+Drives the crash scenarios a TPU fleet actually produces — writer killed
+mid-save, torn manifest, preemption SIGTERM, worker failure mid-run — and
+asserts the commit protocol's invariants: ``latest`` only ever references a
+durable (manifest-verified) tag, retention keeps exactly N + archival tags,
+async and sync saves restore bit-identically, and ``run_resilient`` resumes
+from the last valid checkpoint. Also runs the ``tools/check_ckpt_commit.py``
+AST gate (tier-1, the ``check_timed_ops.py`` pattern).
+"""
+
+import json
+import os
+import signal
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.runtime.resilience import (apply_retention, find_latest_valid, is_committed,
+                                              read_latest, verify_manifest, AutoSaveTrigger,
+                                              CheckpointCorruptError, TrainingPreempted,
+                                              MANIFEST_FILE)
+from deepspeed_tpu.runtime.resilience import fault_injection
+
+
+def _model():
+    # deliberately minimal: the suite exercises the checkpoint plane, not the
+    # model — per-test engine construction + compile dominates its tier-1 cost
+    return TransformerLM(TransformerConfig(vocab_size=64, hidden_size=16, num_layers=1, num_heads=2,
+                                           intermediate_size=32, max_seq_len=16, dtype=jnp.float32,
+                                           attention_impl="reference"))
+
+
+def _config(async_save=False, **ckpt_over):
+    return {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+        "zero_optimization": {"stage": 0},
+        "tpu": {"mesh": {"data": 8}},
+        "checkpoint": {"async_save": async_save, **ckpt_over},
+    }
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 64, size=(8, 16), dtype=np.int32)}
+
+
+def _engine(config=None):
+    groups.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=config or _config())
+    return engine
+
+
+def _params(engine):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(jax.device_get(engine.state["params"]))]
+
+
+@pytest.fixture(autouse=True)
+def _clean_injection():
+    fault_injection.clear()
+    yield
+    fault_injection.clear()
+
+
+# ----------------------------------------------------------------------
+# async save pipeline
+# ----------------------------------------------------------------------
+def test_async_and_sync_saves_restore_bit_identical(tmp_path):
+    engine = _engine(_config())
+    for i in range(2):
+        engine.train_batch(_batch(i))
+    want = _params(engine)
+    engine.save_checkpoint(str(tmp_path / "sync"), tag="t")                  # blocking
+    engine.save_checkpoint(str(tmp_path / "async"), tag="t", blocking=False)  # writer thread
+    assert engine.flush_checkpoints(raise_on_error=True)
+
+    for i, mode in enumerate(("sync", "async")):
+        engine.train_batch(_batch(9 + i))  # diverge from the saved state first
+        engine.load_checkpoint(str(tmp_path / mode), tag="t")
+        got = _params(engine)
+        assert len(got) == len(want)
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_async_save_does_not_block_step_loop(tmp_path):
+    """While the writer is held mid-write, save_checkpoint has already
+    returned and training continues; release -> commit lands."""
+    gate = threading.Event()
+    fault_injection.inject("before_manifest", lambda ctx: gate.wait(timeout=30))
+    engine = _engine(_config(async_save=True))
+    engine.train_batch(_batch())
+    engine.save_checkpoint(str(tmp_path), tag="held")
+    assert engine._ckpt_saver.in_flight          # writer parked on the gate
+    assert read_latest(str(tmp_path)) is None    # not yet advertised
+    engine.train_batch(_batch(1))                # step loop unaffected
+    gate.set()
+    assert engine.flush_checkpoints(raise_on_error=True)
+    assert read_latest(str(tmp_path)) == "held"
+    assert is_committed(str(tmp_path / "held"), deep=True)
+
+
+def test_killed_writer_mid_save_keeps_previous_latest(tmp_path):
+    engine = _engine(_config(async_save=True))
+    engine.save_checkpoint(str(tmp_path), tag="good", blocking=True)
+    assert read_latest(str(tmp_path)) == "good"
+
+    # the writer dies after the payload, before the manifest commit
+    fault_injection.crash_at("before_manifest")
+    engine.save_checkpoint(str(tmp_path), tag="doomed")  # async
+    engine.flush_checkpoints()
+    assert engine._ckpt_saver.last_error is not None
+    assert read_latest(str(tmp_path)) == "good"          # pointer never moved
+    assert not is_committed(str(tmp_path / "doomed"))
+
+    # and the surviving pointer target actually loads
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path.endswith("good")
+
+
+def test_torn_manifest_falls_back_to_newest_valid(tmp_path):
+    engine = _engine(_config())
+    engine.save_checkpoint(str(tmp_path), tag="v1")
+    v1 = _params(engine)
+    engine.train_batch(_batch(1))
+    engine.save_checkpoint(str(tmp_path), tag="v2")
+    assert read_latest(str(tmp_path)) == "v2"
+
+    # tear v2's manifest (crash mid-commit): load must heal onto v1
+    man = tmp_path / "v2" / MANIFEST_FILE
+    man.write_text(man.read_text()[: len(man.read_text()) // 2])
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path.endswith("v1")
+    for a, b in zip(v1, _params(engine)):
+        np.testing.assert_array_equal(a, b)
+
+    # fallback disabled -> the corruption surfaces
+    with pytest.raises(CheckpointCorruptError):
+        engine.load_checkpoint(str(tmp_path), fallback_to_valid=False)
+
+
+def test_payload_size_mismatch_detected(tmp_path):
+    engine = _engine(_config())
+    engine.save_checkpoint(str(tmp_path), tag="v1")
+    path = str(tmp_path / "v1")
+    man = json.loads(open(os.path.join(path, MANIFEST_FILE)).read())
+    rel = next(iter(man["files"]))
+    with open(os.path.join(path, rel), "ab") as f:
+        f.write(b"torn")
+    with pytest.raises(CheckpointCorruptError):
+        verify_manifest(path, deep=False)
+    assert not is_committed(path)
+
+
+def test_missing_arrays_dir_raises_corrupt(tmp_path):
+    """A half-tree (meta sidecar without the arrays payload) must raise, not
+    silently merge (the pre-resilience behavior)."""
+    import shutil
+
+    engine = _engine(_config())
+    engine.save_checkpoint(str(tmp_path), tag="v1")
+    shutil.rmtree(str(tmp_path / "v1" / "arrays"))
+    with pytest.raises(CheckpointCorruptError):
+        engine.load_checkpoint(str(tmp_path), tag="v1", fallback_to_valid=False)
+
+
+def test_save_failure_does_not_commit(tmp_path, monkeypatch):
+    engine = _engine(_config())
+    engine.save_checkpoint(str(tmp_path), tag="ok")
+
+    def boom(state, path):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(engine.checkpoint_engine, "save", boom)
+    with pytest.raises(OSError):
+        engine.save_checkpoint(str(tmp_path), tag="bad", blocking=True)
+    assert read_latest(str(tmp_path)) == "ok"
+    assert not is_committed(str(tmp_path / "bad"))
+
+
+# ----------------------------------------------------------------------
+# retention / GC
+# ----------------------------------------------------------------------
+def test_retention_keeps_exactly_n_plus_archival(tmp_path):
+    engine = _engine(_config(num_of_version_in_retention=2, keep_every_n_steps=4))
+    for i in range(1, 9):
+        engine.global_steps = i  # retention cares about versions, not training
+        engine.save_checkpoint(str(tmp_path))  # tags global_step1..8
+    tags = sorted(d for d in os.listdir(str(tmp_path)) if (tmp_path / d).is_dir())
+    # newest 2 (step7, step8) + archival multiples of 4 (step4, step8)
+    assert tags == ["global_step4", "global_step7", "global_step8"]
+    assert read_latest(str(tmp_path)) == "global_step8"
+    for t in tags:
+        assert is_committed(str(tmp_path / t))
+
+
+def test_retention_sweeps_stale_torn_dirs(tmp_path):
+    engine = _engine(_config(async_save=True, num_of_version_in_retention=2))
+    fault_injection.crash_at("before_manifest")
+    engine.save_checkpoint(str(tmp_path), tag="torn1")
+    engine.flush_checkpoints()
+    fault_injection.clear()
+    for tag in ("a1", "a2", "a3"):
+        engine.save_checkpoint(str(tmp_path), tag=tag, blocking=True)
+    tags = sorted(d for d in os.listdir(str(tmp_path)) if (tmp_path / d).is_dir())
+    assert "torn1" not in tags  # crash garbage swept once superseded
+    assert tags == ["a2", "a3"]
+
+
+def test_retention_disabled_keeps_everything(tmp_path):
+    assert apply_retention(str(tmp_path), keep=0) == []
+
+
+def test_retention_never_deletes_user_named_tags(tmp_path):
+    """A user-named tag ('best') is an explicit decision: it neither gets
+    GC'd by the cadence window nor shrinks the window for real versions."""
+    engine = _engine(_config(num_of_version_in_retention=2))
+    engine.save_checkpoint(str(tmp_path), tag="best")
+    for i in range(2, 7):
+        engine.global_steps = i
+        engine.save_checkpoint(str(tmp_path))  # global_step2..6
+    tags = sorted(d for d in os.listdir(str(tmp_path)) if (tmp_path / d).is_dir())
+    assert tags == ["best", "global_step5", "global_step6"]
+
+
+# ----------------------------------------------------------------------
+# preemption + auto-save triggers
+# ----------------------------------------------------------------------
+def test_sigterm_produces_final_checkpoint_and_clean_exit(tmp_path):
+    engine = _engine(_config(preemption_save=True))
+    engine.set_checkpoint_dir(str(tmp_path))
+    engine.train_batch(_batch())
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)  # the handler only flips a flag
+        with pytest.raises(TrainingPreempted) as ei:
+            engine.train_batch(_batch(1))
+        assert ei.value.code == 0  # clean exit for the scheduler
+        tag = ei.value.tag
+        assert tag == "global_step2"
+        assert read_latest(str(tmp_path)) == tag
+        assert is_committed(str(tmp_path / tag), deep=True)
+        # exactly one checkpoint was produced
+        assert sorted(d for d in os.listdir(str(tmp_path)) if (tmp_path / d).is_dir()) == [tag]
+    finally:
+        engine.destroy()  # restores the previous SIGTERM disposition
+
+
+def test_autosave_interval_steps(tmp_path):
+    engine = _engine(_config(save_interval_steps=3))
+    engine.set_checkpoint_dir(str(tmp_path))
+    for i in range(7):
+        engine.train_batch(_batch(i))
+    engine.flush_checkpoints()
+    tags = sorted(d for d in os.listdir(str(tmp_path)) if (tmp_path / d).is_dir())
+    assert tags == ["global_step3", "global_step6"]
+    assert all(is_committed(str(tmp_path / t)) for t in tags)
+
+
+def test_autosave_async_failure_retries_promptly(tmp_path):
+    """An async commit that dies AFTER the cadence reset must be retried at
+    the next step boundary, not a full interval later."""
+    engine = _engine(_config(async_save=True, save_interval_steps=3))
+    engine.set_checkpoint_dir(str(tmp_path))
+    fault_injection.crash_at("before_manifest")
+    for i in range(3):
+        engine.train_batch(_batch(i))  # auto-save fires at step 3, writer dies
+    engine.flush_checkpoints()
+    assert engine._ckpt_saver.last_error is not None
+    assert read_latest(str(tmp_path)) is None
+    fault_injection.clear()
+    engine.train_batch(_batch(3))  # step 4: prompt retry, not step 6
+    engine.flush_checkpoints()
+    assert read_latest(str(tmp_path)) == "global_step4"
+
+
+def test_resume_does_not_immediately_autosave(tmp_path):
+    """Loading a checkpoint restarts the cadence from the resume step — no
+    redundant re-save of the state just loaded."""
+    engine = _engine(_config(save_interval_steps=3))
+    engine.set_checkpoint_dir(str(tmp_path))
+    for i in range(3):
+        engine.train_batch(_batch(i))
+    engine.flush_checkpoints()
+    assert read_latest(str(tmp_path)) == "global_step3"
+
+    eng2 = _engine(_config(save_interval_steps=3))
+    eng2.set_checkpoint_dir(str(tmp_path))
+    eng2.load_checkpoint(str(tmp_path))
+    eng2.train_batch(_batch(3))  # one step past the loaded save
+    eng2.flush_checkpoints()
+    assert read_latest(str(tmp_path)) == "global_step3"  # no redundant write
+    eng2.train_batch(_batch(4))
+    eng2.train_batch(_batch(5))  # three steps past the resume point
+    eng2.flush_checkpoints()
+    assert read_latest(str(tmp_path)) == "global_step6"
+
+
+def test_autosave_trigger_wall_clock():
+    clock = [0.0]
+    trig = AutoSaveTrigger(persistent_time_interval=100, clock=lambda: clock[0])
+    assert trig.enabled
+    assert not trig.should_save(1)
+    clock[0] = 99.0
+    assert not trig.should_save(2)
+    clock[0] = 100.0
+    assert trig.should_save(3)
+    trig.mark_saved(3)
+    clock[0] = 150.0
+    assert not trig.should_save(4)  # cadence reset by the save
+
+
+def test_nebula_block_arms_the_resilience_plane(tmp_path):
+    """nebula.* are live knobs now: async save + retention + auto-save dir
+    + preemption all flip on from the reference config block."""
+    groups.reset()
+    cfg = _config()
+    del cfg["checkpoint"]
+    cfg["nebula"] = {"enabled": True, "persistent_storage_path": str(tmp_path),
+                     "persistent_time_interval": 100, "num_of_version_in_retention": 3}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=cfg)
+    try:
+        assert engine.config.checkpoint_config.async_save
+        assert engine._ckpt_saver.retention == 3
+        assert engine._ckpt_save_dir == str(tmp_path)
+        assert engine._auto_save.persistent_time_interval == 100
+        assert engine._preemption is not None and not engine._preemption.requested
+        assert engine._resilience_active
+    finally:
+        engine.destroy()
+
+
+# ----------------------------------------------------------------------
+# auto-resume
+# ----------------------------------------------------------------------
+def test_run_resilient_resumes_from_last_valid_checkpoint(tmp_path):
+    """An injected worker failure resumes from the last durable tag and ends
+    with losses identical to an uninterrupted run."""
+    from deepspeed_tpu.runtime.resilience import run_resilient
+
+    ds_config = _config()
+    ds_config["elasticity"] = {"enabled": True, "max_train_batch_size": 8,
+                               "micro_batch_sizes": [1], "min_gpus": 1, "max_gpus": 64,
+                               "min_time": 0, "version": 0.2}
+
+    def uninterrupted():
+        engine = _engine(_config())
+        losses = [float(engine.train_batch(_batch(i))) for i in range(6)]
+        groups.reset()
+        return losses
+
+    want = uninterrupted()
+
+    state = {"attempt": 0}
+
+    def train_fn(batch_config, resume):
+        state["attempt"] += 1
+        assert batch_config["train_batch_size"] == 8
+        engine = _engine(_config())
+        tag, path = resume
+        start = 0
+        if tag is not None:
+            engine.load_checkpoint(str(tmp_path), tag=tag)
+            start = engine.global_steps
+        losses = []
+        for i in range(start, 6):
+            losses.append(float(engine.train_batch(_batch(i))))
+            if state["attempt"] == 1 and i == 2:
+                engine.save_checkpoint(str(tmp_path), blocking=True)  # durable at step 3
+                groups.reset()
+                raise RuntimeError("injected worker failure")
+        groups.reset()
+        return losses
+
+    tail = run_resilient(train_fn, ds_config, save_dir=str(tmp_path),
+                         max_restarts=2, restart_delay_s=0.0)
+    assert state["attempt"] == 2
+    # attempt 2 resumed at step 3: its losses must match the uninterrupted tail
+    np.testing.assert_allclose(tail, want[3:], rtol=1e-6, atol=0)
+
+
+def test_run_resilient_returns_preemption_cleanly(tmp_path):
+    from deepspeed_tpu.runtime.resilience import run_resilient
+
+    ds_config = _config()
+    ds_config["elasticity"] = {"enabled": True, "max_train_batch_size": 8,
+                               "micro_batch_sizes": [1], "min_gpus": 1, "max_gpus": 64,
+                               "min_time": 0, "version": 0.2}
+
+    def train_fn(batch_config, resume):
+        raise TrainingPreempted("global_step5")
+
+    out = run_resilient(train_fn, ds_config, save_dir=str(tmp_path), restart_delay_s=0.0)
+    assert isinstance(out, TrainingPreempted) and out.tag == "global_step5"
+
+
+def test_non_numeric_tag_does_not_outsort_step_tags(tmp_path):
+    """Tags order by manifest commit time, so a committed 'best' tag cannot
+    permanently occupy the newest slot over later global_stepN tags."""
+    engine = _engine(_config())
+    engine.save_checkpoint(str(tmp_path), tag="best")
+    engine.save_checkpoint(str(tmp_path), tag="global_step2")
+    os.remove(str(tmp_path / "latest"))  # force the recency scan
+    tag, _ = find_latest_valid(str(tmp_path))
+    assert tag == "global_step2"
+
+
+def test_flush_status_tracks_most_recent_save(tmp_path):
+    """One failed save must not poison flush() forever: status is reset by
+    the next submitted save."""
+    engine = _engine(_config(async_save=True))
+    fault_injection.crash_at("before_manifest")
+    engine.save_checkpoint(str(tmp_path), tag="doomed")
+    assert engine.flush_checkpoints() is False
+    fault_injection.clear()
+    engine.save_checkpoint(str(tmp_path), tag="fine")
+    assert engine.flush_checkpoints(raise_on_error=True) is True
+    assert read_latest(str(tmp_path)) == "fine"
+
+
+def test_find_latest_valid_skips_torn_tags(tmp_path):
+    engine = _engine(_config())
+    engine.save_checkpoint(str(tmp_path), tag="global_step1")
+    engine.save_checkpoint(str(tmp_path), tag="global_step2")
+    (tmp_path / "global_step2" / MANIFEST_FILE).unlink()  # torn
+    tag, path = find_latest_valid(str(tmp_path))
+    assert tag == "global_step1" and path.endswith("global_step1")
+
+
+# ----------------------------------------------------------------------
+# CI gate
+# ----------------------------------------------------------------------
+def test_check_ckpt_commit_gate():
+    from tools.check_ckpt_commit import check
+
+    assert check() == [], "latest-pointer writes / tag deletions outside runtime/resilience/saver.py"
+
+
+def test_check_ckpt_commit_catches_drift(tmp_path):
+    from tools.check_ckpt_commit import check
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "import os, shutil\n"
+        "def f(save_dir, tag, LATEST_FILE='latest'):\n"
+        "    with open(os.path.join(save_dir, LATEST_FILE), 'w') as fh:\n"
+        "        fh.write(tag)\n"
+        "    shutil.rmtree(os.path.join(save_dir, 'old_tag'))\n")
+    bad = check(str(pkg))
+    assert len(bad) == 2
+    assert any("latest" in b for b in bad) and any("rmtree" in b for b in bad)
+
+
+def test_check_ckpt_commit_catches_update_mode_and_rename(tmp_path):
+    """The gate's evasion holes: writable 'r+' (no w/a/x) and renaming a tmp
+    file onto the pointer without any open() at all."""
+    from tools.check_ckpt_commit import check
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "import os\n"
+        "def g(save_dir, tag):\n"
+        "    with open(os.path.join(save_dir, 'latest'), 'r+') as fh:\n"
+        "        fh.write(tag)\n"
+        "    os.replace(os.path.join(save_dir, tag + '.tmp'), os.path.join(save_dir, 'latest'))\n")
+    bad = check(str(pkg))
+    assert len(bad) == 2
+    assert any("pointer write" in b for b in bad) and any("pointer rename" in b for b in bad)

@@ -1,0 +1,121 @@
+"""Static check: the checkpoint commit protocol has ONE implementation.
+
+The crash-consistency guarantee (``latest`` only ever references a
+manifest-committed tag; superseded tags are deleted only after the newer
+commit landed) holds because every pointer flip and every tag deletion goes
+through ``deepspeed_tpu/runtime/resilience/saver.py``. A second writer —
+an engine "quick fix" that re-grows an inline ``open(latest, 'w')``, a tool
+that rmtree's checkpoint dirs — silently reopens the torn-checkpoint window
+the subsystem exists to close. This AST walk (no package imports, runs
+anywhere) flags:
+
+* any ``open(...)`` call in a writable mode (``w``/``a``/``x``/``+``, or a
+  non-literal mode) whose path expression mentions ``LATEST_FILE`` or the
+  literal ``"latest"``;
+* any ``os.replace`` / ``os.rename`` whose arguments mention the same (the
+  tmp+rename idiom is exactly how the real commit path flips the pointer);
+* any ``shutil.rmtree`` / ``os.rmdir`` / ``os.removedirs`` call;
+
+outside the allowed commit-path module. A tier-1 test
+(``tests/test_resilience.py``) runs it on every CI pass, the same pattern as
+``check_timed_ops.py`` / ``check_data_paths.py``.
+"""
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+DEFAULT_PKG = os.path.join(REPO_ROOT, "deepspeed_tpu")
+
+# the one module allowed to flip `latest` and delete tags
+ALLOWED = ("runtime/resilience/saver.py", )
+
+_WRITE_MODES = ("w", "a", "x", "+")  # '+' upgrades any mode to writable
+_RM_CALLS = {("shutil", "rmtree"), ("os", "rmdir"), ("os", "removedirs")}
+_RENAME_CALLS = {("os", "replace"), ("os", "rename")}
+
+
+def _mentions_latest(node):
+    """True if the expression subtree references LATEST_FILE or 'latest'."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == "LATEST_FILE":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "LATEST_FILE":
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == "latest":
+            return True
+    return False
+
+
+def _open_mode(call):
+    """The literal mode of an open() call, or None when non-literal."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None  # dynamic mode: treat as suspect
+
+
+def _violations_in(path, rel):
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "open":
+            mode = _open_mode(node)
+            writes = mode is None or any(m in mode for m in _WRITE_MODES)
+            if writes and any(_mentions_latest(a) for a in list(node.args) + [kw.value for kw in node.keywords]):
+                out.append(f"{rel}:{node.lineno}: 'latest' pointer write outside the "
+                           f"resilience commit path")
+        elif isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            if (func.value.id, func.attr) in _RM_CALLS:
+                out.append(f"{rel}:{node.lineno}: checkpoint-tag deletion "
+                           f"({func.value.id}.{func.attr}) outside the resilience commit path")
+            elif ((func.value.id, func.attr) in _RENAME_CALLS
+                  and any(_mentions_latest(a) for a in list(node.args) + [kw.value for kw in node.keywords])):
+                out.append(f"{rel}:{node.lineno}: 'latest' pointer rename "
+                           f"({func.value.id}.{func.attr}) outside the resilience commit path")
+    return out
+
+
+def check(pkg_root=DEFAULT_PKG):
+    """Return violations: `latest` writes / tag deletions outside ALLOWED."""
+    violations = []
+    for root, _dirs, files in os.walk(pkg_root):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            full = os.path.join(root, fname)
+            rel = os.path.relpath(full, pkg_root).replace(os.sep, "/")
+            if rel in ALLOWED:
+                continue
+            violations.extend(_violations_in(full, rel))
+    return violations
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    pkg = argv[0] if argv else DEFAULT_PKG
+    bad = check(pkg)
+    if bad:
+        print("check_ckpt_commit: commit-protocol violations:")
+        for v in bad:
+            print(f"  {v}")
+        return 1
+    print("check_ckpt_commit: all `latest` writes and tag deletions live in the "
+          "resilience commit path")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
