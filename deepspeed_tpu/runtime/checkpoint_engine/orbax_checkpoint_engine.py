@@ -74,6 +74,15 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         arrays = {}
         arrays_path = os.path.join(path, "arrays")
         expects_arrays = template is None or bool(_split_state(template)[0])
+        if not os.path.exists(meta_path) and os.path.exists(arrays_path):
+            # the inverse torn shape: save() always writes the meta sidecar
+            # (even when empty), so arrays without it mean a crash between
+            # the tensorstore finalize and the meta write. Loading it
+            # silently hands back a tree with step counters/schedulers reset
+            # to zero on old weights.
+            raise CheckpointCorruptError(
+                f"{path}: 'arrays' tree present but meta sidecar missing — partial "
+                f"checkpoint (crash mid-write?); refusing to return a half-tree")
         if not os.path.exists(arrays_path):
             if expects_arrays and not meta:
                 # neither payload half exists: a torn/never-committed dir (or

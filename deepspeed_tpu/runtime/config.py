@@ -136,6 +136,10 @@ class CheckpointConfig(DeepSpeedConfigModel):
     # persistent_storage_path wins when set); engine.set_checkpoint_dir()
     # overrides at runtime
     auto_save_dir: Optional[str] = None
+    # record per-file sha256 in the commit manifest (deep verification of
+    # bit-rot). Costs a full read-back of the payload per save — turn off for
+    # huge checkpoints where the size-only manifest check is enough
+    manifest_digests: bool = True
 
 
 class PipelineConfig(DeepSpeedConfigModel):

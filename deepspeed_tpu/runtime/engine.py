@@ -367,7 +367,8 @@ class DeepSpeedEngine:
         self._ckpt_saver = ResilientSaver(self.checkpoint_engine,
                                           retention=ckpt_cfg.num_of_version_in_retention,
                                           keep_every_n_steps=ckpt_cfg.keep_every_n_steps,
-                                          is_lead=dist.get_rank() == 0)
+                                          is_lead=dist.get_rank() == 0,
+                                          digests=ckpt_cfg.manifest_digests)
         self._auto_save = AutoSaveTrigger(
             save_interval_steps=ckpt_cfg.save_interval_steps,
             persistent_time_interval=(config.nebula_config.persistent_time_interval
@@ -1769,7 +1770,15 @@ class DeepSpeedEngine:
         crash mid-write leaves ``latest`` on the previous durable tag. A
         subsequent save/:meth:`flush_checkpoints`/:meth:`destroy` joins the
         in-flight write. Returns False (and leaves ``latest`` untouched) when
-        the engine refuses commit on the blocking path.
+        the engine refuses commit on the blocking path, or when the payload
+        write fails on the multi-host async path (where it runs at the step
+        boundary and only commit/manifest I/O is backgrounded).
+
+        True on the async path means *submitted*, not durable: the auto-save
+        plane retries failed async commits on its own, but any other caller
+        must check :meth:`flush_checkpoints` (or ``_ckpt_saver.last_error``)
+        before relying on the tag — an async failure is never re-raised into
+        the step loop.
         """
         if blocking is None:
             blocking = not self.config.checkpoint_config.async_save
@@ -1781,19 +1790,54 @@ class DeepSpeedEngine:
         with self._tracer.span("checkpoint/save", tid="checkpoint", tag=str(tag),
                                blocking=bool(blocking)):
             state = self._ckpt_state(client_state)
+            # cross-rank success vote (single-host: gathers over one rank and
+            # degenerates to the local result). It replaces a trailing
+            # dist.barrier(): the vote itself holds every rank at the same
+            # point, and unlike a barrier it is reached on EVERY path — a
+            # rank whose save raises still votes False before unwinding,
+            # where skipping a barrier would hang its peers for good.
+            gate = lambda local_ok: all(dist.all_gather_host(bool(local_ok)))
             if blocking:
+                # blocking saves vote twice: on the engine commit result
+                # (durability) just before the manifest/`latest` flip — one
+                # rank's failed payload or refused commit withholds
+                # advertisement everywhere — and again after the flip, so no
+                # rank returns (and possibly exits, taking the gang with it)
+                # while the lead is still writing the manifest
                 ok = self._ckpt_saver.save(state, save_dir, str(tag), blocking=True,
-                                           save_latest=save_latest)
-                dist.barrier()
-            else:
+                                           save_latest=save_latest, commit_gate=gate)
+            elif jax.process_count() == 1:
                 # step-boundary host snapshot: after this, training may
                 # mutate engine state freely while the writer persists the
-                # snapshot (single-process only — multi-host arrays are not
-                # fully addressable, and orbax snapshots them itself)
-                if jax.process_count() == 1:
-                    state = self._host_snapshot(state)
+                # snapshot
+                state = self._host_snapshot(state)
                 ok = self._ckpt_saver.save(state, save_dir, str(tag), blocking=False,
                                            save_latest=save_latest)
+            else:
+                # multi-host arrays are not fully addressable, so the host
+                # snapshot above can't be taken here — the orbax save itself
+                # performs it. That payload write runs synchronously at the
+                # step boundary: handing live jax.Array leaves to the writer
+                # thread would race the next train_batch's buffer donation
+                # (donate_argnums=(0,)), and orbax's save-side cross-process
+                # sync must not interleave with training collectives from a
+                # non-main thread. Only host-side I/O (commit join, manifest,
+                # `latest`, retention GC) is left to the background writer.
+                # The gate here votes on payload *submission* (all the step
+                # boundary can observe: with an async engine, save() returns
+                # once the snapshot is taken and the write submitted) — a
+                # rank whose snapshot fails withholds every rank's commit
+                # stage, and the all-gather holds all ranks at the boundary
+                # until every snapshot is down. Write-side divergence AFTER
+                # submission fails closed in the background commit instead:
+                # orbax's AsyncCheckpointer finalize runs its own cross-
+                # process sync (via the jax.distributed client — safe off
+                # the main thread), so a peer's failed write surfaces as
+                # wait_until_finished raising on every rank -> commit()
+                # False -> no manifest, no `latest` flip.
+                ok = self._ckpt_saver.save(
+                    state, save_dir, str(tag), blocking=False,
+                    save_latest=save_latest, payload_in_caller=True, commit_gate=gate)
         if self._metrics.enabled:
             self._metrics.histogram("train/ckpt_blocked_ms").observe(
                 (time.perf_counter() - t0) * 1e3)
@@ -1801,7 +1845,16 @@ class DeepSpeedEngine:
             # a refused commit must NOT reset the auto-save cadence — the
             # next retry should come promptly, not a full interval away
             self._auto_save.mark_saved(self.global_steps)
-            log_dist(f"saved checkpoint {path} (blocking={bool(blocking)})", ranks=[0])
+            if blocking:
+                log_dist(f"saved checkpoint {path}", ranks=[0])
+            else:
+                # submission, not durability: the writer logs commit/failure
+                # when it happens. The auto-save plane retries a failed async
+                # commit itself (see _poll_resilience); any other caller must
+                # check flush_checkpoints() before relying on the tag.
+                log_dist(f"submitted async checkpoint {path} (durable only after the "
+                         f"writer commits; flush_checkpoints() reports the outcome)",
+                         ranks=[0])
         else:
             logger.error(f"checkpoint {path} NOT committed; 'latest' untouched")
         return ok
@@ -1860,8 +1913,17 @@ class DeepSpeedEngine:
             tag = None
             if self._ckpt_save_dir is not None:
                 tag = f"global_step{self.global_steps}"
-                if not self.save_checkpoint(self._ckpt_save_dir, tag=tag, blocking=True):
-                    tag = None  # never advertise a refused commit as the resume point
+                # the grace window is for a durable EXIT, not for crashing: a
+                # raising final save (disk full, backend gone) must still end
+                # in the clean TrainingPreempted exit so the scheduler — and
+                # run_resilient — resume from the previous durable tag
+                try:
+                    if not self.save_checkpoint(self._ckpt_save_dir, tag=tag, blocking=True):
+                        tag = None  # never advertise a refused commit as the resume point
+                except Exception as e:
+                    logger.error(f"preemption: final save raised {e!r}; exiting cleanly "
+                                 f"on the previous durable tag")
+                    tag = None
             self.flush_checkpoints()
             if self._tracer.enabled:
                 self._tracer.instant("preemption_exit", tid="checkpoint")
@@ -1873,7 +1935,14 @@ class DeepSpeedEngine:
                              "resume will use the previous durable tag")
             raise TrainingPreempted(tag)
         if due and self._ckpt_save_dir is not None:
-            self.save_checkpoint(self._ckpt_save_dir)
+            try:
+                self.save_checkpoint(self._ckpt_save_dir)
+            except Exception as e:
+                # a failed cadence save must not kill training — the cadence
+                # was not reset (mark_saved only runs on success), so the
+                # next step-boundary poll retries promptly
+                logger.error(f"auto-save failed: {e!r}; training continues, "
+                             f"will retry at the next step boundary")
 
     def _checkpoint_tag_validation(self, tag):
         """All ranks must agree on the tag (reference ``engine.py:3052``)."""

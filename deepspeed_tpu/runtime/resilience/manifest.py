@@ -62,14 +62,21 @@ def tree_spec(state):
     return spec
 
 
-def build_manifest(ckpt_path, tag, state=None):
-    """Hash every payload file already on disk under ``ckpt_path``."""
+def build_manifest(ckpt_path, tag, state=None, tree=None, digests=True):
+    """Inventory every payload file already on disk under ``ckpt_path``.
+
+    ``tree`` is a precomputed :func:`tree_spec` — the async commit stage
+    passes one so the manifest build never touches ``state`` (whose leaves
+    may be donated device buffers by the time the writer thread runs).
+    ``digests=False`` skips the per-file sha256 (which costs a full
+    read-back of the payload); the size-only manifest still gates commit,
+    and deep verifies just skip the digest comparison for those entries."""
     files = {}
     total = 0
     for rel in _iter_files(ckpt_path):
         full = os.path.join(ckpt_path, rel)
         n = os.path.getsize(full)
-        files[rel] = {"bytes": n, "sha256": _sha256(full)}
+        files[rel] = {"bytes": n, "sha256": _sha256(full)} if digests else {"bytes": n}
         total += n
     return {
         "version": MANIFEST_VERSION,
@@ -77,7 +84,7 @@ def build_manifest(ckpt_path, tag, state=None):
         "created_unix": time.time(),
         "total_bytes": total,
         "files": files,
-        "tree": tree_spec(state) if state is not None else None,
+        "tree": tree if tree is not None else (tree_spec(state) if state is not None else None),
     }
 
 
@@ -122,7 +129,7 @@ def verify_manifest(ckpt_path, deep=True):
         if size != meta.get("bytes"):
             raise CheckpointCorruptError(
                 f"{ckpt_path}: {rel} is {size}B, manifest says {meta.get('bytes')}B")
-        if deep and _sha256(full) != meta.get("sha256"):
+        if deep and meta.get("sha256") and _sha256(full) != meta["sha256"]:
             raise CheckpointCorruptError(f"{ckpt_path}: digest mismatch on {rel}")
     return man
 

@@ -27,12 +27,30 @@ class PreemptionHandler:
         self._event = threading.Event()
         self._prev = {}
         self._installed = False
+        self._forwarding = False
 
     def install(self):
         if self._installed:
             return self
         for sig in self.signals:
-            self._prev[sig] = signal.signal(sig, self._on_signal)
+            prev = signal.signal(sig, self._on_signal)
+            if sig in self._prev:
+                # re-install after a non-LIFO uninstall: a successor may
+                # still chain to our trap. Overwriting _prev with it would
+                # both cycle the chain (a._prev -> b, b._prev -> a) and drop
+                # our ORIGINAL predecessor — a third-party trap whose
+                # cleanup would silently never run again. Walk the successor
+                # chain and hand whoever points at us our old predecessor,
+                # straightening a -> successors -> original.
+                node, seen = getattr(prev, "__self__", None), set()
+                while isinstance(node, PreemptionHandler) and id(node) not in seen:
+                    seen.add(id(node))
+                    nxt = node._prev.get(sig)
+                    if nxt == self._on_signal:
+                        node._prev[sig] = self._prev[sig]
+                        break
+                    node = getattr(nxt, "__self__", None)
+            self._prev[sig] = prev
         self._installed = True
         return self
 
@@ -41,17 +59,51 @@ class PreemptionHandler:
             return
         for sig, prev in self._prev.items():
             try:
-                signal.signal(sig, prev)
+                # only restore when the disposition is still OUR trap: if a
+                # later handler chained on top of us, restoring `prev` would
+                # silently detach it (non-LIFO teardown) — leave theirs in
+                # place; its chain through us dead-ends harmlessly
+                if signal.getsignal(sig) == self._on_signal:
+                    signal.signal(sig, prev)
+                else:
+                    logger.warning(f"preemption trap for signal {sig} was overridden after "
+                                   f"install; leaving the current handler in place")
             except (ValueError, TypeError):  # non-main thread / exotic prev
                 pass
-        self._prev = {}
+        # keep self._prev: if a later handler's chain still points here (it
+        # restored us as ITS prev), _on_signal forwards through it
         self._installed = False
 
     def _on_signal(self, signum, frame):
-        self.request(reason=f"signal {signum}")
-        prev = self._prev.get(signum)
-        if callable(prev):  # chain: whoever trapped SIGTERM before us still runs
-            prev(signum, frame)
+        if self._forwarding:
+            # chain cycle: re-installing after a non-LIFO uninstall can make
+            # two handlers each other's predecessor (a._prev -> b, b._prev
+            # -> a) — the outer frame of this delivery already ran us, so
+            # forwarding again would recurse until RecursionError fires
+            # inside the signal handler
+            return
+        self._forwarding = True
+        try:
+            if not self._installed:
+                # uninstalled, but a successor's restored chain still reaches
+                # us: act as a transparent link — forward to whoever preceded
+                # us, or re-deliver with the default disposition so SIGTERM
+                # still kills
+                prev = self._prev.get(signum)
+                if callable(prev):
+                    prev(signum, frame)
+                elif prev is signal.SIG_IGN:
+                    pass  # the disposition we replaced ignored this signal
+                else:
+                    signal.signal(signum, signal.SIG_DFL)
+                    signal.raise_signal(signum)
+                return
+            self.request(reason=f"signal {signum}")
+            prev = self._prev.get(signum)
+            if callable(prev):  # chain: whoever trapped SIGTERM before us still runs
+                prev(signum, frame)
+        finally:
+            self._forwarding = False
 
     def request(self, reason="api"):
         """Arm the preemption flag (signal handler or direct test call)."""

@@ -3,8 +3,11 @@
 enforces this statically, the way ``check_timed_ops.py`` pins collectives to
 ``@timed_op``).
 
-Commit protocol per save (all stages in the writer thread on the async
-path; :mod:`fault_injection` points mark the stage boundaries)::
+Commit protocol per save (:mod:`fault_injection` points mark the stage
+boundaries; on the async path the commit stages run in the writer thread,
+and the payload stage does too unless the caller keeps it — the
+``payload_in_caller`` multi-host shape, where device arrays must be
+persisted before the step loop donates them)::
 
     payload (engine.save -> arrays/ + meta.pkl)     [crash here: no manifest]
     engine.commit()  -> must return True            [False: save aborted]
@@ -26,13 +29,17 @@ import time
 
 from . import fault_injection
 from .errors import CheckpointCorruptError
-from .manifest import build_manifest, is_committed, read_manifest, write_manifest, MANIFEST_FILE
+from .manifest import (build_manifest, is_committed, read_manifest, tree_spec,
+                       write_manifest, MANIFEST_FILE)
 from ...monitor.metrics import get_metrics
 from ...monitor.trace import get_tracer
 from ...utils.logging import logger
 
 LATEST_FILE = "latest"  # reference `latest` tag file semantics
-_STEP_RE = re.compile(r"(\d+)\s*$")
+# exactly the auto-save naming scheme (engine.save_checkpoint's default tag)
+# — a user-named tag that merely ends in digits (`best2`, `release_v3`,
+# `exp_2024`) must NOT compete in the retention window
+_STEP_RE = re.compile(r"^global_step(\d+)$")
 
 
 def read_latest(save_dir):
@@ -53,11 +60,13 @@ def list_tags(save_dir):
             if os.path.isdir(os.path.join(save_dir, d))]
 
 
-def tag_step(save_dir, tag):
-    """Trailing integer of a step-style tag (``global_step12`` -> 12), or
-    None for non-numeric tags (``best``) — used only by the
-    ``keep_every_n_steps`` archival rule."""
-    m = _STEP_RE.search(str(tag))
+def tag_step(tag):
+    """Step number of an auto-save-style tag (``global_step12`` -> 12), or
+    None for anything else. Only tags the auto-save scheme produced compete
+    in the newest-N retention window and the ``keep_every_n_steps`` archival
+    rule; every other tag — including one that happens to end in digits —
+    is a user-named checkpoint and protected from cadence GC."""
+    m = _STEP_RE.match(str(tag))
     return int(m.group(1)) if m else None
 
 
@@ -153,14 +162,14 @@ def apply_retention(save_dir, keep, keep_every_n_steps=0, protect=()):
     for tag in list_tags(save_dir):
         (committed if is_committed(os.path.join(save_dir, tag)) else torn).append(tag)
     committed.sort(key=lambda t: tag_order_key(save_dir, t), reverse=True)
-    # only step-style tags compete for the newest-N window; named tags are
-    # kept unconditionally (and don't shrink the window for real versions)
-    step_tags = [t for t in committed if tag_step(save_dir, t) is not None]
+    # only auto-save-style tags compete for the newest-N window; named tags
+    # are kept unconditionally (and don't shrink the window for real versions)
+    step_tags = [t for t in committed if tag_step(t) is not None]
     keep_set = set(step_tags[:keep]) | protect
-    keep_set.update(t for t in committed if tag_step(save_dir, t) is None)
+    keep_set.update(t for t in committed if tag_step(t) is None)
     if keep_every_n_steps > 0:
         for tag in step_tags:
-            if tag_step(save_dir, tag) % keep_every_n_steps == 0:
+            if tag_step(tag) % keep_every_n_steps == 0:
                 keep_set.add(tag)
     newest_key = tag_order_key(save_dir, committed[0]) if committed else None
     deleted = []
@@ -187,11 +196,13 @@ class ResilientSaver:
     in-flight save first, so at most one write is ever outstanding and HBM
     holds at most one extra host snapshot)."""
 
-    def __init__(self, checkpoint_engine, retention=0, keep_every_n_steps=0, is_lead=True):
+    def __init__(self, checkpoint_engine, retention=0, keep_every_n_steps=0, is_lead=True,
+                 digests=True):
         self.checkpoint_engine = checkpoint_engine
         self.retention = int(retention)
         self.keep_every_n_steps = int(keep_every_n_steps)
         self.is_lead = is_lead
+        self.digests = bool(digests)
         self._thread = None
         self._lock = threading.Lock()
         self.last_error = None
@@ -199,19 +210,76 @@ class ResilientSaver:
         self.saves_failed = 0
 
     # ------------------------------------------------------------------
-    def save(self, state, save_dir, tag, blocking=True, save_latest=True):
+    def save(self, state, save_dir, tag, blocking=True, save_latest=True,
+             payload_in_caller=False, commit_gate=None):
         """Write ``state`` under ``save_dir/tag``. Blocking mode returns the
         commit result; async mode returns True immediately after handing the
-        (already host-resident) tree to the writer thread. The lock
-        serializes concurrent submitters (depth-1 bound: join the in-flight
-        writer first, exactly one thread ever owns a write)."""
+        (already host-resident) tree to the writer thread.
+
+        ``payload_in_caller`` is the multi-host async shape: the payload
+        write (engine create/save — the device-to-host snapshot plus any
+        save-side cross-process sync) runs synchronously in the caller's
+        thread at the step boundary, and the background thread is restricted
+        to host-side I/O (commit join, manifest, ``latest``, retention GC).
+        Handing live device arrays to the writer thread would race the step
+        loop's buffer donation, and the engine's save-side collectives must
+        not interleave with training collectives from another thread. A
+        payload failure is reported synchronously (returns False, no thread
+        spawned).
+
+        ``commit_gate`` is the cross-rank success vote: called in the
+        caller's (main) thread — it runs a collective, which may not
+        interleave with training collectives from another thread — and only
+        a unanimous True proceeds. Success is process-local, so without the
+        vote the lead would manifest/advertise a tag that failed on a peer —
+        and the manifest would verify, because it inventories whatever IS on
+        disk. Every rank votes even when its own stage failed (the peers are
+        already blocked in the same collective), including ranks that are
+        about to unwind with an exception. Placement differs by mode:
+        blocking saves vote twice — on the engine commit result (durability)
+        just before the manifest stage, then again after the lead's
+        manifest/``latest`` flip (advertisement), so no rank returns from a
+        final save while the lead is still writing; the
+        ``payload_in_caller`` async shape votes once, on payload
+        *submission* right after the payload stage — the engine's own async
+        commit (e.g. orbax's cross-process finalize) is what fails the
+        background commit closed if a rank's write later diverges.
+
+        The lock serializes concurrent submitters (depth-1 bound: join the
+        in-flight writer first, exactly one thread ever owns a write)."""
         with self._lock:
             self._join_locked()
             self.last_error = None  # status tracks the save being started
             if blocking:
-                return self._write_and_commit(state, save_dir, tag, save_latest)
-            self._thread = threading.Thread(target=self._background_write,
-                                            args=(state, save_dir, tag, save_latest),
+                return self._write_and_commit(state, save_dir, tag, save_latest,
+                                              commit_gate=commit_gate)
+            if payload_in_caller:
+                t0 = time.perf_counter()
+                local_ok, spec = True, None
+                try:
+                    spec = self._write_payload(state, save_dir, tag)
+                except Exception as e:
+                    local_ok = False
+                    self._record_failure(e, f"checkpoint payload write failed for tag "
+                                            f"{tag}: {e!r}; 'latest' left untouched")
+                if commit_gate is not None and not commit_gate(local_ok):
+                    if local_ok:
+                        self._record_failure(
+                            RuntimeError(f"checkpoint payload for tag {tag} failed on a "
+                                         f"peer rank"),
+                            f"checkpoint payload for tag {tag} failed on a peer rank; "
+                            f"commit withheld, 'latest' left untouched")
+                    self._abandon_payload(tag)
+                    return False
+                if not local_ok:
+                    self._abandon_payload(tag)
+                    return False
+                target = self._background_commit
+                args = (save_dir, tag, save_latest, spec, t0)
+            else:
+                target = self._background_write
+                args = (state, save_dir, tag, save_latest)
+            self._thread = threading.Thread(target=target, args=args,
                                             name=f"ckpt-writer-{tag}", daemon=True)
             self._thread.start()
             return True
@@ -237,57 +305,148 @@ class ResilientSaver:
         t = self._thread
         return t is not None and t.is_alive()
 
+    def _record_failure(self, err=None, msg=None):
+        """Failed-save accounting, in one place. Exception paths pass the
+        exception but no ``msg`` — the raise itself reaches the blocking
+        caller's log, and on background paths ``_run_writer`` logs it; but
+        ``last_error`` must be set regardless, so a caller that caught (or
+        never saw) the raise still gets the truth from ``flush()``."""
+        self.saves_failed += 1
+        get_metrics().counter("checkpoint/saves_failed").inc()
+        if err is not None:
+            self.last_error = err
+        if msg:
+            logger.error(msg)
+
+    def _abandon_payload(self, tag):
+        """Join (and discard) an already-submitted engine write whose commit
+        stage was withheld — gate veto or local payload failure. An async
+        engine otherwise still owns an in-flight write, and the next save's
+        submit would collide with it; the tag is never advertised either
+        way."""
+        try:
+            self.checkpoint_engine.commit(tag)
+        except Exception:
+            pass  # the abandoned write's error must not mask the recorded one
+
     # ------------------------------------------------------------------
     def _background_write(self, state, save_dir, tag, save_latest):
+        self._run_writer(tag, lambda: self._write_and_commit(state, save_dir, tag, save_latest))
+
+    def _background_commit(self, save_dir, tag, save_latest, spec, t0):
+        self._run_writer(tag, lambda: self._commit(save_dir, tag, save_latest, spec, t0))
+
+    def _run_writer(self, tag, fn):
         tracer = get_tracer()
         t0 = time.perf_counter()
         try:
-            ok = self._write_and_commit(state, save_dir, tag, save_latest)
+            ok = fn()
             if tracer.enabled:
                 tracer.complete("checkpoint/async_write", t0, time.perf_counter() - t0,
                                 tid="checkpoint", args={"tag": str(tag), "committed": bool(ok)})
         except BaseException as e:  # noqa: BLE001 — a dead writer must never kill training
-            self.last_error = e  # failure counters already bumped in _write_and_commit
+            self.last_error = e  # failure counters already bumped in the commit path
             if tracer.enabled:
                 tracer.complete("checkpoint/async_write", t0, time.perf_counter() - t0,
                                 tid="checkpoint", args={"tag": str(tag), "error": repr(e)})
             logger.error(f"async checkpoint writer died for tag {tag}: {e!r}; "
                          f"'latest' still references the previous durable tag")
 
-    def _write_and_commit(self, state, save_dir, tag, save_latest):
+    def _write_payload(self, state, save_dir, tag):
+        """Payload stage: engine create + save. Returns the manifest tree
+        spec, computed here so the commit stage never touches ``state`` — on
+        the payload-in-caller path the leaves are live device arrays that
+        training donates as soon as the caller returns."""
+        path = os.path.join(save_dir, str(tag))
+        ctx = {"path": path, "tag": str(tag)}
+        fault_injection.fire("before_arrays", ctx)
+        self.checkpoint_engine.create(tag)
+        self.checkpoint_engine.save(state, path)
+        fault_injection.fire("after_arrays", ctx)
+        return tree_spec(state)
+
+    def _write_and_commit(self, state, save_dir, tag, save_latest, commit_gate=None):
         """The one commit path (see module docstring for the protocol)."""
+        t0 = time.perf_counter()
+        try:
+            spec = self._write_payload(state, save_dir, tag)
+        except Exception as e:
+            # record even though the raise carries the cause: a blocking
+            # caller that catches it may still consult flush()/last_error
+            self._record_failure(e)
+            if commit_gate is not None:
+                # the peers are already blocked in the vote collective — a
+                # raising rank must still cast its (False) vote before the
+                # exception unwinds, or every other rank hangs
+                commit_gate(False)
+            raise
+        return self._commit(save_dir, tag, save_latest, spec, t0,
+                            commit_gate=commit_gate)
+
+    def _commit(self, save_dir, tag, save_latest, spec, t0, commit_gate=None):
+        """Commit stage: engine commit -> durability vote (blocking mode) ->
+        manifest -> ``latest`` -> retention GC -> advertisement vote
+        (blocking mode). Without a gate this is host-side I/O only (plus the
+        engine's async-write join) — safe off the main thread even when the
+        payload was written elsewhere; a gate is only ever passed on the
+        blocking path, where this runs in the caller's thread."""
         path = os.path.join(save_dir, str(tag))
         ctx = {"path": path, "tag": str(tag)}
         metrics = get_metrics()
-        t0 = time.perf_counter()
         try:
-            fault_injection.fire("before_arrays", ctx)
-            self.checkpoint_engine.create(tag)
-            self.checkpoint_engine.save(state, path)
-            fault_injection.fire("after_arrays", ctx)
-            ok = self.checkpoint_engine.commit(tag)
+            try:
+                local_ok = bool(self.checkpoint_engine.commit(tag))
+            except Exception:
+                if commit_gate is not None:
+                    # vote False before unwinding — peers are in the collective
+                    commit_gate(False)
+                raise
+            ok = commit_gate(local_ok) if commit_gate is not None else local_ok
             if not ok:
-                self.saves_failed += 1
-                self.last_error = RuntimeError(
-                    f"checkpoint engine refused commit for tag {tag}")
-                metrics.counter("checkpoint/saves_failed").inc()
-                logger.error(f"checkpoint engine refused commit for tag {tag}; "
-                             f"'latest' left untouched")
+                if local_ok:
+                    self._record_failure(
+                        RuntimeError(f"checkpoint for tag {tag} failed on a peer rank"),
+                        f"checkpoint for tag {tag} failed on a peer rank; commit "
+                        f"withheld, 'latest' left untouched")
+                else:
+                    self._record_failure(
+                        RuntimeError(f"checkpoint engine refused commit for tag {tag}"),
+                        f"checkpoint engine refused commit for tag {tag}; 'latest' "
+                        f"left untouched")
                 return False
             if self.is_lead:
-                fault_injection.fire("before_manifest", ctx)
-                man = build_manifest(path, tag, state=state)
-                write_manifest(path, man)
-                fault_injection.fire("after_manifest", ctx)
-                metrics.counter("checkpoint/bytes_written").inc(man["total_bytes"])
-                if save_latest:
-                    fault_injection.fire("before_latest", ctx)
-                    write_latest(save_dir, tag)
-                apply_retention(save_dir, self.retention, self.keep_every_n_steps,
-                                protect=(str(tag), ))
-        except Exception:
-            self.saves_failed += 1
-            metrics.counter("checkpoint/saves_failed").inc()
+                try:
+                    fault_injection.fire("before_manifest", ctx)
+                    man = build_manifest(path, tag, tree=spec, digests=self.digests)
+                    write_manifest(path, man)
+                    fault_injection.fire("after_manifest", ctx)
+                    metrics.counter("checkpoint/bytes_written").inc(man["total_bytes"])
+                    if save_latest:
+                        fault_injection.fire("before_latest", ctx)
+                        write_latest(save_dir, tag)
+                    apply_retention(save_dir, self.retention, self.keep_every_n_steps,
+                                    protect=(str(tag), ))
+                except Exception:
+                    if commit_gate is not None:
+                        # cast the advertisement vote (False) before
+                        # unwinding — the peers are waiting in it
+                        commit_gate(False)
+                    raise
+            if commit_gate is not None and not commit_gate(True):
+                # advertisement vote: holds every rank until the lead's
+                # manifest/`latest` flip is durable — a rank returning from a
+                # final (preemption) save early can get the lead gang-killed
+                # mid-manifest after this rank already advertised the tag as
+                # its resume point. A False here is only reachable on
+                # non-lead ranks, when the lead's flip failed.
+                self._record_failure(
+                    RuntimeError(f"checkpoint manifest/'latest' flip for tag {tag} "
+                                 f"failed on the lead rank"),
+                    f"checkpoint manifest/'latest' flip for tag {tag} failed on the "
+                    f"lead rank; tag not advertised")
+                return False
+        except Exception as e:
+            self._record_failure(e)
             raise
         self.saves_committed += 1
         metrics.counter("checkpoint/saves_committed").inc()

@@ -173,6 +173,29 @@ def test_missing_arrays_dir_raises_corrupt(tmp_path):
         engine.load_checkpoint(str(tmp_path), tag="v1", fallback_to_valid=False)
 
 
+def test_missing_meta_sidecar_raises_corrupt(tmp_path):
+    """The inverse torn shape: arrays finalized but the meta sidecar never
+    landed (crash before the manifest commit too). Loading it silently would
+    hand back step counters/schedulers reset to zero on old weights."""
+    engine = _engine(_config())
+    engine.save_checkpoint(str(tmp_path), tag="v1")
+    os.remove(str(tmp_path / "v1" / "meta.pkl"))
+    os.remove(str(tmp_path / "v1" / MANIFEST_FILE))  # manifest-less legacy shape
+    with pytest.raises(CheckpointCorruptError):
+        engine.load_checkpoint(str(tmp_path), tag="v1", fallback_to_valid=False)
+
+
+def test_manifest_digests_opt_out(tmp_path):
+    """checkpoint.manifest_digests=False skips the per-file sha256 (a full
+    payload read-back per save); size gating and deep verify still work."""
+    engine = _engine(_config(manifest_digests=False))
+    engine.save_checkpoint(str(tmp_path), tag="v1")
+    man = verify_manifest(str(tmp_path / "v1"), deep=True)  # skips absent digests
+    assert man["files"] and all("sha256" not in f for f in man["files"].values())
+    path, _ = engine.load_checkpoint(str(tmp_path), tag="v1")
+    assert path.endswith("v1")
+
+
 def test_save_failure_does_not_commit(tmp_path, monkeypatch):
     engine = _engine(_config())
     engine.save_checkpoint(str(tmp_path), tag="ok")
@@ -185,6 +208,236 @@ def test_save_failure_does_not_commit(tmp_path, monkeypatch):
         engine.save_checkpoint(str(tmp_path), tag="bad", blocking=True)
     assert read_latest(str(tmp_path)) == "ok"
     assert not is_committed(str(tmp_path / "bad"))
+    # a blocking failure must be visible through flush() too — a caller that
+    # caught the raise still gets the truth
+    assert engine.flush_checkpoints() is False
+    assert isinstance(engine._ckpt_saver.last_error, OSError)
+
+
+def test_multihost_async_save_keeps_payload_at_step_boundary(tmp_path, monkeypatch):
+    """On multi-host the async path must NOT hand live jax.Array leaves to
+    the writer thread — the next train_batch donates those buffers. The
+    payload stage stays in the caller; only commit I/O is backgrounded."""
+    engine = _engine(_config(async_save=True))
+    engine.train_batch(_batch())
+    seen = {}
+
+    real_save = engine._ckpt_saver.save
+    real_process_count = jax.process_count
+
+    def spy(state, save_dir, tag, blocking=True, save_latest=True, payload_in_caller=False,
+            commit_gate=None):
+        # the fake process_count existed only to steer the engine's routing;
+        # orbax must see the truth for the write itself
+        jax.process_count = real_process_count
+        seen.update(blocking=blocking, payload_in_caller=payload_in_caller,
+                    gated=commit_gate is not None)
+        return real_save(state, save_dir, tag, blocking=blocking, save_latest=save_latest,
+                         payload_in_caller=payload_in_caller, commit_gate=commit_gate)
+
+    monkeypatch.setattr(engine._ckpt_saver, "save", spy)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    assert engine.save_checkpoint(str(tmp_path), tag="mh")
+    assert seen == {"blocking": False, "payload_in_caller": True, "gated": True}
+    assert engine.flush_checkpoints(raise_on_error=True)
+    assert read_latest(str(tmp_path)) == "mh"
+    assert is_committed(str(tmp_path / "mh"), deep=True)
+
+
+def test_payload_in_caller_backgrounds_only_commit(tmp_path):
+    """With payload_in_caller, the arrays are on disk before save() returns
+    (no device references cross the thread boundary) and the parked writer
+    owns only the manifest/latest/GC stages."""
+    gate = threading.Event()
+    fault_injection.inject("before_manifest", lambda ctx: gate.wait(timeout=30))
+    engine = _engine(_config(async_save=True))
+    saver = engine._ckpt_saver
+    state = engine._ckpt_state(None)
+    assert saver.save(state, str(tmp_path), "t", blocking=False, payload_in_caller=True)
+    # payload dispatched synchronously: the snapshot is down (meta sidecar +
+    # orbax's arrays tree, still tmp-named until commit finalizes it)
+    assert os.path.isfile(str(tmp_path / "t" / "meta.pkl"))
+    assert any(d.startswith("arrays") for d in os.listdir(str(tmp_path / "t")))
+    assert saver.in_flight                                # commit parked on the gate
+    assert read_latest(str(tmp_path)) is None
+    gate.set()
+    assert saver.flush(raise_on_error=True)
+    assert read_latest(str(tmp_path)) == "t"
+    man = verify_manifest(str(tmp_path / "t"), deep=True)
+    assert man["tree"]  # spec captured at submit time, not from donated state
+
+
+def test_payload_in_caller_failure_is_synchronous(tmp_path, monkeypatch):
+    """A payload failure on the payload-in-caller path surfaces in the
+    submitting call itself — no writer thread is spawned for it."""
+    engine = _engine(_config(async_save=True))
+    saver = engine._ckpt_saver
+
+    def boom(state, path):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(engine.checkpoint_engine, "save", boom)
+    ok = saver.save(engine._ckpt_state(None), str(tmp_path), "bad",
+                    blocking=False, payload_in_caller=True)
+    assert ok is False
+    assert not saver.in_flight
+    assert isinstance(saver.last_error, OSError)
+    assert read_latest(str(tmp_path)) is None
+
+
+def test_blocking_commit_gate_withholds_manifest_on_peer_failure(tmp_path):
+    """Blocking mode votes on the engine commit result just before the
+    manifest stage: a peer's failure (vote False) leaves this rank's tag
+    unadvertised even though its local payload and commit both succeeded."""
+    engine = _engine(_config())
+    saver = engine._ckpt_saver
+    votes = []
+
+    def gate(local_ok):
+        votes.append(local_ok)
+        return False  # a peer rank failed
+
+    ok = saver.save(engine._ckpt_state(None), str(tmp_path), "mh", blocking=True,
+                    commit_gate=gate)
+    assert ok is False
+    assert votes == [True]
+    assert not is_committed(str(tmp_path / "mh"))  # manifest withheld
+    assert read_latest(str(tmp_path)) is None
+    assert "peer" in str(saver.last_error)
+
+
+def test_blocking_raising_rank_still_votes(tmp_path, monkeypatch):
+    """A rank whose blocking save raises must cast its False vote before the
+    exception unwinds — its peers are already blocked in the collective, and
+    with the old trailing barrier this was a permanent multi-host hang."""
+    engine = _engine(_config())
+    saver = engine._ckpt_saver
+
+    def boom(state, path):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(engine.checkpoint_engine, "save", boom)
+    votes = []
+
+    def gate(local_ok):
+        votes.append(local_ok)
+        return False
+
+    with pytest.raises(OSError):
+        saver.save(engine._ckpt_state(None), str(tmp_path), "bad", blocking=True,
+                   commit_gate=gate)
+    assert votes == [False]
+    assert read_latest(str(tmp_path)) is None
+
+
+def test_blocking_gate_votes_twice_on_success(tmp_path):
+    """Blocking mode casts a second (advertisement) vote after the lead's
+    manifest/`latest` flip: every rank is held until the flip is durable, so
+    no rank can return from a final save and get the lead gang-killed
+    mid-manifest."""
+    engine = _engine(_config())
+    saver = engine._ckpt_saver
+    votes = []
+
+    def gate(local_ok):
+        votes.append(local_ok)
+        return True
+
+    ok = saver.save(engine._ckpt_state(None), str(tmp_path), "t", blocking=True,
+                    commit_gate=gate)
+    assert ok is True
+    assert votes == [True, True]  # durability, then advertisement
+    assert read_latest(str(tmp_path)) == "t"
+
+
+def test_blocking_lead_manifest_failure_votes_false(tmp_path):
+    """A lead whose manifest stage raises must cast its advertisement vote
+    (False) before unwinding — the peers are already blocked in it."""
+    engine = _engine(_config())
+    saver = engine._ckpt_saver
+    votes = []
+
+    def boom(ctx):
+        raise OSError("manifest disk full")
+
+    fault_injection.inject("before_manifest", boom)
+
+    def gate(local_ok):
+        votes.append(local_ok)
+        return all(votes)
+
+    with pytest.raises(OSError):
+        saver.save(engine._ckpt_state(None), str(tmp_path), "t", blocking=True,
+                   commit_gate=gate)
+    assert votes == [True, False]
+    assert read_latest(str(tmp_path)) is None
+
+
+def test_gate_veto_joins_abandoned_async_write(tmp_path):
+    """A gate veto must join the already-submitted engine write before
+    returning False — an async engine otherwise still owns the in-flight
+    write and the next save's submit collides with it."""
+    engine = _engine(_config(async_save=True))
+    saver = engine._ckpt_saver
+    state = engine._ckpt_state(None)
+    ok = saver.save(state, str(tmp_path), "vetoed", blocking=False,
+                    payload_in_caller=True, commit_gate=lambda local_ok: False)
+    assert ok is False
+    assert "peer" in str(saver.last_error)
+    # the engine is immediately reusable: a follow-up save commits cleanly
+    assert saver.save(state, str(tmp_path), "good", blocking=False,
+                      payload_in_caller=True, commit_gate=lambda local_ok: True)
+    assert saver.flush(raise_on_error=True)
+    assert read_latest(str(tmp_path)) == "good"
+    assert not is_committed(str(tmp_path / "vetoed"))
+
+
+def test_commit_gate_withholds_commit_on_peer_failure(tmp_path):
+    """A rank whose local payload succeeded must NOT submit the commit stage
+    when the cross-rank vote reports a peer's payload failure — the lead's
+    manifest would verify (it inventories whatever IS on disk) and advertise
+    a tag missing a peer's shard."""
+    engine = _engine(_config(async_save=True))
+    saver = engine._ckpt_saver
+    votes = []
+
+    def gate(local_ok):
+        votes.append(local_ok)
+        return False  # a peer rank reported a failed payload
+
+    ok = saver.save(engine._ckpt_state(None), str(tmp_path), "mh",
+                    blocking=False, payload_in_caller=True, commit_gate=gate)
+    assert ok is False
+    assert votes == [True]            # this rank's payload was fine
+    assert not saver.in_flight        # commit stage never submitted
+    assert "peer" in str(saver.last_error)
+    assert read_latest(str(tmp_path)) is None
+
+
+def test_commit_gate_votes_even_after_local_failure(tmp_path, monkeypatch):
+    """Every rank enters the vote collective even when its own payload
+    raised — the peers are already blocked in the same collective, and a
+    rank that skipped the vote would deadlock them."""
+    engine = _engine(_config(async_save=True))
+    saver = engine._ckpt_saver
+
+    def boom(state, path):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(engine.checkpoint_engine, "save", boom)
+    votes = []
+
+    def gate(local_ok):
+        votes.append(local_ok)
+        return False  # unanimity is impossible: this rank already failed
+
+    ok = saver.save(engine._ckpt_state(None), str(tmp_path), "bad",
+                    blocking=False, payload_in_caller=True, commit_gate=gate)
+    assert ok is False
+    assert votes == [False]
+    assert not saver.in_flight
+    assert isinstance(saver.last_error, OSError)  # local cause, not the vote
+    assert read_latest(str(tmp_path)) is None
 
 
 # ----------------------------------------------------------------------
@@ -209,11 +462,12 @@ def test_retention_sweeps_stale_torn_dirs(tmp_path):
     engine.save_checkpoint(str(tmp_path), tag="torn1")
     engine.flush_checkpoints()
     fault_injection.clear()
-    for tag in ("a1", "a2", "a3"):
-        engine.save_checkpoint(str(tmp_path), tag=tag, blocking=True)
+    for i in (1, 2, 3):
+        engine.global_steps = i
+        engine.save_checkpoint(str(tmp_path), blocking=True)  # global_step1..3
     tags = sorted(d for d in os.listdir(str(tmp_path)) if (tmp_path / d).is_dir())
     assert "torn1" not in tags  # crash garbage swept once superseded
-    assert tags == ["a2", "a3"]
+    assert tags == ["global_step2", "global_step3"]
 
 
 def test_retention_disabled_keeps_everything(tmp_path):
@@ -230,6 +484,26 @@ def test_retention_never_deletes_user_named_tags(tmp_path):
         engine.save_checkpoint(str(tmp_path))  # global_step2..6
     tags = sorted(d for d in os.listdir(str(tmp_path)) if (tmp_path / d).is_dir())
     assert tags == ["best", "global_step5", "global_step6"]
+
+
+def test_retention_protects_named_tags_with_trailing_digits(tmp_path):
+    """Only tags the auto-save scheme produced (global_step<N>) compete in
+    the newest-N window — a user tag that merely ends in digits ('best2',
+    'exp_2024') must never be GC'd by cadence retention."""
+    from deepspeed_tpu.runtime.resilience.saver import tag_step
+
+    assert tag_step("global_step12") == 12
+    for named in ("best2", "release_v3", "exp_2024", "global_step7_fp32"):
+        assert tag_step(named) is None
+
+    engine = _engine(_config(num_of_version_in_retention=2))
+    engine.save_checkpoint(str(tmp_path), tag="best2")
+    engine.save_checkpoint(str(tmp_path), tag="exp_2024")
+    for i in range(1, 5):
+        engine.global_steps = i
+        engine.save_checkpoint(str(tmp_path))  # global_step1..4
+    tags = sorted(d for d in os.listdir(str(tmp_path)) if (tmp_path / d).is_dir())
+    assert tags == ["best2", "exp_2024", "global_step3", "global_step4"]
 
 
 # ----------------------------------------------------------------------
@@ -252,6 +526,113 @@ def test_sigterm_produces_final_checkpoint_and_clean_exit(tmp_path):
         assert sorted(d for d in os.listdir(str(tmp_path)) if (tmp_path / d).is_dir()) == [tag]
     finally:
         engine.destroy()  # restores the previous SIGTERM disposition
+
+
+def test_preemption_exits_cleanly_when_final_save_raises(tmp_path, monkeypatch):
+    """A raising final save (disk full, backend gone) must not break the
+    clean-exit contract: the grace window still ends in TrainingPreempted
+    with exit code 0, and resume uses the previous durable tag."""
+    engine = _engine(_config(preemption_save=True))
+    engine.set_checkpoint_dir(str(tmp_path))
+    engine.save_checkpoint(str(tmp_path), tag="good", blocking=True)
+    try:
+        def boom(state, path):
+            raise OSError("disk on fire")
+
+        monkeypatch.setattr(engine.checkpoint_engine, "save", boom)
+        engine._preemption.request()
+        with pytest.raises(TrainingPreempted) as ei:
+            engine.train_batch(_batch())
+        assert ei.value.code == 0          # still a clean scheduler exit
+        assert ei.value.tag is None        # no torn tag advertised
+        assert read_latest(str(tmp_path)) == "good"
+    finally:
+        engine.destroy()
+
+
+def test_autosave_failure_does_not_kill_training(tmp_path, monkeypatch):
+    """A raising cadence save is contained at the step boundary: training
+    continues and the un-reset cadence retries promptly."""
+    engine = _engine(_config(save_interval_steps=1))
+    engine.set_checkpoint_dir(str(tmp_path))
+
+    def boom(state, path):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(engine.checkpoint_engine, "save", boom)
+    engine.train_batch(_batch())      # cadence fires, save raises, contained
+    engine.train_batch(_batch(1))     # step loop survives
+    assert read_latest(str(tmp_path)) is None
+    monkeypatch.undo()
+    engine.train_batch(_batch(2))     # prompt retry once the disk recovers
+    engine.flush_checkpoints()
+    assert read_latest(str(tmp_path)) is not None
+
+
+def test_preemption_uninstall_is_safe_non_lifo():
+    """Destroying chained handlers out of install order must neither detach
+    the live trap nor leave a dead flag-setter swallowing SIGTERM."""
+    from deepspeed_tpu.runtime.resilience import PreemptionHandler
+
+    orig = signal.getsignal(signal.SIGTERM)
+    delivered = []
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: delivered.append(s))  # user trap
+        a = PreemptionHandler().install()
+        b = PreemptionHandler().install()
+        a.uninstall()  # non-LIFO: b chained on top of a — must stay installed
+        assert signal.getsignal(signal.SIGTERM) == b._on_signal
+        b.uninstall()  # restores b's prev: a's (now uninstalled) trap
+        h = signal.getsignal(signal.SIGTERM)
+        h(signal.SIGTERM, None)  # a acts as a transparent link, not a trap
+        assert delivered == [signal.SIGTERM]
+        assert not a.requested and not b.requested
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+def test_reinstall_after_non_lifo_uninstall_repairs_chain():
+    """Re-installing a handler after a non-LIFO uninstall must neither cycle
+    the chain (unbounded recursion in the signal handler) nor drop the
+    original third-party trap: the successor that still chains to us adopts
+    our old predecessor, straightening a -> b -> original."""
+    from deepspeed_tpu.runtime.resilience import PreemptionHandler
+
+    orig = signal.getsignal(signal.SIGTERM)
+    delivered = []
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: delivered.append(s))  # user trap
+        a = PreemptionHandler().install()
+        b = PreemptionHandler().install()
+        a.uninstall()   # non-LIFO: b stays installed, a keeps its _prev
+        a.install()     # must repair into a -> b -> user trap, not a <-> b
+        h = signal.getsignal(signal.SIGTERM)
+        h(signal.SIGTERM, None)
+        assert a.requested and b.requested
+        assert delivered == [signal.SIGTERM]  # the original trap still ran
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+def test_uninstalled_forwarder_respects_sig_ign():
+    """A dead chain link whose predecessor was SIG_IGN must stay transparent:
+    resetting to SIG_DFL and re-raising would turn an ignored SIGTERM into
+    process death."""
+    from deepspeed_tpu.runtime.resilience import PreemptionHandler
+
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        a = PreemptionHandler().install()
+        b = PreemptionHandler().install()
+        a.uninstall()   # non-LIFO: b stays, a becomes a dead link
+        b.uninstall()   # restores b's prev — a's uninstalled trap
+        h = signal.getsignal(signal.SIGTERM)
+        h(signal.SIGTERM, None)  # forwards to a's prev: SIG_IGN → no-op
+        assert not a.requested and not b.requested
+        assert signal.getsignal(signal.SIGTERM) == h  # disposition untouched
+    finally:
+        signal.signal(signal.SIGTERM, orig)
 
 
 def test_autosave_interval_steps(tmp_path):
