@@ -630,6 +630,68 @@ def run_bench():
             ckpt_line["blocked_ratio_async_vs_sync"] = round(
                 ckpt_line["ckpt_blocked_ms_p50_async"] / ckpt_line["ckpt_blocked_ms_p50_sync"], 4)
 
+    # --health: live-health-plane micro-bench — a short health-armed run
+    # (flight recorder + watchdog + in-process exporter) on a deliberately
+    # tiny model: proves the watchdog stays silent on a healthy loop and
+    # prices a /metrics scrape. Runs OUTSIDE the headline timed window (the
+    # headline arms no health plane at all, per the zero-overhead contract).
+    health_line = None
+    if os.environ.get("DS_TPU_BENCH_HEALTH", "1") != "0":
+        import urllib.request
+        from deepspeed_tpu.parallel import groups
+        from deepspeed_tpu.monitor.health import get_health
+        from deepspeed_tpu.monitor.metrics import configure_metrics, get_metrics
+
+        groups.reset()
+        configure_metrics(enabled=True)
+        get_metrics().reset()
+        n_chips = len(jax.devices())
+        h_cfg = TransformerConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+                                  intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
+                                  attention_impl="reference")
+        h_config = {
+            "train_batch_size": 2 * n_chips,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.0}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 10**9,
+            "tpu": {"mesh": {"data": n_chips}},
+            "health": {"export_port": 0, "deadline_train_step_s": 300.0,
+                       "dump_on_destroy": False},
+        }
+        h_engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(h_cfg),
+                                                     config=h_config)
+        h = get_health()
+        h_rng = np.random.default_rng(0)
+        h_batch = {"input_ids": h_rng.integers(0, h_cfg.vocab_size,
+                                               size=(h_config["train_batch_size"], 64),
+                                               dtype=np.int32)}
+        for _ in range(4):
+            h_engine.train_batch(h_batch)
+        scrape_ms, body = [], b""
+        url = h.server.url + "/metrics"
+        for _ in range(20):
+            t_s = time.perf_counter()
+            body = urllib.request.urlopen(url, timeout=10).read()
+            scrape_ms.append((time.perf_counter() - t_s) * 1e3)
+        skew_hist = get_metrics().histogram("train/straggler_skew_ms_hist")
+        health_line = {
+            # a healthy loop must produce ZERO watchdog trips
+            "stalls": h.stall_count,
+            # cross-rank skew rides the multi-host resilience vote; a
+            # single-host run has no samples, disclosed as null
+            "straggler_skew_ms_p50": (round(skew_hist.percentile(50), 3)
+                                      if skew_hist.count else None),
+            "export_scrape_ms_p50": round(sorted(scrape_ms)[len(scrape_ms) // 2], 3),
+            "scrape_bytes": len(body),
+        }
+        if skew_hist.count == 0:
+            health_line["note"] = "single-host run: no cross-rank skew samples"
+        h_engine.destroy()
+        h.shutdown()
+        _free_engine(h_engine, "state")
+
     if trace_path:
         # eager 3-call path demo: genuine fwd/bwd/step spans plus an eager
         # device collective (comm/all_reduce span with real bytes + bandwidth)
@@ -675,6 +737,8 @@ def run_bench():
         line["prefetch"] = prefetch_line
     if ckpt_line is not None:
         line["checkpoint"] = ckpt_line
+    if health_line is not None:
+        line["health"] = health_line
     if not on_tpu:
         line["tpu_unavailable_reason"] = tpu_error or "no TPU device visible"
     if gate_note:

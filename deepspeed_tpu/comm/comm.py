@@ -15,6 +15,7 @@ The global backend handle is ``cdb`` — same name as reference ``comm.py:41``.
 
 import inspect
 import os
+import threading
 import time
 import functools
 
@@ -108,6 +109,64 @@ def _block_on(result):
     return result
 
 
+class _InflightCollectives:
+    """Registry of collectives currently executing on this host — the table
+    the health plane (``monitor/health.py``) dumps when a run wedges: a hung
+    all-reduce is invisible from outside the process, but THIS table names
+    the op, its payload size, how long it has been in flight, and which
+    thread sits in it. Fed by ``@timed_op`` (device collectives) and the
+    host-plane gather/broadcast helpers. Disabled by default: one attribute
+    check per call, no locking, no allocations — the health config block
+    flips ``enabled`` and installs the ``on_enter``/``on_exit`` heartbeat
+    hooks (the ``collective`` stall-watchdog source)."""
+
+    __slots__ = ("enabled", "on_enter", "on_exit", "_lock", "_entries", "_next")
+
+    def __init__(self):
+        self.enabled = False
+        self.on_enter = None  # health hook: begin("collective")
+        self.on_exit = None  # health hook: end("collective")
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._next = 0
+
+    def enter(self, op, msg_size=0):
+        """Register an in-flight collective; returns the token for exit()."""
+        with self._lock:
+            token = self._next
+            self._next += 1
+            self._entries[token] = {"op": op, "msg_size": int(msg_size),
+                                    "t0": time.perf_counter(),
+                                    "thread": threading.current_thread().name}
+        cb = self.on_enter
+        if cb is not None:
+            cb()
+        return token
+
+    def exit(self, token):
+        with self._lock:
+            self._entries.pop(token, None)
+        cb = self.on_exit
+        if cb is not None:
+            cb()
+
+    def snapshot(self):
+        """Ordered view of the table: ``[{op, msg_size, age_s, thread}]``,
+        oldest first."""
+        now = time.perf_counter()
+        with self._lock:
+            entries = sorted(self._entries.items())
+        return [{"op": e["op"], "msg_size": e["msg_size"],
+                 "age_s": round(now - e["t0"], 4), "thread": e["thread"]}
+                for _, e in entries]
+
+    def __len__(self):
+        return len(self._entries)
+
+
+inflight_collectives = _InflightCollectives()
+
+
 def timed_op(func):
     """Reference ``comm.py:101`` @timed_op — wall-times collectives with REAL
     payload bytes (pytree nbytes sum, not the old hardcoded 0).
@@ -140,32 +199,46 @@ def timed_op(func):
     @functools.wraps(func)
     def wrapper(*args, **kwargs):
         tracer = get_tracer()
+        watch = inflight_collectives
         prof = comms_logger.enabled and (comms_logger.prof_all or name in comms_logger.prof_ops)
-        if not (prof or tracer.enabled):
+        timing = prof or tracer.enabled
+        if not (timing or watch.enabled):
             return func(*args, **kwargs)
         msg_size = _msg_bytes(args, kwargs)
         if _has_tracer(args, kwargs):
+            # under jit the call only records into the step program: nothing
+            # can block here, so it is neither timed nor held in flight
             if tracer.enabled:
                 tracer.instant(f"comm/{name}", tid="comm", msg_size=msg_size, traced=True)
             return func(*args, **kwargs)
-        n = _group_degree(_call_group(args, kwargs))
-        _eager_state["compiled"] = False
-        t0 = time.perf_counter()
-        result = _block_on(func(*args, **kwargs))
-        duration = time.perf_counter() - t0
-        compiled = _eager_state["compiled"]
-        if prof and not compiled:
-            # a call that just compiled its eager executable is not a
-            # steady-state sample — keep it out of the bandwidth stats
-            comms_logger.append(name, name, duration, msg_size, n=n)
-        if tracer.enabled:
-            algbw, busbw, _ = calc_bw_log(name, msg_size, duration, n=n)
-            span_args = {"msg_size": msg_size, "algbw_gbps": round(algbw, 4),
-                         "busbw_gbps": round(busbw, 4), "n": n}
-            if compiled:
-                span_args["compiled"] = True  # disclosed, excluded from stats
-            tracer.complete(f"comm/{name}", t0, duration, tid="comm", args=span_args)
-        return result
+        token = watch.enter(name, msg_size) if watch.enabled else None
+        try:
+            if not timing:
+                # watch-only mode (health plane armed, profiling off): the
+                # in-flight entry brackets the call with NO forced device
+                # sync — eager dispatch keeps its async perf profile
+                return func(*args, **kwargs)
+            n = _group_degree(_call_group(args, kwargs))
+            _eager_state["compiled"] = False
+            t0 = time.perf_counter()
+            result = _block_on(func(*args, **kwargs))
+            duration = time.perf_counter() - t0
+            compiled = _eager_state["compiled"]
+            if prof and not compiled:
+                # a call that just compiled its eager executable is not a
+                # steady-state sample — keep it out of the bandwidth stats
+                comms_logger.append(name, name, duration, msg_size, n=n)
+            if tracer.enabled:
+                algbw, busbw, _ = calc_bw_log(name, msg_size, duration, n=n)
+                span_args = {"msg_size": msg_size, "algbw_gbps": round(algbw, 4),
+                             "busbw_gbps": round(busbw, 4), "n": n}
+                if compiled:
+                    span_args["compiled"] = True  # disclosed, excluded from stats
+                tracer.complete(f"comm/{name}", t0, duration, tid="comm", args=span_args)
+            return result
+        finally:
+            if token is not None:
+                watch.exit(token)
 
     return wrapper
 
@@ -376,18 +449,37 @@ def barrier(group=None):
     _ensure().barrier()
 
 
+def _watched_host_op(op, fn):
+    """Host-plane collectives (key-value-store gather/broadcast) BLOCK the
+    calling thread until every process arrives — they are the ops a dead
+    peer wedges first (the step-boundary resilience vote rides
+    ``all_gather_host``). Register them in the in-flight table while the
+    health plane watches."""
+    watch = inflight_collectives
+    if not watch.enabled:
+        return fn()
+    token = watch.enter(op)
+    try:
+        return fn()
+    finally:
+        watch.exit(token)
+
+
 def broadcast_object_list(object_list, src=0, group=None):
-    out = _ensure().broadcast_host(object_list, src=src)
+    out = _watched_host_op("broadcast_object_list",
+                           lambda: _ensure().broadcast_host(object_list, src=src))
     object_list[:] = list(out) if not isinstance(out, list) else out
     return object_list
 
 
 def broadcast_host(value, src=0):
-    return _ensure().broadcast_host(value, src=src)
+    return _watched_host_op("broadcast_host",
+                            lambda: _ensure().broadcast_host(value, src=src))
 
 
 def all_gather_host(value):
-    return _ensure().all_gather_host(value)
+    return _watched_host_op("all_gather_host",
+                            lambda: _ensure().all_gather_host(value))
 
 
 def new_group(ranks=None):

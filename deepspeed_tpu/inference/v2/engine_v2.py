@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...monitor.flight import get_flight_recorder
+from ...monitor.health import get_health
 from ...monitor.metrics import get_metrics
 from ...monitor.trace import get_tracer, observe_latency
 from ...utils.logging import log_dist
@@ -83,6 +85,29 @@ class InferenceEngineV2:
             max_blocks_per_seq=self._max_blocks_per_seq, block_size=bs)
 
         self._compiled: Dict[Tuple[int, int, Optional[str]], object] = {}
+        # live-health plane: serving heartbeats (`serving` watchdog source,
+        # armed per forward) + a /healthz section. One boolean per call when
+        # the plane is off.
+        self._health = get_health()
+        if self._health.enabled:
+            import weakref
+
+            # the plane is a process-global singleton and this engine has no
+            # destroy(): a strong closure would pin the whole KV cache (and
+            # keep /healthz reporting a dead engine) after the engine is
+            # discarded — hold a weakref and self-unregister once collected
+            ref = weakref.ref(self)
+
+            def _serving_state():
+                eng = ref()
+                if eng is None:
+                    get_health().set_state_provider("serving", None)
+                    return {"engine": "collected"}
+                return {"tracked_sequences": eng.state_manager.n_tracked_sequences,
+                        "free_blocks": eng.free_blocks,
+                        "available_blocks": eng.available_blocks}
+
+            self._health.set_state_provider("serving", _serving_state)
         log_dist(
             f"InferenceEngineV2 ready: blocks={self.num_kv_blocks}x{bs} "
             f"kv={self.state_manager.kv_cache.memory_bytes()/2**20:.0f}MiB "
@@ -179,6 +204,25 @@ class InferenceEngineV2:
         scheduler that doesn't need the values (e.g. speculative admission,
         or a benchmark on a high-latency relay) can pipeline several steps
         into the device queue."""
+        hb = self._health
+        # normalize ONCE, before any breadcrumb math: both arguments may be
+        # single-pass iterables, and _put's re-asarray of the converted rows
+        # is then a free no-op
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
+        if not hb.enabled:
+            return self._put(batch_uids, batch_tokens, do_checks, sample, block)
+        # operation-style heartbeat: `serving` is watched exactly while a
+        # forward is in flight, so a wedged device call trips the watchdog
+        hb.begin("serving")
+        get_flight_recorder().record("serving", "put", seqs=len(batch_uids),
+                                     tokens=int(sum(t.size for t in batch_tokens)))
+        try:
+            return self._put(batch_uids, batch_tokens, do_checks, sample, block)
+        finally:
+            hb.end("serving")
+
+    def _put(self, batch_uids, batch_tokens, do_checks, sample, block):
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
         batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
@@ -251,6 +295,19 @@ class InferenceEngineV2:
         refuses if the pool can't cover it). Returns token ids
         [len(batch_uids), n_steps].
         """
+        batch_uids = list(batch_uids)
+        hb = self._health
+        if not hb.enabled:
+            return self._decode(batch_uids, first_tokens, n_steps, block)
+        hb.begin("serving")
+        get_flight_recorder().record("serving", "decode", seqs=len(batch_uids),
+                                     steps=int(n_steps))
+        try:
+            return self._decode(batch_uids, first_tokens, n_steps, block)
+        finally:
+            hb.end("serving")
+
+    def _decode(self, batch_uids, first_tokens, n_steps, block):
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
         uids = list(batch_uids)

@@ -11,3 +11,5 @@ from .trace import get_tracer, configure_tracer, to_chrome_trace, NULL_SPAN  # n
 from .metrics import (  # noqa: F401
     get_metrics, configure_metrics, compute_mfu, peak_flops_per_chip, CHIP_PEAK_FLOPS,
     DEFAULT_LATENCY_BUCKETS_MS)
+from .flight import get_flight_recorder, FlightRecorder  # noqa: F401
+from .health import get_health, configure_health, HealthPlane  # noqa: F401

@@ -10,11 +10,14 @@ from ..runtime.config_utils import DeepSpeedConfigModel
 
 def get_monitor_config(param_dict):
     monitor_dict = {key: param_dict.get(key, {})
-                    for key in ("tensorboard", "wandb", "csv_monitor", "comet", "trace")}
-    # presence-enables: an EMPTY {"trace": {}} block in the config means "on
-    # with defaults" (the validator can only see set fields, not presence)
-    if "trace" in param_dict and not monitor_dict["trace"]:
-        monitor_dict["trace"] = {"enabled": True}
+                    for key in ("tensorboard", "wandb", "csv_monitor", "comet", "trace",
+                                "health")}
+    # presence-enables: an EMPTY {"trace": {}} / {"health": {}} block in the
+    # config means "on with defaults" (the validator can only see set
+    # fields, not presence)
+    for key in ("trace", "health"):
+        if key in param_dict and not monitor_dict[key]:
+            monitor_dict[key] = {"enabled": True}
     return DeepSpeedMonitorConfig(**monitor_dict)
 
 
@@ -66,12 +69,56 @@ class TraceConfig(DeepSpeedConfigModel):
         return self
 
 
+class HealthConfig(DeepSpeedConfigModel):
+    """``monitor.health`` block — the live-health plane (``monitor/health.py``
+    / ``monitor/flight.py`` / ``monitor/export.py``): flight recorder, stall
+    watchdog, straggler detection, and the Prometheus/JSON exporter. Enabled
+    by presence (same contract as ``trace``); off by default, and every
+    deadline defaults to 0 (= that source unwatched), so enabling the block
+    alone arms only the flight recorder + heartbeat bookkeeping — no
+    watchdog thread, no server, no behavior change to the step loop beyond
+    one boolean check."""
+    enabled: bool = False
+    # flight recorder ring capacity (events retained for stall/exit dumps)
+    flight_capacity: int = Field(4096, ge=16)
+    # quarantine directory for watchdog-trip / SIGQUIT / destroy() dumps
+    dump_dir: str = "/tmp/dstpu_health"
+    dump_on_destroy: bool = True
+    # install a SIGQUIT handler that writes a dump (faulthandler-style
+    # kill -QUIT forensics); main-thread only
+    sigquit_dump: bool = False
+    watchdog_poll_s: float = Field(1.0, gt=0)
+    # per-source stall deadlines, seconds; 0 = unwatched. The watchdog
+    # thread only starts when at least one is > 0.
+    deadline_train_step_s: float = Field(0.0, ge=0)
+    deadline_collective_s: float = Field(0.0, ge=0)
+    deadline_serving_s: float = Field(0.0, ge=0)
+    deadline_saver_s: float = Field(0.0, ge=0)
+    deadline_prefetch_s: float = Field(0.0, ge=0)
+    # straggler trace instants fire past this skew; the skew gauge itself is
+    # recorded whenever the engine's resilience vote carries the samples
+    straggler_threshold_ms: float = Field(0.0, ge=0)
+    # None = no HTTP server; 0 = ephemeral port; N = fixed port
+    export_port: Optional[int] = Field(None, ge=0)
+    export_host: str = "127.0.0.1"
+    # scrape-less mode: atomically rewrite this JSON file every N steps
+    snapshot_path: str = ""
+    snapshot_every_steps: int = Field(50, ge=1)
+
+    @model_validator(mode="after")
+    def enable_when_configured(self):
+        if self.model_fields_set and "enabled" not in self.model_fields_set:
+            self.enabled = True
+        return self
+
+
 class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
     tensorboard: TensorBoardConfig = {}
     wandb: WandbConfig = {}
     csv_monitor: CSVConfig = {}
     comet: CometConfig = {}
     trace: TraceConfig = {}
+    health: HealthConfig = {}
 
     @property
     def enabled(self):

@@ -1,0 +1,221 @@
+"""Telemetry exporter: Prometheus text rendering + the /metrics and
+/healthz endpoints.
+
+The registry (``monitor/metrics.py``) is in-process state; a pod running for
+days needs that state visible to an EXTERNAL scraper that keeps working when
+the step loop stops making progress — which is precisely when in-band
+logging goes quiet. Three surfaces, all stdlib:
+
+  * :func:`render_prometheus` — the registry in Prometheus text exposition
+    format 0.0.4: counters (``_total`` suffix convention), gauges, and
+    histograms as cumulative ``_bucket{le="..."}`` series + ``_sum`` /
+    ``_count``. Metric names are sanitized into the legal charset (slashes
+    become underscores, original name preserved in ``# HELP``); label values
+    go through :func:`escape_label_value` (backslash, quote, newline).
+  * :class:`HealthHTTPServer` — an opt-in daemon-thread
+    ``http.server.ThreadingHTTPServer`` serving ``GET /metrics`` (Prometheus
+    text, including per-source heartbeat-age gauges) and ``GET /healthz``
+    (the health plane's JSON payload: last-heartbeat ages, current step,
+    in-flight collectives, saver state). Port 0 binds an ephemeral port
+    (``server.port`` reports the real one).
+  * snapshot mode lives on the health plane itself
+    (``HealthPlane.write_snapshot``): an atomically-rewritten JSON file for
+    scrape-less deployments (cron + object store instead of a Prometheus).
+
+Import-light: stdlib + sibling monitor modules only.
+"""
+
+import json
+import re
+import threading
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+METRIC_PREFIX = "dstpu_"
+
+
+def sanitize_metric_name(name, prefix=METRIC_PREFIX):
+    """Fold an internal metric name (``train/step_time_ms``) into the legal
+    Prometheus charset ``[a-zA-Z_:][a-zA-Z0-9_:]*``, prefixed."""
+    out = _NAME_SANITIZE.sub("_", str(name))
+    out = prefix + out
+    if not _NAME_OK.match(out):  # pathological: name was all-invalid chars
+        out = prefix + "metric_" + out[len(prefix):]
+    return out
+
+
+def escape_label_value(value):
+    """Prometheus label-value escaping: backslash, double-quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(text):
+    """HELP-line escaping: backslash and newline only (quotes are legal)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v):
+    """Float formatting: integers render bare (Prometheus-idiomatic counts),
+    +Inf/NaN in the spec spelling."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(registry, extra_gauges=None):
+    """Render a ``MetricsRegistry`` snapshot as Prometheus text format.
+
+    ``extra_gauges``: optional ``[(name, labels_dict, value), ...]`` rows
+    appended as gauges (the health exporter feeds heartbeat ages through
+    here so the label-escaping path is exercised by real output)."""
+    snap = registry.snapshot()
+    lines = []
+
+    def header(pname, raw, kind):
+        lines.append(f"# HELP {pname} {escape_help(raw)}")
+        lines.append(f"# TYPE {pname} {kind}")
+
+    for raw, value in sorted(snap["counters"].items()):
+        pname = sanitize_metric_name(raw)
+        if not pname.endswith("_total"):
+            pname += "_total"
+        header(pname, raw, "counter")
+        lines.append(f"{pname} {_fmt(value)}")
+    for raw, value in sorted(snap["gauges"].items()):
+        pname = sanitize_metric_name(raw)
+        header(pname, raw, "gauge")
+        lines.append(f"{pname} {_fmt(value)}")
+
+    # histograms need the live objects (bucket bounds + counts), not the
+    # percentile summary the snapshot carries
+    with registry._lock:
+        hists = list(registry._histograms.values())
+    for h in sorted(hists, key=lambda h: h.name):
+        pname = sanitize_metric_name(h.name)
+        header(pname, h.name, "histogram")
+        with h._lock:
+            bucket_counts = list(h.bucket_counts)
+            bounds = h.buckets
+            count, total = h.count, h.total
+        acc = 0
+        for bound, c in zip(bounds, bucket_counts[:-1]):
+            acc += c
+            lines.append(f'{pname}_bucket{{le="{_fmt(bound)}"}} {acc}')
+        lines.append(f'{pname}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{pname}_sum {_fmt(total)}")
+        lines.append(f"{pname}_count {count}")
+
+    # group rows by metric family first: the text format allows exactly ONE
+    # TYPE line per family, and interleaved families (two heartbeat sources
+    # alternating age/armed rows) would otherwise emit duplicates that a
+    # real Prometheus scraper rejects wholesale
+    by_family = {}
+    for name, labels, value in (extra_gauges or ()):
+        by_family.setdefault(name, []).append((labels, value))
+    for name, rows in by_family.items():
+        pname = sanitize_metric_name(name)
+        header(pname, name, "gauge")
+        for labels, value in rows:
+            body = ",".join(f'{k}="{escape_label_value(v)}"'
+                            for k, v in sorted(labels.items()))
+            lines.append(f"{pname}{{{body}}} {_fmt(value)}" if body
+                         else f"{pname} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def heartbeat_gauge_rows(heartbeats):
+    """Heartbeat snapshot -> ``extra_gauges`` rows for the /metrics text."""
+    rows = []
+    for source, hb in sorted(heartbeats.items()):
+        rows.append(("health/heartbeat_age_seconds", {"source": source},
+                     hb["age_s"]))
+        rows.append(("health/heartbeat_armed", {"source": source},
+                     1.0 if (hb["armed"] or hb["active"] > 0) else 0.0))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints
+# ---------------------------------------------------------------------------
+class HealthHTTPServer:
+    """Tiny stdlib exporter: ``/metrics`` (Prometheus text 0.0.4) and
+    ``/healthz`` (JSON). Daemon serving thread; ``stop()`` shuts it down."""
+
+    def __init__(self, host, port, registry, healthz_fn, heartbeats_fn=None):
+        self.registry = registry
+        self.healthz_fn = healthz_fn
+        self.heartbeats_fn = heartbeats_fn
+        self._host, self._want_port = host, int(port)
+        self._httpd = None
+        self._thread = None
+
+    def start(self):
+        import http.server
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # no stderr chatter per scrape
+                pass
+
+            def _send(self, code, ctype, body):
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        extra = (heartbeat_gauge_rows(outer.heartbeats_fn())
+                                 if outer.heartbeats_fn else None)
+                        self._send(200, "text/plain; version=0.0.4; charset=utf-8",
+                                   render_prometheus(outer.registry, extra_gauges=extra))
+                    elif path == "/healthz":
+                        self._send(200, "application/json",
+                                   json.dumps(outer.healthz_fn(), default=repr))
+                    else:
+                        self._send(404, "text/plain; charset=utf-8",
+                                   "not found: /metrics or /healthz\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # scraper went away mid-response
+
+        self._httpd = http.server.ThreadingHTTPServer((self._host, self._want_port),
+                                                      Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dstpu-health-http", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def port(self):
+        """The bound port (differs from the requested one when it was 0)."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self):
+        return f"http://{self._host}:{self.port}" if self._httpd else None
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,119 @@
+"""Flight recorder — the crash-forensics half of the live-health plane.
+
+A bounded, lock-protected ring buffer (default ~4k entries) that keeps the
+LAST window of observability events in memory at all times, so a stall dump
+(``monitor/health.py``), a ``SIGQUIT`` request, or ``engine.destroy()`` can
+reconstruct what the process was doing right before it wedged — even when
+file tracing is disabled (the production default: nobody runs a multi-day
+pod job with the JSONL trace writer on, but everybody wants the tail of it
+after a hang). Two feeds:
+
+  * the :class:`~.trace.Tracer` mirrors every span/instant/counter it emits
+    into the ring via ``Tracer.set_mirror`` — including in "tracing off"
+    mode, where the health plane arms the mirror without arming the file
+    writer;
+  * explicit breadcrumbs (``record(kind, name, **fields)``) from the engine
+    step loop, the serving engine, and the checkpoint writer — the
+    host-level narrative the trace bus doesn't carry.
+
+Ordering is strict: every entry gets a monotonically increasing ``seq`` under
+the ring lock, the ring is lossless up to capacity, and past capacity the
+oldest entries are overwritten in ``seq`` order (tested). Zero overhead when
+disabled: one attribute check per call, no allocations.
+
+Import-light by design (stdlib only): pulled in during package bootstrap via
+the monitor wiring.
+"""
+
+import json
+import threading
+import time
+
+
+class FlightRecorder:
+    """Bounded in-memory event ring. One per process (see
+    :func:`get_flight_recorder`)."""
+
+    def __init__(self, capacity=4096):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._cap = max(16, int(capacity))
+        self._ring = [None] * self._cap
+        self._seq = 0  # total entries ever recorded (== next seq)
+
+    # -- configuration --------------------------------------------------
+    def configure(self, enabled=None, capacity=None):
+        with self._lock:
+            if capacity is not None and int(capacity) != self._cap:
+                # resizing drops the old window: the ring is forensic state,
+                # not durable data, and a reconfigure marks a new run anyway
+                self._cap = max(16, int(capacity))
+                self._ring = [None] * self._cap
+                self._seq = 0
+            if enabled is not None:
+                self.enabled = bool(enabled)
+        return self
+
+    @property
+    def capacity(self):
+        return self._cap
+
+    @property
+    def total_recorded(self):
+        """Entries ever recorded (a ring past capacity has dropped
+        ``total_recorded - capacity`` of them)."""
+        return self._seq
+
+    # -- feeds ----------------------------------------------------------
+    def record(self, kind, name, **fields):
+        """Explicit breadcrumb: ``kind`` is the subsystem (``engine`` /
+        ``serving`` / ``saver`` / ``health``), ``name`` the event."""
+        if not self.enabled:
+            return
+        entry = {"kind": kind, "name": name, "t_unix": time.time()}
+        if fields:
+            entry.update(fields)
+        self._push(entry)
+
+    def record_event(self, ev):
+        """Tracer mirror feed: ``ev`` is a Chrome-trace event dict (already
+        fully built by the tracer — stored as-is under a ``trace`` kind)."""
+        if not self.enabled:
+            return
+        self._push({"kind": "trace", "ev": ev})
+
+    def _push(self, entry):
+        with self._lock:
+            entry["seq"] = self._seq
+            self._ring[self._seq % self._cap] = entry
+            self._seq += 1
+
+    # -- read side ------------------------------------------------------
+    def dump(self):
+        """The retained window, strictly ordered oldest -> newest."""
+        with self._lock:
+            n, cap = self._seq, self._cap
+            if n <= cap:
+                return [e for e in self._ring[:n]]
+            start = n % cap
+            return self._ring[start:] + self._ring[:start]
+
+    def dump_jsonl(self, fh):
+        """Write the ordered window to an open text file handle, one JSON
+        object per line; returns the number of lines written."""
+        entries = self.dump()
+        for e in entries:
+            fh.write(json.dumps(e, default=repr) + "\n")
+        return len(entries)
+
+    def clear(self):
+        with self._lock:
+            self._ring = [None] * self._cap
+            self._seq = 0
+
+
+_flight = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _flight

@@ -1,0 +1,541 @@
+"""Live-health plane: heartbeats, stall watchdog, straggler math, dumps.
+
+PR 1 made this repo observable *post-hoc* (Chrome-trace spans, the metrics
+registry); this module is the component that NOTICES while the run is still
+alive. The framework the paper targets runs multi-host pods for days, and
+the three production failure shapes are all silent: a collective wedges (one
+host died, the others sit in the all-reduce forever), one host straggles
+(the step time is the max over hosts, and nothing reports WHO), or a
+background writer stalls (the async checkpoint thread hangs in storage I/O
+and ``destroy()`` joins it forever). The plane here is the TPU-native analog
+of the PyTorch-distributed flight recorder + DeepSpeed comms logger +
+Orbax-style heartbeating:
+
+  * **heartbeats** — named sources (``engine`` step boundary, ``collective``
+    entry/exit via the in-flight registry in ``comm/comm.py``, ``serving``
+    prefill/decode, ``saver`` writer, ``prefetch`` worker) either *beat*
+    (recurring-activity style: armed until disarmed) or *begin/end*
+    (operation style: watched only while an op is in flight);
+  * **stall watchdog** — one daemon thread (started only when some deadline
+    is configured > 0) that polls heartbeat ages and, past a per-source
+    deadline, dumps all-thread stacks + the in-flight collective table + the
+    flight-recorder ring to a quarantine file, bumps ``health/stall_total``,
+    and invokes an optional user callback. It NEVER kills the process — the
+    decision to abort belongs to the operator (or the callback they gave
+    us), not to telemetry;
+  * **straggler detection** — :meth:`HealthPlane.note_straggler` folds the
+    per-rank ``(step, step_wall_ms, input_wait_ms)`` tuples the engine
+    piggybacks on its existing step-boundary resilience vote into a
+    slowest-rank-vs-median skew, recorded as ``train/straggler_skew_ms``
+    (gauge + histogram) and a ``straggler`` trace instant past the
+    threshold;
+  * **dumps** — :meth:`HealthPlane.dump` is callable on demand, fires on
+    watchdog trip, on ``SIGQUIT`` (opt-in), and from ``engine.destroy()``.
+
+Everything defaults OFF and the disabled path is one attribute check with no
+locking and no allocations — the same contract as the ``trace`` block.
+Import-light by design: stdlib + sibling monitor modules only (``comm`` and
+the HTTP exporter are imported lazily).
+"""
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+from .flight import get_flight_recorder
+from .metrics import get_metrics
+from .trace import get_tracer
+
+# config-block field -> heartbeat source name
+_DEADLINE_FIELDS = {
+    "deadline_train_step_s": "engine",
+    "deadline_collective_s": "collective",
+    "deadline_serving_s": "serving",
+    "deadline_saver_s": "saver",
+    "deadline_prefetch_s": "prefetch",
+}
+
+
+def _utcnow():
+    return time.time()
+
+
+class HealthPlane:
+    """Process-global live-health state (see :func:`get_health`)."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._hb = {}  # source -> {"last", "armed", "active", "tripped"}
+        self._deadlines = {}  # source -> seconds (0/absent = unwatched)
+        self._poll_s = 1.0
+        self._watchdog = None
+        self._watch_stop = threading.Event()
+        self._server = None
+        self._snapshot_path = None
+        self._snapshot_every = 50
+        self._providers = {}  # name -> callable() -> dict (healthz sections)
+        self._stall_callback = None
+        self._dump_dir = "/tmp/dstpu_health"
+        self._dump_n = 0
+        self.dump_on_destroy = True
+        self.straggler_threshold_ms = 0.0
+        self.stall_count = 0
+        self.last_dump_path = None
+        self._prev_sigquit = None
+
+    # ------------------------------------------------------------------
+    # configuration / lifecycle
+    # ------------------------------------------------------------------
+    def configure(self, config=None, stall_callback=None, **kwargs):
+        """Arm the plane. ``config`` is a ``HealthConfig`` block
+        (``monitor_config.health``); explicit kwargs win over it.
+        ``stall_callback(source, age_s, dump_path)`` runs after a trip dump
+        (exceptions are swallowed loudly — telemetry must not kill runs)."""
+
+        def knob(name, default=None):
+            if name in kwargs and kwargs[name] is not None:
+                return kwargs[name]
+            if config is not None:
+                return getattr(config, name, default)
+            return default
+
+        enabled = knob("enabled")
+        if stall_callback is not None:
+            self._stall_callback = stall_callback
+        if enabled is not None and not enabled:
+            self.shutdown()
+            return self
+        if not enabled:
+            return self
+
+        self._dump_dir = str(knob("dump_dir", self._dump_dir) or self._dump_dir)
+        self.dump_on_destroy = bool(knob("dump_on_destroy", self.dump_on_destroy))
+        self._poll_s = max(0.01, float(knob("watchdog_poll_s", self._poll_s)))
+        self.straggler_threshold_ms = float(knob("straggler_threshold_ms",
+                                                 self.straggler_threshold_ms))
+        deadlines = dict(kwargs.get("deadlines") or {})
+        for field, source in _DEADLINE_FIELDS.items():
+            v = knob(field)
+            if v is not None and source not in deadlines:
+                deadlines[source] = float(v)
+        self._deadlines.update(deadlines)
+        self._snapshot_path = str(knob("snapshot_path", "") or "") or None
+        self._snapshot_every = max(1, int(knob("snapshot_every_steps",
+                                               self._snapshot_every)))
+
+        # metrics registry carries the plane's counters/gauges and is what
+        # /metrics serves — the health block implies it, like `trace` does
+        get_metrics().enable()
+        get_flight_recorder().configure(enabled=True,
+                                        capacity=knob("flight_capacity", None))
+        get_tracer().set_mirror(get_flight_recorder())
+        self._configure_comm_watch(True)
+        self.enabled = True
+
+        if any(v and v > 0 for v in self._deadlines.values()):
+            self._start_watchdog()
+        port = knob("export_port")
+        if port is not None:
+            self._start_server(str(knob("export_host", "127.0.0.1")), int(port))
+        if bool(knob("sigquit_dump", False)):
+            self._install_sigquit()
+        return self
+
+    def shutdown(self):
+        """Disarm everything this plane started: watchdog thread, HTTP
+        server, SIGQUIT trap, tracer mirror, comm registry. Idempotent."""
+        self.enabled = False
+        if self._watchdog is not None:
+            self._watch_stop.set()
+            self._watchdog.join(timeout=5.0)
+            self._watchdog = None
+            self._watch_stop = threading.Event()
+        if self._server is not None:
+            try:
+                self._server.stop()
+            finally:
+                self._server = None
+        self._uninstall_sigquit()
+        get_tracer().set_mirror(None)
+        get_flight_recorder().configure(enabled=False)
+        self._configure_comm_watch(False)
+        with self._lock:
+            self._hb.clear()
+            self._deadlines.clear()
+        self._providers.clear()
+        self._snapshot_path = None
+        self._stall_callback = None
+        return self
+
+    def _configure_comm_watch(self, on):
+        try:
+            from ..comm import comm as _comm  # lazy: comm imports monitor.trace
+
+            reg = _comm.inflight_collectives
+            if on:
+                reg.on_enter = lambda: self.begin("collective")
+                reg.on_exit = lambda: self.end("collective")
+            else:
+                reg.on_enter = reg.on_exit = None
+            reg.enabled = bool(on)
+        except Exception as e:  # noqa: BLE001
+            # swallowed LOUDLY: an operator who set deadline_collective_s
+            # must not silently lose the collective watch to a comm-module
+            # failure (telemetry still must never kill the run)
+            self._log().warning(f"health: collective watch not armed: {e!r}")
+
+    # ------------------------------------------------------------------
+    # heartbeats
+    # ------------------------------------------------------------------
+    def _entry(self, source):
+        e = self._hb.get(source)
+        if e is None:
+            with self._lock:
+                e = self._hb.setdefault(
+                    source, {"last": time.perf_counter(), "armed": False,
+                             "active": 0, "tripped": False})
+        return e
+
+    def beat(self, source):
+        """Recurring-activity heartbeat: arms the source (watched until
+        :meth:`disarm`) and resets its age + any tripped latch."""
+        if not self.enabled:
+            return
+        e = self._entry(source)
+        e["last"] = time.perf_counter()
+        e["armed"] = True
+        e["tripped"] = False
+
+    def touch(self, source):
+        """Reset a source's age without changing its armed state (a worker
+        loop ticking inside a begin/end window)."""
+        if not self.enabled:
+            return
+        e = self._entry(source)
+        e["last"] = time.perf_counter()
+        e["tripped"] = False
+
+    def begin(self, source):
+        """Operation-style heartbeat: the source is watched while at least
+        one :meth:`begin` is unmatched by :meth:`end`."""
+        if not self.enabled:
+            return
+        e = self._entry(source)
+        with self._lock:
+            e["active"] += 1
+        e["last"] = time.perf_counter()
+        e["tripped"] = False
+
+    def end(self, source):
+        if not self.enabled:
+            return
+        e = self._entry(source)
+        with self._lock:
+            e["active"] = max(0, e["active"] - 1)
+        e["last"] = time.perf_counter()
+        e["tripped"] = False
+
+    def disarm(self, source):
+        e = self._hb.get(source)
+        if e is not None:
+            e["armed"] = False
+
+    def release(self, source):
+        """Drop a dynamic (instance-qualified) source entirely — called on
+        worker exit so short-lived sources (one prefetch worker per epoch)
+        don't accumulate dead rows in /healthz forever."""
+        with self._lock:
+            self._hb.pop(source, None)
+
+    def _deadline_for(self, source):
+        """Deadline lookup with prefix fallback: instance-qualified sources
+        (``prefetch:worker-3`` — one entry per worker, so a healthy sibling
+        cannot mask a wedged one) inherit their family's deadline."""
+        d = self._deadlines.get(source)
+        if d is None and ":" in source:
+            d = self._deadlines.get(source.split(":", 1)[0])
+        return float(d or 0.0)
+
+    def heartbeats(self):
+        """Snapshot: source -> {age_s, armed, active, deadline_s, tripped}."""
+        now = time.perf_counter()
+        out = {}
+        with self._lock:
+            items = list(self._hb.items())
+        for source, e in items:
+            out[source] = {"age_s": max(0.0, now - e["last"]),
+                           "armed": bool(e["armed"]), "active": int(e["active"]),
+                           "deadline_s": self._deadline_for(source),
+                           "tripped": bool(e["tripped"])}
+        return out
+
+    # ------------------------------------------------------------------
+    # stall watchdog
+    # ------------------------------------------------------------------
+    def _start_watchdog(self):
+        if self._watchdog is not None and self._watchdog.is_alive():
+            return
+        self._watch_stop = threading.Event()
+        self._watchdog = threading.Thread(target=self._watch_loop,
+                                          name="dstpu-health-watchdog", daemon=True)
+        self._watchdog.start()
+
+    @property
+    def watchdog_alive(self):
+        return self._watchdog is not None and self._watchdog.is_alive()
+
+    def _watch_loop(self):
+        # bounded wait on the stop event: the watchdog itself must never be
+        # the unwatchable background loop it exists to catch
+        while not self._watch_stop.wait(self._poll_s):
+            try:
+                self.check_once()
+            except Exception as e:  # noqa: BLE001 — telemetry never kills runs
+                self._log().error(f"health watchdog check failed: {e!r}")
+
+    def check_once(self):
+        """One watchdog pass (the thread's body; callable from tests)."""
+        now = time.perf_counter()
+        with self._lock:
+            items = list(self._hb.items())
+        for source, e in items:
+            deadline = self._deadline_for(source)
+            if deadline <= 0 or e["tripped"]:
+                continue
+            if not (e["armed"] or e["active"] > 0):
+                continue
+            age = now - e["last"]
+            if age > deadline:
+                e["tripped"] = True  # one trip per stall; a fresh beat re-arms
+                self._on_stall(source, age)
+
+    def _on_stall(self, source, age):
+        self.stall_count += 1
+        get_metrics().counter("health/stall_total").inc()
+        get_metrics().counter(f"health/stall_{source}_total").inc()
+        get_flight_recorder().record("health", "stall", source=source,
+                                     age_s=round(age, 3))
+        tr = get_tracer()
+        if tr.enabled:
+            tr.instant("stall", tid="engine", source=source, age_s=round(age, 3))
+        path = None
+        try:
+            path = self.dump(f"stall_{source}",
+                             extra={"stall": {"source": source, "age_s": age}})
+        except Exception as e:  # noqa: BLE001
+            self._log().error(f"health: stall dump failed: {e!r}")
+        self._log().error(
+            f"health watchdog: source '{source}' stalled for {age:.1f}s "
+            f"(deadline {self._deadline_for(source)}s); quarantine dump: {path}. "
+            f"The process is NOT being killed — inspect the dump / attach a debugger.")
+        cb = self._stall_callback
+        if cb is not None:
+            try:
+                cb(source, age, path)
+            except Exception as e:  # noqa: BLE001
+                self._log().error(f"health: stall callback raised {e!r}")
+
+    # ------------------------------------------------------------------
+    # dumps
+    # ------------------------------------------------------------------
+    def dump(self, reason="manual", extra=None, path=None):
+        """Write the forensic bundle — all-thread stacks, the in-flight
+        collective table, heartbeat ages, and the flight-recorder ring — as
+        ordered JSONL. Returns the file path."""
+        import json
+
+        if path is None:
+            os.makedirs(self._dump_dir, exist_ok=True)
+            self._dump_n += 1
+            path = os.path.join(
+                self._dump_dir, f"health_{reason}_{os.getpid()}_{self._dump_n:03d}.jsonl")
+        names = {t.ident: t.name for t in threading.enumerate()}
+        stacks = {}
+        for ident, frame in sys._current_frames().items():
+            stacks[names.get(ident, f"ident-{ident}")] = [
+                ln.rstrip() for ln in traceback.format_stack(frame)]
+        with open(path, "w") as f:
+            f.write(json.dumps({"kind": "header", "reason": reason,
+                                "time_unix": _utcnow(), "pid": os.getpid(),
+                                "stall_count": self.stall_count}) + "\n")
+            if extra:
+                f.write(json.dumps({"kind": "extra", **extra}, default=repr) + "\n")
+            f.write(json.dumps({"kind": "threads", "stacks": stacks}) + "\n")
+            f.write(json.dumps({"kind": "inflight_collectives",
+                                "entries": self.inflight_collectives()},
+                               default=repr) + "\n")
+            f.write(json.dumps({"kind": "heartbeats",
+                                "sources": self.heartbeats()}) + "\n")
+            f.write(json.dumps({"kind": "flight_begin",
+                                "entries": get_flight_recorder().total_recorded,
+                                "capacity": get_flight_recorder().capacity}) + "\n")
+            get_flight_recorder().dump_jsonl(f)
+        get_metrics().counter("health/dumps_total").inc()
+        self.last_dump_path = path
+        return path
+
+    def inflight_collectives(self):
+        try:
+            from ..comm import comm as _comm
+
+            return _comm.inflight_collectives.snapshot()
+        except Exception:
+            return []
+
+    # ------------------------------------------------------------------
+    # straggler detection
+    # ------------------------------------------------------------------
+    def note_straggler(self, samples):
+        """Fold per-rank ``(step, step_wall_ms, input_wait_ms)`` tuples (one
+        per host, from the engine's piggybacked resilience vote) into
+        slowest-rank skew: ``max(wall) - median(wall)`` in ms. Recorded as
+        the ``train/straggler_skew_ms`` gauge + histogram; past
+        ``straggler_threshold_ms`` also a ``straggler`` trace instant, a
+        flight breadcrumb, and ``health/straggler_total``. Returns the skew."""
+        walls = sorted(float(s[1]) for s in samples)
+        if not walls:
+            return 0.0
+        n = len(walls)
+        # true median (middle-two average on even n): the upper median would
+        # make skew identically 0 on a 2-host pod — the straggler would be
+        # its own baseline
+        median = walls[n // 2] if n % 2 else 0.5 * (walls[n // 2 - 1] + walls[n // 2])
+        skew = walls[-1] - median
+        slowest = max(range(len(samples)), key=lambda i: float(samples[i][1]))
+        reg = get_metrics()
+        reg.gauge("train/straggler_skew_ms").set(skew)
+        reg.histogram("train/straggler_skew_ms_hist").observe(skew)
+        if self.straggler_threshold_ms > 0 and skew > self.straggler_threshold_ms:
+            reg.counter("health/straggler_total").inc()
+            get_flight_recorder().record("health", "straggler",
+                                         skew_ms=round(skew, 3), slowest_rank=slowest)
+            tr = get_tracer()
+            if tr.enabled:
+                tr.instant("straggler", tid="engine", skew_ms=round(skew, 3),
+                           slowest_rank=slowest)
+        return skew
+
+    # ------------------------------------------------------------------
+    # step-boundary hook + healthz composition
+    # ------------------------------------------------------------------
+    def step_boundary(self, step):
+        """Engine step-boundary tick: heartbeat + breadcrumb + snapshot
+        cadence. One call per train_batch while the plane is enabled."""
+        if not self.enabled:
+            return
+        self.beat("engine")
+        get_flight_recorder().record("engine", "step", step=int(step))
+        if self._snapshot_path is not None and step % self._snapshot_every == 0:
+            try:
+                self.write_snapshot()
+            except Exception as e:  # noqa: BLE001
+                self._log().error(f"health: snapshot write failed: {e!r}")
+
+    def set_state_provider(self, name, fn):
+        """Register a healthz section: ``fn() -> dict`` under key ``name``
+        (the engine registers step/sample counts, the saver its writer
+        state). Pass ``None`` to remove."""
+        if fn is None:
+            self._providers.pop(name, None)
+        else:
+            self._providers[name] = fn
+
+    def healthz_payload(self):
+        out = {"time_unix": _utcnow(), "enabled": self.enabled,
+               "stalls": self.stall_count,
+               "watchdog_alive": self.watchdog_alive,
+               "heartbeats": self.heartbeats(),
+               "inflight_collectives": self.inflight_collectives()}
+        for name, fn in list(self._providers.items()):
+            try:
+                out[name] = fn()
+            except Exception as e:  # noqa: BLE001
+                out[name] = {"error": repr(e)}
+        return out
+
+    def write_snapshot(self, path=None):
+        """Atomically rewrite the scrape-less JSON artifact (healthz payload
+        + full metrics snapshot): tmp + fsync + rename, so a reader never
+        sees a torn file."""
+        import json
+
+        path = path or self._snapshot_path
+        if path is None:
+            return None
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        payload = self.healthz_payload()
+        payload["metrics"] = get_metrics().snapshot()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=repr)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    # ------------------------------------------------------------------
+    # exporter / signal plumbing
+    # ------------------------------------------------------------------
+    def _start_server(self, host, port):
+        if self._server is not None:
+            return
+        from .export import HealthHTTPServer  # lazy: http.server only on demand
+
+        self._server = HealthHTTPServer(host, port, registry=get_metrics(),
+                                        healthz_fn=self.healthz_payload,
+                                        heartbeats_fn=self.heartbeats)
+        self._server.start()
+
+    @property
+    def server(self):
+        return self._server
+
+    def _install_sigquit(self):
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            self._log().warning("health: sigquit_dump needs the main thread; disabled")
+            return
+        self._prev_sigquit = signal.getsignal(signal.SIGQUIT)
+
+        def _on_sigquit(signum, frame):
+            try:
+                self.dump("sigquit")
+            finally:
+                if callable(self._prev_sigquit):
+                    self._prev_sigquit(signum, frame)
+
+        signal.signal(signal.SIGQUIT, _on_sigquit)
+
+    def _uninstall_sigquit(self):
+        if self._prev_sigquit is None:
+            return
+        import signal
+
+        try:
+            if threading.current_thread() is threading.main_thread():
+                signal.signal(signal.SIGQUIT, self._prev_sigquit)
+        finally:
+            self._prev_sigquit = None
+
+    @staticmethod
+    def _log():
+        from ..utils.logging import logger  # lazy: keep module import-light
+
+        return logger
+
+
+_health = HealthPlane()
+
+
+def get_health() -> HealthPlane:
+    return _health
+
+
+def configure_health(config=None, **kwargs) -> HealthPlane:
+    return _health.configure(config=config, **kwargs)

@@ -109,8 +109,11 @@ class Histogram:
     def summary(self):
         with self._lock:
             data = sorted(self._raw)  # one sort shared by every quantile
-            count, mean = self.count, self.mean()
-        return {"count": count, "mean": mean,
+            # mean from the SAME locked (count, total) read — calling
+            # self.mean() here would re-read both fields unlocked and could
+            # pair a new count with an old total under concurrent observe()
+            count, total = self.count, self.total
+        return {"count": count, "mean": total / count if count else 0.0,
                 "p50": self.percentile(50, data), "p90": self.percentile(90, data),
                 "p99": self.percentile(99, data)}
 
@@ -227,6 +230,15 @@ class MetricsRegistry:
                 "gauges": {g.name: g.value for g in self._gauges.values()},
                 "histograms": {h.name: h.summary() for h in self._histograms.values()},
             }
+
+    def to_prometheus(self):
+        """The registry in Prometheus text exposition format (0.0.4) —
+        counters/gauges/histograms with cumulative bucket series. The
+        rendering lives in ``monitor/export.py`` (imported lazily to keep
+        this module import-light for package bootstrap)."""
+        from .export import render_prometheus
+
+        return render_prometheus(self)
 
 
 _registry = MetricsRegistry(enabled=False)

@@ -89,6 +89,11 @@ class Tracer:
         self._pid = None
         self._tids = {}
         self._opened_paths = set()  # paths truncated once this process
+        # event mirror (the health plane's flight recorder): when set, every
+        # emitted event is also handed to mirror.record_event — INCLUDING in
+        # "tracing disabled" mode, where the spans exist only for the mirror
+        self._mirror = None
+        self._atexit_installed = False
 
     # -- configuration --------------------------------------------------
     def configure(self, enabled=None, path=None, flush_every=None, config=None):
@@ -112,6 +117,7 @@ class Tracer:
                 if enabled and not self.enabled:
                     self._pid = _process_id()
                     _install_compile_listener()
+                    self._install_atexit()
                     self.enabled = True
                     self._emit({"name": "process_name", "ph": "M", "ts": 0, "pid": self._pid,
                                 "tid": 0, "args": {"name": "deepspeed_tpu"}})
@@ -120,18 +126,42 @@ class Tracer:
                     self.enabled = False
         return self
 
+    def set_mirror(self, mirror):
+        """Install/remove the event mirror (``record_event(ev)`` duck type —
+        the health plane's flight recorder). With a mirror installed the
+        emitters run even while ``enabled`` is False, feeding the mirror
+        only: nothing is buffered or written to the trace path."""
+        with self._lock:
+            if mirror is not None and self._pid is None:
+                self._pid = _process_id()
+            self._mirror = mirror
+        return self
+
+    def _install_atexit(self):
+        """Flush/close at interpreter exit: without this, an abrupt
+        ``sys.exit`` (preemption runners do exactly that) truncates the tail
+        ``flush_every`` window of the JSONL artifact mid-run. Registered
+        once per tracer, on first enable; ``close()`` is idempotent so an
+        orderly ``drain()``/``close()`` beforehand costs nothing."""
+        if self._atexit_installed:
+            return
+        import atexit
+
+        atexit.register(self.close)
+        self._atexit_installed = True
+
     # -- emission -------------------------------------------------------
     def span(self, name, tid="engine", **args):
         """Context manager for a duration event. Allocation-free no-op
-        (the shared ``NULL_SPAN`` object) while disabled."""
-        if not self.enabled:
+        (the shared ``NULL_SPAN`` object) while disabled and unmirrored."""
+        if not self.enabled and self._mirror is None:
             return NULL_SPAN
         return _Span(self, name, tid, args)
 
     def complete(self, name, t0, duration, tid="engine", args=None):
         """Emit a ``ph:"X"`` duration event. ``t0`` is a ``time.perf_counter``
         reading; ``duration`` is in seconds."""
-        if not self.enabled:
+        if not self.enabled and self._mirror is None:
             return
         ev = {"name": name, "ph": "X", "ts": round((t0 - self._origin) * 1e6, 3),
               "dur": round(duration * 1e6, 3), "pid": self._pid, "tid": self._tid(tid)}
@@ -140,7 +170,7 @@ class Tracer:
         self._emit(ev)
 
     def instant(self, name, tid="engine", **args):
-        if not self.enabled:
+        if not self.enabled and self._mirror is None:
             return
         ev = {"name": name, "ph": "i", "s": "t", "ts": self._now_us(), "dur": 0,
               "pid": self._pid, "tid": self._tid(tid)}
@@ -149,7 +179,7 @@ class Tracer:
         self._emit(ev)
 
     def counter(self, name, value, tid="engine"):
-        if not self.enabled:
+        if not self.enabled and self._mirror is None:
             return
         self._emit({"name": name, "ph": "C", "ts": self._now_us(), "dur": 0, "pid": self._pid,
                     "tid": self._tid(tid), "args": {"value": float(value)}})
@@ -171,6 +201,11 @@ class Tracer:
             return tid
 
     def _emit(self, ev):
+        m = self._mirror
+        if m is not None:
+            m.record_event(ev)
+        if not self.enabled:
+            return  # mirror-only mode: nothing buffered, nothing written
         with self._lock:
             self._buf.append(ev)
             if self._path is None:
@@ -305,7 +340,7 @@ def observe_latency(t0, span_name, hist_name=None, tid="serving", span_args=None
             reg.histogram(hist_name).observe(dt * 1e3)
         for gname, gval in (gauges or {}).items():
             reg.gauge(gname).set(gval(dt) if callable(gval) else gval)
-    if _tracer.enabled:
+    if _tracer.enabled or _tracer._mirror is not None:
         _tracer.complete(span_name, t0, dt, tid=tid, args=span_args or {})
     return dt
 

@@ -38,14 +38,17 @@ makes prefetched and synchronous runs bit-identical on a fixed seed
 (test-enforced in ``tests/test_prefetch.py``).
 """
 
+import itertools
 import queue
 import threading
 import time
 
+from ...monitor.health import get_health
 from ...monitor.metrics import get_metrics
 from ...monitor.trace import get_tracer
 
 _END = object()  # worker sentinel: wrapped loader exhausted
+_WORKER_SEQ = itertools.count()  # unique heartbeat-source suffix per worker
 
 
 class DeviceBatch:
@@ -77,6 +80,13 @@ def _worker(loader, prepare_fn, place_fn, gas, start_step, out_q, stop, name):
     garbage-collected while the thread runs (the GC-safety half of the
     shutdown contract)."""
 
+    # per-worker stall-watchdog source (inherits the `prefetch` family
+    # deadline via the health plane's prefix fallback): two live workers must
+    # not share one heartbeat, or the healthy one masks the wedged one —
+    # the seq suffix keeps same-named loaders (epoch restarts) distinct
+    hb = get_health()
+    hb_src = f"prefetch:{name}-{next(_WORKER_SEQ)}"
+
     def put(item):
         # bounded-wait put so a consumer that vanished (close()/GC) cannot
         # strand the worker on a full queue forever
@@ -85,13 +95,20 @@ def _worker(loader, prepare_fn, place_fn, gas, start_step, out_q, stop, name):
                 out_q.put(item, timeout=0.1)
                 return True
             except queue.Full:
+                hb.touch(hb_src)  # parked on backpressure ≠ stalled
                 continue
         return False
 
     step = start_step
+    hb.begin(hb_src)
     try:
         it = iter(loader)
         while not stop.is_set():
+            # heartbeat per item: a worker wedged inside the loader or the
+            # H2D placement stops touching and trips the watchdog; a worker
+            # merely parked on a full queue keeps touching via put()'s
+            # bounded-wait loop below
+            hb.touch(hb_src)
             t0 = time.perf_counter()
             try:
                 mbs = [next(it) for _ in range(gas)]
@@ -113,6 +130,11 @@ def _worker(loader, prepare_fn, place_fn, gas, start_step, out_q, stop, name):
             step += 1
     except BaseException as e:  # noqa: BLE001 — every failure must reach the consumer
         put(_WorkerFailure(e))
+    finally:
+        hb.end(hb_src)
+        # dynamic source: drop the entry so per-epoch workers don't
+        # accumulate dead rows in /healthz forever
+        hb.release(hb_src)
 
 
 class DevicePrefetchIterator:

@@ -41,6 +41,7 @@ from ..comm import comm as dist
 from ..monitor.monitor import MonitorMaster
 from ..monitor.trace import configure_tracer, get_tracer
 from ..monitor.metrics import get_metrics, compute_mfu
+from ..monitor.health import get_health
 from ..parallel import groups
 from ..parallel.mesh import (BATCH_AXES, DATA_AXIS, DATA_REPL_AXIS, SEQ_AXIS, MeshConfig, build_mesh,
                              shard_map_compat)
@@ -383,6 +384,28 @@ class DeepSpeedEngine:
                 logger.warning("preemption_save: not on the main thread, SIGTERM trap disabled")
         self._resilience_active = (self._preemption is not None
                                    or (self._auto_save.enabled and self._ckpt_save_dir is not None))
+        # live-health plane (monitor/health.py): flight recorder + stall
+        # watchdog + telemetry exporter, all off by default — when the
+        # `health` block is absent the step loop pays one boolean check
+        self._health = get_health()
+        self._last_step_wall_ms = 0.0
+        self._last_input_wait_ms = 0.0
+        self._hb_prev_step_t = None
+        if config.monitor_config.health.enabled:
+            self._health.configure(config=config.monitor_config.health)
+            self._health.set_state_provider(
+                "engine", lambda: {"step": self.global_steps,
+                                   "samples": self.global_samples,
+                                   "skipped_steps": self.skipped_steps,
+                                   "last_step_wall_ms": round(self._last_step_wall_ms, 3),
+                                   "last_input_wait_ms": round(self._last_input_wait_ms, 3)})
+            self._health.set_state_provider("saver", self._ckpt_saver.health_state)
+            # arm the engine source NOW: a run that wedges inside its very
+            # first train_batch (the jit-traced collective class the
+            # in-flight registry deliberately can't see) must still trip
+            # deadline_train_step_s — a slow first compile past the deadline
+            # costs one latched dump, not a kill
+            self._health.beat("engine")
         if config.flops_profiler_config.enabled:
             from ..profiling.flops_profiler import FlopsProfiler
 
@@ -1226,7 +1249,8 @@ class DeepSpeedEngine:
         span on the ``data`` trace stream.
         """
         gas = self.config.gradient_accumulation_steps
-        wait_obs = self._tracer.enabled or self._metrics.enabled
+        health_on = self._health.enabled
+        wait_obs = self._tracer.enabled or self._metrics.enabled or health_on
         t_in = time.perf_counter() if wait_obs else 0.0
         prefetched = isinstance(batch, DeviceBatch)
         if batch is None:
@@ -1245,6 +1269,8 @@ class DeepSpeedEngine:
                 placed = self._shard_batch(batch, leading=("mb", ))
         if wait_obs:
             dt_in = time.perf_counter() - t_in
+            if health_on:
+                self._last_input_wait_ms = dt_in * 1e3  # straggler-vote sample
             if self._metrics.enabled:
                 self._metrics.histogram("train/input_wait_ms").observe(dt_in * 1e3)
             if self._tracer.enabled:
@@ -1291,8 +1317,16 @@ class DeepSpeedEngine:
             self.skipped_steps += 1  # offload path counts inside _host_apply_update
         self._record_metrics(metrics)
         self._maybe_flops_profile(placed)
+        if health_on:
+            # host wall clock from train_batch entry to the step boundary —
+            # no device sync forced (dispatch-side time is what skews when a
+            # host straggles on input/assembly/python work, and a forced
+            # block here would serialize the async step pipeline)
+            self._last_step_wall_ms = (time.perf_counter() - t_in) * 1e3
         if self._resilience_active:
             self._poll_resilience()
+        if health_on:
+            self._health.step_boundary(self.global_steps)
         return metrics["loss"]
 
     def aot_lower_train_step(self, seq_len: int):
@@ -1906,9 +1940,25 @@ class DeepSpeedEngine:
             # the votes so every process takes the same branch at the same
             # step (one small host all-gather per step, only while the
             # resilience plane is active at all).
-            votes = dist.all_gather_host((bool(preempt), bool(due)))
+            #
+            # Straggler piggyback: with the health plane on, each rank rides
+            # its (step, step_wall_ms, input_wait_ms) sample on this SAME
+            # gather — every host then computes slowest-rank skew for free
+            # (no extra collective). Arity is config-derived, so all ranks
+            # agree on the tuple shape.
+            payload = (bool(preempt), bool(due))
+            if self._health.enabled:
+                payload += (self.global_steps, round(self._last_step_wall_ms, 3),
+                            round(self._last_input_wait_ms, 3))
+            votes = dist.all_gather_host(payload)
             preempt = any(v[0] for v in votes)
             due = any(v[1] for v in votes)
+            # ranks can be health-armed asymmetrically (programmatic
+            # configure() on rank 0 only): skew is only meaningful — and the
+            # per-vote tail only present — when EVERY rank sent its sample
+            samples = [v[2:] for v in votes if len(v) >= 5]
+            if self._health.enabled and samples and len(samples) == len(votes):
+                self._health.note_straggler(samples)
         if preempt:
             tag = None
             if self._ckpt_save_dir is not None:
@@ -2177,8 +2227,22 @@ class DeepSpeedEngine:
             # to close it — flush the artifact before tearing state down
             self.stop_device_trace()
         # join any in-flight async checkpoint write: tearing down state under
-        # a live writer would hand tensorstore a half-freed tree
-        self.flush_checkpoints()
+        # a live writer would hand tensorstore a half-freed tree. The join is
+        # BOUNDED: a writer wedged in storage I/O must not hang destroy()
+        # forever (it warns, counts health/saver_join_timeout_total, and the
+        # daemon thread dies with the process).
+        self._ckpt_saver.shutdown()
+        if self._health.enabled:
+            # final forensic record: the tail window of everything the run
+            # did, so a post-mortem has the same bundle a stall dump carries
+            if self._health.dump_on_destroy:
+                try:
+                    self._health.dump("destroy")
+                except Exception as e:
+                    logger.warning(f"health: destroy() dump failed: {e!r}")
+            self._health.disarm("engine")
+            self._health.set_state_provider("engine", None)
+            self._health.set_state_provider("saver", None)
         if self._preemption is not None:
             self._preemption.uninstall()
             self._preemption = None

@@ -31,6 +31,8 @@ from . import fault_injection
 from .errors import CheckpointCorruptError
 from .manifest import (build_manifest, is_committed, read_manifest, tree_spec,
                        write_manifest, MANIFEST_FILE)
+from ...monitor.flight import get_flight_recorder
+from ...monitor.health import get_health
 from ...monitor.metrics import get_metrics
 from ...monitor.trace import get_tracer
 from ...utils.logging import logger
@@ -251,8 +253,13 @@ class ResilientSaver:
             self._join_locked()
             self.last_error = None  # status tracks the save being started
             if blocking:
-                return self._write_and_commit(state, save_dir, tag, save_latest,
-                                              commit_gate=commit_gate)
+                health = get_health()
+                health.begin("saver")
+                try:
+                    return self._write_and_commit(state, save_dir, tag, save_latest,
+                                                  commit_gate=commit_gate)
+                finally:
+                    health.end("saver")
             if payload_in_caller:
                 t0 = time.perf_counter()
                 local_ok, spec = True, None
@@ -300,6 +307,41 @@ class ResilientSaver:
             t.join()
             self._thread = None
 
+    def shutdown(self, timeout=60.0):
+        """Teardown-path join with a BOUND: ``engine.destroy()`` must not
+        hang forever behind a writer wedged in storage I/O (the unbounded
+        ``flush()`` join is for durability-critical paths — load, the
+        preemption final save — where waiting is the point). On timeout the
+        writer is abandoned loudly: a warning names the tag thread,
+        ``health/saver_join_timeout_total`` counts it, and the daemon thread
+        is left to die with the process. Returns True iff the writer is
+        fully joined (or there was none)."""
+        with self._lock:
+            t = self._thread
+            if t is None:
+                return True
+            t.join(timeout=timeout)
+            if t.is_alive():
+                get_metrics().counter("health/saver_join_timeout_total").inc()
+                get_flight_recorder().record("saver", "join_timeout",
+                                             thread=t.name, timeout_s=timeout)
+                logger.warning(
+                    f"checkpoint writer {t.name!r} did not finish within {timeout}s at "
+                    f"shutdown; abandoning the join (the daemon thread dies with the "
+                    f"process, 'latest' still references the last durable tag)")
+                return False
+            self._thread = None
+            return True
+
+    def health_state(self):
+        """The /healthz ``saver`` section: writer liveness + commit tallies."""
+        t = self._thread
+        return {"in_flight": bool(t is not None and t.is_alive()),
+                "writer_thread": t.name if t is not None else None,
+                "saves_committed": self.saves_committed,
+                "saves_failed": self.saves_failed,
+                "last_error": repr(self.last_error) if self.last_error else None}
+
     @property
     def in_flight(self):
         t = self._thread
@@ -338,19 +380,30 @@ class ResilientSaver:
 
     def _run_writer(self, tag, fn):
         tracer = get_tracer()
+        health = get_health()
+        flight = get_flight_recorder()
         t0 = time.perf_counter()
+        # operation-style heartbeat: the `saver` source is watched exactly
+        # while a write is in flight — a writer wedged in storage I/O stops
+        # beating and trips the stall watchdog past its deadline
+        health.begin("saver")
+        flight.record("saver", "write_begin", tag=str(tag))
         try:
             ok = fn()
+            flight.record("saver", "write_end", tag=str(tag), committed=bool(ok))
             if tracer.enabled:
                 tracer.complete("checkpoint/async_write", t0, time.perf_counter() - t0,
                                 tid="checkpoint", args={"tag": str(tag), "committed": bool(ok)})
         except BaseException as e:  # noqa: BLE001 — a dead writer must never kill training
             self.last_error = e  # failure counters already bumped in the commit path
+            flight.record("saver", "write_error", tag=str(tag), error=repr(e))
             if tracer.enabled:
                 tracer.complete("checkpoint/async_write", t0, time.perf_counter() - t0,
                                 tid="checkpoint", args={"tag": str(tag), "error": repr(e)})
             logger.error(f"async checkpoint writer died for tag {tag}: {e!r}; "
                          f"'latest' still references the previous durable tag")
+        finally:
+            health.end("saver")
 
     def _write_payload(self, state, save_dir, tag):
         """Payload stage: engine create + save. Returns the manifest tree

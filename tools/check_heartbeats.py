@@ -1,0 +1,204 @@
+"""Static check: background-thread loops stay watchable.
+
+Companion to ``check_timed_ops.py`` / ``check_data_paths.py`` /
+``check_ckpt_commit.py`` (same lesson: structural invariants rot silently
+unless CI asserts them). The live-health plane (``monitor/health.py``) can
+only catch a wedged background thread if that thread's loop either touches a
+heartbeat (``beat``/``touch``/``begin``/``end``) or bounds every wait — an
+unbounded ``while True: q.get()`` in a worker is invisible to the watchdog
+AND un-joinable at shutdown. This AST walk (no package imports, runs
+anywhere) asserts, for every file in ``runtime/resilience/`` plus
+``runtime/data_pipeline/prefetch.py``:
+
+  * every function used as a ``threading.Thread(target=...)`` (resolved
+    through module functions, ``self._method`` attributes, and one level of
+    plain-name aliasing) is a KNOWN WORKER;
+  * every ``while`` loop inside a known worker (including its nested helper
+    functions, and the methods it calls on ``self``) contains — directly or
+    via a helper defined in the same scope — a heartbeat call or a bounded
+    wait (a call with a ``timeout`` argument, ``*_nowait``, or ``sleep``).
+
+A tier-1 test (``tests/test_health.py``) runs this on every CI pass, so a
+new background loop cannot silently become unwatchable.
+"""
+
+import ast
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PKG = os.path.join(_HERE, os.pardir, "deepspeed_tpu")
+
+DEFAULT_TARGETS = (
+    os.path.join(_PKG, "runtime", "resilience"),
+    os.path.join(_PKG, "runtime", "data_pipeline", "prefetch.py"),
+)
+
+# heartbeat surface of monitor/health.py
+HEARTBEAT_CALLS = {"beat", "touch", "begin", "end"}
+# calls that bound a wait by construction
+BOUNDED_CALLS = {"sleep", "get_nowait", "put_nowait"}
+
+
+def _iter_py_files(target):
+    if os.path.isfile(target):
+        yield target
+        return
+    for root, _dirs, files in os.walk(target):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def _func_defs(tree):
+    """Every function/method in the module: name -> [nodes] (methods and
+    module functions may share names; all candidates are checked)."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _thread_target_names(tree):
+    """Names passed as ``target=`` to a ``Thread(...)`` construction:
+    bare function names, ``self._method`` attribute names, and plain-name
+    aliases (``target = self._background_write`` two lines earlier)."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v = node.value
+            if isinstance(v, ast.Attribute):
+                aliases[node.targets[0].id] = v.attr
+            elif isinstance(v, ast.Name):
+                aliases[node.targets[0].id] = v.id
+    names = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) else \
+            (node.func.id if isinstance(node.func, ast.Name) else None)
+        if fname != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Attribute):
+                names.add(v.attr)
+            elif isinstance(v, ast.Name):
+                names.add(aliases.get(v.id, v.id))
+    return names
+
+
+def _walk_pruning_defs(node):
+    """Like ``ast.walk`` but does not descend into nested function/lambda
+    bodies: code inside an uncalled nested def never runs, so a heartbeat
+    there must not count as covering the enclosing loop."""
+    stack = [node]
+    while stack:
+        sub = stack.pop()
+        yield sub
+        for child in ast.iter_child_nodes(sub):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _calls_in(node, skip_nested_defs=False):
+    """(bare names + attribute names of call targets, whether any call
+    carries a bounded wait) inside ``node``. With ``skip_nested_defs`` the
+    scan stays in the directly-executed body (nested defs pruned) — their
+    contribution comes through helper resolution when they are CALLED."""
+    names, bounded = set(), False
+    walker = _walk_pruning_defs(node) if skip_nested_defs else ast.walk(node)
+    for sub in walker:
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            fname = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else None)
+            if fname is not None:
+                names.add(fname)
+                if fname in BOUNDED_CALLS:
+                    bounded = True
+            if any(kw.arg == "timeout" for kw in sub.keywords):
+                bounded = True
+    return names, bounded
+
+
+def _loop_ok(loop, helper_defs):
+    """A loop is watchable when its body touches a heartbeat or a bounded
+    wait — directly, or through a helper function visible in scope. Nested
+    defs in the body are pruned from the direct scan (defining a heartbeat
+    is not calling one)."""
+    names, bounded = _calls_in(loop, skip_nested_defs=True)
+    if bounded or names & HEARTBEAT_CALLS:
+        return True
+    # one level of helper resolution: `put(item)` where the sibling-scoped
+    # `put` contains the bounded wait / heartbeat
+    for n in names:
+        for helper in helper_defs.get(n, ()):
+            h_names, h_bounded = _calls_in(helper)
+            if h_bounded or h_names & HEARTBEAT_CALLS:
+                return True
+    return False
+
+
+def _worker_closure(defs, roots):
+    """Worker functions plus everything they call that is defined in the
+    same module (the thread executes those bodies too)."""
+    seen, frontier = set(), list(roots)
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name not in defs:
+            continue
+        seen.add(name)
+        for node in defs[name]:
+            called, _ = _calls_in(node)
+            frontier.extend(called - seen)
+    return seen
+
+
+def check(targets=DEFAULT_TARGETS):
+    """Return a list of human-readable violations (empty == clean)."""
+    violations = []
+    for target in targets:
+        for path in _iter_py_files(target):
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            defs = _func_defs(tree)
+            workers = _thread_target_names(tree)
+            if not workers:
+                continue
+            for fn_name in sorted(_worker_closure(defs, workers)):
+                for fn in defs.get(fn_name, ()):
+                    for sub in ast.walk(fn):
+                        if not isinstance(sub, ast.While):
+                            continue
+                        if not _loop_ok(sub, defs):
+                            rel = os.path.relpath(path, os.path.join(_HERE, os.pardir))
+                            violations.append(
+                                f"{rel}:{sub.lineno} `while` loop in worker-thread "
+                                f"function '{fn_name}' has neither a heartbeat "
+                                f"(beat/touch/begin/end) nor a bounded wait "
+                                f"(timeout=/sleep/*_nowait) — the stall watchdog "
+                                f"cannot see it and shutdown cannot bound it")
+    return violations
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    targets = tuple(argv) if argv else DEFAULT_TARGETS
+    violations = check(targets)
+    if violations:
+        print("check_heartbeats: FAILED")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print("check_heartbeats: all worker-thread loops are heartbeat-covered or bounded")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -321,11 +321,37 @@ def main():
         # sitecustomize's config-level jax_platforms beats the env var
         jax.config.update("jax_platforms", "cpu")
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
+
+    # arm the live-health plane for the whole run (serving heartbeats wrap
+    # every put/decode): a wedged device forward trips the watchdog instead
+    # of the tool hanging silently, and the final JSON reports the counters.
+    # DS_TPU_SERVING_HEALTH=0 runs bare; the deadline is generous because a
+    # cold compile of a new shape bucket legitimately takes a while.
+    health = None
+    if os.environ.get("DS_TPU_SERVING_HEALTH", "1") != "0":
+        from deepspeed_tpu.monitor.health import get_health
+
+        health = get_health().configure(
+            enabled=True,
+            deadlines={"serving": float(os.environ.get("DS_TPU_SERVING_DEADLINE_S", "300"))})
+
     if "shared_prefix" in sys.argv[1:]:
         out = shared_prefix_ab(on_tpu)
     else:
         out = serving_load_bench(on_tpu)
     out["on_tpu"] = on_tpu
+
+    if health is not None:
+        from deepspeed_tpu.monitor.metrics import get_metrics
+
+        reg = get_metrics()
+        out["health"] = {
+            "stalls": health.stall_count,
+            "stall_serving_total": int(reg.counter("health/stall_serving_total").value),
+            "dumps_total": int(reg.counter("health/dumps_total").value),
+            "last_dump": health.last_dump_path,
+        }
+        health.shutdown()
     print(json.dumps(out))
 
 
