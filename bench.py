@@ -451,6 +451,19 @@ def run_bench():
                 gate_note = add_note("non-critical on-chip kernel stage timed out (cold compiles?)")
 
     serving = bench_serving(on_tpu)
+    # gateway plane (PR 6): latency-under-load curves through the HTTP/SSE
+    # request plane + the prefix-router vs random-placement A/B. Small-engine
+    # config by design (two production replicas do not share one chip), so it
+    # rides every bench run; DS_TPU_BENCH_GATEWAY=0 skips, and a failure
+    # costs this block only — never the headline serving numbers.
+    if os.environ.get("DS_TPU_BENCH_GATEWAY", "1") != "0":
+        try:
+            from tools.serving_load import gateway_bench
+
+            serving["gateway"] = gateway_bench(on_tpu)
+        except Exception as e:
+            print(f"# WARNING: gateway bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
     print(json.dumps(serving))
 
     def train_tps(cfg, micro, gas, seq, steps, warmup, data="batch"):
@@ -724,7 +737,9 @@ def run_bench():
         "workload": f"{n_params/1e6:.1f}M llama-arch, seq {seq}, ZeRO-3, single v5e chip",
         "serving": {k: serving[k] for k in ("value", "ttft_p50_ms", "vs_baseline")
                     if k in serving} | ({"prefix_cache": serving["prefix_cache"]}
-                                       if "prefix_cache" in serving else {}),
+                                       if "prefix_cache" in serving else {})
+                                     | ({"gateway": serving["gateway"]}
+                                        if "gateway" in serving else {}),
         # achieved MFU fraction (null on the CPU fallback — the v5e-peak
         # denominator would read as a 99.9% regression, the VERDICT r4 trap)
         "mfu": round(mfu, 4) if on_tpu else None,

@@ -522,6 +522,21 @@ class InferenceEngineV2:
         log_dist(f"InferenceEngineV2 serialized to {save_path}", ranks=[0])
 
     @property
+    def max_context(self) -> int:
+        """Per-sequence context ceiling in tokens (prompt + generation),
+        after the model's own ``max_seq_len`` clamp. Public so the request
+        plane (``deepspeed_tpu/serving/``) can validate without reaching
+        into engine internals — the ``tools/check_gateway_api.py`` gate
+        forbids it anything non-public."""
+        return self._max_context
+
+    @property
+    def max_concurrent_sequences(self) -> int:
+        """Sequences one ragged forward may carry (the scheduler/batch
+        ceiling) — the request plane's default in-flight bound."""
+        return self.config.state_manager.max_ragged_sequence_count
+
+    @property
     def free_blocks(self) -> int:
         return self.state_manager.free_blocks
 

@@ -23,6 +23,7 @@ scheduler / engine (asserted by ``tests/test_prefix_cache.py`` and the
 """
 
 import heapq
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -79,6 +80,13 @@ class PrefixKVCache:
         self._root = _Node(chunk=(), block=-1, parent=None)
         self._n_nodes = 0
         self._clock = 0  # monotonic LRU clock
+        # the serving gateway's router/admission probe the tree with `match`
+        # from HTTP handler threads while the replica driver publishes/evicts
+        # — concurrent dict iteration against a mutating node.children is a
+        # CPython RuntimeError, so every tree walk serializes on this lock.
+        # RLock: acquire() reaches evict() through _reserve_with_eviction.
+        # Uncontended cost is ~100ns per op, noise against a forward.
+        self._tree_lock = threading.RLock()
         self.stats = {"lookups": 0, "hits": 0, "cached_tokens": 0, "cow_copies": 0,
                       "insertions": 0, "evictions": 0}
 
@@ -93,7 +101,8 @@ class PrefixKVCache:
 
     def cached_block_ids(self) -> List[int]:
         """Block ids currently held by the tree (one tree reference each)."""
-        return [n.block for n in self._iter_nodes()]
+        with self._tree_lock:
+            return [n.block for n in self._iter_nodes()]
 
     @property
     def evictable_blocks(self) -> int:
@@ -106,15 +115,23 @@ class PrefixKVCache:
         O(tree) per call — fine at the current pool scale; an incrementally
         maintained counter needs refcount-transition hooks in the allocator
         and is the first thing to add if admission ever shows up hot."""
-        return sum(1 for n in self._iter_nodes() if self.kv_cache.refcount(n.block) == 1)
+        with self._tree_lock:
+            return sum(1 for n in self._iter_nodes()
+                       if self.kv_cache.refcount(n.block) == 1)
 
     # -- admission side ----------------------------------------------------
     def match(self, tokens) -> PrefixMatch:
         """PURE longest-prefix walk (no refs taken, no LRU touch): how much
         of ``tokens`` the tree could serve. The usable prefix is capped at
         ``len(tokens) - 1`` — the engine must always compute at least the
-        last prompt token to produce the first generated token."""
+        last prompt token to produce the first generated token.
+        Thread-safe: the serving gateway's router/admission probe from HTTP
+        handler threads while the owning replica driver mutates the tree."""
         tokens = np.asarray(tokens, dtype=np.int64).reshape(-1)
+        with self._tree_lock:
+            return self._match_locked(tokens)
+
+    def _match_locked(self, tokens) -> PrefixMatch:
         m = PrefixMatch()
         bs = self.block_size
         usable = tokens.size - 1
@@ -172,35 +189,36 @@ class PrefixKVCache:
         allocation can trigger eviction, so eviction can never reclaim the
         blocks this very hit depends on."""
         tokens = np.asarray(tokens, dtype=np.int64).reshape(-1)
-        self.stats["lookups"] += 1
-        m = match if match is not None else self.match(tokens)
-        if m.n_cached_tokens == 0:
-            return [], 0, 0
-        # touch the matched path (LRU) and pin the shared run
-        node = self._root
-        bs = self.block_size
-        for i, b in enumerate(m.shared_blocks):
-            node = node.children[tuple(int(t) for t in np.asarray(tokens[i * bs:(i + 1) * bs]))]
-            self._touch(node)
-        if m.shared_blocks:
-            self.kv_cache.incref(m.shared_blocks)
-        blocks = list(m.shared_blocks)
-        n_cached = len(m.shared_blocks) * bs
-        if m.cow_src is not None:
-            try:
-                dst = int(self._reserve_with_eviction(1)[0])
-            except ValueError:
-                dst = None  # pool truly dry: fall back to the full-block hit
-            if dst is not None:
-                self.kv_cache.copy_block(m.cow_src, dst)
-                blocks.append(dst)
-                n_cached += m.cow_tokens
-                self.stats["cow_copies"] += 1
-        if n_cached == 0:
-            return [], 0, 0
-        self.stats["hits"] += 1
-        self.stats["cached_tokens"] += n_cached
-        return blocks, n_cached, len(m.shared_blocks)
+        with self._tree_lock:
+            self.stats["lookups"] += 1
+            m = match if match is not None else self._match_locked(tokens)
+            if m.n_cached_tokens == 0:
+                return [], 0, 0
+            # touch the matched path (LRU) and pin the shared run
+            node = self._root
+            bs = self.block_size
+            for i, b in enumerate(m.shared_blocks):
+                node = node.children[tuple(int(t) for t in np.asarray(tokens[i * bs:(i + 1) * bs]))]
+                self._touch(node)
+            if m.shared_blocks:
+                self.kv_cache.incref(m.shared_blocks)
+            blocks = list(m.shared_blocks)
+            n_cached = len(m.shared_blocks) * bs
+            if m.cow_src is not None:
+                try:
+                    dst = int(self._reserve_with_eviction(1)[0])
+                except ValueError:
+                    dst = None  # pool truly dry: fall back to the full-block hit
+                if dst is not None:
+                    self.kv_cache.copy_block(m.cow_src, dst)
+                    blocks.append(dst)
+                    n_cached += m.cow_tokens
+                    self.stats["cow_copies"] += 1
+            if n_cached == 0:
+                return [], 0, 0
+            self.stats["hits"] += 1
+            self.stats["cached_tokens"] += n_cached
+            return blocks, n_cached, len(m.shared_blocks)
 
     # -- exit side ---------------------------------------------------------
     def publish(self, seq) -> int:
@@ -231,24 +249,25 @@ class PrefixKVCache:
         full = min(known // bs, len(seq.kv_blocks))
         if full <= getattr(seq, "published_blocks", 0):
             return 0
-        node = self._root
-        inserted = 0
-        for b in range(full):
-            chunk = tuple(int(t) for t in seq.token_history[b * bs:(b + 1) * bs])
-            child = node.children.get(chunk)
-            if child is None:
-                child = _Node(chunk=chunk, block=seq.kv_blocks[b], parent=node)
-                self.kv_cache.incref(child.block)
-                node.children[chunk] = child
-                self._n_nodes += 1
-                self.stats["insertions"] += 1
-                self._touch(child)
-                inserted += 1
-            elif child.block != seq.kv_blocks[b]:
-                break  # a different writer owns this path from here down
-            node = child
-        seq.published_blocks = full
-        return inserted
+        with self._tree_lock:
+            node = self._root
+            inserted = 0
+            for b in range(full):
+                chunk = tuple(int(t) for t in seq.token_history[b * bs:(b + 1) * bs])
+                child = node.children.get(chunk)
+                if child is None:
+                    child = _Node(chunk=chunk, block=seq.kv_blocks[b], parent=node)
+                    self.kv_cache.incref(child.block)
+                    node.children[chunk] = child
+                    self._n_nodes += 1
+                    self.stats["insertions"] += 1
+                    self._touch(child)
+                    inserted += 1
+                elif child.block != seq.kv_blocks[b]:
+                    break  # a different writer owns this path from here down
+                node = child
+            seq.published_blocks = full
+            return inserted
 
     # -- pressure valve ----------------------------------------------------
     def evict(self, n_blocks: int) -> int:
@@ -257,31 +276,33 @@ class PrefixKVCache:
         exposes its parent (now a leaf, tree-only) pushes the parent — no
         per-block rescan of the whole tree.
         Returns how many blocks actually went back to the free list."""
-        heap = [(n.last_access, id(n), n) for n in self._iter_leaves()
-                if self.kv_cache.refcount(n.block) == 1]
-        heapq.heapify(heap)
-        freed = 0
-        while heap and freed < n_blocks:
-            _, _, node = heapq.heappop(heap)
-            parent = node.parent
-            self._remove(node)
-            freed += 1
-            self.stats["evictions"] += 1
-            if (parent is not self._root and not parent.children
-                    and self.kv_cache.refcount(parent.block) == 1):
-                heapq.heappush(heap, (parent.last_access, id(parent), parent))
-        return freed
+        with self._tree_lock:
+            heap = [(n.last_access, id(n), n) for n in self._iter_leaves()
+                    if self.kv_cache.refcount(n.block) == 1]
+            heapq.heapify(heap)
+            freed = 0
+            while heap and freed < n_blocks:
+                _, _, node = heapq.heappop(heap)
+                parent = node.parent
+                self._remove(node)
+                freed += 1
+                self.stats["evictions"] += 1
+                if (parent is not self._root and not parent.children
+                        and self.kv_cache.refcount(parent.block) == 1):
+                    heapq.heappush(heap, (parent.last_access, id(parent), parent))
+            return freed
 
     def clear(self) -> int:
         """Release EVERY tree reference (eviction flush): blocks whose only
         holder was the tree return to the free list; blocks still held by
         live sequences merely lose the tree's reference."""
-        nodes = list(self._iter_nodes())
-        for node in nodes:
-            self.kv_cache.release(node.block)
-        self._root.children = {}
-        self._n_nodes = 0
-        return len(nodes)
+        with self._tree_lock:
+            nodes = list(self._iter_nodes())
+            for node in nodes:
+                self.kv_cache.release(node.block)
+            self._root.children = {}
+            self._n_nodes = 0
+            return len(nodes)
 
     def _reserve_with_eviction(self, n: int) -> np.ndarray:
         short = n - self.kv_cache.free_blocks

@@ -112,6 +112,44 @@ class DynamicSplitFuseScheduler:
             out[uid] = list(req.generated)
         return out
 
+    def discard_result(self, uid: int) -> None:
+        """Drop a FINISHED request's stored generation (and its ``finished``
+        membership). The serving gateway streams tokens out incrementally
+        and reads ``results`` every step — without discarding, a long-lived
+        scheduler's result dict (and each per-step copy) grows with every
+        request ever served. No-op for unknown/active uids."""
+        self._results.pop(uid, None)
+
+    def new_tokens(self, uid: int, start: int) -> List[int]:
+        """Tokens generated past position ``start`` for a pending/active/
+        finished uid — the gateway's per-step fan-out read. Copies only the
+        TAIL, where ``results`` would copy every active generation whole
+        each step (O(total tokens) per step, quadratic over a request's
+        life). Unknown uids yield []."""
+        req = self._active.get(uid)
+        gen = req.generated if req is not None else self._results.get(uid)
+        return [] if gen is None else list(gen[start:])
+
+    def cancel(self, uid: int) -> bool:
+        """Abort a request NOW: a pending one is dropped, an active one is
+        finished in place (engine sequence flushed, lifetime KV reservation
+        released, tokens-so-far kept in ``results``). The serving gateway
+        calls this when a client times out or disconnects — without it an
+        abandoned request would keep decoding to ``max_new_tokens``,
+        holding its KV blocks and an admission slot against live traffic.
+        MUST be called from the thread that drives ``step`` (it mutates
+        scheduler/engine state). Returns False for unknown uids."""
+        for i, req in enumerate(self._pending):
+            if req.uid == uid:
+                self._pending.pop(i)
+                self._results[uid] = req.generated  # partial = empty, kept
+                return True
+        req = self._active.get(uid)
+        if req is None:
+            return False
+        self._finish(req)
+        return True
+
     def _blocks_for(self, n_tokens: int) -> int:
         bs = self.engine.config.kv_block_size
         return -(-n_tokens // bs)

@@ -14,9 +14,12 @@ logging goes quiet. Three surfaces, all stdlib:
     go through :func:`escape_label_value` (backslash, quote, newline).
   * :class:`HealthHTTPServer` — an opt-in daemon-thread
     ``http.server.ThreadingHTTPServer`` serving ``GET /metrics`` (Prometheus
-    text, including per-source heartbeat-age gauges) and ``GET /healthz``
+    text, including per-source heartbeat-age gauges), ``GET /healthz``
     (the health plane's JSON payload: last-heartbeat ages, current step,
-    in-flight collectives, saver state). Port 0 binds an ephemeral port
+    in-flight collectives, saver state, and a ``ready`` field distinct
+    from liveness) and ``GET /readyz`` (same payload, but the status code
+    follows ``ready`` — 200/503 — so a load balancer can drain a replica
+    that is alive but not taking traffic). Port 0 binds an ephemeral port
     (``server.port`` reports the real one).
   * snapshot mode lives on the health plane itself
     (``HealthPlane.write_snapshot``): an atomically-rewritten JSON file for
@@ -188,9 +191,19 @@ class HealthHTTPServer:
                     elif path == "/healthz":
                         self._send(200, "application/json",
                                    json.dumps(outer.healthz_fn(), default=repr))
+                    elif path == "/readyz":
+                        # readiness ≠ liveness: the payload's `ready` field
+                        # (the health plane's ready provider — warmup done,
+                        # admission queues below shed depth, not draining)
+                        # drives the STATUS code, so an LB health check can
+                        # pull a replica from rotation without killing it
+                        payload = outer.healthz_fn()
+                        code = 200 if payload.get("ready", True) else 503
+                        self._send(code, "application/json",
+                                   json.dumps(payload, default=repr))
                     else:
                         self._send(404, "text/plain; charset=utf-8",
-                                   "not found: /metrics or /healthz\n")
+                                   "not found: /metrics, /healthz or /readyz\n")
                 except (BrokenPipeError, ConnectionResetError):
                     pass  # scraper went away mid-response
 

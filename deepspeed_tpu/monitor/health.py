@@ -77,6 +77,7 @@ class HealthPlane:
         self._snapshot_path = None
         self._snapshot_every = 50
         self._providers = {}  # name -> callable() -> dict (healthz sections)
+        self._ready_provider = None  # callable() -> bool (LB readiness)
         self._stall_callback = None
         self._dump_dir = "/tmp/dstpu_health"
         self._dump_n = 0
@@ -166,6 +167,7 @@ class HealthPlane:
             self._hb.clear()
             self._deadlines.clear()
         self._providers.clear()
+        self._ready_provider = None
         self._snapshot_path = None
         self._stall_callback = None
         return self
@@ -443,8 +445,44 @@ class HealthPlane:
         else:
             self._providers[name] = fn
 
+    def set_ready_provider(self, fn):
+        """Register the READINESS oracle: ``fn() -> bool``, distinct from
+        liveness. A live process can be not-ready (warmup still compiling,
+        admission queues at their shed depth, operator-initiated drain) —
+        an LB keying on ``/readyz`` takes it out of rotation without
+        killing it. Pass ``None`` to remove (ready defaults back to the
+        process being up). The serving gateway registers its composite
+        readiness here on start."""
+        self._ready_provider = fn
+
+    def clear_ready_provider(self, fn):
+        """Remove ``fn`` only if it is still the registered provider — a
+        stale owner shutting down must not clobber a newer registration
+        (in-process gateway rollover: B starts, then old A stops)."""
+        if self._ready_provider is fn:
+            self._ready_provider = None
+
+    def clear_state_provider(self, name, fn):
+        """Ownership-checked removal of a healthz section (same rollover
+        hazard as :meth:`clear_ready_provider`)."""
+        if self._providers.get(name) is fn:
+            self._providers.pop(name, None)
+
+    def ready(self):
+        """Current readiness verdict: the provider's answer (False on any
+        provider exception — a broken oracle must fail closed, not keep a
+        sick replica in rotation), True when no provider is registered."""
+        fn = self._ready_provider
+        if fn is None:
+            return True
+        try:
+            return bool(fn())
+        except Exception:  # noqa: BLE001 — fail closed, never raise
+            return False
+
     def healthz_payload(self):
         out = {"time_unix": _utcnow(), "enabled": self.enabled,
+               "ready": self.ready(),
                "stalls": self.stall_count,
                "watchdog_alive": self.watchdog_alive,
                "heartbeats": self.heartbeats(),

@@ -1,0 +1,36 @@
+"""Production serving gateway: the HTTP/SSE request plane over one-or-N
+:class:`~deepspeed_tpu.inference.v2.InferenceEngineV2` replicas.
+
+Layering (request -> token):
+
+  * :mod:`gateway`   — stdlib ``ThreadingHTTPServer`` front end
+    (``POST /v1/generate`` with SSE token streaming or a blocking JSON
+    mode, ``GET /healthz``), request validation, replica selection,
+    readiness/drain for load balancers;
+  * :mod:`admission` — per-SLO-class bounded queues with 429/503 shedding;
+    the cost of a request is its *uncached* prompt tokens, consulting the
+    prefix cache exactly the way ``DynamicSplitFuseScheduler`` admission
+    does (pure probe, no tree mutation);
+  * :mod:`router`    — places each request across replicas by radix-tree
+    prefix overlap (the pure ``PrefixKVCache.match`` as the routing
+    oracle), falling back to least-loaded; liveness comes from the
+    PR 5 heartbeat state;
+  * :mod:`replica`   — one driver thread per engine running the
+    SplitFuse put/decode loop and fanning generated tokens out to
+    bounded per-request stream queues.
+
+Everything defaults OFF: importing this package starts no threads, and a
+constructed-but-never-started gateway allocates no queues' worth of
+background machinery (asserted by ``tests/test_gateway.py``).
+
+The request plane talks to the engine ONLY through its public API
+(``put``/``decode`` via the scheduler, ``probe_prefix``, ``prefix_cache``,
+``available_blocks``, ``max_context``, ``warmup``) — enforced structurally
+by the ``tools/check_gateway_api.py`` AST gate, run from tier-1.
+"""
+
+from .config import GatewayConfig, SLOClassConfig
+from .admission import AdmissionController
+from .router import ReplicaRouter
+from .replica import EngineReplica, GatewayRequest, TokenStream
+from .gateway import ServingGateway, parse_sse, sse_frame

@@ -1,0 +1,167 @@
+"""SLO-aware admission: per-class bounded queues with 429/503 shedding.
+
+Admission is where the gateway stops over-promising: each (replica, SLO
+class) pair has a bounded queue, and the cost charged for a request is its
+**uncached** prompt tokens — the prefix cache is consulted through the same
+pure probe the ``DynamicSplitFuseScheduler`` admission path uses
+(``engine.probe_prefix``: no references taken, no LRU touch, no stats), so
+a shed request leaves the radix tree untouched and a hot shared prefix
+makes its followers cheap at the door, not just at the prefill.
+
+Shedding contract (the HTTP layer maps these straight to status codes):
+
+  * ``429`` — the class queue for the routed replica is past its
+    configured depth (requests or uncached tokens): the client should back
+    off and retry; the gateway is alive and draining work;
+  * ``503`` — no live replica / gateway draining: retry against another
+    instance (the LB sees the same signal via ``/readyz``).
+
+Queues are plain bounded deques under one lock; replicas pull in class
+priority order (``SLOClassConfig.priority``), so an interactive request
+admitted after a pile of batch work still reaches the scheduler first.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from ..monitor.metrics import get_metrics
+
+
+class AdmissionController:
+    """Bounded per-(replica, class) queues + uncached-token accounting."""
+
+    def __init__(self, config):
+        self.config = config
+        self._lock = threading.Lock()
+        self._queues: Dict[Tuple[str, str], deque] = {}
+        self._queued_uncached: Dict[Tuple[str, str], int] = {}
+        self._order = config.class_order()
+        self.stats = {"admitted": 0, "shed": 0,
+                      "uncached_tokens_admitted": 0, "cached_tokens_admitted": 0}
+
+    # -- depth introspection -------------------------------------------------
+    def depth(self, replica: Optional[str] = None, slo_class: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(len(q) for (r, c), q in self._queues.items()
+                       if (replica is None or r == replica)
+                       and (slo_class is None or c == slo_class))
+
+    def below_shed_threshold(self) -> bool:
+        """True while every bounded class queue has headroom — the
+        readiness half of /healthz ``ready`` (an LB drains the instance
+        when admission is already refusing work)."""
+        with self._lock:
+            for (r, c), q in self._queues.items():
+                cls = self.config.slo_classes.get(c)
+                if cls is not None and cls.max_queue_depth > 0 \
+                        and len(q) >= cls.max_queue_depth:
+                    return False
+        return True
+
+    # -- admission -----------------------------------------------------------
+    def try_admit(self, req, replica) -> Tuple[bool, Optional[str]]:
+        """Admit ``req`` onto ``replica``'s class queue, charging its
+        uncached prompt tokens. Returns ``(True, None)`` or
+        ``(False, reason)`` — a refusal mutates nothing (probe is pure)."""
+        cls = self.config.slo_classes[req.slo_class]
+        # the probe runs OUTSIDE the queue lock (it walks the radix tree);
+        # single-writer per tree (only the replica driver mutates it), so
+        # the credit is a floor — concurrent publishes only raise it
+        n_cached, _shared, _tree_only, _match = replica.engine.probe_prefix(req.prompt)
+        uncached = int(req.prompt.size - n_cached)
+        key = (replica.name, req.slo_class)
+        reg = get_metrics()
+        with self._lock:
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = deque()
+                self._queued_uncached[key] = 0
+            if cls.max_queue_depth > 0 and len(q) >= cls.max_queue_depth:
+                reason = "queue_depth"
+            elif (cls.max_queue_uncached_tokens > 0
+                  and self._queued_uncached[key] + uncached > cls.max_queue_uncached_tokens):
+                reason = "queue_tokens"
+            else:
+                reason = None
+            if reason is not None:
+                self.stats["shed"] += 1
+                reg.counter(f"gateway/shed_{req.slo_class}_total").inc()
+                return False, reason
+            req.cached_tokens = int(n_cached)
+            req.uncached_tokens = uncached
+            req.replica_name = replica.name
+            req.t_admitted = time.perf_counter()
+            q.append(req)
+            self._queued_uncached[key] += uncached
+            self.stats["admitted"] += 1
+            self.stats["uncached_tokens_admitted"] += uncached
+            self.stats["cached_tokens_admitted"] += int(n_cached)
+        reg.counter(f"gateway/requests_{req.slo_class}_total").inc()
+        reg.counter("gateway/admitted_uncached_tokens_total").inc(uncached)
+        reg.counter("gateway/admitted_cached_tokens_total").inc(int(n_cached))
+        reg.gauge(f"gateway/queue_depth_{req.slo_class}").set(self.depth(slo_class=req.slo_class))
+        return True, None
+
+    def pop_for(self, replica_name: str):
+        """Next queued request for ``replica_name`` in class priority order
+        (FIFO within a class). None when nothing is queued."""
+        with self._lock:
+            for c in self._order:
+                q = self._queues.get((replica_name, c))
+                if q:
+                    req = q.popleft()
+                    self._queued_uncached[(replica_name, c)] -= req.uncached_tokens
+                    depth = sum(len(qq) for (r, cc), qq in self._queues.items()
+                                if cc == c)
+                    get_metrics().gauge(f"gateway/queue_depth_{c}").set(depth)
+                    return req
+        return None
+
+    def fail_all(self, reason: str):
+        """Drain every queue, failing the waiting streams (gateway stop)."""
+        with self._lock:
+            reqs = [r for q in self._queues.values() for r in q]
+            self._queues.clear()
+            self._queued_uncached.clear()
+        for req in reqs:
+            req.stream.finish(reason="error", error=reason)
+
+    def cancel(self, req) -> bool:
+        """Remove a still-queued request (client gave up before a replica
+        pulled it). False when it already left the queue — the caller then
+        routes the cancel to the replica driver instead."""
+        key = (req.replica_name, req.slo_class)
+        with self._lock:
+            q = self._queues.get(key)
+            if q is None:
+                return False
+            try:
+                q.remove(req)
+            except ValueError:
+                return False
+            self._queued_uncached[key] -= req.uncached_tokens
+        return True
+
+    def fail_for(self, replica_name: str, reason: str) -> int:
+        """Drain ONE replica's queues, failing the waiting streams — the
+        driver's exit path (crash or stop). Without this, requests admitted
+        onto a replica whose driver died would wait out the full client
+        timeout, and a stranded full queue would pin readiness to False for
+        the whole gateway."""
+        reqs = []
+        with self._lock:
+            for (r, c), q in self._queues.items():
+                if r == replica_name:
+                    reqs.extend(q)
+                    q.clear()
+                    self._queued_uncached[(r, c)] = 0
+        for req in reqs:
+            req.stream.finish(reason="error", error=reason)
+        return len(reqs)
+
+    def state(self) -> dict:
+        with self._lock:
+            queues = {f"{r}/{c}": len(q) for (r, c), q in self._queues.items() if q}
+        return {"queues": queues, **self.stats}

@@ -1,0 +1,122 @@
+"""``serving.gateway`` configuration block.
+
+Plain dataclasses (the gateway is a standalone serving entry point, not a
+training-engine subsystem, so it does not ride the pydantic runtime config):
+:meth:`GatewayConfig.from_dict` accepts the ds_config-style nested dict
+
+.. code-block:: python
+
+    {"serving": {"gateway": {
+        "enabled": true,
+        "port": 8100,
+        "router": "prefix",
+        "slo_classes": {
+            "interactive": {"max_queue_depth": 32, "ttft_target_ms": 250},
+            "batch": {"priority": 1, "max_queue_depth": 256},
+        },
+    }}}
+
+via :meth:`GatewayConfig.from_ds_config`. EVERY knob defaults to off:
+``enabled=False``, depth limits 0 (= unbounded, no shedding), SLO targets 0
+(= no conformance counters), ``port=0`` (= ephemeral), warmup empty.
+"""
+
+from dataclasses import dataclass, field, fields
+from typing import Dict, Tuple
+
+
+@dataclass
+class SLOClassConfig:
+    """One TTFT/TPOT service class. ``priority`` orders replica pull
+    (lower = served first); depth limits of 0 disable shedding for the
+    class; targets of 0 disable the SLO-miss conformance counters."""
+
+    priority: int = 0
+    # admission sheds (HTTP 429) once this many requests are queued for one
+    # replica in this class; 0 = unbounded
+    max_queue_depth: int = 0
+    # admission sheds once the queued UNCACHED prompt tokens (the real
+    # prefill cost after prefix-cache credit) exceed this; 0 = unbounded
+    max_queue_uncached_tokens: int = 0
+    # advisory SLO targets: a completed request past the target bumps
+    # gateway/slo_{ttft,tpot}_miss_<class>_total; 0 = untracked
+    ttft_target_ms: float = 0.0
+    tpot_target_ms: float = 0.0
+
+
+def _default_classes() -> Dict[str, SLOClassConfig]:
+    # two conventional classes so an empty block is usable out of the box;
+    # both unbounded/untracked until the operator sets depths/targets
+    return {"interactive": SLOClassConfig(priority=0),
+            "batch": SLOClassConfig(priority=1)}
+
+
+@dataclass
+class GatewayConfig:
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral (ServingGateway.port reports the real one)
+    # replica placement policy: 'prefix' (radix-overlap oracle, least-loaded
+    # fallback) | 'least_loaded' | 'random'
+    router: str = "prefix"
+    default_slo_class: str = "interactive"
+    slo_classes: Dict[str, SLOClassConfig] = field(default_factory=_default_classes)
+    # per-forward token budget handed to each replica's SplitFuse scheduler;
+    # 0 = the scheduler default (the engine's max_ragged_batch_size)
+    token_budget: int = 0
+    # requests handed to a replica's scheduler at once (admitted requests
+    # beyond this wait in the class queues, preserving SLO priority);
+    # 0 = the engine's max_ragged_sequence_count
+    max_inflight_per_replica: int = 0
+    # hard cap on a request's max_new_tokens; 0 = engine max_context only
+    max_new_tokens_cap: int = 0
+    # HTTP handler wait bound for one request end-to-end, seconds
+    request_timeout_s: float = 120.0
+    # (seq_bucket, decode_steps) pairs pre-compiled per replica at start()
+    # via engine.warmup; empty = no warmup
+    warmup: Tuple = ()
+
+    @classmethod
+    def from_dict(cls, d) -> "GatewayConfig":
+        d = dict(d or {})
+        classes = d.pop("slo_classes", None)
+        known = {f.name for f in fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"serving.gateway: unknown keys {sorted(unknown)}")
+        cfg = cls(**d)
+        if classes is not None:
+            slo_known = {f.name for f in fields(SLOClassConfig)}
+            parsed = {}
+            for name, body in dict(classes).items():
+                bad = set(body) - slo_known
+                if bad:
+                    raise ValueError(f"serving.gateway.slo_classes[{name!r}]: "
+                                     f"unknown keys {sorted(bad)}")
+                parsed[str(name)] = SLOClassConfig(**body)
+            cfg.slo_classes = parsed
+        if cfg.default_slo_class not in cfg.slo_classes:
+            raise ValueError(f"serving.gateway: default_slo_class "
+                             f"{cfg.default_slo_class!r} not in slo_classes "
+                             f"{sorted(cfg.slo_classes)}")
+        if cfg.router not in ("prefix", "least_loaded", "random"):
+            raise ValueError(f"serving.gateway: unknown router {cfg.router!r}: "
+                             "'prefix' | 'least_loaded' | 'random'")
+        return cfg
+
+    @classmethod
+    def from_ds_config(cls, param_dict) -> "GatewayConfig":
+        """Parse the ``serving.gateway`` block out of a full ds_config dict.
+        An absent block yields the all-off defaults; a present-but-empty
+        block enables the gateway with defaults (the presence-enables
+        contract of the ``trace``/``health`` blocks)."""
+        block = dict((param_dict or {}).get("serving", {}).get("gateway", {}))
+        present = "gateway" in (param_dict or {}).get("serving", {})
+        if present and "enabled" not in block:
+            block["enabled"] = True
+        return cls.from_dict(block)
+
+    def class_order(self):
+        """Class names in pull order: priority ascending, then name (a
+        deterministic tiebreak so replica pull order is reproducible)."""
+        return sorted(self.slo_classes, key=lambda n: (self.slo_classes[n].priority, n))

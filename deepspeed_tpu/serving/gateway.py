@@ -1,0 +1,386 @@
+"""HTTP/SSE serving gateway over one-or-N ``InferenceEngineV2`` replicas.
+
+Endpoints (stdlib ``ThreadingHTTPServer``, the ``monitor/export.py``
+pattern — one daemon accept thread, one handler thread per connection):
+
+  * ``POST /v1/generate`` — body ``{"prompt": [token ids],
+    "max_new_tokens": N, "slo_class": "interactive", "stream": true,
+    "eos_token_id": null}``. With ``stream`` (the default) the response is
+    ``text/event-stream``: one ``meta`` frame (uid, routed replica,
+    prefix-cache credit), one frame per generated token, and a terminal
+    ``done`` frame carrying finish_reason + TTFT/TPOT. With
+    ``stream: false`` the handler blocks and returns one JSON object with
+    the full token list. Admission failures map to HTTP statuses: 400
+    (invalid request), 429 (class queue past its shed depth — back off),
+    503 (draining / no live replica — go elsewhere).
+  * ``GET /healthz`` — liveness + the full gateway state (replicas,
+    queues, router), always 200 while the process serves.
+  * ``GET /readyz`` — readiness for LB rotation: 200 while ``ready``
+    (started, not draining, replicas warmed + live, every bounded class
+    queue below its shed depth), 503 otherwise — so a drained replica
+    leaves rotation without being killed.
+
+SSE frame format (``sse_frame``/``parse_sse`` are the canonical pair; the
+load generator and the tests share them):
+
+    data: {"token": 1234, "index": 0}\\n\\n          # one per token
+    data: {"done": true, "n_tokens": 8, "finish_reason": "length", ...}
+
+The handler thread is the stream CONSUMER: it drains the request's bounded
+``TokenStream`` at the client's pace, so a slow reader backs up its own
+socket, never the replica decode loop.
+"""
+
+import json
+import threading
+import time
+from typing import Optional
+
+from ..monitor.health import get_health
+from ..monitor.metrics import get_metrics
+from .admission import AdmissionController
+from .config import GatewayConfig
+from .replica import EngineReplica, GatewayRequest
+from .router import ReplicaRouter
+
+
+def sse_frame(obj) -> bytes:
+    """One server-sent-event frame carrying a JSON payload."""
+    return b"data: " + json.dumps(obj).encode("utf-8") + b"\n\n"
+
+
+def parse_sse(text):
+    """Parse an SSE body (bytes or str) back into its JSON payloads —
+    the exact inverse of :func:`sse_frame` (round-trip asserted in
+    ``tests/test_gateway.py``). Multi-``data:``-line events are joined per
+    the SSE spec; non-JSON payloads raise (the gateway never emits them)."""
+    if isinstance(text, bytes):
+        text = text.decode("utf-8")
+    events = []
+    for block in text.split("\n\n"):
+        datas = [ln[5:].lstrip() for ln in block.split("\n") if ln.startswith("data:")]
+        if datas:
+            events.append(json.loads("\n".join(datas)))
+    return events
+
+
+class ServingGateway:
+    """Request plane over ``engines`` (one :class:`EngineReplica` each)."""
+
+    def __init__(self, engines, config: Optional[GatewayConfig] = None):
+        self.config = config or GatewayConfig()
+        self.admission = AdmissionController(self.config)
+        self.replicas = [EngineReplica(str(i), eng, self.admission, self.config)
+                         for i, eng in enumerate(engines)]
+        self.router = ReplicaRouter(self.replicas, policy=self.config.router)
+        self._uid_lock = threading.Lock()
+        self._next_uid = 1
+        self._httpd = None
+        self._http_thread = None
+        self._registered_ready = None
+        self._registered_state = None
+        self.started = False
+        self.draining = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        """Start every replica driver + the HTTP front end; registers the
+        gateway's readiness + state with the health plane so the PR 5
+        exporter's ``/healthz``/``/readyz`` reflect this gateway."""
+        if self.started:
+            return self
+        if not self.config.enabled:
+            # the knob is live, not documentation: a deployment driven by a
+            # ds_config without a serving.gateway block must not serve
+            raise ValueError("serving gateway disabled by config — set "
+                             "serving.gateway.enabled (or GatewayConfig(enabled=True)) "
+                             "before start()")
+        get_metrics().enable()  # gateway metrics ride the registry
+        for r in self.replicas:
+            r.start()
+        self._start_http()
+        self.started = True
+        health = get_health()
+        # bound methods are fresh objects per access: keep THE registered
+        # objects so stop() can remove exactly what this gateway installed
+        self._registered_ready = self._readiness
+        self._registered_state = self.state
+        health.set_ready_provider(self._registered_ready)
+        health.set_state_provider("gateway", self._registered_state)
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5.0)
+            self._http_thread = None
+        for r in self.replicas:
+            r.stop(timeout=timeout)
+        self.admission.fail_all("gateway_shutdown")
+        if self.started:
+            # ownership-checked: a newer gateway's registration survives an
+            # old instance's shutdown (in-process rollover)
+            health = get_health()
+            health.clear_ready_provider(self._registered_ready)
+            health.clear_state_provider("gateway", self._registered_state)
+        self.started = False
+
+    def drain(self, on: bool = True):
+        """Stop admitting (503 + not ready) while in-flight work finishes —
+        the LB-facing half of a graceful rollout."""
+        self.draining = bool(on)
+
+    def _readiness(self) -> bool:
+        return self.ready
+
+    @property
+    def ready(self) -> bool:
+        """Distinct from liveness: serving AND able to take traffic —
+        replicas warmed + at least one live, not draining, and every
+        bounded class queue below its shed depth."""
+        return (self.started and not self.draining
+                and all(r.warmed for r in self.replicas)
+                and bool(self.router.live())
+                and self.admission.below_shed_threshold())
+
+    @property
+    def engines(self):
+        return [r.engine for r in self.replicas]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self):
+        return f"http://{self.config.host}:{self.port}" if self._httpd else None
+
+    # -- programmatic entry (what the HTTP handler calls) ---------------------
+    def submit(self, prompt, max_new_tokens: int = 16, slo_class: Optional[str] = None,
+               eos_token_id=None):
+        """Validate -> route -> admit. Returns ``(200, GatewayRequest)`` or
+        ``(status, error_dict)`` with status 400/429/503."""
+        if not self.started or self.draining:
+            return 503, {"error": "not_ready",
+                         "detail": "draining" if self.draining else "not started"}
+        cls = slo_class or self.config.default_slo_class
+        if cls not in self.config.slo_classes:
+            return 400, {"error": "unknown_slo_class", "slo_class": cls,
+                         "known": sorted(self.config.slo_classes)}
+        try:
+            max_new_tokens = int(max_new_tokens)
+            with self._uid_lock:
+                uid = self._next_uid
+                self._next_uid += 1
+            req = GatewayRequest(uid, prompt, max_new_tokens, cls,
+                                 eos_token_id=eos_token_id)
+        except (TypeError, ValueError, OverflowError) as e:
+            # OverflowError: a token id outside int32 range from np.asarray
+            return 400, {"error": "invalid_request", "detail": str(e)}
+        if req.prompt.size == 0:
+            return 400, {"error": "invalid_request", "detail": "empty prompt"}
+        if req.max_new_tokens <= 0:
+            return 400, {"error": "invalid_request",
+                         "detail": "max_new_tokens must be positive"}
+        cap = self.config.max_new_tokens_cap
+        if cap and req.max_new_tokens > cap:
+            return 400, {"error": "invalid_request",
+                         "detail": f"max_new_tokens {req.max_new_tokens} > cap {cap}"}
+        replica = self.router.select(req.prompt)
+        if replica is None:
+            get_metrics().counter("gateway/rejected_total").inc()
+            return 503, {"error": "no_live_replica"}
+        total = req.prompt.size + req.max_new_tokens
+        if total > replica.engine.max_context:
+            return 400, {"error": "too_large",
+                         "detail": f"prompt {req.prompt.size} + max_new_tokens "
+                                   f"{req.max_new_tokens} exceeds max_context "
+                                   f"{replica.engine.max_context}"}
+        blocks = -(-total // replica.engine.config.kv_block_size)
+        if blocks > replica.pool_blocks:
+            # the scheduler could NEVER admit this (its lifetime reservation
+            # exceeds the whole pool) — refuse now instead of queueing forever
+            return 400, {"error": "too_large",
+                         "detail": f"request needs {blocks} KV blocks, pool has "
+                                   f"{replica.pool_blocks}"}
+        ok, reason = self.admission.try_admit(req, replica)
+        if not ok:
+            return 429, {"error": "shed", "reason": reason, "slo_class": cls,
+                         "replica": replica.name}
+        replica.wake()
+        return 200, req
+
+    def cancel_request(self, req: GatewayRequest) -> bool:
+        """Abandon an admitted request (client timeout / disconnect):
+        removed from its admission queue if still waiting, else handed to
+        its replica's driver for teardown (engine sequence flushed, KV
+        reservation released) at the next loop. Without this an abandoned
+        request keeps decoding to max_new_tokens against live traffic."""
+        if self.admission.cancel(req):
+            req.stream.finish(reason="error", error="cancelled")
+            return True
+        for r in self.replicas:
+            if r.name == req.replica_name:
+                r.cancel(req.uid)
+                return True
+        return False
+
+    # -- introspection --------------------------------------------------------
+    def state(self) -> dict:
+        return {"ready": self.ready, "draining": self.draining,
+                "replicas": [r.state() for r in self.replicas],
+                "admission": self.admission.state(),
+                "router": self.router.state()}
+
+    # -- HTTP front end --------------------------------------------------------
+    def _start_http(self):
+        import http.server
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            # HTTP/1.0: every response closes its connection, so SSE bodies
+            # need no chunked framing — clients read until EOF (exactly the
+            # contract stdlib http.client implements)
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, fmt, *args):  # no stderr chatter per request
+                pass
+
+            def _json(self, code, obj):
+                data = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._json(200, {"live": True, **outer.state()})
+                    elif path == "/readyz":
+                        ready = outer.ready
+                        self._json(200 if ready else 503,
+                                   {"ready": ready, "draining": outer.draining})
+                    else:
+                        self._json(404, {"error": "not_found",
+                                         "paths": ["/v1/generate", "/healthz", "/readyz"]})
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path != "/v1/generate":
+                        self._json(404, {"error": "not_found"})
+                        return
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError("body must be a JSON object")
+                    except (ValueError, json.JSONDecodeError) as e:
+                        self._json(400, {"error": "bad_json", "detail": str(e)})
+                        return
+                    status, result = outer.submit(
+                        body.get("prompt"),
+                        max_new_tokens=body.get("max_new_tokens", 16),
+                        slo_class=body.get("slo_class"),
+                        eos_token_id=body.get("eos_token_id"))
+                    if status != 200:
+                        self._json(status, result)
+                        return
+                    if body.get("stream", True):
+                        self._stream_response(result)
+                    else:
+                        self._blocking_response(result)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-response
+                except Exception as e:  # noqa: BLE001 — a malformed request
+                    # must come back as a status, never kill the handler
+                    # without a response (the client would see a bare reset)
+                    try:
+                        self._json(500, {"error": "internal",
+                                         "detail": f"{type(e).__name__}: {e}"})
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
+            def _final_frame(self, req: GatewayRequest) -> dict:
+                st = req.stream
+                return {"done": True, "uid": req.uid, "n_tokens": st.produced,
+                        "finish_reason": st.finish_reason, "error": st.error,
+                        "ttft_ms": round(req.ttft_ms, 3) if req.ttft_ms else None,
+                        "tpot_ms": round(req.tpot_ms, 3) if req.tpot_ms else None,
+                        "cached_tokens": req.cached_tokens, "dropped": st.dropped}
+
+            def _stream_response(self, req: GatewayRequest):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                st = req.stream
+                try:
+                    self.wfile.write(sse_frame({"meta": True, "uid": req.uid,
+                                                "slo_class": req.slo_class,
+                                                "replica": req.replica_name,
+                                                "cached_tokens": req.cached_tokens}))
+                    self.wfile.flush()
+                    deadline = time.perf_counter() + outer.config.request_timeout_s
+                    index = 0
+                    while True:
+                        toks, done = st.get(timeout=0.1)
+                        for t in toks:
+                            self.wfile.write(sse_frame({"token": t, "index": index}))
+                            index += 1
+                        if toks:
+                            self.wfile.flush()
+                        if done:
+                            break
+                        if time.perf_counter() > deadline:
+                            st.finish(reason="error", error="request_timeout")
+                            outer.cancel_request(req)  # stop decoding for nobody
+                            break
+                    self.wfile.write(sse_frame(self._final_frame(req)))
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client is gone: release its engine-side resources
+                    st.finish(reason="error", error="client_disconnected")
+                    outer.cancel_request(req)
+                    raise
+
+            @staticmethod
+            def _error_status(error):
+                """Status contract: 503 = retry elsewhere (this instance is
+                going away), 504 = the request timed out here, 500 = it
+                failed here."""
+                if error is None:
+                    return 200
+                if error in ("replica_stopped", "gateway_shutdown"):
+                    return 503
+                if error == "request_timeout":
+                    return 504
+                return 500
+
+            def _blocking_response(self, req: GatewayRequest):
+                finished = req.stream.wait_done(timeout=outer.config.request_timeout_s)
+                if not finished:
+                    req.stream.finish(reason="error", error="request_timeout")
+                    outer.cancel_request(req)
+                out = self._final_frame(req)
+                out.pop("done")
+                out["tokens"] = req.stream.all_tokens()
+                out["slo_class"] = req.slo_class
+                out["replica"] = req.replica_name
+                self._json(self._error_status(out["error"]), out)
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.config.host, int(self.config.port)), Handler)
+        self._httpd.daemon_threads = True
+        self._http_thread = threading.Thread(target=self._httpd.serve_forever,
+                                             name="dstpu-gateway-http", daemon=True)
+        self._http_thread.start()

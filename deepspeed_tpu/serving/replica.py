@@ -1,0 +1,381 @@
+"""Replica driver: one background thread per engine running the SplitFuse
+put/decode loop and fanning generated tokens out to per-request streams.
+
+The driver owns the ONLY thread that touches its engine (JAX dispatch,
+scheduler state): the HTTP handlers and the admission path never call into
+the engine's forward — they enqueue work and read from
+:class:`TokenStream`s. A slow (or absent) stream consumer therefore cannot
+stall the decode loop: ``TokenStream.push`` never blocks, and the stream's
+buffer is bounded by the request's own ``max_new_tokens`` (which admission
+capped), so a stalled client costs one bounded buffer, not batch progress.
+
+Liveness rides the PR 5 health plane: while a replica has work its driver
+beats the instance-qualified ``serving:<name>`` source every loop (the
+family deadline ``monitor.health.deadline_serving_s`` applies via the
+prefix fallback), and the engine's own ``put``/``decode`` begin/end the
+``serving`` source around each forward — a wedged device call or a wedged
+driver both trip the stall watchdog with a full forensic dump.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..monitor.flight import get_flight_recorder
+from ..monitor.health import get_health
+from ..monitor.metrics import get_metrics
+from ..inference.v2 import DynamicSplitFuseScheduler
+
+
+class TokenStream:
+    """Bounded single-producer / single-consumer token queue for ONE request.
+
+    The replica driver pushes token batches (never blocking — overflow past
+    ``capacity`` is counted and dropped, though with ``capacity ==
+    max_new_tokens`` it is unreachable); the HTTP handler drains at the
+    client's pace. ``finish`` latches the terminal state exactly once.
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._tokens: List[int] = []   # produced tokens, in order
+        self._cursor = 0               # consumer read position
+        self._cond = threading.Condition()
+        self.done = False
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.dropped = 0
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+
+    @property
+    def produced(self) -> int:
+        return len(self._tokens)
+
+    def push(self, tokens) -> int:
+        """Append ``tokens`` (non-blocking). Returns how many were kept.
+        A finished stream drops everything — ``finish`` latches the
+        terminal state, so a late producer cannot make the final frame's
+        ``n_tokens`` disagree with the token list a reader collects."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            return 0
+        now = time.perf_counter()
+        with self._cond:
+            if self.done:
+                self.dropped += len(tokens)
+                return 0
+            space = self.capacity - len(self._tokens)
+            kept = tokens[:max(0, space)]
+            self.dropped += len(tokens) - len(kept)
+            if kept:
+                if self.first_token_t is None:
+                    self.first_token_t = now
+                self.last_token_t = now
+                self._tokens.extend(kept)
+                self._cond.notify_all()
+        return len(kept)
+
+    def finish(self, reason: str = "length", error: Optional[str] = None):
+        with self._cond:
+            if self.done:
+                return
+            self.done = True
+            self.finish_reason = reason
+            self.error = error
+            self._cond.notify_all()
+
+    def get(self, timeout: Optional[float] = None):
+        """Drain everything available (blocking up to ``timeout`` for the
+        first new token). Returns ``(tokens, done)`` — ``([], done)`` on
+        timeout, so the caller can distinguish 'no progress' from 'over'."""
+        with self._cond:
+            if self._cursor >= len(self._tokens) and not self.done:
+                self._cond.wait(timeout)
+            out = self._tokens[self._cursor:]
+            self._cursor += len(out)
+            return out, self.done and self._cursor >= len(self._tokens)
+
+    def wait_done(self, timeout: Optional[float] = None) -> bool:
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        with self._cond:
+            while not self.done:
+                rem = None if deadline is None else deadline - time.perf_counter()
+                if rem is not None and rem <= 0:
+                    return False
+                self._cond.wait(rem)
+            return True
+
+    def all_tokens(self) -> List[int]:
+        with self._cond:
+            return list(self._tokens)
+
+
+class GatewayRequest:
+    """One admitted request's lifecycle record (admission -> stream)."""
+
+    __slots__ = ("uid", "prompt", "max_new_tokens", "slo_class", "eos_token_id",
+                 "stream", "replica_name", "t_admitted", "cached_tokens",
+                 "uncached_tokens", "ttft_ms", "tpot_ms")
+
+    def __init__(self, uid, prompt, max_new_tokens, slo_class, eos_token_id=None):
+        self.uid = int(uid)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.slo_class = str(slo_class)
+        self.eos_token_id = eos_token_id
+        self.stream = TokenStream(capacity=self.max_new_tokens)
+        self.replica_name = None
+        self.t_admitted = None
+        self.cached_tokens = 0    # prefix-cache credit measured at admission
+        self.uncached_tokens = 0  # what admission actually charged
+        self.ttft_ms = None
+        self.tpot_ms = None
+
+
+class EngineReplica:
+    """Driver thread + SplitFuse scheduler over ONE ``InferenceEngineV2``."""
+
+    # bounded idle wait between wake polls: purely a backstop — submit()
+    # sets the wake event, so admit latency does not ride this; short
+    # enough that pause()/stop() stay responsive, long enough that an idle
+    # fleet of replicas is not spinning on the admission lock
+    IDLE_WAIT_S = 0.05
+
+    def __init__(self, name, engine, admission, config):
+        self.name = str(name)
+        self.engine = engine
+        self.config = config
+        self._admission = admission
+        self._scheduler = DynamicSplitFuseScheduler(
+            engine, token_budget=config.token_budget or None)
+        self._max_inflight = (config.max_inflight_per_replica
+                              or engine.max_concurrent_sequences)
+        # total KV blocks a lone request may reserve: measured on the idle
+        # engine (free + evictable = the whole usable pool), so validation
+        # can refuse requests the scheduler could NEVER admit (they would
+        # otherwise sit in the pending queue forever)
+        self.pool_blocks = engine.available_blocks
+        self._streams: Dict[int, GatewayRequest] = {}
+        self._inflight = 0  # requests submitted to the scheduler, not finished
+        self._cancel_lock = threading.Lock()
+        self._cancelled = []  # uids handed back by timed-out/gone clients
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self.paused = False
+        self.started = False
+        self.warmed = False
+        self.steps = 0
+
+    # -- public surface the gateway/router/tests drive ---------------------
+    @property
+    def alive(self) -> bool:
+        if not (self.started and self._thread is not None and self._thread.is_alive()):
+            return False
+        hb = get_health()
+        if hb.enabled:
+            entry = hb.heartbeats().get(self.heartbeat_source)
+            if entry is not None and entry["tripped"]:
+                return False
+        return True
+
+    @property
+    def heartbeat_source(self) -> str:
+        return f"serving:{self.name}"
+
+    @property
+    def load(self) -> int:
+        """Scheduler-inflight + class-queued requests bound for this replica
+        (the router's least-loaded signal)."""
+        return self._inflight + self._admission.depth(replica=self.name)
+
+    def prefix_overlap(self, prompt_tokens) -> int:
+        """Routing oracle: tokens of ``prompt_tokens`` this replica's radix
+        tree could serve, via the PURE read-only ``PrefixKVCache.match`` —
+        no references taken, no LRU touch, no stats."""
+        pc = self.engine.prefix_cache
+        if pc is None:
+            return 0
+        return int(pc.match(np.asarray(prompt_tokens, np.int32).reshape(-1)).n_cached_tokens)
+
+    def cancel(self, uid: int):
+        """Request abort of ``uid`` (client timed out / disconnected). The
+        actual teardown runs on the DRIVER thread at its next loop — the
+        scheduler is single-threaded by contract. An abandoned request
+        would otherwise decode to max_new_tokens holding its KV reservation
+        and an inflight slot against live traffic."""
+        with self._cancel_lock:
+            self._cancelled.append(int(uid))
+        self.wake()
+
+    def pause(self):
+        self.paused = True
+
+    def resume(self):
+        self.paused = False
+        self.wake()
+
+    def wake(self):
+        self._wake.set()
+
+    def start(self):
+        if self.started:
+            return self
+        if self.config.warmup:
+            for bucket, steps in self.config.warmup:
+                self.engine.warmup([int(bucket)], int(steps))
+        self.warmed = True
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"dstpu-serving-{self.name}", daemon=True)
+        self.started = True
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        self.started = False
+        for req in list(self._streams.values()):
+            req.stream.finish(reason="error", error="replica_stopped")
+        self._streams.clear()
+
+    # -- driver loop --------------------------------------------------------
+    def _run(self):
+        hb = get_health()
+        src = self.heartbeat_source
+        try:
+            while not self._stop.is_set():
+                busy = False
+                self._process_cancellations()
+                if not self.paused:
+                    busy = self._pull_admitted() or busy
+                    if self._scheduler.has_work:
+                        if hb.enabled:
+                            # armed exactly while work is in flight: a wedged
+                            # step (or a dead driver) goes stale and trips the
+                            # serving-family deadline
+                            hb.beat(src)
+                        busy = self._step() or busy
+                if not busy:
+                    if hb.enabled:
+                        hb.disarm(src)
+                    self._wake.wait(self.IDLE_WAIT_S)
+                    self._wake.clear()
+        finally:
+            # the driver is the ONLY consumer of this replica's admission
+            # queues: on the way out (clean stop or crash) fail whatever is
+            # still queued, so waiting clients get an immediate error instead
+            # of the full request timeout, and a stranded full queue cannot
+            # pin gateway readiness to False
+            self._admission.fail_for(self.name, "replica_stopped")
+            if hb.enabled:
+                hb.release(src)
+
+    def _process_cancellations(self):
+        with self._cancel_lock:
+            uids, self._cancelled = self._cancelled, []
+        for uid in uids:
+            req = self._streams.pop(uid, None)
+            if req is None:
+                continue  # already finished (or never reached this replica)
+            if self._scheduler.cancel(uid):
+                self._scheduler.discard_result(uid)
+            self._inflight -= 1
+            req.stream.finish(reason="error", error="cancelled")
+            get_metrics().counter(f"gateway/cancelled_{req.slo_class}_total").inc()
+
+    def _pull_admitted(self) -> bool:
+        pulled = False
+        while self._inflight < self._max_inflight:
+            req = self._admission.pop_for(self.name)
+            if req is None:
+                break
+            try:
+                self._scheduler.submit(req.uid, req.prompt,
+                                       max_new_tokens=req.max_new_tokens,
+                                       eos_token_id=req.eos_token_id)
+            except Exception as e:  # validation said yes, scheduler said no
+                req.stream.finish(reason="error", error=f"{type(e).__name__}: {e}")
+                continue
+            self._streams[req.uid] = req
+            self._inflight += 1
+            pulled = True
+        return pulled
+
+    def _step(self) -> bool:
+        try:
+            n = self._scheduler.step()
+        except Exception as e:  # noqa: BLE001 — one poisoned batch must not
+            # silently wedge every queued request: fail the active streams
+            # loudly and drop the driver's view of them
+            get_flight_recorder().record("serving", "replica_step_error",
+                                         replica=self.name, error=repr(e))
+            for req in list(self._streams.values()):
+                req.stream.finish(reason="error", error=f"{type(e).__name__}: {e}")
+            self._streams.clear()
+            self._inflight = 0
+            raise
+        self.steps += 1
+        self._fanout()
+        return n > 0
+
+    def _fanout(self):
+        """Push newly generated tokens to each request's stream; close out
+        finished requests with TTFT/TPOT accounting. Reads only each
+        stream's TAIL (``new_tokens``) — snapshotting ``results`` here
+        would re-copy every active generation whole on every step."""
+        finished = self._scheduler.finished
+        reg = get_metrics()
+        for uid, req in list(self._streams.items()):
+            st = req.stream
+            new = self._scheduler.new_tokens(uid, st.produced)
+            if new:
+                pushed = st.push(new)
+                if pushed:
+                    reg.counter("gateway/tokens_streamed_total").inc(pushed)
+                    if req.ttft_ms is None and st.first_token_t is not None:
+                        req.ttft_ms = (st.first_token_t - req.t_admitted) * 1e3
+                        reg.histogram(f"gateway/ttft_ms_{req.slo_class}").observe(req.ttft_ms)
+            if uid in finished:  # once: the stream entry is removed with it
+                self._inflight -= 1
+                del self._streams[uid]
+                self._close_out(req)
+                # the stream holds the full generation; dropping the
+                # scheduler's copy keeps a long-lived replica's results dict
+                # (and each per-step `results` snapshot) from growing with
+                # every request ever served
+                self._scheduler.discard_result(uid)
+
+    def _close_out(self, req: GatewayRequest):
+        st = req.stream
+        n = st.produced
+        toks = st.all_tokens()
+        reason = ("eos" if (req.eos_token_id is not None and toks
+                            and toks[-1] == req.eos_token_id) else "length")
+        if (n > 1 and st.first_token_t is not None and st.last_token_t is not None
+                and st.last_token_t > st.first_token_t):
+            req.tpot_ms = (st.last_token_t - st.first_token_t) / (n - 1) * 1e3
+            get_metrics().histogram(f"gateway/tpot_ms_{req.slo_class}").observe(req.tpot_ms)
+        cls = self.config.slo_classes.get(req.slo_class)
+        if cls is not None:
+            if cls.ttft_target_ms > 0 and (req.ttft_ms or 0) > cls.ttft_target_ms:
+                get_metrics().counter(f"gateway/slo_ttft_miss_{req.slo_class}_total").inc()
+            if cls.tpot_target_ms > 0 and (req.tpot_ms or 0) > cls.tpot_target_ms:
+                get_metrics().counter(f"gateway/slo_tpot_miss_{req.slo_class}_total").inc()
+        get_metrics().counter(f"gateway/completed_{req.slo_class}_total").inc()
+        st.finish(reason=reason)
+
+    # -- introspection -------------------------------------------------------
+    def state(self) -> dict:
+        return {"name": self.name, "alive": self.alive, "paused": self.paused,
+                "warmed": self.warmed, "inflight": self._inflight,
+                "queued": self._admission.depth(replica=self.name),
+                "steps": self.steps,
+                "available_blocks": self.engine.available_blocks}

@@ -1,0 +1,68 @@
+"""Prefix-aware multi-replica router.
+
+Placement policy for N engine replicas, in preference order:
+
+  * ``prefix`` — score each LIVE replica by how many prompt tokens its
+    radix tree could serve, using the pure read-only
+    ``PrefixKVCache.match`` (via ``EngineReplica.prefix_overlap``) as the
+    routing oracle: the walk takes no references, touches no LRU clock,
+    and bumps no stats, so routing N candidates costs N tree walks and
+    ZERO cache mutations. Highest overlap wins; ties (including the
+    all-zero cold-start case) fall back to least-loaded.
+  * ``least_loaded`` — smallest (scheduler-inflight + queued) count.
+  * ``random`` — uniform over live replicas (the A/B control the bench
+    measures the prefix policy against).
+
+Liveness comes from the PR 5 health plane: a replica whose
+``serving:<name>`` heartbeat tripped the stall watchdog (or whose driver
+thread died) is excluded from placement until a fresh beat re-arms it —
+so a wedged replica sheds to its siblings instead of black-holing
+requests.
+"""
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class ReplicaRouter:
+
+    def __init__(self, replicas: List, policy: str = "prefix", seed: int = 0):
+        if policy not in ("prefix", "least_loaded", "random"):
+            raise ValueError(f"unknown router policy {policy!r}: "
+                             "'prefix' | 'least_loaded' | 'random'")
+        self.replicas = list(replicas)
+        self.policy = policy
+        self._rng = np.random.default_rng(seed)
+        self.stats = {"routed": 0, "prefix_hits": 0, "fallback_least_loaded": 0,
+                      "no_live_replica": 0}
+
+    def live(self) -> List:
+        return [r for r in self.replicas if r.alive]
+
+    def select(self, prompt_tokens) -> Optional[object]:
+        """Pick the replica for a prompt; None when no replica is live."""
+        live = self.live()
+        if not live:
+            self.stats["no_live_replica"] += 1
+            return None
+        self.stats["routed"] += 1
+        if self.policy == "random":
+            return live[int(self._rng.integers(len(live)))]
+        if self.policy == "prefix":
+            scores = [r.prefix_overlap(prompt_tokens) for r in live]
+            best = max(scores)
+            if best > 0:
+                self.stats["prefix_hits"] += 1
+                # ties on overlap (two replicas both hold the hot prefix)
+                # break by load, so affinity never builds a hotspot
+                cands = [r for r, s in zip(live, scores) if s == best]
+                return min(cands, key=lambda r: r.load)
+            self.stats["fallback_least_loaded"] += 1
+        return min(live, key=lambda r: r.load)
+
+    def state(self) -> dict:
+        return {"policy": self.policy,
+                "replicas": [r.name for r in self.replicas],
+                "live": [r.name for r in self.live()],
+                **self.stats}

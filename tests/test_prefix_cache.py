@@ -116,6 +116,46 @@ def test_radix_match_insert_and_cap():
     assert pc2.match([1, 2, 3, 4, 9]).n_cached_tokens == 0
 
 
+def test_match_purity_no_mutation():
+    """ISSUE 6 satellite: the serving gateway's router scores EVERY replica's
+    tree with ``match`` per request — the contract that makes that free is
+    that ``match`` is a pure read. Pin it: tree topology (chunks, blocks,
+    parent links), per-node LRU stamps, the LRU clock, allocator refcounts,
+    the free count and the stats dict are bit-identical before/after any mix
+    of full-hit / partial-tail / miss / degenerate matches."""
+    kv = _tiny_pool(num_blocks=8, block_size=4)
+    pc = PrefixKVCache(kv)
+    a = kv.reserve(2)
+    pc.publish(_Seq([1, 2, 3, 4, 5, 6, 7, 8], a))
+    held, _, _ = pc.acquire([1, 2, 3, 4, 9, 9, 9])  # COW holder + LRU touches
+
+    def snapshot():
+        nodes = []
+        stack = [((), pc._root)]
+        while stack:
+            path, node = stack.pop()
+            for chunk, child in sorted(node.children.items()):
+                nodes.append((path + chunk, child.block, child.last_access))
+                stack.append((path + chunk, child))
+        return {
+            "nodes": sorted(nodes),
+            "clock": pc._clock,
+            "free": kv.free_blocks,
+            "refcounts": [kv.refcount(b) for b in pc.cached_block_ids()]
+                         + [kv.refcount(b) for b in held],
+            "stats": dict(pc.stats),
+        }
+
+    before = snapshot()
+    for probe in ([1, 2, 3, 4, 5, 6, 7, 8],          # capped exact hit
+                  [1, 2, 3, 4, 5, 6, 7, 8, 9, 9],    # full-block hit + suffix
+                  [1, 2, 3, 4, 5, 6, 99, 98],        # mid-block COW candidate
+                  [7, 7, 7, 7, 7],                   # clean miss
+                  [1], []):                          # degenerate prompts
+        pc.match(np.asarray(probe, np.int32))
+    assert snapshot() == before, "match() mutated tree/LRU/refcount/stats state"
+
+
 def test_radix_acquire_cow_and_release():
     kv = _tiny_pool()
     pc = PrefixKVCache(kv)

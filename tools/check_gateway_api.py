@@ -1,0 +1,92 @@
+"""Static check: the serving request plane touches ``InferenceEngineV2``
+(and everything else) ONLY through public API.
+
+Companion to ``check_kv_blocks.py`` / ``check_data_paths.py`` /
+``check_heartbeats.py`` (same lesson: structural invariants rot silently
+unless CI asserts them). The gateway/router/admission layer sits ABOVE the
+engine: the moment request-plane code reaches into ``state_manager``, the
+scheduler's ``_pending``/``_active``, or any ``_private`` engine attribute,
+the engine's admission invariants (lifetime KV reservations, refcounted
+block sharing, single-writer radix tree) stop being enforceable at one
+layer and every future engine refactor silently breaks the gateway. This
+AST walk (no package imports, runs anywhere) asserts, for every module in
+``deepspeed_tpu/serving/``:
+
+  * no attribute access beginning with ``_`` on anything other than
+    ``self``/``cls`` (dunders exempt) — request-plane objects may have
+    private state, but may not reach into OTHER objects' private state;
+  * no access to the engine-internal surfaces by name:
+    ``state_manager`` / ``kv_cache`` / ``allocator`` — the request plane
+    budgets through ``available_blocks`` / ``probe_prefix`` /
+    ``max_context``, never against raw pool state.
+
+A tier-1 test (``tests/test_gateway.py``) runs this on every CI pass.
+"""
+
+import ast
+import os
+import sys
+
+DEFAULT_SERVING_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                                   "deepspeed_tpu", "serving")
+
+# engine/scheduler internals the request plane must never name, even though
+# they are "public" attributes on the engine object itself
+FORBIDDEN_ATTRS = ("state_manager", "kv_cache", "allocator")
+
+
+def _is_self_or_cls(node) -> bool:
+    return isinstance(node, ast.Name) and node.id in ("self", "cls")
+
+
+def find_violations(serving_dir=DEFAULT_SERVING_DIR):
+    """[(relpath, lineno, snippet, why)] for every private reach-in or
+    named-internal access inside the serving package."""
+    violations = []
+    for root, _dirs, files in os.walk(serving_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, serving_dir)
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+            lines = src.splitlines()
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                attr = node.attr
+                why = None
+                if attr in FORBIDDEN_ATTRS:
+                    why = f"engine internal '{attr}'"
+                elif (attr.startswith("_") and not attr.startswith("__")
+                        and not _is_self_or_cls(node.value)):
+                    why = f"private attribute '{attr}' on a foreign object"
+                if why:
+                    snippet = lines[node.lineno - 1].strip() if node.lineno <= len(lines) else ""
+                    violations.append((rel, node.lineno, snippet, why))
+    return violations
+
+
+def check(serving_dir=DEFAULT_SERVING_DIR):
+    """Return the violation list (empty = the request plane is clean)."""
+    return find_violations(serving_dir)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    serving_dir = argv[0] if argv else DEFAULT_SERVING_DIR
+    bad = check(serving_dir)
+    if bad:
+        print(f"check_gateway_api: request-plane code reaches past the public "
+              f"engine API in {serving_dir}:")
+        for rel, lineno, snippet, why in bad:
+            print(f"  {rel}:{lineno}: {why}: {snippet}")
+        return 1
+    print("check_gateway_api: the serving request plane touches only public API")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

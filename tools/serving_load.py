@@ -20,11 +20,26 @@ never WHAT it computes (asserted in tests/test_serving_load.py).
 
 Output: one JSON line — a saturated-throughput comparison plus an
 rps-vs-latency curve (p50/p95 per policy per offered rate).
+
+PR 6 grew this harness a second face: a **closed-loop HTTP load
+generator** over the serving gateway (``deepspeed_tpu/serving/``).
+:func:`run_http_load` drives ``POST /v1/generate`` with a bounded worker
+pool that HONORS the workload's arrival times (sleep-until-arrival — an
+offered rate is a promise, not a timestamp column) and reports offered vs
+achieved rate alongside client-side TTFT/TPOT percentiles and the shed
+(429) rate, so a saturated point on the curve is visibly saturated instead
+of silently self-pacing. :func:`gateway_latency_curves` sweeps offered
+rates into latency-under-load curves and :func:`router_prefix_ab` runs the
+prefix-aware-router vs random-placement A/B on the Zipf shared-prefix
+workload (same engines, caches cleared between arms — strictly higher
+aggregate hit rate is the acceptance bar). CLI: ``python
+tools/serving_load.py gateway`` emits both as one JSON line.
 """
 
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -314,6 +329,260 @@ def shared_prefix_ab(on_tpu, n_requests=None, seed=0):
     return result
 
 
+# ---------------------------------------------------------------------------
+# gateway plane: closed-loop HTTP load generation + router A/B
+# ---------------------------------------------------------------------------
+def _percentiles(vals, keys=(50, 99)):
+    if not vals:
+        return {f"p{k}_ms": None for k in keys}
+    arr = np.asarray(vals)
+    return {f"p{k}_ms": round(float(np.percentile(arr, k)), 1) for k in keys}
+
+
+def _http_generate(host, port, r, stream, timeout_s, slo_class):
+    """One ``POST /v1/generate`` with client-side TTFT/TPOT timestamps."""
+    import http.client
+
+    body = {"prompt": np.asarray(r["prompt"]).tolist(),
+            "max_new_tokens": int(r["max_new_tokens"]), "stream": bool(stream)}
+    if slo_class:
+        body["slo_class"] = slo_class
+    rec = {"uid": r["uid"], "status": None, "tokens": [], "ttft_ms": None,
+           "tpot_ms": None, "latency_ms": None, "error": None}
+    t_send = time.time()
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("POST", "/v1/generate", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        rec["status"] = resp.status
+        if resp.status != 200:
+            payload = json.loads(resp.read() or b"{}")
+            rec["error"] = payload.get("error")
+            return rec
+        if not stream:
+            payload = json.loads(resp.read())
+            rec["tokens"] = payload["tokens"]
+            rec["error"] = payload.get("error")
+            rec["ttft_ms"] = payload.get("ttft_ms")  # server-side (no frames)
+            rec["tpot_ms"] = payload.get("tpot_ms")
+            return rec
+        # incremental SSE read: the response closes when the stream ends
+        # (HTTP/1.0 semantics), so readline() yields frames as they arrive —
+        # client-side token timestamps are the honest TTFT/TPOT
+        token_times = []
+        ev_lines = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.rstrip(b"\r\n")
+            if line:
+                ev_lines.append(line)
+                continue
+            if not ev_lines:
+                continue
+            datas = [ln[5:].lstrip() for ln in ev_lines if ln.startswith(b"data:")]
+            ev_lines = []
+            if not datas:
+                continue
+            ev = json.loads(b"\n".join(datas))
+            if "token" in ev:
+                token_times.append(time.time())
+                rec["tokens"].append(ev["token"])
+            elif ev.get("done"):
+                rec["error"] = ev.get("error")
+        if token_times:
+            rec["ttft_ms"] = (token_times[0] - t_send) * 1e3
+            if len(token_times) > 1:
+                rec["tpot_ms"] = ((token_times[-1] - token_times[0])
+                                  / (len(token_times) - 1) * 1e3)
+        return rec
+    except Exception as e:  # noqa: BLE001 — the harness reports, never dies
+        rec["error"] = f"{type(e).__name__}: {e}"
+        return rec
+    finally:
+        conn.close()
+        rec["latency_ms"] = (time.time() - t_send) * 1e3
+
+
+def run_http_load(host, port, workload, concurrency=8, stream=True,
+                  timeout_s=120.0, slo_class=None):
+    """Closed-loop HTTP load over a running gateway: ``concurrency`` workers
+    pull arrival-ordered requests, SLEEP until each one's arrival time
+    (offered rate honored, not merely timestamped), then drive the request
+    to completion before pulling the next. When the pool saturates, later
+    requests launch behind schedule — disclosed as ``send_lag_ms_p50`` and
+    the offered-vs-achieved gap, which is exactly the honesty the open-loop
+    curves lacked. Returns aggregate + per-request records."""
+    work = sorted(workload, key=lambda r: r["arrival"])
+    records = [None] * len(work)
+    cursor = [0]
+    lock = threading.Lock()
+    t0 = time.time()
+
+    def worker():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(work):
+                    return
+                cursor[0] += 1
+            r = work[i]
+            delay = r["arrival"] - (time.time() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            t_send = time.time()
+            rec = _http_generate(host, port, r, stream, timeout_s, slo_class)
+            rec["send_lag_ms"] = max(0.0, (t_send - t0 - r["arrival"]) * 1e3)
+            records[i] = rec
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"dstpu-loadgen-{i}")
+               for i in range(min(concurrency, len(work)))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    makespan = time.time() - t0
+    recs = [r for r in records if r is not None]
+    done = [r for r in recs if r["status"] == 200 and r["error"] is None]
+    shed = [r for r in recs if r["status"] == 429]
+    errors = [r for r in recs
+              if not (r["status"] == 200 and r["error"] is None) and r["status"] != 429]
+    last_arrival = work[-1]["arrival"] if work else 0.0
+    agg = {
+        "n_requests": len(work),
+        "completed": len(done),
+        "shed": len(shed),
+        "errors": len(errors),
+        # offered = what the arrival schedule asked for; achieved = what the
+        # system absorbed — divergence means saturation, not a faster clock
+        "offered_rps": (round((len(work) - 1) / last_arrival, 2)
+                        if last_arrival > 0 else None),
+        "achieved_rps": round(len(done) / makespan, 2) if makespan > 0 else None,
+        "shed_rate": round(len(shed) / len(work), 3) if work else 0.0,
+        "ttft": _percentiles([r["ttft_ms"] for r in done if r["ttft_ms"]]),
+        "tpot": _percentiles([r["tpot_ms"] for r in done if r["tpot_ms"]]),
+        "latency": _percentiles([r["latency_ms"] for r in done if r["latency_ms"]]),
+        "send_lag_ms_p50": (round(float(np.percentile(
+            [r["send_lag_ms"] for r in recs], 50)), 1) if recs else None),
+    }
+    return agg, recs
+
+
+def build_gateway(n_replicas=2, prefix_cache=True, on_tpu=False, **cfg_kwargs):
+    """N fresh replicas (identical deterministic params — greedy outputs are
+    placement-invariant) under one started gateway."""
+    from deepspeed_tpu.serving import GatewayConfig, ServingGateway
+
+    engines = [build_engine(on_tpu, prefix_cache=prefix_cache)
+               for _ in range(n_replicas)]
+    cfg = GatewayConfig(enabled=True, port=0, **cfg_kwargs)
+    return ServingGateway(engines, cfg).start()
+
+
+def gateway_latency_curves(on_tpu, n_requests=None, seed=0, n_replicas=2):
+    """Latency-under-load through the full HTTP plane: a saturated
+    calibration pass, then an offered-rate sweep around it — TTFT/TPOT
+    p50/p99 + shed rate per point. Engines are the small smoke config
+    regardless of backend (two production-sized replicas do not share one
+    chip's HBM); the headline serving numbers stay with bench_serving."""
+    n = n_requests or (32 if on_tpu else 12)
+    shape = dict(prompt_lo=8, prompt_hi=24, new_lo=4, new_hi=10)
+    gw = build_gateway(n_replicas=n_replicas, prefix_cache=True)
+    # the 2x point must shed, not queue unboundedly: bound the default class
+    for cls in gw.config.slo_classes.values():
+        cls.max_queue_depth = max(4, n // 2)
+    try:
+        warm = make_workload(n, rate_rps=None, seed=seed, uid_base=0, **shape)
+        run_http_load(gw.config.host, gw.port, warm)  # compile the buckets
+        sat = make_workload(n, rate_rps=None, seed=seed, uid_base=10_000, **shape)
+        sat_agg, _ = run_http_load(gw.config.host, gw.port, sat)
+        result = {"config": "gateway_http_load", "n_requests": n,
+                  "n_replicas": n_replicas, "engine_config": "cpu_smoke",
+                  "saturated": sat_agg, "curve": []}
+        base = sat_agg["achieved_rps"] or 1.0
+        for mi, mult in enumerate((0.5, 1.0, 2.0)):
+            wl = make_workload(n, rate_rps=base * mult, seed=seed + 1 + mi,
+                               uid_base=50_000 + 20_000 * mi, **shape)
+            agg, _ = run_http_load(gw.config.host, gw.port, wl)
+            result["curve"].append({"offered_mult": mult, **agg})
+        return result
+    finally:
+        gw.stop()
+
+
+def router_prefix_ab(on_tpu, n_requests=None, seed=0, n_replicas=2, gateway=None):
+    """Prefix-aware router vs random placement, same engines, same Zipf
+    shared-prefix workload (ISSUE 6 acceptance): the radix-overlap oracle
+    keeps each hot prefix on ONE replica, so the fleet pays one cold miss
+    per prefix instead of one per (prefix, replica) pair — strictly higher
+    AGGREGATE hit rate. Between arms every tree is cleared and its stats
+    zeroed; greedy + identical params make the generations
+    placement-invariant, reported as ``token_parity``. The load runs with
+    ONE closed-loop worker so each request's prefix is published before the
+    next routes — hit accounting measures PLACEMENT, not racing admissions
+    (both arms, same discipline, so the comparison stays apples-to-apples
+    and deterministic under the fixed seeds)."""
+    n = n_requests or (48 if on_tpu else 24)
+    shape = dict(n_prefixes=4, prefix_len=24, suffix_lo=4, suffix_hi=10,
+                 new_lo=3, new_hi=6)
+    own = gateway is None
+    gw = gateway or build_gateway(n_replicas=n_replicas, prefix_cache=True)
+    n_replicas = len(gw.replicas)
+    try:
+        # compile the shape buckets on an all-unique stream so neither arm
+        # pays XLA inside its measured window
+        warm = make_shared_prefix_workload(n // 2, rate_rps=None, seed=seed + 7,
+                                           uid_base=90_000, unique=True, **shape)
+        run_http_load(gw.config.host, gw.port, warm, stream=False)
+        out = {"config": "router_prefix_ab", "n_requests": n,
+               "n_replicas": n_replicas, "zipf_a": 1.2,
+               # cache-hit prefill trims produce chunk shapes the unique-mode
+               # warmup never saw, so the FIRST arm pays residual XLA
+               # compiles: compare hit rates across arms, not wall-clock
+               "note": "arms run sequentially; rps/ttft not arm-comparable",
+               "arms": {}}
+        tokens = {}
+        for ai, policy in enumerate(("random", "prefix")):
+            for eng in gw.engines:
+                eng.prefix_cache.clear()
+                eng.prefix_cache.stats.update({k: 0 for k in eng.prefix_cache.stats})
+            gw.router.policy = policy
+            wl = make_shared_prefix_workload(n, rate_rps=None, seed=seed,
+                                             uid_base=1000 * (ai + 1), **shape)
+            agg, recs = run_http_load(gw.config.host, gw.port, wl, stream=False,
+                                      concurrency=1)
+            hits = sum(e.prefix_cache.stats["hits"] for e in gw.engines)
+            lookups = sum(e.prefix_cache.stats["lookups"] for e in gw.engines)
+            cached = sum(e.prefix_cache.stats["cached_tokens"] for e in gw.engines)
+            out["arms"][policy] = {
+                "aggregate_hit_rate": round(hits / lookups, 3) if lookups else 0.0,
+                "hits": hits, "lookups": lookups, "cached_tokens": cached,
+                "achieved_rps": agg["achieved_rps"],
+                "ttft_p50_ms": agg["ttft"]["p50_ms"],
+            }
+            tokens[policy] = {r["uid"] - 1000 * (ai + 1): list(r["tokens"])
+                              for r in recs if r["status"] == 200}
+        out["token_parity"] = tokens["random"] == tokens["prefix"]
+        out["prefix_beats_random"] = (out["arms"]["prefix"]["aggregate_hit_rate"]
+                                      > out["arms"]["random"]["aggregate_hit_rate"])
+        return out
+    finally:
+        if own:
+            gw.stop()
+        else:  # a borrowed gateway gets its configured policy back
+            gw.router.policy = gw.config.router
+
+
+def gateway_bench(on_tpu, seed=0):
+    """The bench.py serving-block entry: latency-under-load curves + the
+    router A/B, one dict."""
+    return {"load": gateway_latency_curves(on_tpu, seed=seed),
+            "router_ab": router_prefix_ab(on_tpu, seed=seed)}
+
+
 def main():
     import jax
 
@@ -337,6 +606,8 @@ def main():
 
     if "shared_prefix" in sys.argv[1:]:
         out = shared_prefix_ab(on_tpu)
+    elif "gateway" in sys.argv[1:]:
+        out = gateway_bench(on_tpu)
     else:
         out = serving_load_bench(on_tpu)
     out["on_tpu"] = on_tpu
