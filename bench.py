@@ -461,6 +461,17 @@ def run_bench():
             from tools.serving_load import gateway_bench
 
             serving["gateway"] = gateway_bench(on_tpu)
+            # request-scoped tracing (PR 8): surface the p99-TTFT attribution
+            # and the measured trace-on-vs-off throughput tax as one readable
+            # line — the full table rides the serving JSON below
+            tr = serving["gateway"].get("tracing", {})
+            attr = tr.get("attribution", {})
+            if attr.get("stages_p99_ms"):
+                stages = " ".join(f"{k.removesuffix('_ms')}={v}ms"
+                                  for k, v in attr["stages_p99_ms"].items())
+                print(f"# p99 TTFT attribution: ttft_p99={attr.get('ttft_p99_ms')}ms "
+                      f"[{stages}] breakdown_ok={attr.get('breakdown_ok_frac')} "
+                      f"trace_overhead={tr.get('overhead_pct')}%", flush=True)
         except Exception as e:
             print(f"# WARNING: gateway bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)

@@ -276,9 +276,13 @@ class InferenceEngineV2:
             # block=False measures only async dispatch, so no latency sample
             kind = "prefill" if had_prefill else "decode_step"
             hist = ("serving/ttft_ms" if kind == "prefill" else "serving/decode_step_ms") if block else None
+            # uids ride the span so a request-scoped trace can attribute
+            # every engine forward to the requests composing it (capped:
+            # span args are JSONL payload, not a table)
             observe_latency(t0, f"serving/{kind}", hist_name=hist,
                             span_args={"seqs": len(batch_uids),
                                        "tokens": int(sum(t.size for t in batch_tokens)),
+                                       "uids": [int(u) for u in batch_uids[:16]],
                                        "blocked": bool(block)})
         return out
 
@@ -380,7 +384,9 @@ class InferenceEngineV2:
                             hist_name="serving/decode_ms" if block else None,
                             gauges=({"serving/decode_tokens_per_sec":
                                      lambda dt: S * n_steps / max(dt, 1e-9)} if block else None),
-                            span_args={"seqs": S, "steps": int(n_steps), "blocked": bool(block)})
+                            span_args={"seqs": S, "steps": int(n_steps),
+                                       "uids": [int(u) for u in uids[:16]],
+                                       "blocked": bool(block)})
         return toks
 
     def _ragged_step(self, params, packed, pools, t_bucket, s_bucket):

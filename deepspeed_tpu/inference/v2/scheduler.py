@@ -19,6 +19,7 @@ Design points beyond the happy path:
   uids named, and partial generations stay readable via ``results``.
 """
 
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -78,6 +79,11 @@ class DynamicSplitFuseScheduler:
         # actually computed vs skipped via radix hits (exact — counted at the
         # feed site, not inferred from latency)
         self.stats = {"prefill_tokens_fed": 0, "prefill_tokens_skipped": 0}
+        # optional per-step observer, `fn(uids, chunk_sizes, t0, dur)` after
+        # each composed `put` forward — the serving replica attaches one to
+        # attribute step wall time to the requests whose chunks composed it
+        # (per-chunk prefill spans). None (the default) adds zero work.
+        self.step_observer = None
 
     def submit(self, uid: int, prompt, max_new_tokens: int = 32, eos_token_id=None):
         if uid in self._active or any(r.uid == uid for r in self._pending):
@@ -286,7 +292,13 @@ class DynamicSplitFuseScheduler:
 
         if not uids:
             return 0
-        toks = self.engine.put(uids, chunks, sample="greedy")
+        if self.step_observer is None:
+            toks = self.engine.put(uids, chunks, sample="greedy")
+        else:
+            t0 = time.perf_counter()
+            toks = self.engine.put(uids, chunks, sample="greedy")
+            self.step_observer(uids, [c.size for c in chunks], t0,
+                               time.perf_counter() - t0)
         n = sum(c.size for c in chunks)
         for uid, tok in zip(uids, np.asarray(toks).reshape(-1)):
             req = self._active[uid]

@@ -153,10 +153,15 @@ class HealthHTTPServer:
     """Tiny stdlib exporter: ``/metrics`` (Prometheus text 0.0.4) and
     ``/healthz`` (JSON). Daemon serving thread; ``stop()`` shuts it down."""
 
-    def __init__(self, host, port, registry, healthz_fn, heartbeats_fn=None):
+    def __init__(self, host, port, registry, healthz_fn, heartbeats_fn=None,
+                 extra_rows_fn=None):
         self.registry = registry
         self.healthz_fn = healthz_fn
         self.heartbeats_fn = heartbeats_fn
+        # additional labelled gauge rows appended per scrape — the health
+        # plane routes its registered gauge providers (serving admission
+        # queue depth / shed rate) through here
+        self.extra_rows_fn = extra_rows_fn
         self._host, self._want_port = host, int(port)
         self._httpd = None
         self._thread = None
@@ -184,10 +189,13 @@ class HealthHTTPServer:
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/metrics":
-                        extra = (heartbeat_gauge_rows(outer.heartbeats_fn())
-                                 if outer.heartbeats_fn else None)
+                        extra = list(heartbeat_gauge_rows(outer.heartbeats_fn())
+                                     if outer.heartbeats_fn else ())
+                        if outer.extra_rows_fn is not None:
+                            extra.extend(outer.extra_rows_fn())
                         self._send(200, "text/plain; version=0.0.4; charset=utf-8",
-                                   render_prometheus(outer.registry, extra_gauges=extra))
+                                   render_prometheus(outer.registry,
+                                                     extra_gauges=extra or None))
                     elif path == "/healthz":
                         self._send(200, "application/json",
                                    json.dumps(outer.healthz_fn(), default=repr))

@@ -78,6 +78,13 @@ class HealthPlane:
         self._snapshot_every = 50
         self._providers = {}  # name -> callable() -> dict (healthz sections)
         self._ready_provider = None  # callable() -> bool (LB readiness)
+        # name -> callable() -> [(metric, labels, value)] labelled gauge rows
+        # appended to /metrics (the serving gateway feeds queue depth and
+        # shed rate through here so admission state is actually scrapeable)
+        self._gauge_providers = {}
+        # name -> callable() -> dict written as one JSONL line into every
+        # forensic dump (the gateway's in-flight request roster rides here)
+        self._dump_providers = {}
         self._stall_callback = None
         self._dump_dir = "/tmp/dstpu_health"
         self._dump_n = 0
@@ -167,6 +174,8 @@ class HealthPlane:
             self._hb.clear()
             self._deadlines.clear()
         self._providers.clear()
+        self._gauge_providers.clear()
+        self._dump_providers.clear()
         self._ready_provider = None
         self._snapshot_path = None
         self._stall_callback = None
@@ -371,6 +380,13 @@ class HealthPlane:
                                default=repr) + "\n")
             f.write(json.dumps({"kind": "heartbeats",
                                 "sources": self.heartbeats()}) + "\n")
+            for name, fn in list(self._dump_providers.items()):
+                # each provider guarded: a broken one costs its own section,
+                # never the bundle (the dump is the last artifact of a stall)
+                try:
+                    f.write(json.dumps({"kind": name, **fn()}, default=repr) + "\n")
+                except Exception as e:  # noqa: BLE001
+                    f.write(json.dumps({"kind": name, "error": repr(e)}) + "\n")
             f.write(json.dumps({"kind": "flight_begin",
                                 "entries": get_flight_recorder().total_recorded,
                                 "capacity": get_flight_recorder().capacity}) + "\n")
@@ -468,6 +484,45 @@ class HealthPlane:
         if self._providers.get(name) is fn:
             self._providers.pop(name, None)
 
+    def set_gauge_provider(self, name, fn):
+        """Register a labelled-gauge source for ``/metrics``: ``fn() ->
+        [(metric_name, labels_dict, value), ...]`` rendered through the
+        exporter's ``extra_gauges`` path. Pass ``None`` to remove."""
+        if fn is None:
+            self._gauge_providers.pop(name, None)
+        else:
+            self._gauge_providers[name] = fn
+
+    def clear_gauge_provider(self, name, fn):
+        """Ownership-checked removal (the rollover contract)."""
+        if self._gauge_providers.get(name) is fn:
+            self._gauge_providers.pop(name, None)
+
+    def gauge_rows(self):
+        """All provider rows, each provider guarded — a broken provider
+        costs its own rows, never the scrape."""
+        rows = []
+        for name, fn in list(self._gauge_providers.items()):
+            try:
+                rows.extend(fn())
+            except Exception as e:  # noqa: BLE001 — telemetry never raises
+                self._log().error(f"health: gauge provider {name!r} failed: {e!r}")
+        return rows
+
+    def set_dump_provider(self, name, fn):
+        """Register a forensic-dump section: ``fn() -> dict`` written as one
+        ``{"kind": name, ...}`` JSONL line in every :meth:`dump` bundle —
+        how a stall dump names the requests on a wedged replica. Pass
+        ``None`` to remove."""
+        if fn is None:
+            self._dump_providers.pop(name, None)
+        else:
+            self._dump_providers[name] = fn
+
+    def clear_dump_provider(self, name, fn):
+        if self._dump_providers.get(name) is fn:
+            self._dump_providers.pop(name, None)
+
     def ready(self):
         """Current readiness verdict: the provider's answer (False on any
         provider exception — a broken oracle must fail closed, not keep a
@@ -526,7 +581,8 @@ class HealthPlane:
 
         self._server = HealthHTTPServer(host, port, registry=get_metrics(),
                                         healthz_fn=self.healthz_payload,
-                                        heartbeats_fn=self.heartbeats)
+                                        heartbeats_fn=self.heartbeats,
+                                        extra_rows_fn=self.gauge_rows)
         self._server.start()
 
     @property

@@ -121,6 +121,15 @@ class Tracer:
                     self.enabled = True
                     self._emit({"name": "process_name", "ph": "M", "ts": 0, "pid": self._pid,
                                 "tid": 0, "args": {"name": "deepspeed_tpu"}})
+                    # re-announce streams first seen in mirror-only mode:
+                    # their thread_name metadata went to the flight ring,
+                    # never to the buffer/file — without this, a trace
+                    # enabled AFTER the health plane armed the mirror has
+                    # tids no viewer can name
+                    for stream, tid in sorted(self._tids.items()):
+                        self._emit({"name": "thread_name", "ph": "M", "ts": 0,
+                                    "pid": self._pid, "tid": tid,
+                                    "args": {"name": stream}})
                 elif not enabled and self.enabled:
                     self.flush()
                     self.enabled = False

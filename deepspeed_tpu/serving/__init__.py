@@ -29,8 +29,11 @@ The request plane talks to the engine ONLY through its public API
 by the ``tools/check_gateway_api.py`` AST gate, run from tier-1.
 """
 
-from .config import GatewayConfig, SLOClassConfig
+from .config import GatewayConfig, RequestTraceConfig, SLOClassConfig
 from .admission import AdmissionController
 from .router import ReplicaRouter
 from .replica import EngineReplica, GatewayRequest, TokenStream
+from .reqtrace import (RequestContext, RequestLog, RequestTracing,
+                       extract_request_id, new_request_id, parse_traceparent,
+                       sanitize_request_id)
 from .gateway import ServingGateway, parse_sse, sse_frame

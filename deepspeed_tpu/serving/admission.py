@@ -32,14 +32,18 @@ from ..monitor.metrics import get_metrics
 class AdmissionController:
     """Bounded per-(replica, class) queues + uncached-token accounting."""
 
-    def __init__(self, config):
+    def __init__(self, config, reqtrace=None):
         self.config = config
+        self.reqtrace = reqtrace
         self._lock = threading.Lock()
         self._queues: Dict[Tuple[str, str], deque] = {}
         self._queued_uncached: Dict[Tuple[str, str], int] = {}
         self._order = config.class_order()
         self.stats = {"admitted": 0, "shed": 0,
                       "uncached_tokens_admitted": 0, "cached_tokens_admitted": 0}
+        # per-SLO-class admitted/shed counts behind the scrapeable shed-rate
+        # gauge (gauge_rows) — the aggregate stats above can't give per-class
+        self.class_stats: Dict[str, Dict[str, int]] = {}
 
     # -- depth introspection -------------------------------------------------
     def depth(self, replica: Optional[str] = None, slo_class: Optional[str] = None) -> int:
@@ -71,6 +75,10 @@ class AdmissionController:
         # the credit is a floor — concurrent publishes only raise it
         n_cached, _shared, _tree_only, _match = replica.engine.probe_prefix(req.prompt)
         uncached = int(req.prompt.size - n_cached)
+        if req.ctx is not None:
+            # the probe already ran: a SHED record should still say how much
+            # of the refused prompt the cache could have served
+            req.ctx.prefix_hit_tokens = int(n_cached)
         key = (replica.name, req.slo_class)
         reg = get_metrics()
         with self._lock:
@@ -78,6 +86,8 @@ class AdmissionController:
             if q is None:
                 q = self._queues[key] = deque()
                 self._queued_uncached[key] = 0
+            cs = self.class_stats.setdefault(req.slo_class,
+                                             {"admitted": 0, "shed": 0})
             if cls.max_queue_depth > 0 and len(q) >= cls.max_queue_depth:
                 reason = "queue_depth"
             elif (cls.max_queue_uncached_tokens > 0
@@ -87,15 +97,23 @@ class AdmissionController:
                 reason = None
             if reason is not None:
                 self.stats["shed"] += 1
+                cs["shed"] += 1
                 reg.counter(f"gateway/shed_{req.slo_class}_total").inc()
                 return False, reason
             req.cached_tokens = int(n_cached)
             req.uncached_tokens = uncached
             req.replica_name = replica.name
             req.t_admitted = time.perf_counter()
+            if req.ctx is not None:
+                # stamped BEFORE the request is published to the queue: the
+                # replica driver can dequeue (and even finish) it the moment
+                # it lands, racing any later stamp — pure field write here,
+                # span emission stays outside the lock
+                req.ctx.t_admitted = req.t_admitted
             q.append(req)
             self._queued_uncached[key] += uncached
             self.stats["admitted"] += 1
+            cs["admitted"] += 1
             self.stats["uncached_tokens_admitted"] += uncached
             self.stats["cached_tokens_admitted"] += int(n_cached)
         reg.counter(f"gateway/requests_{req.slo_class}_total").inc()
@@ -127,6 +145,8 @@ class AdmissionController:
             self._queued_uncached.clear()
         for req in reqs:
             req.stream.finish(reason="error", error=reason)
+            if self.reqtrace is not None:
+                self.reqtrace.finalize(req)
 
     def cancel(self, req) -> bool:
         """Remove a still-queued request (client gave up before a replica
@@ -159,9 +179,31 @@ class AdmissionController:
                     self._queued_uncached[(r, c)] = 0
         for req in reqs:
             req.stream.finish(reason="error", error=reason)
+            if self.reqtrace is not None:
+                self.reqtrace.finalize(req)
         return len(reqs)
+
+    def gauge_rows(self):
+        """Admission state as labelled Prometheus gauge rows for the
+        ``monitor/export.py`` ``extra_gauges`` path — per-(replica, class)
+        queue depth + queued uncached tokens, and per-class shed rate.
+        Before this, queue state was reachable only via the /healthz JSON,
+        invisible to an actual Prometheus scraper."""
+        rows = []
+        with self._lock:
+            for (r, c), q in self._queues.items():
+                labels = {"replica": r, "slo_class": c}
+                rows.append(("gateway/queue_depth", labels, float(len(q))))
+                rows.append(("gateway/queued_uncached_tokens", labels,
+                             float(self._queued_uncached.get((r, c), 0))))
+            for c, cs in self.class_stats.items():
+                total = cs["admitted"] + cs["shed"]
+                rows.append(("gateway/shed_rate", {"slo_class": c},
+                             (cs["shed"] / total) if total else 0.0))
+        return rows
 
     def state(self) -> dict:
         with self._lock:
             queues = {f"{r}/{c}": len(q) for (r, c), q in self._queues.items() if q}
-        return {"queues": queues, **self.stats}
+            per_class = {c: dict(cs) for c, cs in self.class_stats.items()}
+        return {"queues": queues, "per_class": per_class, **self.stats}

@@ -52,6 +52,33 @@ def _default_classes() -> Dict[str, SLOClassConfig]:
 
 
 @dataclass
+class RequestTraceConfig:
+    """``serving.gateway.tracing`` block — request-scoped tracing and the
+    per-request summary log (``serving/reqtrace.py``). Presence-enables
+    (the ``trace``/``health`` contract): an absent block costs the request
+    path zero allocations and zero threads (test-enforced); a present one
+    turns on request contexts, request-id-carrying spans on the Tracer/
+    FlightRecorder, per-stage Prometheus histograms, and the JSONL summary
+    log with tail-aware sampling."""
+
+    enabled: bool = False
+    # per-request summary records (JSONL, one line per terminal request);
+    # "" = in-memory ring only, no file
+    log_path: str = ""
+    # atomic rotation: past this size the log rotates to .1/.2/... and the
+    # oldest retained file is dropped — the log is bounded, never unbounded
+    log_max_bytes: int = 16 << 20
+    log_max_files: int = 2
+    # head-sampling rate for HEALTHY requests (deterministic on request id).
+    # SLO-miss / shed / error / cancelled records are ALWAYS retained
+    # regardless — tails are the records the log exists for.
+    sample_rate: float = 1.0
+    # terminal-summary ring retained in memory (flight-dump forensics +
+    # programmatic reads without touching the file)
+    last_n: int = 64
+
+
+@dataclass
 class GatewayConfig:
     enabled: bool = False
     host: str = "127.0.0.1"
@@ -75,16 +102,34 @@ class GatewayConfig:
     # (seq_bucket, decode_steps) pairs pre-compiled per replica at start()
     # via engine.warmup; empty = no warmup
     warmup: Tuple = ()
+    # request-scoped tracing + per-request summary log; off by default
+    tracing: RequestTraceConfig = field(default_factory=RequestTraceConfig)
 
     @classmethod
     def from_dict(cls, d) -> "GatewayConfig":
         d = dict(d or {})
         classes = d.pop("slo_classes", None)
+        tracing = d.pop("tracing", None)
         known = {f.name for f in fields(cls)}
         unknown = set(d) - known
         if unknown:
             raise ValueError(f"serving.gateway: unknown keys {sorted(unknown)}")
         cfg = cls(**d)
+        if tracing is not None:
+            if isinstance(tracing, RequestTraceConfig):
+                cfg.tracing = tracing
+            else:
+                body = dict(tracing)
+                tr_known = {f.name for f in fields(RequestTraceConfig)}
+                bad = set(body) - tr_known
+                if bad:
+                    raise ValueError(f"serving.gateway.tracing: unknown keys {sorted(bad)}")
+                if "enabled" not in body:  # presence-enables
+                    body["enabled"] = True
+                cfg.tracing = RequestTraceConfig(**body)
+            if not 0.0 <= cfg.tracing.sample_rate <= 1.0:
+                raise ValueError("serving.gateway.tracing: sample_rate must be in [0, 1], "
+                                 f"got {cfg.tracing.sample_rate}")
         if classes is not None:
             slo_known = {f.name for f in fields(SLOClassConfig)}
             parsed = {}

@@ -41,6 +41,8 @@ from ..monitor.metrics import get_metrics
 from .admission import AdmissionController
 from .config import GatewayConfig
 from .replica import EngineReplica, GatewayRequest
+from .reqtrace import (RequestTracing, extract_request_id, new_request_id,
+                       sanitize_request_id)
 from .router import ReplicaRouter
 
 
@@ -69,8 +71,15 @@ class ServingGateway:
 
     def __init__(self, engines, config: Optional[GatewayConfig] = None):
         self.config = config or GatewayConfig()
-        self.admission = AdmissionController(self.config)
-        self.replicas = [EngineReplica(str(i), eng, self.admission, self.config)
+        # request-scoped tracing plane: exists ONLY when the config block
+        # asked for it — with it absent the request path allocates no
+        # contexts, opens no log, and emits nothing (the PR 1/5 bar)
+        self.reqtrace = (RequestTracing(self.config.tracing,
+                                        slo_classes=self.config.slo_classes)
+                         if self.config.tracing.enabled else None)
+        self.admission = AdmissionController(self.config, reqtrace=self.reqtrace)
+        self.replicas = [EngineReplica(str(i), eng, self.admission, self.config,
+                                       reqtrace=self.reqtrace)
                          for i, eng in enumerate(engines)]
         self.router = ReplicaRouter(self.replicas, policy=self.config.router)
         self._uid_lock = threading.Lock()
@@ -79,6 +88,8 @@ class ServingGateway:
         self._http_thread = None
         self._registered_ready = None
         self._registered_state = None
+        self._registered_gauges = None
+        self._registered_dump = None
         self.started = False
         self.draining = False
 
@@ -107,6 +118,14 @@ class ServingGateway:
         self._registered_state = self.state
         health.set_ready_provider(self._registered_ready)
         health.set_state_provider("gateway", self._registered_state)
+        # scrapeable admission state: per-(replica, class) queue depth +
+        # per-class shed rate ride /metrics as labelled gauges, and stall
+        # dumps get the in-flight request roster (which requests were ON
+        # the wedged replica) — both ownership-checked like ready/state
+        self._registered_gauges = self.admission.gauge_rows
+        self._registered_dump = self.inflight_request_summaries
+        health.set_gauge_provider("gateway", self._registered_gauges)
+        health.set_dump_provider("inflight_requests", self._registered_dump)
         return self
 
     def stop(self, timeout: float = 10.0):
@@ -126,6 +145,10 @@ class ServingGateway:
             health = get_health()
             health.clear_ready_provider(self._registered_ready)
             health.clear_state_provider("gateway", self._registered_state)
+            health.clear_gauge_provider("gateway", self._registered_gauges)
+            health.clear_dump_provider("inflight_requests", self._registered_dump)
+        if self.reqtrace is not None:
+            self.reqtrace.close()
         self.started = False
 
     def drain(self, on: bool = True):
@@ -160,56 +183,87 @@ class ServingGateway:
 
     # -- programmatic entry (what the HTTP handler calls) ---------------------
     def submit(self, prompt, max_new_tokens: int = 16, slo_class: Optional[str] = None,
-               eos_token_id=None):
+               eos_token_id=None, rid: Optional[str] = None,
+               traceparent: Optional[str] = None):
         """Validate -> route -> admit. Returns ``(200, GatewayRequest)`` or
-        ``(status, error_dict)`` with status 400/429/503."""
-        if not self.started or self.draining:
-            return 503, {"error": "not_ready",
-                         "detail": "draining" if self.draining else "not started"}
+        ``(status, error_dict)`` with status 400/429/503. ``rid`` is the
+        (already-sanitized) client request id — generated when absent, so
+        every refusal carries one too."""
+        rt = self.reqtrace
+        rid = sanitize_request_id(rid) or new_request_id()
         cls = slo_class or self.config.default_slo_class
+        ctx = rt.open(rid, traceparent=traceparent, slo_class=cls) \
+            if rt is not None else None
+
+        def refuse(status, payload, replica=None):
+            payload["request_id"] = rid
+            if rt is not None:
+                rt.finalize_rejected(ctx, status,
+                                     payload.get("reason") or payload.get("error"),
+                                     replica=replica.name if replica else None)
+            return status, payload
+
+        if not self.started or self.draining:
+            return refuse(503, {"error": "not_ready",
+                                "detail": "draining" if self.draining else "not started"})
         if cls not in self.config.slo_classes:
-            return 400, {"error": "unknown_slo_class", "slo_class": cls,
-                         "known": sorted(self.config.slo_classes)}
+            return refuse(400, {"error": "unknown_slo_class", "slo_class": cls,
+                                "known": sorted(self.config.slo_classes)})
         try:
             max_new_tokens = int(max_new_tokens)
             with self._uid_lock:
                 uid = self._next_uid
                 self._next_uid += 1
             req = GatewayRequest(uid, prompt, max_new_tokens, cls,
-                                 eos_token_id=eos_token_id)
+                                 eos_token_id=eos_token_id, rid=rid, ctx=ctx)
+            if ctx is not None:
+                # stamped here (not at admission) so too_large/shed records
+                # — exactly the always-retained tail — carry the real size
+                ctx.prompt_tokens = int(req.prompt.size)
         except (TypeError, ValueError, OverflowError) as e:
             # OverflowError: a token id outside int32 range from np.asarray
-            return 400, {"error": "invalid_request", "detail": str(e)}
+            return refuse(400, {"error": "invalid_request", "detail": str(e)})
         if req.prompt.size == 0:
-            return 400, {"error": "invalid_request", "detail": "empty prompt"}
+            return refuse(400, {"error": "invalid_request", "detail": "empty prompt"})
         if req.max_new_tokens <= 0:
-            return 400, {"error": "invalid_request",
-                         "detail": "max_new_tokens must be positive"}
+            return refuse(400, {"error": "invalid_request",
+                                "detail": "max_new_tokens must be positive"})
         cap = self.config.max_new_tokens_cap
         if cap and req.max_new_tokens > cap:
-            return 400, {"error": "invalid_request",
-                         "detail": f"max_new_tokens {req.max_new_tokens} > cap {cap}"}
-        replica = self.router.select(req.prompt)
+            return refuse(400, {"error": "invalid_request",
+                                "detail": f"max_new_tokens {req.max_new_tokens} > cap {cap}"})
+        replica = self.router.select(req.prompt, ctx=ctx)
         if replica is None:
             get_metrics().counter("gateway/rejected_total").inc()
-            return 503, {"error": "no_live_replica"}
+            return refuse(503, {"error": "no_live_replica"})
+        if rt is not None:
+            # the decision instant carries what justified the placement:
+            # per-candidate prefix-overlap tokens AND whole blocks (the
+            # unit the radix tree actually shares)
+            bs = replica.engine.config.kv_block_size
+            rt.on_route(ctx, replica.name, ctx.route_policy, ctx.route_scores,
+                        overlap_blocks=({n: s // bs
+                                         for n, s in (ctx.route_scores or {}).items()}
+                                        if ctx.route_policy == "prefix" else None))
         total = req.prompt.size + req.max_new_tokens
         if total > replica.engine.max_context:
-            return 400, {"error": "too_large",
-                         "detail": f"prompt {req.prompt.size} + max_new_tokens "
-                                   f"{req.max_new_tokens} exceeds max_context "
-                                   f"{replica.engine.max_context}"}
+            return refuse(400, {"error": "too_large",
+                                "detail": f"prompt {req.prompt.size} + max_new_tokens "
+                                          f"{req.max_new_tokens} exceeds max_context "
+                                          f"{replica.engine.max_context}"}, replica)
         blocks = -(-total // replica.engine.config.kv_block_size)
         if blocks > replica.pool_blocks:
             # the scheduler could NEVER admit this (its lifetime reservation
             # exceeds the whole pool) — refuse now instead of queueing forever
-            return 400, {"error": "too_large",
-                         "detail": f"request needs {blocks} KV blocks, pool has "
-                                   f"{replica.pool_blocks}"}
+            return refuse(400, {"error": "too_large",
+                                "detail": f"request needs {blocks} KV blocks, pool has "
+                                          f"{replica.pool_blocks}"}, replica)
         ok, reason = self.admission.try_admit(req, replica)
         if not ok:
-            return 429, {"error": "shed", "reason": reason, "slo_class": cls,
-                         "replica": replica.name}
+            return refuse(429, {"error": "shed", "reason": reason, "slo_class": cls,
+                                "replica": replica.name}, replica)
+        if rt is not None:
+            rt.on_admitted(req)
         replica.wake()
         return 200, req
 
@@ -221,6 +275,10 @@ class ServingGateway:
         request keeps decoding to max_new_tokens against live traffic."""
         if self.admission.cancel(req):
             req.stream.finish(reason="error", error="cancelled")
+            if self.reqtrace is not None:
+                # still queued: the driver never saw it, finalize here (the
+                # stream latched the real cause — timeout/disconnect — first)
+                self.reqtrace.finalize(req)
             return True
         for r in self.replicas:
             if r.name == req.replica_name:
@@ -230,10 +288,23 @@ class ServingGateway:
 
     # -- introspection --------------------------------------------------------
     def state(self) -> dict:
-        return {"ready": self.ready, "draining": self.draining,
-                "replicas": [r.state() for r in self.replicas],
-                "admission": self.admission.state(),
-                "router": self.router.state()}
+        out = {"ready": self.ready, "draining": self.draining,
+               "replicas": [r.state() for r in self.replicas],
+               "admission": self.admission.state(),
+               "router": self.router.state()}
+        if self.reqtrace is not None:
+            out["tracing"] = self.reqtrace.state()
+        return out
+
+    def inflight_request_summaries(self) -> dict:
+        """Dump-provider payload for the health plane's forensic bundles:
+        every request currently on a replica (the roster a stall dump needs
+        to NAME who was on the wedged replica) plus the most recent
+        terminal summaries when request tracing is on."""
+        return {"inflight": [row for r in self.replicas
+                             for row in r.inflight_summaries()],
+                "recent": (self.reqtrace.last_summaries(16)
+                           if self.reqtrace is not None else [])}
 
     # -- HTTP front end --------------------------------------------------------
     def _start_http(self):
@@ -250,34 +321,55 @@ class ServingGateway:
             def log_message(self, fmt, *args):  # no stderr chatter per request
                 pass
 
-            def _json(self, code, obj):
-                data = json.dumps(obj).encode("utf-8")
+            # -- the ONE response entry point: EVERY response this gateway
+            # writes — success, 400/404/429/503/504, the catch-all 500, the
+            # GET endpoints, SSE headers — attaches `X-Request-Id` here.
+            # Structurally enforced: `tools/check_request_tracing.py`
+            # asserts no send_response/send_header/end_headers call exists
+            # outside this helper, so an error path added later cannot
+            # silently lose the id.
+            def _respond(self, code, ctype, body=None, rid=None, extra=()):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Content-Type", ctype)
+                self.send_header("X-Request-Id", rid or new_request_id())
+                for k, v in extra:
+                    self.send_header(k, v)
+                if body is not None:
+                    self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                self.wfile.write(data)
+                if body is not None:
+                    self.wfile.write(body)
+
+            def _json(self, code, obj, rid=None):
+                self._respond(code, "application/json",
+                              json.dumps(obj).encode("utf-8"), rid=rid)
 
             def do_GET(self):
+                rid, _tp = extract_request_id(self.headers)
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/healthz":
-                        self._json(200, {"live": True, **outer.state()})
+                        self._json(200, {"live": True, **outer.state()}, rid=rid)
                     elif path == "/readyz":
                         ready = outer.ready
                         self._json(200 if ready else 503,
-                                   {"ready": ready, "draining": outer.draining})
+                                   {"ready": ready, "draining": outer.draining},
+                                   rid=rid)
                     else:
                         self._json(404, {"error": "not_found",
-                                         "paths": ["/v1/generate", "/healthz", "/readyz"]})
+                                         "paths": ["/v1/generate", "/healthz", "/readyz"]},
+                                   rid=rid)
                 except (BrokenPipeError, ConnectionResetError):
                     pass
 
             def do_POST(self):
+                # id resolution FIRST (sanitize client id / adopt traceparent
+                # / generate) so even the catch-all 500 echoes it
+                rid, traceparent = extract_request_id(self.headers)
                 path = self.path.split("?", 1)[0]
                 try:
                     if path != "/v1/generate":
-                        self._json(404, {"error": "not_found"})
+                        self._json(404, {"error": "not_found"}, rid=rid)
                         return
                     try:
                         length = int(self.headers.get("Content-Length", 0))
@@ -285,15 +377,17 @@ class ServingGateway:
                         if not isinstance(body, dict):
                             raise ValueError("body must be a JSON object")
                     except (ValueError, json.JSONDecodeError) as e:
-                        self._json(400, {"error": "bad_json", "detail": str(e)})
+                        self._json(400, {"error": "bad_json", "detail": str(e),
+                                         "request_id": rid}, rid=rid)
                         return
                     status, result = outer.submit(
                         body.get("prompt"),
                         max_new_tokens=body.get("max_new_tokens", 16),
                         slo_class=body.get("slo_class"),
-                        eos_token_id=body.get("eos_token_id"))
+                        eos_token_id=body.get("eos_token_id"),
+                        rid=rid, traceparent=traceparent)
                     if status != 200:
-                        self._json(status, result)
+                        self._json(status, result, rid=rid)
                         return
                     if body.get("stream", True):
                         self._stream_response(result)
@@ -306,26 +400,27 @@ class ServingGateway:
                     # without a response (the client would see a bare reset)
                     try:
                         self._json(500, {"error": "internal",
-                                         "detail": f"{type(e).__name__}: {e}"})
+                                         "detail": f"{type(e).__name__}: {e}",
+                                         "request_id": rid}, rid=rid)
                     except (BrokenPipeError, ConnectionResetError):
                         pass
 
             def _final_frame(self, req: GatewayRequest) -> dict:
                 st = req.stream
-                return {"done": True, "uid": req.uid, "n_tokens": st.produced,
+                return {"done": True, "uid": req.uid, "request_id": req.rid,
+                        "n_tokens": st.produced,
                         "finish_reason": st.finish_reason, "error": st.error,
                         "ttft_ms": round(req.ttft_ms, 3) if req.ttft_ms else None,
                         "tpot_ms": round(req.tpot_ms, 3) if req.tpot_ms else None,
                         "cached_tokens": req.cached_tokens, "dropped": st.dropped}
 
             def _stream_response(self, req: GatewayRequest):
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.end_headers()
+                self._respond(200, "text/event-stream", rid=req.rid,
+                              extra=(("Cache-Control", "no-cache"),))
                 st = req.stream
                 try:
                     self.wfile.write(sse_frame({"meta": True, "uid": req.uid,
+                                                "request_id": req.rid,
                                                 "slo_class": req.slo_class,
                                                 "replica": req.replica_name,
                                                 "cached_tokens": req.cached_tokens}))
@@ -347,6 +442,8 @@ class ServingGateway:
                             break
                     self.wfile.write(sse_frame(self._final_frame(req)))
                     self.wfile.flush()
+                    if outer.reqtrace is not None and req.ctx is not None:
+                        outer.reqtrace.on_respond(req.ctx, 200)
                 except (BrokenPipeError, ConnectionResetError):
                     # the client is gone: release its engine-side resources
                     st.finish(reason="error", error="client_disconnected")
@@ -376,7 +473,10 @@ class ServingGateway:
                 out["tokens"] = req.stream.all_tokens()
                 out["slo_class"] = req.slo_class
                 out["replica"] = req.replica_name
-                self._json(self._error_status(out["error"]), out)
+                status = self._error_status(out["error"])
+                self._json(status, out, rid=req.rid)
+                if outer.reqtrace is not None and req.ctx is not None:
+                    outer.reqtrace.on_respond(req.ctx, status)
 
         self._httpd = http.server.ThreadingHTTPServer(
             (self.config.host, int(self.config.port)), Handler)

@@ -119,9 +119,10 @@ class GatewayRequest:
 
     __slots__ = ("uid", "prompt", "max_new_tokens", "slo_class", "eos_token_id",
                  "stream", "replica_name", "t_admitted", "cached_tokens",
-                 "uncached_tokens", "ttft_ms", "tpot_ms")
+                 "uncached_tokens", "ttft_ms", "tpot_ms", "rid", "ctx")
 
-    def __init__(self, uid, prompt, max_new_tokens, slo_class, eos_token_id=None):
+    def __init__(self, uid, prompt, max_new_tokens, slo_class, eos_token_id=None,
+                 rid=None, ctx=None):
         self.uid = int(uid)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -134,6 +135,10 @@ class GatewayRequest:
         self.uncached_tokens = 0  # what admission actually charged
         self.ttft_ms = None
         self.tpot_ms = None
+        # request id: always present (echoed on the X-Request-Id response
+        # header + SSE meta); ctx only when request tracing is configured
+        self.rid = rid
+        self.ctx = ctx
 
 
 class EngineReplica:
@@ -145,13 +150,18 @@ class EngineReplica:
     # fleet of replicas is not spinning on the admission lock
     IDLE_WAIT_S = 0.05
 
-    def __init__(self, name, engine, admission, config):
+    def __init__(self, name, engine, admission, config, reqtrace=None):
         self.name = str(name)
         self.engine = engine
         self.config = config
         self._admission = admission
+        self._reqtrace = reqtrace
         self._scheduler = DynamicSplitFuseScheduler(
             engine, token_budget=config.token_budget or None)
+        if reqtrace is not None:
+            # per-chunk prefill attribution rides the scheduler's step
+            # observer (None by default — the un-traced path is untouched)
+            self._scheduler.step_observer = self._on_sched_step
         self._max_inflight = (config.max_inflight_per_replica
                               or engine.max_concurrent_sequences)
         # total KV blocks a lone request may reserve: measured on the idle
@@ -202,6 +212,38 @@ class EngineReplica:
             return 0
         return int(pc.match(np.asarray(prompt_tokens, np.int32).reshape(-1)).n_cached_tokens)
 
+    def inflight_summaries(self):
+        """Last-resort forensics: one summary row per request this replica
+        is currently serving (queued-to-scheduler or decoding) — the rows a
+        stall dump needs to NAME the requests on a wedged replica."""
+        now = time.perf_counter()
+        out = []
+        for uid, req in list(self._streams.items()):
+            row = {"request_id": req.rid, "uid": uid, "replica": self.name,
+                   "slo_class": req.slo_class,
+                   "prompt_tokens": int(req.prompt.size),
+                   "max_new_tokens": req.max_new_tokens,
+                   "produced": req.stream.produced,
+                   "age_ms": (round((now - req.t_admitted) * 1e3, 1)
+                              if req.t_admitted else None)}
+            if req.ctx is not None:
+                row.update({"prefix_hit_tokens": req.ctx.prefix_hit_tokens,
+                            "prefill_chunks": req.ctx.prefill_chunks})
+            out.append(row)
+        return out
+
+    def _on_sched_step(self, uids, chunk_sizes, t0, dur):
+        """Scheduler step observer: apportion one composed forward's wall
+        time across its prefill chunks (a request still pre-first-token is
+        by definition prefilling)."""
+        total = sum(chunk_sizes) or 1
+        for uid, n in zip(uids, chunk_sizes):
+            req = self._streams.get(uid)
+            if req is None or req.ctx is None:
+                continue
+            if req.stream.first_token_t is None:
+                self._reqtrace.on_prefill_chunk(req, n, t0, dur * (n / total))
+
     def cancel(self, uid: int):
         """Request abort of ``uid`` (client timed out / disconnected). The
         actual teardown runs on the DRIVER thread at its next loop — the
@@ -244,6 +286,8 @@ class EngineReplica:
         self.started = False
         for req in list(self._streams.values()):
             req.stream.finish(reason="error", error="replica_stopped")
+            if self._reqtrace is not None:
+                self._reqtrace.finalize(req)
         self._streams.clear()
 
     # -- driver loop --------------------------------------------------------
@@ -290,6 +334,10 @@ class EngineReplica:
             self._inflight -= 1
             req.stream.finish(reason="error", error="cancelled")
             get_metrics().counter(f"gateway/cancelled_{req.slo_class}_total").inc()
+            if self._reqtrace is not None:
+                # the stream latched its REAL terminal first (timeout /
+                # disconnect / explicit cancel) — finalize reads it
+                self._reqtrace.finalize(req)
 
     def _pull_admitted(self) -> bool:
         pulled = False
@@ -303,7 +351,11 @@ class EngineReplica:
                                        eos_token_id=req.eos_token_id)
             except Exception as e:  # validation said yes, scheduler said no
                 req.stream.finish(reason="error", error=f"{type(e).__name__}: {e}")
+                if self._reqtrace is not None:
+                    self._reqtrace.finalize(req)
                 continue
+            if self._reqtrace is not None and req.ctx is not None:
+                self._reqtrace.on_dequeue(req)
             self._streams[req.uid] = req
             self._inflight += 1
             pulled = True
@@ -319,6 +371,8 @@ class EngineReplica:
                                          replica=self.name, error=repr(e))
             for req in list(self._streams.values()):
                 req.stream.finish(reason="error", error=f"{type(e).__name__}: {e}")
+                if self._reqtrace is not None:
+                    self._reqtrace.finalize(req)
             self._streams.clear()
             self._inflight = 0
             raise
@@ -343,6 +397,8 @@ class EngineReplica:
                     if req.ttft_ms is None and st.first_token_t is not None:
                         req.ttft_ms = (st.first_token_t - req.t_admitted) * 1e3
                         reg.histogram(f"gateway/ttft_ms_{req.slo_class}").observe(req.ttft_ms)
+                        if self._reqtrace is not None and req.ctx is not None:
+                            self._reqtrace.on_first_token(req, req.ttft_ms)
             if uid in finished:  # once: the stream entry is removed with it
                 self._inflight -= 1
                 del self._streams[uid]
@@ -370,6 +426,11 @@ class EngineReplica:
             if cls.tpot_target_ms > 0 and (req.tpot_ms or 0) > cls.tpot_target_ms:
                 get_metrics().counter(f"gateway/slo_tpot_miss_{req.slo_class}_total").inc()
         get_metrics().counter(f"gateway/completed_{req.slo_class}_total").inc()
+        if self._reqtrace is not None:
+            # finalize BEFORE the stream latches done: the HTTP handler
+            # wakes on finish and may read the request log immediately —
+            # the summary record must already be durable by then
+            self._reqtrace.finalize(req, finish_reason=reason, n_tokens=n)
         st.finish(reason=reason)
 
     # -- introspection -------------------------------------------------------

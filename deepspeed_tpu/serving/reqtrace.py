@@ -1,0 +1,470 @@
+"""Request-scoped tracing: the per-REQUEST observability plane.
+
+PR 1/5 made the serving plane explain *steps* (``serving/prefill`` spans,
+TTFT histograms, stall dumps); this module makes it explain *requests* —
+the unit users and SLOs care about. One :class:`RequestContext` rides each
+request end-to-end (gateway parse -> admission queue -> router decision ->
+per-chunk prefill -> first token -> decode tail -> terminal), stamping
+stage boundaries on one clock so the breakdown SUMS to the request's
+end-to-end latency (acceptance: within 10%, test-enforced). Three outputs:
+
+  * **spans** on the existing Tracer/FlightRecorder bus, every one carrying
+    a ``request_id`` field (structurally enforced by
+    ``tools/check_request_tracing.py``): ``serving/queue_wait`` and
+    ``serving/decode_tail`` durations, ``serving/route`` /
+    ``serving/first_token`` / terminal instants, ``serving/prefill_chunk``
+    per scheduler chunk (step wall time apportioned by chunk tokens);
+  * **per-stage Prometheus histograms** (``gateway/stage_{ingress,queue,
+    prefill,decode}_ms`` + ``gateway/prefill_cache_miss_tokens``) so p99
+    TTFT decomposes into queue vs route vs prefill vs cache-miss straight
+    off ``/metrics``;
+  * a **bounded JSONL request log** (atomic rotation, tail-aware sampling:
+    SLO-miss/shed/error/cancelled records always retained, healthy ones
+    head-sampled deterministically on the request id) — one summary line
+    per terminal request with the full stage breakdown
+    ``{queue_ms, route_choice, prefix_hit_tokens, prefill_ms, ttft_ms,
+    tpot_ms, finish_reason, slo_verdict}``.
+
+Request ids: a client-supplied ``X-Request-Id`` (or the trace-id of a W3C
+``traceparent``) is sanitized (charset/length) and propagated — echoed on
+the ``X-Request-Id`` response header of EVERY gateway response path, in the
+SSE meta frame, in every span, and in the log record — else one is
+generated. Zero overhead with the config block absent: the gateway holds
+no plane object, no context is allocated, no thread exists (the log writer
+is synchronous under its own lock), mirroring the PR 1/5 contract.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+import uuid
+import zlib
+from collections import deque
+from typing import Optional
+
+from ..monitor.flight import get_flight_recorder
+from ..monitor.metrics import get_metrics
+from ..monitor.trace import get_tracer
+
+# client-supplied id charset (header-safe, label-safe, log-safe) and bound
+_RID_OK = re.compile(r"[^A-Za-z0-9._\-]")
+RID_MAX_LEN = 64
+
+# W3C traceparent: version "00", 32-hex trace-id, 16-hex parent-id, 2-hex flags
+_TRACEPARENT = re.compile(r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def sanitize_request_id(raw) -> Optional[str]:
+    """Fold a client-supplied id into the safe charset, bounded length.
+    Returns None when nothing usable remains (caller generates instead) —
+    a hostile header can never smuggle bytes into responses, spans, or
+    Prometheus labels."""
+    if raw is None:
+        return None
+    rid = _RID_OK.sub("", str(raw).strip())[:RID_MAX_LEN]
+    return rid or None
+
+
+def parse_traceparent(raw) -> Optional[str]:
+    """The trace-id of a well-formed W3C ``traceparent`` header (lowercased),
+    None for anything malformed — never a partial parse."""
+    if not raw:
+        return None
+    m = _TRACEPARENT.match(str(raw).strip().lower())
+    if m is None or m.group(2) == "0" * 32:
+        return None
+    return m.group(2)
+
+
+def extract_request_id(headers):
+    """(rid, traceparent_trace_id) from an HTTP header mapping: a sanitized
+    ``X-Request-Id`` wins, else the ``traceparent`` trace-id, else a fresh
+    id — every request leaves with SOME id attached."""
+    tp = parse_traceparent(headers.get("traceparent") if headers else None)
+    rid = sanitize_request_id(headers.get("X-Request-Id") if headers else None)
+    return rid or tp or new_request_id(), tp
+
+
+class RequestContext:
+    """Stage timestamps + routing facts for ONE request, all on the
+    ``time.perf_counter`` clock so stage durations and end-to-end latency
+    subtract exactly (no cross-clock skew in the breakdown)."""
+
+    __slots__ = ("rid", "traceparent", "slo_class", "sampled", "closed",
+                 "t_recv", "t_admitted", "t_dequeued", "t_first_token",
+                 "t_last_token", "t_done",
+                 "route_choice", "route_policy", "route_scores",
+                 "prefix_hit_tokens", "prompt_tokens",
+                 "prefill_chunks", "prefill_compute_ms")
+
+    def __init__(self, rid, traceparent=None, slo_class=None, sampled=True):
+        self.rid = rid
+        self.traceparent = traceparent
+        self.slo_class = slo_class
+        self.sampled = sampled
+        self.closed = False
+        self.t_recv = time.perf_counter()
+        self.t_admitted = None
+        self.t_dequeued = None
+        self.t_first_token = None
+        self.t_last_token = None
+        self.t_done = None
+        self.route_choice = None
+        self.route_policy = None
+        self.route_scores = None
+        self.prefix_hit_tokens = 0
+        self.prompt_tokens = 0
+        self.prefill_chunks = 0
+        self.prefill_compute_ms = 0.0
+
+    @staticmethod
+    def _ms(a, b):
+        return None if (a is None or b is None) else max(0.0, (b - a) * 1e3)
+
+    def stages(self) -> dict:
+        """The stage breakdown. Stages partition [t_recv, t_last_token] on
+        one clock — ``ingress + queue + prefill + decode`` reconstructs
+        end-to-end latency up to the (sub-ms) close-out residual:
+
+          ingress  — parse/validate/route (recv -> admitted)
+          queue    — admission class-queue wait (admitted -> replica pull)
+          prefill  — scheduler pickup -> first generated token
+          decode   — first -> last generated token (the decode tail)
+        """
+        return {"ingress_ms": self._ms(self.t_recv, self.t_admitted),
+                "queue_ms": self._ms(self.t_admitted, self.t_dequeued),
+                "prefill_ms": self._ms(self.t_dequeued, self.t_first_token),
+                "decode_ms": self._ms(self.t_first_token, self.t_last_token),
+                "e2e_ms": self._ms(self.t_recv, self.t_done)}
+
+
+class RequestLog:
+    """Bounded JSONL writer with atomic rotation. Synchronous (no thread:
+    one short lock-held write per terminal request — terminal rate, not
+    token rate) and bounded: past ``max_bytes`` the live file rotates to
+    ``path.1`` (older shift up, oldest dropped past ``max_files``) via
+    ``os.replace``, so a reader never sees a torn or unbounded file."""
+
+    def __init__(self, path, max_bytes=16 << 20, max_files=2):
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self.max_files = max(1, int(max_files))
+        self._lock = threading.Lock()
+        self._fh = None
+        self._size = 0
+        self.written = 0   # records written (post-sampling)
+        self.rotations = 0
+
+    def write(self, record: dict):
+        line = json.dumps(record, default=repr) + "\n"
+        data = line.encode("utf-8")
+        with self._lock:
+            if self._fh is None:
+                d = os.path.dirname(os.path.abspath(self.path))
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._fh = open(self.path, "a")
+                self._size = self._fh.tell()
+            if self._size + len(data) > self.max_bytes and self._size > 0:
+                self._rotate_locked()
+            self._fh.write(line)
+            self._fh.flush()
+            self._size += len(data)
+            self.written += 1
+
+    def _rotate_locked(self):
+        self._fh.close()
+        self._fh = None
+        # shift path.(n-1) -> path.n, ..., path -> path.1; each shift is one
+        # atomic os.replace, and the oldest file simply gets overwritten
+        for i in range(self.max_files - 1, 0, -1):
+            src = self.path if i == 1 else f"{self.path}.{i - 1}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i}")
+        self._fh = open(self.path, "w")
+        self._size = 0
+        self.rotations += 1
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class RequestTracing:
+    """The per-gateway request-tracing plane: context factory, span
+    emission (every event carries ``request_id``), the summary log, and the
+    last-N terminal ring. One instance per ServingGateway, shared (by
+    reference) with its admission controller and replicas."""
+
+    def __init__(self, config, slo_classes=None):
+        self.config = config
+        self.slo_classes = dict(slo_classes or {})
+        self.log = (RequestLog(config.log_path, config.log_max_bytes,
+                               config.log_max_files) if config.log_path else None)
+        self._lock = threading.Lock()
+        self._recent = deque(maxlen=max(1, int(config.last_n)))
+        self.stats = {"opened": 0, "finalized": 0, "retained": 0, "head_sampled_out": 0}
+
+    # -- sampling -------------------------------------------------------
+    def head_sample(self, rid: str) -> bool:
+        """Deterministic head-sampling on the request id: the same request
+        samples the same way on every replica/retry, and tests can pick
+        ids on either side of the line."""
+        rate = self.config.sample_rate
+        if rate >= 1.0:
+            return True
+        if rate <= 0.0:
+            return False
+        return (zlib.crc32(rid.encode("utf-8")) % 10_000) < rate * 10_000
+
+    # -- lifecycle ------------------------------------------------------
+    def open(self, rid, traceparent=None, slo_class=None) -> RequestContext:
+        ctx = RequestContext(rid, traceparent=traceparent, slo_class=slo_class,
+                             sampled=self.head_sample(rid))
+        self.stats["opened"] += 1
+        return ctx
+
+    def on_admitted(self, req):
+        """Admission success — emission only: the ctx admission stamp and
+        prompt/prefix facts were written by ``AdmissionController.try_admit``
+        UNDER its lock, before the request was published to the queue (the
+        driver can dequeue and even finish a request the instant it lands,
+        so any post-publish ctx mutation would race finalize)."""
+        ctx = req.ctx
+        get_tracer().instant("serving/admitted", tid="serving",
+                             request_id=ctx.rid, replica=req.replica_name,
+                             slo_class=ctx.slo_class,
+                             prefix_hit_tokens=int(req.cached_tokens))
+
+    def on_route(self, ctx: RequestContext, chosen, policy, scores,
+                 overlap_blocks=None):
+        """Router-decision instant: the candidate scores + prefix-overlap
+        blocks that justified the placement (the forensic answer to 'why
+        was this p99 request cold-routed')."""
+        ctx.route_choice = chosen
+        ctx.route_policy = policy
+        ctx.route_scores = dict(scores or {})
+        get_tracer().instant("serving/route", tid="serving",
+                             request_id=ctx.rid, chosen=chosen, policy=policy,
+                             scores=dict(scores or {}),
+                             overlap_blocks=dict(overlap_blocks or {}))
+
+    def on_dequeue(self, req):
+        """Replica pulled the request off its class queue: stamp + emit the
+        per-class queue-wait duration span."""
+        ctx = req.ctx
+        ctx.t_dequeued = time.perf_counter()
+        if ctx.t_admitted is not None:
+            wait = ctx.t_dequeued - ctx.t_admitted
+            get_tracer().complete(
+                "serving/queue_wait", ctx.t_admitted, wait, tid="serving",
+                args={"request_id": ctx.rid, "slo_class": ctx.slo_class,
+                      "replica": req.replica_name,
+                      "queue_ms": round(wait * 1e3, 3)})
+            get_metrics().histogram("gateway/stage_queue_ms").observe(wait * 1e3)
+
+    def on_prefill_chunk(self, req, n_tokens, t0, dur):
+        """One scheduler prefill chunk for this request: the composed step's
+        wall time apportioned by this chunk's share of the step's tokens
+        (chunks of one composed forward are not separately timeable)."""
+        ctx = req.ctx
+        ctx.prefill_chunks += 1
+        ctx.prefill_compute_ms += dur * 1e3
+        get_tracer().complete(
+            "serving/prefill_chunk", t0, dur, tid="serving",
+            args={"request_id": ctx.rid, "tokens": int(n_tokens),
+                  "chunk_index": ctx.prefill_chunks,
+                  "replica": req.replica_name})
+
+    def on_first_token(self, req, ttft_ms):
+        ctx = req.ctx
+        ctx.t_first_token = req.stream.first_token_t
+        get_tracer().instant("serving/first_token", tid="serving",
+                             request_id=ctx.rid, ttft_ms=round(ttft_ms, 3),
+                             slo_class=ctx.slo_class, replica=req.replica_name)
+
+    def on_respond(self, ctx: RequestContext, status):
+        """Gateway parse/respond span: the HTTP handler's own walltime for
+        this request (recv -> response written), emitted by the handler
+        thread after the terminal frame/body went out."""
+        now = time.perf_counter()
+        get_tracer().complete("serving/gateway_respond", ctx.t_recv,
+                              now - ctx.t_recv, tid="serving",
+                              args={"request_id": ctx.rid, "status": int(status)})
+
+    # -- terminal -------------------------------------------------------
+    def _close(self, ctx) -> bool:
+        """Latch terminal exactly once (handler timeout, driver close-out,
+        and gateway-stop fail paths can race to finalize)."""
+        with self._lock:
+            if ctx.closed:
+                return False
+            ctx.closed = True
+            return True
+
+    def slo_verdict(self, slo_class, ttft_ms, tpot_ms) -> str:
+        cls = self.slo_classes.get(slo_class)
+        if cls is None or (cls.ttft_target_ms <= 0 and cls.tpot_target_ms <= 0):
+            return "ok"  # untargeted class: completion is conformance
+        miss = []
+        if cls.ttft_target_ms > 0 and (ttft_ms or 0) > cls.ttft_target_ms:
+            miss.append("ttft_miss")
+        if cls.tpot_target_ms > 0 and (tpot_ms or 0) > cls.tpot_target_ms:
+            miss.append("tpot_miss")
+        return "+".join(miss) or "ok"
+
+    def finalize(self, req, finish_reason=None, error=None, n_tokens=None):
+        """Terminal path for an ADMITTED request (completed, cancelled,
+        timed out, errored, failed by a dying replica): stamp the tail,
+        derive the verdict, emit the terminal instant + decode-tail span,
+        feed the stage histograms, and write the summary record (tail-aware
+        sampling). Exactly-once per request."""
+        ctx = req.ctx
+        if ctx is None or not self._close(ctx):
+            return
+        st = req.stream
+        now = time.perf_counter()
+        ctx.t_last_token = st.last_token_t or ctx.t_first_token
+        ctx.t_done = now
+        error = error if error is not None else st.error
+        if finish_reason is None:
+            if error is not None:
+                finish_reason = {"request_timeout": "timeout",
+                                 "cancelled": "cancelled",
+                                 "client_disconnected": "disconnect"}.get(error, "error")
+            else:
+                finish_reason = st.finish_reason or "length"
+        healthy = error is None and finish_reason in ("length", "eos")
+        verdict = (self.slo_verdict(ctx.slo_class, req.ttft_ms, req.tpot_ms)
+                   if healthy else "n/a")
+        stages = ctx.stages()
+        reg = get_metrics()
+        if healthy:
+            for key, hist in (("ingress_ms", "gateway/stage_ingress_ms"),
+                              ("prefill_ms", "gateway/stage_prefill_ms"),
+                              ("decode_ms", "gateway/stage_decode_ms")):
+                if stages[key] is not None:
+                    reg.histogram(hist).observe(stages[key])
+            reg.histogram("gateway/prefill_cache_miss_tokens").observe(
+                max(0, ctx.prompt_tokens - ctx.prefix_hit_tokens))
+        if ctx.t_first_token is not None and ctx.t_last_token is not None \
+                and ctx.t_last_token > ctx.t_first_token:
+            get_tracer().complete(
+                "serving/decode_tail", ctx.t_first_token,
+                ctx.t_last_token - ctx.t_first_token, tid="serving",
+                args={"request_id": ctx.rid,
+                      "tokens": int(n_tokens if n_tokens is not None else st.produced),
+                      "tpot_ms": round(req.tpot_ms, 3) if req.tpot_ms else None})
+        record = {
+            "request_id": ctx.rid, "uid": req.uid,
+            "traceparent": ctx.traceparent,
+            "slo_class": ctx.slo_class, "replica": req.replica_name,
+            "finish_reason": finish_reason, "error": error,
+            "slo_verdict": verdict, "t_unix": time.time(),
+            "n_tokens": int(n_tokens if n_tokens is not None else st.produced),
+            "prompt_tokens": ctx.prompt_tokens,
+            "prefix_hit_tokens": ctx.prefix_hit_tokens,
+            "route_choice": ctx.route_choice, "route_policy": ctx.route_policy,
+            "route_scores": ctx.route_scores,
+            "prefill_chunks": ctx.prefill_chunks,
+            "prefill_compute_ms": round(ctx.prefill_compute_ms, 3),
+            "ttft_ms": round(req.ttft_ms, 3) if req.ttft_ms else None,
+            "tpot_ms": round(req.tpot_ms, 3) if req.tpot_ms else None,
+            "sampled": ctx.sampled,
+        }
+        record.update({k: (round(v, 3) if v is not None else None)
+                       for k, v in stages.items()})
+        get_tracer().instant("serving/request_done", tid="serving",
+                             request_id=ctx.rid, finish_reason=finish_reason,
+                             slo_verdict=verdict, error=error,
+                             e2e_ms=record["e2e_ms"])
+        get_flight_recorder().record("serving", "request_done",
+                                     request_id=ctx.rid,
+                                     finish_reason=finish_reason,
+                                     slo_verdict=verdict, error=error)
+        self._record_terminal(record, healthy and verdict == "ok")
+
+    def finalize_rejected(self, ctx: RequestContext, status, reason,
+                          replica=None):
+        """Terminal path for a request refused BEFORE admission (400/429/503)
+        — shed and rejection records are always retained (they ARE the
+        tail), and the shed instant names the queue that refused."""
+        if ctx is None or not self._close(ctx):
+            return
+        ctx.t_done = time.perf_counter()
+        finish = "shed" if status == 429 else "rejected"
+        get_tracer().instant("serving/request_shed" if status == 429
+                             else "serving/request_rejected", tid="serving",
+                             request_id=ctx.rid, status=int(status),
+                             reason=str(reason), slo_class=ctx.slo_class,
+                             replica=replica)
+        get_flight_recorder().record("serving", f"request_{finish}",
+                                     request_id=ctx.rid, status=int(status),
+                                     reason=str(reason))
+        record = {
+            "request_id": ctx.rid, "traceparent": ctx.traceparent,
+            "slo_class": ctx.slo_class, "replica": replica,
+            "finish_reason": finish, "error": str(reason),
+            "slo_verdict": "n/a", "t_unix": time.time(), "status": int(status),
+            "n_tokens": 0, "prompt_tokens": ctx.prompt_tokens,
+            "prefix_hit_tokens": ctx.prefix_hit_tokens,
+            "route_choice": ctx.route_choice, "route_policy": ctx.route_policy,
+            "route_scores": ctx.route_scores,
+            "ttft_ms": None, "tpot_ms": None, "sampled": ctx.sampled,
+        }
+        record.update({k: (round(v, 3) if v is not None else None)
+                       for k, v in ctx.stages().items()})
+        self._record_terminal(record, healthy=False)
+
+    def _record_terminal(self, record, healthy):
+        """Tail-aware retention: unhealthy terminals (SLO miss, shed,
+        rejection, cancel, timeout, error) are ALWAYS written; healthy ones
+        only when head-sampled. The in-memory ring keeps every terminal
+        (bounded) for dump forensics either way."""
+        self.stats["finalized"] += 1
+        with self._lock:
+            self._recent.append(record)
+        if healthy and not record["sampled"]:
+            self.stats["head_sampled_out"] += 1
+            return
+        self.stats["retained"] += 1
+        if self.log is not None:
+            try:
+                self.log.write(record)
+            except Exception as e:  # noqa: BLE001 — finalize runs on the
+                # replica DRIVER thread: a full disk (ENOSPC) or revoked
+                # permission must cost the record, never the driver loop
+                # and every in-flight stream behind it
+                self.stats["log_errors"] = self.stats.get("log_errors", 0) + 1
+                self._log().error(f"request log write failed: {e!r}")
+
+    @staticmethod
+    def _log():
+        from ..utils.logging import logger  # lazy: keep module import-light
+
+        return logger
+
+    # -- read side ------------------------------------------------------
+    def last_summaries(self, n=None):
+        with self._lock:
+            out = list(self._recent)
+        return out[-int(n):] if n else out
+
+    def state(self) -> dict:
+        return {**self.stats,
+                "log_path": self.config.log_path or None,
+                "log_written": self.log.written if self.log else 0,
+                "log_rotations": self.log.rotations if self.log else 0,
+                "sample_rate": self.config.sample_rate}
+
+    def close(self):
+        if self.log is not None:
+            self.log.close()

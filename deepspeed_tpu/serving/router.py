@@ -40,17 +40,26 @@ class ReplicaRouter:
     def live(self) -> List:
         return [r for r in self.replicas if r.alive]
 
-    def select(self, prompt_tokens) -> Optional[object]:
-        """Pick the replica for a prompt; None when no replica is live."""
+    def select(self, prompt_tokens, ctx=None) -> Optional[object]:
+        """Pick the replica for a prompt; None when no replica is live.
+        With a request-tracing ``ctx``, the candidate scores that justified
+        the placement are recorded on it (the gateway emits them as the
+        router-decision instant) — pure bookkeeping, no tracer calls here."""
         live = self.live()
         if not live:
             self.stats["no_live_replica"] += 1
             return None
         self.stats["routed"] += 1
         if self.policy == "random":
-            return live[int(self._rng.integers(len(live)))]
+            chosen = live[int(self._rng.integers(len(live)))]
+            if ctx is not None:
+                ctx.route_policy, ctx.route_scores = self.policy, {}
+            return chosen
         if self.policy == "prefix":
             scores = [r.prefix_overlap(prompt_tokens) for r in live]
+            if ctx is not None:
+                ctx.route_policy = self.policy
+                ctx.route_scores = {r.name: int(s) for r, s in zip(live, scores)}
             best = max(scores)
             if best > 0:
                 self.stats["prefix_hits"] += 1
@@ -59,6 +68,9 @@ class ReplicaRouter:
                 cands = [r for r, s in zip(live, scores) if s == best]
                 return min(cands, key=lambda r: r.load)
             self.stats["fallback_least_loaded"] += 1
+        if ctx is not None and ctx.route_policy is None:
+            ctx.route_policy = self.policy
+            ctx.route_scores = {r.name: int(r.load) for r in live}
         return min(live, key=lambda r: r.load)
 
     def state(self) -> dict:

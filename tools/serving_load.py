@@ -39,6 +39,7 @@ tools/serving_load.py gateway`` emits both as one JSON line.
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -348,14 +349,19 @@ def _http_generate(host, port, r, stream, timeout_s, slo_class):
     if slo_class:
         body["slo_class"] = slo_class
     rec = {"uid": r["uid"], "status": None, "tokens": [], "ttft_ms": None,
-           "tpot_ms": None, "latency_ms": None, "error": None}
+           "tpot_ms": None, "latency_ms": None, "error": None,
+           "request_id": None}
     t_send = time.time()
     conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
     try:
+        # a client-supplied id keyed on the workload uid: request-log lines
+        # and trace spans join back to the workload row by inspection
         conn.request("POST", "/v1/generate", json.dumps(body),
-                     {"Content-Type": "application/json"})
+                     {"Content-Type": "application/json",
+                      "X-Request-Id": f"load-{r['uid']}"})
         resp = conn.getresponse()
         rec["status"] = resp.status
+        rec["request_id"] = resp.getheader("X-Request-Id")
         if resp.status != 200:
             payload = json.loads(resp.read() or b"{}")
             rec["error"] = payload.get("error")
@@ -576,11 +582,134 @@ def router_prefix_ab(on_tpu, n_requests=None, seed=0, n_replicas=2, gateway=None
             gw.router.policy = gw.config.router
 
 
+# ---------------------------------------------------------------------------
+# request-scoped tracing: log consumption, p99 attribution, overhead A/B
+# ---------------------------------------------------------------------------
+_STAGES = ("ingress_ms", "queue_ms", "prefill_ms", "decode_ms")
+
+
+def read_request_log(path):
+    """Parse a request-summary JSONL log (rotated siblings ``path.N``
+    included, oldest first) into a record list. The rotation chain is
+    contiguous (``.1`` is newest rotation), so walk until the first gap —
+    no hardcoded bound on how many rotations a config retained."""
+    rotated = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        rotated.append(f"{path}.{i}")
+        i += 1
+    records = []
+    for p in rotated[::-1] + [path]:
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    records.append(json.loads(line))
+    return records
+
+
+def attribution_table(records):
+    """The p99-attribution table: where completed requests spent their time
+    (per-stage p50/p99), the single p99-TTFT request's own breakdown (the
+    forensic 'this one was slow BECAUSE...'), and the fraction of records
+    whose stage sum reconstructs end-to-end latency within 10% (the
+    honesty check on the breakdown itself)."""
+    done = [r for r in records if r.get("finish_reason") in ("length", "eos")]
+    out = {"n_records": len(records), "n_completed": len(done),
+           "by_reason": {}, "stages_p50_ms": {}, "stages_p99_ms": {},
+           "p99_request": None, "breakdown_ok_frac": None, "ttft_p99_ms": None}
+    for r in records:
+        k = r.get("finish_reason") or "unknown"
+        out["by_reason"][k] = out["by_reason"].get(k, 0) + 1
+    if not done:
+        return out
+    for st in _STAGES:
+        vals = [r[st] for r in done if r.get(st) is not None]
+        if vals:
+            out["stages_p50_ms"][st] = round(float(np.percentile(vals, 50)), 2)
+            out["stages_p99_ms"][st] = round(float(np.percentile(vals, 99)), 2)
+    with_ttft = [r for r in done if r.get("ttft_ms")]
+    if with_ttft:
+        ttfts = [r["ttft_ms"] for r in with_ttft]
+        out["ttft_p99_ms"] = round(float(np.percentile(ttfts, 99)), 2)
+        worst = max(with_ttft, key=lambda r: r["ttft_ms"])
+        out["p99_request"] = {k: worst.get(k) for k in
+                              ("request_id", "slo_class", "route_choice",
+                               "prefix_hit_tokens", "prompt_tokens",
+                               "ttft_ms", "slo_verdict") + _STAGES}
+    ok = 0
+    checked = 0
+    for r in done:
+        parts = [r.get(st) for st in _STAGES]
+        if r.get("e2e_ms") and all(p is not None for p in parts):
+            checked += 1
+            if abs(sum(parts) - r["e2e_ms"]) <= max(0.1 * r["e2e_ms"], 2.0):
+                ok += 1
+    out["breakdown_ok_frac"] = round(ok / checked, 3) if checked else None
+    return out
+
+
+def tracing_overhead_ab(on_tpu, n_requests=None, seed=0, n_replicas=2):
+    """Trace-on vs trace-off A/B over the same closed-loop saturated
+    workload: identical engines/config except the ``tracing`` block, so the
+    throughput delta IS the tracing tax (the zero-overhead-off claim,
+    measured rather than asserted). The trace-on arm also yields the
+    p99-attribution table from its request log."""
+    from deepspeed_tpu.serving import RequestTraceConfig
+
+    n = n_requests or (32 if on_tpu else 12)
+    shape = dict(prompt_lo=8, prompt_hi=24, new_lo=4, new_hi=10)
+    out = {"config": "request_tracing_ab", "n_requests": n,
+           # arms run sequentially in one process: on CPU smoke the SECOND
+           # arm can ride XLA caching the first paid for, so small negative
+           # overhead is order noise — judge the tax on TPU steady-state
+           "note": "arms sequential; cpu-smoke rps is order-noisy", "arms": {}}
+    import shutil
+
+    log_dir = tempfile.mkdtemp(prefix="dstpu_reqlog_")
+    log_path = os.path.join(log_dir, "requests.jsonl")
+    try:
+        for arm in ("trace_off", "trace_on"):
+            cfg_kwargs = {}
+            if arm == "trace_on":
+                cfg_kwargs["tracing"] = RequestTraceConfig(enabled=True,
+                                                           log_path=log_path)
+            gw = build_gateway(n_replicas=n_replicas, prefix_cache=True,
+                               on_tpu=False, **cfg_kwargs)
+            try:
+                warm = make_workload(n, rate_rps=None, seed=seed, uid_base=0, **shape)
+                run_http_load(gw.config.host, gw.port, warm)  # compile buckets
+                wl = make_workload(n, rate_rps=None, seed=seed, uid_base=10_000, **shape)
+                agg, _ = run_http_load(gw.config.host, gw.port, wl)
+                out["arms"][arm] = {"achieved_rps": agg["achieved_rps"],
+                                    "completed": agg["completed"],
+                                    "ttft_p50_ms": agg["ttft"]["p50_ms"]}
+            finally:
+                gw.stop()
+        off, on = out["arms"]["trace_off"], out["arms"]["trace_on"]
+        if off["achieved_rps"] and on["achieved_rps"]:
+            out["overhead_pct"] = round(
+                (off["achieved_rps"] - on["achieved_rps"]) / off["achieved_rps"] * 100, 2)
+        records = read_request_log(log_path)
+
+        def measured(r):  # the warmup pass logged too: keep the 10k-base uids
+            rid = str(r.get("request_id", ""))
+            return rid.startswith("load-") and rid[5:].isdigit() and int(rid[5:]) >= 10_000
+
+        out["attribution"] = attribution_table([r for r in records if measured(r)])
+        return out
+    finally:
+        shutil.rmtree(log_dir, ignore_errors=True)
+
+
 def gateway_bench(on_tpu, seed=0):
     """The bench.py serving-block entry: latency-under-load curves + the
-    router A/B, one dict."""
+    router A/B + the request-tracing attribution/overhead block, one dict."""
     return {"load": gateway_latency_curves(on_tpu, seed=seed),
-            "router_ab": router_prefix_ab(on_tpu, seed=seed)}
+            "router_ab": router_prefix_ab(on_tpu, seed=seed),
+            "tracing": tracing_overhead_ab(on_tpu, seed=seed)}
 
 
 def main():
