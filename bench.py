@@ -475,6 +475,25 @@ def run_bench():
         except Exception as e:
             print(f"# WARNING: gateway bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+    # speculative decoding (PR 9): spec-on/off A/B on the shared-prefix
+    # workload — acceptance rate + decode tok/s both arms + greedy token
+    # parity. DS_TPU_BENCH_SPEC=0 skips; a failure costs this block only,
+    # never the headline serving numbers.
+    if os.environ.get("DS_TPU_BENCH_SPEC", "1") != "0":
+        try:
+            from tools.serving_load import speculative_ab
+
+            sp = speculative_ab(on_tpu)
+            serving["speculative"] = {k: sp[k] for k in
+                                      ("accept_rate", "decode_tok_s_on", "decode_tok_s_off",
+                                       "speedup", "k", "min_match", "spec_rounds",
+                                       "drafted_tokens", "token_parity") if k in sp}
+            print(f"# speculative: accept_rate={sp.get('accept_rate')} decode_tok_s "
+                  f"on/off={sp.get('decode_tok_s_on')}/{sp.get('decode_tok_s_off')} "
+                  f"(k={sp.get('k')}, parity={sp.get('token_parity')})", flush=True)
+        except Exception as e:
+            print(f"# WARNING: speculative bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
     print(json.dumps(serving))
 
     def train_tps(cfg, micro, gas, seq, steps, warmup, data="batch"):
@@ -750,7 +769,9 @@ def run_bench():
                     if k in serving} | ({"prefix_cache": serving["prefix_cache"]}
                                        if "prefix_cache" in serving else {})
                                      | ({"gateway": serving["gateway"]}
-                                        if "gateway" in serving else {}),
+                                        if "gateway" in serving else {})
+                                     | ({"speculative": serving["speculative"]}
+                                        if "speculative" in serving else {}),
         # achieved MFU fraction (null on the CPU fallback — the v5e-peak
         # denominator would read as a 99.9% regression, the VERDICT r4 trap)
         "mfu": round(mfu, 4) if on_tpu else None,

@@ -2,10 +2,11 @@
 ragged continuous batching over a paged KV cache."""
 
 from .config_v2 import (DSStateManagerConfig, ModulesConfig, PrefixCacheConfig,
-                        RaggedInferenceEngineConfig)
+                        RaggedInferenceEngineConfig, SpeculativeConfig)
 from .engine_v2 import InferenceEngineV2
 from .engine_factory import build_engine, build_model_engine
 from .scheduling_utils import SchedulingError, SchedulingResult
 from .scheduler import DynamicSplitFuseScheduler
 from .inference_utils import (ActivationType, DtypeEnum, NormTypeEnum, ceil_div,
                               elem_size, is_gated)
+from .speculative import Drafter, DraftModelDrafter, NgramDrafter, build_drafter

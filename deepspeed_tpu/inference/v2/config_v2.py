@@ -36,6 +36,39 @@ class PrefixCacheConfig:
 
 
 @dataclass
+class SpeculativeConfig:
+    """``ragged.speculative`` block: speculative decoding over the ragged
+    plane (draft K tokens cheaply, verify them in ONE batched ragged
+    forward, commit the longest prefix the target model's own argmax
+    agrees with, roll the rejected tail back through
+    ``DSStateManager.rollback_to``). Off by default — greedy parity is
+    unconditional when enabled (asserted in ``tests/test_speculative.py``),
+    so the only tradeoff is throughput: larger ``k`` amortizes more host
+    round-trips per accepted run but wastes more verify compute when the
+    acceptance rate is low."""
+
+    mode: str = "off"  # 'off' | 'ngram' (self-speculative prompt lookup) | 'draft_model'
+    k: int = 4         # draft tokens verified per speculative step
+    # ngram drafter: shortest suffix n-gram worth matching (higher = fewer,
+    # better-grounded drafts) and the longest tried first
+    min_match: int = 2
+    max_ngram: int = 4
+    # ngram drafter: search window over the sequence's own stream (0 = the
+    # whole stream). Bounded by default: the scan runs per sequence per
+    # verify round in the hottest serving loop, and an unbounded window
+    # would make steady-state decode O(context) on long-context requests;
+    # the recent window is also where the live repetition signal is.
+    max_history: int = 256
+    # draft_model mode: a small same-tokenizer InferenceEngineV2 (object
+    # handle, not serialized config — built by the caller)
+    draft_engine: object = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+
+@dataclass
 class ModulesConfig:
     """Per-op implementation selection (reference ``modules/heuristics.py``
     config surface). Each slot is ``"auto"`` (heuristic pick), a registered
@@ -63,6 +96,9 @@ class RaggedInferenceEngineConfig:
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
     # prefix-cache subsystem (refcounted COW block sharing + radix reuse)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    # speculative decoding (n-gram self-drafting or a draft model, batched
+    # K-token verification with refcount-aware rollback)
+    speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
     use_pallas_kernels: str = "auto"  # 'auto' | 'never' | 'always'
     # weight-only int8 (per-output-channel scales): halves the decode weight
     # stream, which is the bandwidth-bound term at serving batch sizes

@@ -85,6 +85,9 @@ class InferenceEngineV2:
             max_blocks_per_seq=self._max_blocks_per_seq, block_size=bs)
 
         self._compiled: Dict[Tuple[int, int, Optional[str]], object] = {}
+        # speculative-decoding lifetime totals (two int adds per verify
+        # step; the gauge feeding off them only updates when metrics are on)
+        self._spec_totals = {"drafted": 0, "accepted": 0}
         # live-health plane: serving heartbeats (`serving` watchdog source,
         # armed per forward) + a /healthz section. One boolean per call when
         # the plane is off.
@@ -287,7 +290,8 @@ class InferenceEngineV2:
         return out
 
     # ------------------------------------------------------------------
-    def decode(self, batch_uids: List[int], first_tokens, n_steps: int, block: bool = True) -> np.ndarray:
+    def decode(self, batch_uids: List[int], first_tokens, n_steps: int, block: bool = True,
+               eos_token_ids=None) -> np.ndarray:
         """Run ``n_steps`` greedy decode steps ON DEVICE in one compiled
         program (a ``lax.scan`` feeding each step's argmax back as the next
         token), for sequences already tracked by the engine.
@@ -298,20 +302,28 @@ class InferenceEngineV2:
         KV blocks for the whole horizon are reserved up front (admission
         refuses if the pool can't cover it). Returns token ids
         [len(batch_uids), n_steps].
+
+        ``eos_token_ids`` (blocking mode only): one eos id — a scalar, or a
+        per-sequence list with ``None`` entries — lets the engine rewind the
+        horizon OVERSHOOT of a sequence that hits eos mid-scan: the KV (and
+        token history) materialized past the eos is rolled back through
+        ``DSStateManager.rollback_to`` before publish, so the radix tree
+        never receives post-eos garbage paths and the tail blocks return to
+        the pool immediately instead of idling until flush.
         """
         batch_uids = list(batch_uids)
         hb = self._health
         if not hb.enabled:
-            return self._decode(batch_uids, first_tokens, n_steps, block)
+            return self._decode(batch_uids, first_tokens, n_steps, block, eos_token_ids)
         hb.begin("serving")
         get_flight_recorder().record("serving", "decode", seqs=len(batch_uids),
                                      steps=int(n_steps))
         try:
-            return self._decode(batch_uids, first_tokens, n_steps, block)
+            return self._decode(batch_uids, first_tokens, n_steps, block, eos_token_ids)
         finally:
             hb.end("serving")
 
-    def _decode(self, batch_uids, first_tokens, n_steps, block):
+    def _decode(self, batch_uids, first_tokens, n_steps, block, eos_token_ids=None):
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
         uids = list(batch_uids)
@@ -365,18 +377,34 @@ class InferenceEngineV2:
         pc = self.state_manager.prefix_cache
         if block:
             toks = np.asarray(toks)
-            if pc is not None:
-                # tokens materialized this burst: the fed first token plus
-                # every in-scan feedback token except the last output (whose
-                # KV is not written until it is fed back)
-                for seq, f, row in zip(seqs, first, toks):
+            if eos_token_ids is None or isinstance(eos_token_ids, (int, np.integer)):
+                eos_list = [eos_token_ids] * S
+            else:
+                eos_list = list(eos_token_ids)
+                assert len(eos_list) == S, "eos_token_ids must match batch_uids"
+            for seq, f, row, eos in zip(seqs, first, toks, eos_list):
+                start = seq.seen_tokens
+                if pc is not None:
+                    # tokens materialized this burst: the fed first token
+                    # plus every in-scan feedback token except the last
+                    # output (whose KV is not written until it is fed back)
                     self.state_manager.note_tokens(seq, np.concatenate([f, row[:-1]]))
-        elif pc is not None:
+                seq.post_forward()
+                if eos is not None:
+                    hit = np.nonzero(row == eos)[0]
+                    if hit.size and int(hit[0]) + 1 < n_steps:
+                        # horizon overshoot: the caller keeps row[:hit+1];
+                        # KV/history past the eos is garbage — rewind it
+                        # BEFORE publish so the tree never sees it
+                        self.state_manager.rollback_to(seq, start + 1 + int(hit[0]))
+                self.state_manager.publish_sequence(seq)
+        else:
+            if pc is not None:
+                for seq in seqs:
+                    seq.history_valid = False  # generated ids never reached host
             for seq in seqs:
-                seq.history_valid = False  # generated ids never reached host
-        for seq in seqs:
-            seq.post_forward()
-            self.state_manager.publish_sequence(seq)
+                seq.post_forward()
+                self.state_manager.publish_sequence(seq)
         if observing:
             # as with put(): without the host fetch the wall time is dispatch
             # only — emit the span (blocked flag disclosed), skip the samples
@@ -389,21 +417,195 @@ class InferenceEngineV2:
                                        "blocked": bool(block)})
         return toks
 
-    def _ragged_step(self, params, packed, pools, t_bucket, s_bucket):
+    def _ragged_step(self, params, packed, pools, t_bucket, s_bucket, gather_k: int = 0):
         """One ragged forward over the pool tuple (2 = bf16 pools, 4 = int8
         pools + scales). The SINGLE builder both compiled paths share —
         quant/non-quant variation lives in the tuple arity, not in four
-        hand-copied closures."""
+        hand-copied closures.
+
+        ``gather_k``: the speculative-verify variant — project logits for
+        each sequence's ENTIRE ``gather_k + 1``-token chunk (the chunk is
+        contiguous in the packed layout, so the positions are
+        ``last_idx - gather_k .. last_idx``) instead of only the last
+        token. Returns logits ``[S * (gather_k + 1), V]`` row-major per
+        sequence."""
         from .ragged.ragged_wrapper import unpack_descriptors
 
         token_ids, seq_idx, pos, valid, tables, last_idx = unpack_descriptors(
             packed, t_bucket, s_bucket, self._max_blocks_per_seq)
+        if gather_k:
+            idx = last_idx[:, None] - gather_k + jnp.arange(gather_k + 1, dtype=jnp.int32)
+            # padding rows carry last_idx 0 — clamp their (negative) indices;
+            # the caller slices the garbage rows off with [:n_seqs]
+            last_idx = jnp.maximum(idx, 0).reshape(-1)
         scales = {"k_scale": pools[2], "v_scale": pools[3]} if len(pools) == 4 else {}
         out = ragged_forward(self.model_config, self.config.kv_block_size, params,
                              token_ids, seq_idx, pos, valid, tables, last_idx,
                              pools[0], pools[1], use_pallas=self._use_pallas,
                              modules=self._modules, **scales)
         return out[0], tuple(out[1:])  # logits, new pool tuple
+
+    # ------------------------------------------------------------------
+    def speculate_decode(self, batch_uids: List[int], first_tokens, draft_tokens,
+                         k: Optional[int] = None, eos_token_ids=None) -> List[np.ndarray]:
+        """One speculative verify step over tracked, in-decode sequences:
+        feed ``[next_token, d_1..d_K]`` as ONE ragged chunk per sequence
+        (the packed-batch path already supports multi-token chunks), accept
+        the longest prefix of drafts matching the model's OWN greedy argmax
+        at each position, commit the accepted KV and roll the rejected tail
+        back through ``DSStateManager.rollback_to``.
+
+        ``first_tokens[i]`` — the sequence's pending next token (exactly as
+        :meth:`decode` takes it); ``draft_tokens[i]`` — up to ``k`` proposed
+        continuations (shorter drafts are padded; a pad is only ever
+        accepted when it happens to EQUAL the greedy choice, so parity is
+        unconditional). Returns one 1-D int32 array per sequence: the newly
+        committed tokens — the accepted drafts plus one bonus token from
+        the verify logits. Always at least 1, at most ``k + 1``; the LAST
+        entry is the new pending token (its KV is not yet materialized),
+        exactly like the final column of :meth:`decode`'s output.
+
+        ``eos_token_ids`` (scalar or per-sequence list with ``None``
+        entries): an eos landing INSIDE the accepted run truncates the
+        commit there — the returned tokens end at the eos, and KV/history
+        past it is rolled back before publish, so the radix tree never
+        receives post-eos paths (the same contract as :meth:`decode`'s
+        eos rewind).
+
+        Compiled once per (token-bucket, seq-bucket, K); rollback is free —
+        accepted tokens just advance ``seen_tokens``, rejected drafts
+        release block-table tail refs via the PR 3 refcount machinery."""
+        batch_uids = list(batch_uids)
+        hb = self._health
+        if not hb.enabled:
+            return self._speculate(batch_uids, first_tokens, draft_tokens, k, eos_token_ids)
+        hb.begin("serving")
+        get_flight_recorder().record("serving", "speculate", seqs=len(batch_uids),
+                                     k=int(k) if k is not None else -1)
+        try:
+            return self._speculate(batch_uids, first_tokens, draft_tokens, k, eos_token_ids)
+        finally:
+            hb.end("serving")
+
+    def _speculate(self, batch_uids, first_tokens, draft_tokens, k, eos_token_ids=None):
+        observing = get_tracer().enabled or get_metrics().enabled
+        t0 = time.perf_counter() if observing else 0.0
+        uids = list(batch_uids)
+        S = len(uids)
+        firsts = [np.asarray(t, np.int32).reshape(-1) for t in first_tokens]
+        drafts = [np.asarray(d, np.int32).reshape(-1) for d in draft_tokens]
+        if k is None:
+            k = max((d.size for d in drafts), default=0)
+        k = int(k)
+        if k < 1:
+            raise ValueError("speculate_decode needs k >= 1 (use decode() for plain steps)")
+        assert all(t.size == 1 for t in firsts), \
+            "speculate_decode takes exactly one pending next token per sequence"
+        if any(d.size > k for d in drafts):
+            raise ValueError(f"draft longer than k={k}")
+        if len(set(uids)) != len(uids) or S > self.batch.max_seqs:
+            raise SchedulingError(SchedulingResult.BatchSequenceLimitExceeded)
+        if S * (k + 1) > self.batch.max_tokens:
+            raise SchedulingError(SchedulingResult.TokenLimitExceeded)
+        seqs = []
+        for uid in uids:
+            seq = self.state_manager.get_sequence(uid)
+            if seq is None:
+                raise SchedulingError(SchedulingResult.EngineSequenceLimitExceeded)
+            if seq.seen_tokens + k + 1 > self._max_context:
+                raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+            seqs.append(seq)
+        if sum(s.blocks_needed(k + 1) for s in seqs) > self.state_manager.available_blocks:
+            raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+
+        # one uniform (k+1)-token chunk per sequence; short drafts pad by
+        # repeating their last token (repetitive streams make that a live
+        # guess; a wrong pad is simply rejected like any wrong draft)
+        chunks = []
+        for f, d in zip(firsts, drafts):
+            pad = np.full(k - d.size, int(d[-1]) if d.size else int(f[0]), np.int32)
+            chunks.append(np.concatenate([f, d, pad]))
+        starts = [s.seen_tokens for s in seqs]
+        self.batch.clear()
+        for seq, c in zip(seqs, chunks):
+            # note BEFORE the forward, like _put: history mirrors the fed
+            # chunk and rollback_to truncates it together with seen_tokens
+            self.state_manager.note_tokens(seq, c)
+            self.state_manager.allocate_blocks(seq, k + 1)
+            seq.pre_forward(k + 1)
+            self.batch.insert_sequence(seq, c)
+        rb = self.batch.finalize()
+
+        fn = self._get_compiled_verify(rb.token_ids.shape[0], rb.block_tables.shape[0], k)
+        kv = self.state_manager.kv_cache
+        out, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
+        kv.update(*pools)
+        out = np.asarray(out[:S])  # [S, k+1] greedy argmax at every chunk position
+
+        if eos_token_ids is None or isinstance(eos_token_ids, (int, np.integer)):
+            eos_list = [eos_token_ids] * S
+        else:
+            eos_list = list(eos_token_ids)
+            assert len(eos_list) == S, "eos_token_ids must match batch_uids"
+        results = []
+        drafted = accepted = 0
+        accepts = []
+        for seq, c, row, start, d, eos in zip(seqs, chunks, out, starts, drafts, eos_list):
+            # accept-longest-prefix: chunk[i+1] survives iff it equals the
+            # model's argmax after consuming chunk[..i]
+            neq = np.nonzero(c[1:] != row[:k])[0]
+            a = int(neq[0]) if neq.size else k
+            if eos is not None:
+                # an eos among the ACCEPTED drafts ends the stream there:
+                # commit through the eos only, so the post-eos accepted
+                # tail (KV + history) is rolled back with the rejects and
+                # never published (the bonus-position eos needs nothing —
+                # its KV was never materialized)
+                hit = np.nonzero(row[:a] == eos)[0]
+                if hit.size:
+                    a = int(hit[0])
+            seq.post_forward()                                    # seen = start + k + 1
+            self.state_manager.rollback_to(seq, start + 1 + a)    # keep fed + accepted
+            self.state_manager.publish_sequence(seq)              # accepted full blocks → tree
+            results.append(row[:a + 1].copy())  # accepted drafts + 1 bonus token
+            drafted += int(d.size)
+            accepted += min(a, int(d.size))  # pads excluded from the honest rate
+            accepts.append(a)
+        self._spec_totals["drafted"] += drafted
+        self._spec_totals["accepted"] += accepted
+        if observing:
+            m = get_metrics()
+            if m.enabled:
+                m.counter("serving/spec_drafted_tokens").inc(drafted)
+                m.counter("serving/spec_accepted_tokens").inc(accepted)
+                m.counter("serving/spec_rejected_tokens").inc(drafted - accepted)
+                m.gauge("serving/spec_accept_rate").set(
+                    self._spec_totals["accepted"] / max(1, self._spec_totals["drafted"]))
+            committed = int(sum(len(r) for r in results))
+            observe_latency(t0, "serving/spec_verify",
+                            hist_name="serving/spec_verify_ms",
+                            gauges={"serving/spec_tokens_per_sec":
+                                    lambda dt: committed / max(dt, 1e-9)},
+                            span_args={"seqs": S, "k": k, "drafted": drafted,
+                                       "accepted": accepts[:16],
+                                       "uids": [int(u) for u in uids[:16]]})
+        return results
+
+    def _get_compiled_verify(self, t_bucket: int, s_bucket: int, k: int):
+        key = ("verify", t_bucket, s_bucket, k)
+        if key not in self._compiled:
+            step_fn = self._ragged_step
+
+            def fwd(params, packed, pools):
+                logits, pools = step_fn(params, packed, pools, t_bucket, s_bucket,
+                                        gather_k=k)
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return toks.reshape(s_bucket, k + 1), pools
+
+            self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
+            log_dist(f"compiled speculative verify bucket tokens={t_bucket} "
+                     f"seqs={s_bucket} k={k}", ranks=[0])
+        return self._compiled[key]
 
     def _get_compiled_decode(self, s_bucket: int, n_steps: int):
         key = ("decode", s_bucket, n_steps)

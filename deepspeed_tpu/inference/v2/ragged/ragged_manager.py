@@ -135,6 +135,64 @@ class DSStateManager:
         if self.prefix_cache is not None and seq.history_valid:
             self.prefix_cache.publish(seq)
 
+    def rollback_to(self, seq: DSSequenceDescriptor, n_tokens: int,
+                    final: bool = False) -> int:
+        """THE single sequence-rewind primitive for the serving plane
+        (speculative-draft rejection, decode-horizon overshoot at early
+        finish/cancel — ``tools/check_spec_rollback.py`` gates all other
+        rewind sites out): truncate ``token_history``, rewind
+        ``seen_tokens`` to ``n_tokens``, and release now-unreferenced tail
+        blocks back through the refcount-aware path — a block shared with
+        the radix tree (or another sequence) merely loses THIS sequence's
+        reference and survives for the other holders. Returns the number of
+        tail references released.
+
+        If the rewind lands mid-block in a block that is still SHARED, the
+        block is copy-on-write duplicated first: the sequence's next tokens
+        will scatter into the tail slots, and writing into a shared block
+        would corrupt every other holder's view. The duplicate is reserved
+        BEFORE any state mutates, so a dry pool fails the call atomically
+        (the sequence is untouched). ``final=True`` skips the COW guard —
+        the caller promises the sequence will never be written again (it is
+        about to be flushed: finish/cancel paths), so a shared partial tail
+        is harmless and a dry pool cannot fail a terminal rewind."""
+        n_tokens = int(n_tokens)
+        if not 0 <= n_tokens <= seq.seen_tokens:
+            raise ValueError(f"rollback_to({n_tokens}): sequence {seq.uid} has "
+                             f"{seq.seen_tokens} materialized tokens")
+        if seq.in_flight_tokens:
+            raise RuntimeError(f"rollback_to on sequence {seq.uid} with "
+                               f"{seq.in_flight_tokens} tokens in flight: rewinds happen "
+                               "BETWEEN forwards only")
+        bs = self.block_size
+        keep = -(-n_tokens // bs)  # blocks still (partially) holding kept KV
+        cow_src = cow_dst = None
+        if (not final and n_tokens % bs and keep
+                and self.kv_cache.refcount(seq.kv_blocks[keep - 1]) > 1):
+            # COW guard: the new tail block is partial AND shared — future
+            # appends would scatter into slots other holders read. Reserve
+            # + copy first: if the pool is truly dry this raises with the
+            # sequence still in its pre-rollback state.
+            cow_src = seq.kv_blocks[keep - 1]
+            if self.prefix_cache is not None and self.kv_cache.free_blocks < 1:
+                self.prefix_cache.evict(1)
+            cow_dst = int(self.kv_cache.reserve(1)[0])
+            self.kv_cache.copy_block(cow_src, cow_dst)
+        tail = seq.kv_blocks[keep:]
+        del seq.kv_blocks[keep:]
+        if tail:
+            self.kv_cache.release(tail)
+        seq.seen_tokens = n_tokens
+        if len(seq.token_history) > n_tokens:
+            del seq.token_history[n_tokens:]
+        seq.published_blocks = min(seq.published_blocks, n_tokens // bs)
+        seq.shared_blocks = min(seq.shared_blocks, keep)
+        if cow_dst is not None:
+            seq.kv_blocks[keep - 1] = cow_dst
+            self.kv_cache.release(cow_src)
+            seq.shared_blocks = min(seq.shared_blocks, keep - 1)
+        return len(tail)
+
     def flush_sequence(self, uid: int) -> None:
         """Release a finished sequence's block references (reference
         ``flush:228``): publish completed full blocks first (the tree takes

@@ -62,7 +62,8 @@ class DynamicSplitFuseScheduler:
 
     DECODE_HORIZON = 32  # max on-device steps per multi-step decode call
 
-    def __init__(self, engine, token_budget: Optional[int] = None):
+    def __init__(self, engine, token_budget: Optional[int] = None, speculative=None,
+                 drafter=None):
         self.engine = engine
         sm = engine.config.state_manager
         if token_budget is None:
@@ -79,6 +80,30 @@ class DynamicSplitFuseScheduler:
         # actually computed vs skipped via radix hits (exact — counted at the
         # feed site, not inferred from latency)
         self.stats = {"prefill_tokens_fed": 0, "prefill_tokens_skipped": 0}
+        # speculative decoding: ``speculative`` overrides the engine's
+        # ``ragged.speculative`` block; ``drafter`` overrides the drafter
+        # built from it (tests/benches inject oracle/junk drafters). With
+        # the block absent/off, NO drafter object exists and every step
+        # path below is byte-identical to the pre-speculation scheduler
+        # (test-enforced zero overhead).
+        self._spec = speculative if speculative is not None \
+            else getattr(engine.config, "speculative", None)
+        if self._spec is not None and not getattr(self._spec, "enabled", False):
+            self._spec = None
+        self._drafter = drafter
+        if self._drafter is None and self._spec is not None:
+            from .speculative import build_drafter
+            self._drafter = build_drafter(self._spec)
+        if self._drafter is not None and self._spec is None:
+            from .config_v2 import SpeculativeConfig
+            self._spec = SpeculativeConfig(mode="ngram")  # injected drafter, default k
+        self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0, "rejected": 0}
+        self._spec_by_uid: Dict[int, Dict[str, int]] = {}
+        # incremental prompt+generated context per speculating uid: generated
+        # only ever APPENDS for a live request, so each round copies just the
+        # delta instead of re-concatenating the whole stream (O(new tokens),
+        # not O(context), in the hottest serving loop)
+        self._spec_ctx: Dict[int, np.ndarray] = {}
         # optional per-step observer, `fn(uids, chunk_sizes, t0, dur)` after
         # each composed `put` forward — the serving replica attaches one to
         # attribute step wall time to the requests whose chunks composed it
@@ -125,6 +150,19 @@ class DynamicSplitFuseScheduler:
         scheduler's result dict (and each per-step copy) grows with every
         request ever served. No-op for unknown/active uids."""
         self._results.pop(uid, None)
+        self._spec_by_uid.pop(uid, None)
+
+    @property
+    def speculating(self) -> bool:
+        """True when a drafter is wired in (``ragged.speculative`` present)."""
+        return self._drafter is not None
+
+    def spec_summary(self, uid: int) -> Optional[Dict[str, int]]:
+        """Per-request speculation accounting (``{"drafted", "accepted"}``)
+        for an active/finished uid, until ``discard_result``; None when the
+        request never speculated. The gateway's request summary record
+        carries the derived acceptance rate."""
+        return self._spec_by_uid.get(uid)
 
     def new_tokens(self, uid: int, start: int) -> List[int]:
         """Tokens generated past position ``start`` for a pending/active/
@@ -162,6 +200,24 @@ class DynamicSplitFuseScheduler:
 
     def _finish(self, req: _Request):
         req.done = True
+        seq = self.engine.state_manager.get_sequence(req.uid)
+        if seq is not None:
+            # decode/speculate horizons reserve and materialize KV past the
+            # last token an early-finished (eos) or cancelled request keeps.
+            # Rewind the overshoot through the single rollback helper BEFORE
+            # flush: flush publishes completed full blocks into the radix
+            # tree, and without the rewind the tree would take references on
+            # blocks keyed by post-eos garbage tokens — blocks that then
+            # never return to the free list until LRU pressure evicts them.
+            known = req.fed + max(0, len(req.generated) - 1)
+            if seq.seen_tokens > known:
+                # final=True: the flush below is this sequence's last act, so
+                # the COW guard (which could need a block from a dry pool)
+                # is skipped — a terminal rewind must never be able to fail
+                self.engine.state_manager.rollback_to(seq, known, final=True)
+        if self._drafter is not None:
+            self._drafter.finish(req.uid)
+            self._spec_ctx.pop(req.uid, None)
         self.engine.flush(req.uid)
         self._reserved_blocks -= req.charged_blocks
         self._active.pop(req.uid, None)
@@ -237,13 +293,90 @@ class DynamicSplitFuseScheduler:
         horizon = 1 << (horizon.bit_length() - 1)  # 1,2,4,...,32: <=6 programs per bucket
         uids = [r.uid for r in decoding]
         first = [np.asarray([r.generated[-1]], np.int32) for r in decoding]
-        toks = np.asarray(self.engine.decode(uids, first, horizon))  # [S, horizon]
+        # per-request eos rides down so the engine rewinds a mid-scan eos hit's
+        # horizon overshoot before publishing (post-eos KV never enters the tree)
+        eos = [r.eos_token_id for r in decoding]
+        toks = np.asarray(self.engine.decode(uids, first, horizon,
+                                             eos_token_ids=eos))  # [S, horizon]
         for req, row in zip(decoding, toks):
             for tok in row.tolist():
                 self._append_token(req, int(tok))
                 if req.done:
                     break  # eos/max_new inside the burst: drop the tail
         return len(decoding) * horizon
+
+    def _spec_context(self, req: _Request) -> np.ndarray:
+        """The request's committed stream (prompt + generated) as one int32
+        array, sized once for the request's whole lifetime and extended by
+        only the NEW generated tokens each round (generated never shrinks
+        for a live request). Returns a view of the filled region."""
+        n = req.prompt.size + len(req.generated)
+        entry = self._spec_ctx.get(req.uid)
+        if entry is None:
+            buf = np.empty(req.prompt.size + req.max_new_tokens, np.int32)
+            buf[:req.prompt.size] = req.prompt
+            filled = req.prompt.size
+        else:
+            buf, filled = entry
+        if filled < n:
+            buf[filled:n] = req.generated[filled - req.prompt.size:]
+            filled = n
+        self._spec_ctx[req.uid] = (buf, filled)
+        return buf[:n]
+
+    def _spec_burst(self, decoding: List[_Request]) -> int:
+        """Speculative steady state: draft up to K tokens per sequence, then
+        ONE batched verify forward commits the longest argmax-agreeing
+        prefix per sequence (plus a bonus token) and rolls rejected KV back.
+        Returns committed tokens, or 0 when this round cannot speculate —
+        the caller then falls back to the plain multi-step decode burst
+        (drafters came up empty, a sequence is too close to max_context, or
+        the transient k+1-token KV demand exceeds what the pool can cover)."""
+        k = self._spec.k
+        eng = self.engine
+        if len(decoding) * (k + 1) > min(self.token_budget, eng.config.state_manager.max_ragged_batch_size):
+            return 0
+        seqs = []
+        for r in decoding:
+            seq = eng.state_manager.get_sequence(r.uid)
+            if seq is None or seq.seen_tokens + k + 1 > eng.max_context:
+                return 0
+            seqs.append(seq)
+        # the verify chunk may transiently need blocks beyond the request's
+        # lifetime reservation (near its final tokens): refuse up front
+        # rather than strand the composed batch mid-run
+        if sum(s.blocks_needed(k + 1) for s in seqs) > eng.available_blocks:
+            return 0
+        items = [(r.uid, self._spec_context(r)) for r in decoding]
+        dmap = self._drafter.draft_many(items, k)
+        drafts = [np.asarray(dmap.get(r.uid, ()), np.int32).reshape(-1)[:k]
+                  for r in decoding]
+        if not any(d.size for d in drafts):
+            return 0
+        uids = [r.uid for r in decoding]
+        firsts = [np.asarray([r.generated[-1]], np.int32) for r in decoding]
+        # per-request eos rides down (decode()'s contract): an eos inside
+        # the accepted run truncates the commit there, so the tree never
+        # receives post-eos paths even when acceptance carries past it
+        outs = eng.speculate_decode(uids, firsts, drafts, k,
+                                    eos_token_ids=[r.eos_token_id for r in decoding])
+        self.spec_stats["rounds"] += 1
+        committed = 0
+        for req, d, new in zip(decoding, drafts, outs):
+            a = len(new) - 1  # accepted positions (pads included)
+            acc = min(a, int(d.size))
+            self.spec_stats["drafted"] += int(d.size)
+            self.spec_stats["accepted"] += acc
+            self.spec_stats["rejected"] += int(d.size) - acc
+            rec = self._spec_by_uid.setdefault(req.uid, {"drafted": 0, "accepted": 0})
+            rec["drafted"] += int(d.size)
+            rec["accepted"] += acc
+            committed += len(new)
+            for tok in new:
+                self._append_token(req, int(tok))
+                if req.done:
+                    break  # eos/max_new inside the burst: _finish rewound the rest
+        return committed
 
     def step(self) -> int:
         """Compose and run ONE engine call: all runnable decodes first, then
@@ -252,6 +385,10 @@ class DynamicSplitFuseScheduler:
         decoding = [r for r in self._active.values() if not r.prefilling and not r.done]
         prefilling = [r for r in self._active.values() if r.prefilling]
         if decoding and not prefilling and not self._pending and len(decoding) <= self.max_seqs:
+            if self._drafter is not None:
+                n = self._spec_burst(decoding)
+                if n:
+                    return n
             return self._decode_burst(decoding)
 
         uids: List[int] = []

@@ -1,0 +1,33 @@
+"""Speculative decoding for the ragged serving plane.
+
+Decode is strictly sequential (one argmax token fed back per step) and
+dominates per-user serving cost. This package drafts K candidate tokens
+cheaply, verifies them in ONE ragged forward (a multi-token chunk on an
+in-decode sequence — the packed-batch path already supports ragged chunk
+sizes), and commits the longest draft prefix the target model itself would
+have produced, rolling the rejected tail back through
+``DSStateManager.rollback_to`` (the paged KV layout makes rollback a
+refcount-aware tail release, never a copy).
+
+Two interchangeable drafters behind one :class:`Drafter` protocol:
+
+* :class:`NgramDrafter` — prompt-lookup / self-speculation: match the
+  suffix n-gram of the generated stream against the sequence's OWN history
+  and propose the continuation. No second model; a pure win on the
+  shared-prefix / repetitive workloads the prefix cache already targets.
+* :class:`DraftModelDrafter` — a small same-tokenizer member of the model
+  family running on its own :class:`InferenceEngineV2` (its own small KV
+  pool), kept in sync with the target stream via the SAME rollback helper.
+
+Greedy parity is unconditional by construction: a draft token is accepted
+only when it EQUALS the target model's own argmax at that position, so the
+committed stream is bit-identical to non-speculative greedy decoding
+regardless of what the drafter proposes (asserted for both drafters in
+``tests/test_speculative.py``).
+"""
+
+from .drafter import Drafter, build_drafter
+from .ngram import NgramDrafter
+from .draft_model import DraftModelDrafter
+
+__all__ = ["Drafter", "build_drafter", "NgramDrafter", "DraftModelDrafter"]

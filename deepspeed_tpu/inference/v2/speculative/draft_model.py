@@ -1,0 +1,131 @@
+"""Draft-model speculation: a small same-family model proposes K tokens.
+
+The drafter owns a second, much smaller :class:`InferenceEngineV2` (its own
+small KV pool) whose sequences MIRROR the target's committed streams. Each
+round it (1) re-syncs a mirror to the target's context — longest common
+prefix, then ``DSStateManager.rollback_to`` rewinds any rejected draft tail
+out of the mirror's KV (the same helper the target's verifier uses), then a
+catch-up prefill chunk for newly committed tokens — and (2) runs the draft
+model's own multi-step greedy decode scan (``engine.decode``) to propose K
+continuation tokens in ONE compiled call.
+
+Any failure (draft pool exhausted, context overflow) degrades to an empty
+draft for that request — the drafter is policy only, so the target stream
+is never at risk.
+"""
+
+from typing import Dict, Iterable, List, Tuple
+
+import numpy as np
+
+from .drafter import Drafter
+
+
+class DraftModelDrafter(Drafter):
+
+    name = "draft_model"
+
+    def __init__(self, draft_engine):
+        self.engine = draft_engine
+        # uid -> token ids materialized in the mirror sequence's KV (the
+        # draft-side analog of DSSequenceDescriptor.token_history)
+        self._hist: Dict[int, List[int]] = {}
+
+    def draft_many(self, items: Iterable[Tuple[int, np.ndarray]], k: int) -> Dict[int, np.ndarray]:
+        eng = self.engine
+        sm = eng.state_manager
+        out: Dict[int, np.ndarray] = {}
+        ready = []          # (uid, context) mirrors synced and ready to decode
+        catchup_u, catchup_c = [], []
+        for uid, ctx in items:
+            ctx = np.asarray(ctx, np.int32).reshape(-1)
+            m = ctx.size
+            if m < 2 or m - 1 + k > eng.max_context:
+                out[uid] = np.empty(0, np.int32)
+                continue
+            try:
+                hist = self._hist.setdefault(uid, [])
+                seq = sm.get_sequence(uid)
+                if seq is None and hist:
+                    hist.clear()  # mirror lost (e.g. prior failure reset)
+                # longest common prefix of the mirror with the target's
+                # committed stream; everything past it is rejected-draft
+                # tail that rollback_to rewinds out of the mirror's KV
+                lim = min(len(hist), m - 1)
+                neq = np.nonzero(np.asarray(hist[:lim], np.int32) != ctx[:lim])[0]
+                p = int(neq[0]) if neq.size else lim
+                if seq is not None and seq.seen_tokens > p:
+                    sm.rollback_to(seq, p)
+                del hist[p:]
+                if p < m - 1:  # catch-up prefill: newly committed tokens
+                    catchup_u.append(uid)
+                    catchup_c.append(ctx[p:m - 1])
+                    hist.extend(int(t) for t in ctx[p:m - 1])
+                ready.append((uid, ctx))
+            except Exception:
+                self._reset(uid)
+                out[uid] = np.empty(0, np.int32)
+        if catchup_u:
+            try:
+                self._feed_catchup(catchup_u, catchup_c)
+            except Exception:
+                failed = set(catchup_u)
+                for uid in failed:
+                    self._reset(uid)
+                    out[uid] = np.empty(0, np.int32)
+                ready = [(u, c) for u, c in ready if u not in failed]
+        if ready:
+            uids = [u for u, _ in ready]
+            firsts = [np.asarray([c[-1]], np.int32) for _, c in ready]
+            try:
+                rows = np.asarray(eng.decode(uids, firsts, k))
+            except Exception:
+                for uid in uids:
+                    self._reset(uid)
+                    out[uid] = np.empty(0, np.int32)
+                return out
+            for (uid, ctx), row in zip(ready, rows):
+                out[uid] = row.astype(np.int32, copy=True)
+                # the decode scan materialized the fed token + k-1 feedbacks
+                hist = self._hist[uid]
+                hist.append(int(ctx[-1]))
+                hist.extend(int(t) for t in row[:k - 1])
+        return out
+
+    def _feed_catchup(self, uids, chunks) -> None:
+        """Feed the mirrors' catch-up prefill within the draft engine's own
+        ragged-batch budget: several mirrors re-syncing at once (or one long
+        context) can exceed ``max_ragged_batch_size``, so the feed chunks
+        SplitFuse-style across as many ``put`` calls as it takes.
+        ``block=False`` throughout — the chunk tokens are already known, so
+        the draft logits of a catch-up forward are never fetched."""
+        eng = self.engine
+        sm = eng.config.state_manager
+        budget, max_seqs = sm.max_ragged_batch_size, sm.max_ragged_sequence_count
+        pend = [(u, np.asarray(c, np.int32).reshape(-1)) for u, c in zip(uids, chunks)]
+        while pend:
+            batch_u, batch_c, rest, tokens = [], [], [], 0
+            for u, c in pend:
+                take = min(c.size, budget - tokens) if len(batch_u) < max_seqs else 0
+                if take > 0:
+                    batch_u.append(u)
+                    batch_c.append(c[:take])
+                    tokens += take
+                    if take < c.size:
+                        rest.append((u, c[take:]))
+                else:
+                    rest.append((u, c))
+            if not batch_u:  # budget 0? cannot happen, but never spin
+                raise RuntimeError("draft catch-up cannot make progress")
+            eng.put(batch_u, batch_c, sample="greedy", block=False)
+            pend = rest
+
+    def finish(self, uid: int) -> None:
+        self._reset(uid)
+
+    def _reset(self, uid: int) -> None:
+        self._hist.pop(uid, None)
+        try:
+            self.engine.flush(uid)
+        except Exception:
+            pass

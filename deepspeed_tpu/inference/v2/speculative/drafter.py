@@ -1,0 +1,51 @@
+"""The ``Drafter`` protocol: propose up to K continuation tokens per round.
+
+A drafter is pure POLICY — it never touches the target engine's KV state.
+The scheduler asks it for candidates, the engine verifies them in one
+ragged forward, and acceptance is decided by the target model's own argmax
+(``InferenceEngineV2.speculate_decode``), so a bad drafter can only cost
+throughput, never correctness.
+"""
+
+from typing import Dict, Iterable, Tuple
+
+import numpy as np
+
+
+class Drafter:
+    """Base drafter. Subclasses implement :meth:`draft`; stateful drafters
+    (the draft-model path) may also override :meth:`draft_many` to batch
+    their own forwards, and :meth:`finish` to drop per-request state."""
+
+    name = "base"
+
+    def draft(self, uid: int, context: np.ndarray, k: int) -> np.ndarray:
+        """Up to ``k`` proposed continuation token ids (1-D int32; may be
+        empty = nothing to propose this round). ``context`` is the request's
+        full committed stream so far (prompt + generated tokens)."""
+        raise NotImplementedError
+
+    def draft_many(self, items: Iterable[Tuple[int, np.ndarray]], k: int) -> Dict[int, np.ndarray]:
+        """Batched entry the scheduler actually calls: ``{uid: drafts}`` for
+        every ``(uid, context)``. Default maps :meth:`draft`."""
+        return {uid: self.draft(uid, ctx, k) for uid, ctx in items}
+
+    def finish(self, uid: int) -> None:
+        """The request is done (finished or cancelled) — release any
+        per-request state. Must tolerate unknown uids."""
+
+
+def build_drafter(cfg) -> Drafter:
+    """Resolve a ``ragged.speculative`` config block into a drafter."""
+    from .draft_model import DraftModelDrafter
+    from .ngram import NgramDrafter
+
+    if cfg.mode == "ngram":
+        return NgramDrafter(min_match=cfg.min_match, max_ngram=cfg.max_ngram,
+                            max_history=cfg.max_history)
+    if cfg.mode == "draft_model":
+        if cfg.draft_engine is None:
+            raise ValueError("speculative.mode='draft_model' requires speculative.draft_engine "
+                             "(a small InferenceEngineV2 sharing the target's tokenizer)")
+        return DraftModelDrafter(cfg.draft_engine)
+    raise ValueError(f"unknown speculative mode {cfg.mode!r}: 'off' | 'ngram' | 'draft_model'")

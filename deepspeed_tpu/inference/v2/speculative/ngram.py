@@ -1,0 +1,56 @@
+"""Prompt-lookup / n-gram self-speculation (no second model).
+
+The drafting signal is the sequence's OWN token stream: if the suffix
+n-gram of (prompt + generated) occurred earlier, propose the tokens that
+followed it last time. Structured serving traffic is full of such repeats —
+shared system prompts quoted back, JSON/code templates, multi-turn
+histories, and the repetition loops greedy decoding itself falls into — so
+acceptance is high exactly on the workloads the prefix cache already
+targets, and drafting costs one numpy scan per sequence per round.
+"""
+
+import numpy as np
+
+from .drafter import Drafter
+
+
+class NgramDrafter(Drafter):
+    """``max_ngram`` down to ``min_match``: longer suffix matches are tried
+    first (they are more specific, so their continuations are accepted more
+    often); the MOST RECENT earlier occurrence wins (locality: the stream's
+    current loop beats a stale one). ``max_history`` bounds the scan window
+    (0 = the whole stream)."""
+
+    name = "ngram"
+
+    def __init__(self, min_match: int = 2, max_ngram: int = 4, max_history: int = 0):
+        if min_match < 1:
+            raise ValueError(f"min_match must be >= 1, got {min_match}")
+        if max_ngram < min_match:
+            raise ValueError(f"max_ngram {max_ngram} < min_match {min_match}")
+        self.min_match = int(min_match)
+        self.max_ngram = int(max_ngram)
+        self.max_history = int(max_history)
+
+    def draft(self, uid: int, context: np.ndarray, k: int) -> np.ndarray:
+        ctx = np.asarray(context, np.int32).reshape(-1)
+        if self.max_history and ctx.size > self.max_history:
+            ctx = ctx[-self.max_history:]
+        m = ctx.size
+        # haystack excludes the final token so the suffix can never match
+        # itself (an identity match would propose the suffix again with no
+        # new information)
+        hay = ctx[:m - 1]
+        for n in range(min(self.max_ngram, m - 1), self.min_match - 1, -1):
+            if hay.size < n:
+                continue
+            pat = ctx[m - n:]
+            windows = np.lib.stride_tricks.sliding_window_view(hay, n)
+            hits = np.nonzero((windows == pat).all(axis=1))[0]
+            # a hit at i proposes ctx[i+n : i+n+k]; it must have at least
+            # one continuation token inside the stream
+            hits = hits[hits + n < m]
+            if hits.size:
+                i = int(hits[-1])
+                return ctx[i + n:i + n + k].copy()
+        return np.empty(0, np.int32)

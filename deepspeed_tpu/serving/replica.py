@@ -329,6 +329,7 @@ class EngineReplica:
             req = self._streams.pop(uid, None)
             if req is None:
                 continue  # already finished (or never reached this replica)
+            spec = self._scheduler.spec_summary(uid)  # read before discard drops it
             if self._scheduler.cancel(uid):
                 self._scheduler.discard_result(uid)
             self._inflight -= 1
@@ -337,7 +338,7 @@ class EngineReplica:
             if self._reqtrace is not None:
                 # the stream latched its REAL terminal first (timeout /
                 # disconnect / explicit cancel) — finalize reads it
-                self._reqtrace.finalize(req)
+                self._reqtrace.finalize(req, spec=spec)
 
     def _pull_admitted(self) -> bool:
         pulled = False
@@ -429,14 +430,23 @@ class EngineReplica:
         if self._reqtrace is not None:
             # finalize BEFORE the stream latches done: the HTTP handler
             # wakes on finish and may read the request log immediately —
-            # the summary record must already be durable by then
-            self._reqtrace.finalize(req, finish_reason=reason, n_tokens=n)
+            # the summary record must already be durable by then.
+            # spec_summary is None unless the scheduler actually speculated
+            # for this request (ragged.speculative present) — the summary
+            # record then carries the per-request acceptance rate
+            self._reqtrace.finalize(req, finish_reason=reason, n_tokens=n,
+                                    spec=self._scheduler.spec_summary(req.uid))
         st.finish(reason=reason)
 
     # -- introspection -------------------------------------------------------
     def state(self) -> dict:
-        return {"name": self.name, "alive": self.alive, "paused": self.paused,
-                "warmed": self.warmed, "inflight": self._inflight,
-                "queued": self._admission.depth(replica=self.name),
-                "steps": self.steps,
-                "available_blocks": self.engine.available_blocks}
+        out = {"name": self.name, "alive": self.alive, "paused": self.paused,
+               "warmed": self.warmed, "inflight": self._inflight,
+               "queued": self._admission.depth(replica=self.name),
+               "steps": self.steps,
+               "available_blocks": self.engine.available_blocks}
+        if self._scheduler.speculating:
+            sp = self._scheduler.spec_stats
+            out["speculative"] = dict(sp, accept_rate=round(
+                sp["accepted"] / max(1, sp["drafted"]), 3))
+        return out

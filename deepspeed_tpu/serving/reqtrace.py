@@ -321,12 +321,15 @@ class RequestTracing:
             miss.append("tpot_miss")
         return "+".join(miss) or "ok"
 
-    def finalize(self, req, finish_reason=None, error=None, n_tokens=None):
+    def finalize(self, req, finish_reason=None, error=None, n_tokens=None, spec=None):
         """Terminal path for an ADMITTED request (completed, cancelled,
         timed out, errored, failed by a dying replica): stamp the tail,
         derive the verdict, emit the terminal instant + decode-tail span,
         feed the stage histograms, and write the summary record (tail-aware
-        sampling). Exactly-once per request."""
+        sampling). Exactly-once per request. ``spec`` — the scheduler's
+        per-request speculation summary (``{"drafted", "accepted"}``; None
+        when the request never speculated): the record then carries the
+        request's own draft acceptance rate."""
         ctx = req.ctx
         if ctx is None or not self._close(ctx):
             return
@@ -380,6 +383,10 @@ class RequestTracing:
             "tpot_ms": round(req.tpot_ms, 3) if req.tpot_ms else None,
             "sampled": ctx.sampled,
         }
+        if spec is not None and spec.get("drafted"):
+            record["spec_drafted_tokens"] = int(spec["drafted"])
+            record["spec_accepted_tokens"] = int(spec["accepted"])
+            record["spec_accept_rate"] = round(spec["accepted"] / spec["drafted"], 3)
         record.update({k: (round(v, 3) if v is not None else None)
                        for k, v in stages.items()})
         get_tracer().instant("serving/request_done", tid="serving",

@@ -1,0 +1,132 @@
+"""Static check: every sequence rewind routes through
+``DSStateManager.rollback_to``.
+
+Companion to ``check_kv_blocks.py`` (same lesson: structural invariants rot
+silently unless CI asserts them). The speculative-decoding subsystem rewinds
+sequences constantly — rejected draft tails, decode-horizon overshoot at
+early finish/cancel — and a rewind has FOUR coupled pieces: truncate
+``token_history``, rewind ``seen_tokens``, rewind the publish cursor, and
+release the tail block references refcount-aware (with a COW duplicate when
+the new tail block is still shared). A module mutating any one of those
+directly would desynchronize the others: history longer than ``seen_tokens``
+poisons radix publishing, a bare ``seen_tokens`` rewind leaks tail blocks,
+and a bare tail release under a shared block corrupts other holders' KV.
+
+This AST walk (no package imports, runs anywhere) asserts, over
+``inference/v2/`` AND ``serving/``:
+
+  * no assignment / augmented assignment to a ``.seen_tokens`` attribute
+    outside the state-manager plane (``ragged/ragged_manager.py``,
+    ``ragged/sequence_descriptor.py``);
+  * no mutation of ``.token_history`` (slice/``del``/rebind or a mutating
+    method call) outside that plane;
+  * no direct ``kv_cache.release`` / ``allocator.release`` calls outside
+    ``ragged/`` — tail releases belong to ``rollback_to`` / ``flush_sequence``.
+
+A tier-1 test (``tests/test_speculative.py``) runs this on every CI pass.
+"""
+
+import ast
+import os
+import sys
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir, "deepspeed_tpu")
+DEFAULT_DIRS = (os.path.join(_REPO, "inference", "v2"), os.path.join(_REPO, "serving"))
+
+# the state-manager plane: the only modules allowed to mutate descriptor
+# rewind state (rollback_to and the descriptor's own lifecycle methods live
+# here; create_sequence_with_prefix seeds seen_tokens/token_history here too)
+ALLOWED_REWIND_FILES = (
+    os.path.join("ragged", "ragged_manager.py"),
+    os.path.join("ragged", "sequence_descriptor.py"),
+)
+
+# direct block-release receivers: <x>.kv_cache.release(...) / <x>._allocator
+# .release(...) are allowed only inside ragged/ itself
+_RELEASE_RECEIVERS = ("kv_cache", "_allocator", "allocator")
+
+_HISTORY_MUTATORS = ("append", "extend", "clear", "pop", "remove", "insert",
+                     "sort", "reverse")
+
+
+def _is_attr(node, name):
+    return isinstance(node, ast.Attribute) and node.attr == name
+
+
+def _check_file(path, rel, allowed_rewinds, allowed_release, violations):
+    with open(path) as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    lines = src.splitlines()
+
+    def flag(node, why):
+        snippet = lines[node.lineno - 1].strip() if node.lineno <= len(lines) else ""
+        violations.append((rel, node.lineno, why, snippet))
+
+    for node in ast.walk(tree):
+        if not allowed_rewinds:
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if _is_attr(t, "seen_tokens"):
+                        flag(node, "direct seen_tokens rewind")
+                    if _is_attr(t, "token_history"):
+                        flag(node, "token_history rebind")
+                    if isinstance(t, ast.Subscript) and _is_attr(t.value, "token_history"):
+                        flag(node, "token_history slice assignment")
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and _is_attr(t.value, "token_history"):
+                        flag(node, "token_history del")
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HISTORY_MUTATORS \
+                    and _is_attr(node.func.value, "token_history"):
+                flag(node, f"token_history.{node.func.attr}()")
+        if not allowed_release:
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "release":
+                recv = node.func.value
+                recv_name = recv.attr if isinstance(recv, ast.Attribute) else (
+                    recv.id if isinstance(recv, ast.Name) else None)
+                if recv_name in _RELEASE_RECEIVERS:
+                    flag(node, "direct block release (use rollback_to/flush_sequence)")
+
+
+def find_violations(dirs=DEFAULT_DIRS):
+    """[(relpath, lineno, why, snippet)] for every rewind/release site
+    outside the state-manager plane."""
+    violations = []
+    for scan_dir in dirs:
+        for root, _dirs, files in os.walk(scan_dir):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                rel = os.path.relpath(path, scan_dir)
+                allowed_rewinds = rel in ALLOWED_REWIND_FILES
+                allowed_release = rel.split(os.sep)[0] == "ragged"
+                _check_file(path, rel, allowed_rewinds, allowed_release, violations)
+    return violations
+
+
+def check(dirs=DEFAULT_DIRS):
+    """Return the violation list (empty = every rewind routes through
+    ``DSStateManager.rollback_to``)."""
+    return find_violations(dirs)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    dirs = tuple(argv) if argv else DEFAULT_DIRS
+    bad = check(dirs)
+    if bad:
+        print("check_spec_rollback: sequence rewinds outside DSStateManager.rollback_to:")
+        for rel, lineno, why, snippet in bad:
+            print(f"  {rel}:{lineno}: [{why}] {snippet}")
+        return 1
+    print("check_spec_rollback: all sequence rewinds route through rollback_to")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
