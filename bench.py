@@ -47,6 +47,64 @@ import sys
 import time
 
 
+def backend_stamp(on_tpu: bool) -> dict:
+    """``{'backend': 'tpu'|'cpu', 'chip': <device_kind>}`` — stamped into
+    every final JSON line so round-over-round tooling can tell a CPU-fallback
+    number from an on-chip one WITHOUT reading prose caveats (the
+    BENCH_r04/r05 lesson: r04/r05 ran CPU-only and their headline values are
+    not comparable to the r01-r02 on-chip rounds)."""
+    chip = "cpu"
+    if on_tpu:
+        try:
+            import jax
+
+            chip = str(jax.devices()[0].device_kind)
+        except Exception:
+            chip = "tpu-unknown"
+    return {"backend": "tpu" if on_tpu else "cpu", "chip": chip}
+
+
+def compare_to_baseline(line: dict, baseline_path: str) -> dict:
+    """Headline-vs-previous-round comparison that REFUSES cross-backend
+    ratios. Accepts a raw bench JSON line or the driver's ``BENCH_rXX.json``
+    wrapper (``{"parsed": {...}}``). A baseline without a backend stamp is
+    judged by its ``on_tpu`` field; one with neither is refused — an
+    unknown-backend ratio is exactly the trap this exists to close."""
+    try:
+        with open(baseline_path) as f:
+            base = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"refused": f"unreadable baseline: {type(e).__name__}"}
+    if isinstance(base, dict) and isinstance(base.get("parsed"), dict):
+        base = base["parsed"]
+    if not isinstance(base, dict):
+        return {"refused": "baseline is not a bench JSON object"}
+    b_backend = base.get("backend")
+    if b_backend is None and "on_tpu" in base:
+        b_backend = "tpu" if base.get("on_tpu") else "cpu"
+    cur = line.get("backend")
+    if b_backend is None:
+        return {"refused": "baseline carries no backend stamp (pre-r06 format without on_tpu)"}
+    if b_backend != cur:
+        return {"refused": f"cross-backend comparison: baseline={b_backend} current={cur}"}
+    if (base.get("chip") and line.get("chip") and base["chip"] != line["chip"]):
+        return {"refused": f"cross-chip comparison: baseline={base['chip']} "
+                           f"current={line['chip']}"}
+    if (base.get("metric") and line.get("metric") and base["metric"] != line["metric"]):
+        # bench prints TWO stamped lines (serving + train headline) — a
+        # ratio across metrics is as meaningless as one across backends
+        return {"refused": f"cross-metric comparison: baseline={base['metric']} "
+                           f"current={line['metric']}"}
+    if not base.get("value"):
+        return {"refused": "baseline has no headline value"}
+    try:
+        return {"ratio": round(float(line["value"]) / float(base["value"]), 4),
+                "baseline_value": base["value"], "baseline_backend": b_backend}
+    except (TypeError, ValueError, ZeroDivisionError) as e:
+        # a malformed baseline must cost this field, never the headline line
+        return {"refused": f"non-numeric baseline value: {type(e).__name__}"}
+
+
 def _free_engine(engine, *attrs):
     """Drop an engine's device buffers (params/state/KV pools) so the next
     benchmark configuration has the chip's HBM to itself."""
@@ -259,6 +317,145 @@ def bench_serving(on_tpu: bool):
     if prefix_line is not None:
         out["prefix_cache"] = prefix_line
     _free_engine(engine, "state_manager", "params")
+    return out
+
+
+def bench_kernels(on_tpu: bool) -> dict:
+    """Raw-speed microbench A/Bs (PR 10): q-tiled vs per-token paged
+    attention tok/s, explicit-overlap vs implicit ZeRO-3 step time, tuned vs
+    default flash tiles. Each sub-block is independently guarded — a failure
+    costs that key only, never the headline. Off-TPU the Pallas arms run in
+    interpret mode on tiny shapes (disclosed), so the numbers exercise the
+    plumbing, not the chip."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.autotuning.kernel_config import KernelAutotuner
+
+    out = {}
+    if not on_tpu:
+        out["note"] = "cpu: pallas arms run interpreted on tiny shapes"
+
+    # same warmup/median methodology as the tile sweep, so the A/B block and
+    # the autotuner can never quietly measure differently
+    timeit = KernelAutotuner(output_dir=".", steps=3, warmup=1).measure
+
+    # --- paged attention: q-tiled vs per-token ---
+    try:
+        from deepspeed_tpu.ops.pallas.paged_attention import _pallas_paged, _resolve_q_tile
+
+        rng = np.random.default_rng(0)
+        if on_tpu:
+            nq, nkv, d, bs, chunk, n_seqs = 16, 16, 128, 128, 128, 2
+        else:
+            nq, nkv, d, bs, chunk, n_seqs = 4, 4, 32, 16, 16, 2
+        T = chunk * n_seqs
+        NB = n_seqs * (-(-(chunk + bs) // bs))
+        dt = jnp.bfloat16 if on_tpu else jnp.float32
+        k_pool = jnp.asarray(rng.normal(size=(NB * bs, nkv, d)), dt)
+        v_pool = jnp.asarray(rng.normal(size=(NB * bs, nkv, d)), dt)
+        tables = jnp.arange(NB, dtype=jnp.int32).reshape(n_seqs, -1)
+        q = jnp.asarray(rng.normal(size=(T, nq, d)), dt)
+        seq_idx = jnp.asarray(np.repeat(np.arange(n_seqs), chunk), jnp.int32)
+        pos = jnp.asarray(np.tile(np.arange(chunk), n_seqs) + bs // 2, jnp.int32)
+        qt = _resolve_q_tile(T, n_seqs)
+        if qt <= 1:
+            qt = 8
+
+        def paged(q_tile):
+            return lambda: _pallas_paged(q, k_pool, v_pool, tables, seq_idx, pos,
+                                         block_size=bs, q_tile=q_tile, interpret=not on_tpu)
+
+        t1 = timeit(paged(1))
+        tq = timeit(paged(qt))
+        out["paged_attention"] = {
+            "q_tile": qt, "prefill_tokens": T,
+            "per_token_tok_s": round(T / t1, 1),
+            "q_tiled_tok_s": round(T / tq, 1),
+            "speedup": round(t1 / tq, 3),
+        }
+    except Exception as e:
+        print(f"# WARNING: kernels.paged_attention bench failed "
+              f"({type(e).__name__}: {str(e)[:160]})", flush=True)
+
+    # --- ZeRO-3 overlap_comm: explicit vs implicit step time ---
+    try:
+        import deepspeed_tpu
+        from deepspeed_tpu.models import TransformerConfig, TransformerLM
+        from deepspeed_tpu.parallel import groups
+
+        if on_tpu:
+            mcfg = TransformerConfig(vocab_size=8192, hidden_size=1024, num_layers=8,
+                                     num_heads=8, intermediate_size=2816, max_seq_len=512,
+                                     dtype=jnp.bfloat16, attention_impl="flash")
+            micro, seq, steps = 2, 512, 4
+        else:
+            mcfg = TransformerConfig(vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+                                     intermediate_size=128, max_seq_len=64, dtype=jnp.float32,
+                                     attention_impl="reference")
+            micro, seq, steps = 2, 64, 3
+        step_ms = {}
+        for overlap in (False, True):
+            groups.reset()
+            n = len(jax.devices())
+            cfgd = {
+                "train_batch_size": micro * n,
+                "train_micro_batch_size_per_gpu": micro,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 3, "overlap_comm": overlap},
+                "bf16": {"enabled": bool(on_tpu)},
+                "steps_per_print": 10**9,
+                "tpu": {"mesh": {"data": n}},
+            }
+            eng, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(mcfg), config=cfgd)
+            rng = np.random.default_rng(0)
+            batch = {"input_ids": rng.integers(0, mcfg.vocab_size, size=(micro * n, seq),
+                                               dtype=np.int32)}
+            eng.train_batch(batch)  # compile
+            float(np.asarray(eng.state["step"]))
+            t0 = _t.perf_counter()
+            for _ in range(steps):
+                eng.train_batch(batch)
+            float(np.asarray(eng.state["step"]))
+            step_ms["overlap_on" if overlap else "overlap_off"] = round(
+                (_t.perf_counter() - t0) / steps * 1e3, 3)
+            _free_engine(eng, "state")
+        out["zero3_overlap"] = {
+            "step_ms_off": step_ms["overlap_off"], "step_ms_on": step_ms["overlap_on"],
+            "speedup": round(step_ms["overlap_off"] / max(step_ms["overlap_on"], 1e-9), 3),
+        }
+    except Exception as e:
+        print(f"# WARNING: kernels.zero3_overlap bench failed "
+              f"({type(e).__name__}: {str(e)[:160]})", flush=True)
+
+    # --- flash attention: tuned vs default tiles (only meaningful on-chip) ---
+    if on_tpu:
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import (_default_tile, _pallas_flash,
+                                                                  _resolve_tiles)
+
+            S, nq, d = 2048, 16, 128
+            k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+            qf = jax.random.normal(k1, (1, S, nq, d), jnp.bfloat16)
+            kf = jax.random.normal(k2, (1, S, nq, d), jnp.bfloat16)
+            vf = jax.random.normal(k3, (1, S, nq, d), jnp.bfloat16)
+            dflt = _default_tile()
+            bq, bk = _resolve_tiles(S, d)
+            td = timeit(lambda: _pallas_flash(qf, kf, vf, causal=True, block_q=dflt,
+                                              block_k=dflt))
+            tt = timeit(lambda: _pallas_flash(qf, kf, vf, causal=True, block_q=bq, block_k=bk))
+            out["flash_tiles"] = {
+                "default": [dflt, dflt], "tuned": [bq, bk],
+                "default_ms": round(td * 1e3, 3), "tuned_ms": round(tt * 1e3, 3),
+                "speedup": round(td / tt, 3),
+                "untuned": (bq, bk) == (dflt, dflt),  # no kernel_config.json for this topo
+            }
+        except Exception as e:
+            print(f"# WARNING: kernels.flash_tiles bench failed "
+                  f"({type(e).__name__}: {str(e)[:160]})", flush=True)
     return out
 
 
@@ -494,6 +691,7 @@ def run_bench():
         except Exception as e:
             print(f"# WARNING: speculative bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+    serving.update(backend_stamp(on_tpu))
     print(json.dumps(serving))
 
     def train_tps(cfg, micro, gas, seq, steps, warmup, data="batch"):
@@ -735,6 +933,23 @@ def run_bench():
         h.shutdown()
         _free_engine(h_engine, "state")
 
+    # --kernels: raw-speed microbench A/Bs (q-tiled paged attention, explicit
+    # ZeRO-3 overlap, tuned-vs-default flash tiles). Outside the headline
+    # timed window; DS_TPU_BENCH_KERNELS=0 skips, failure never costs the
+    # headline (each sub-block is guarded inside bench_kernels).
+    kernels_line = None
+    if os.environ.get("DS_TPU_BENCH_KERNELS", "1") != "0":
+        try:
+            kernels_line = bench_kernels(on_tpu)
+            if kernels_line.get("paged_attention"):
+                pa = kernels_line["paged_attention"]
+                print(f"# kernels: paged q_tile={pa['q_tile']} speedup={pa['speedup']}x; "
+                      f"overlap={kernels_line.get('zero3_overlap', {}).get('speedup')}x",
+                      flush=True)
+        except Exception as e:
+            print(f"# WARNING: kernels bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+
     if trace_path:
         # eager 3-call path demo: genuine fwd/bwd/step spans plus an eager
         # device collective (comm/all_reduce span with real bytes + bandwidth)
@@ -779,7 +994,20 @@ def run_bench():
         # (stack+reshape+H2D placement on the batch= path)
         "input_wait_ms_p50": round(input_wait_p50, 3),
         "on_tpu": on_tpu,
+        # machine-checkable comparability stamp (BENCH_r04/r05 lesson):
+        # cross-round tooling compares `value` ONLY within one backend+chip
+        **backend_stamp(on_tpu),
     }
+    if kernels_line is not None:
+        line["kernels"] = kernels_line
+    # DS_TPU_BENCH_BASELINE=<prior BENCH_rXX.json or raw line>: attach the
+    # round-over-round ratio — or the refusal — computed by the same rules
+    baseline_path = os.environ.get("DS_TPU_BENCH_BASELINE")
+    if baseline_path:
+        try:
+            line["vs_prev"] = compare_to_baseline(line, baseline_path)
+        except Exception as e:  # belt-and-braces: the headline always prints
+            line["vs_prev"] = {"refused": f"comparison failed: {type(e).__name__}"}
     if prefetch_line is not None:
         line["prefetch"] = prefetch_line
     if ckpt_line is not None:

@@ -354,6 +354,36 @@ send = timed_op(_functional.send)
 recv = timed_op(_functional.recv)
 
 
+@timed_op
+def zero3_params_allgather(params, specs=None, mesh=None, group=None):
+    """Explicit ZeRO-3 per-layer parameter all-gather (the
+    ``zero_optimization.overlap_comm`` schedule — ``models/transformer.py``
+    issues layer *l+1*'s gather during layer *l*'s compute).
+
+    In GSPMD form the gather IS a sharding constraint: each leaf is pinned to
+    its gathered compute layout (TP spec with the ZeRO data axes dropped) and
+    XLA lowers the boundary to the all-gather. Riding ``@timed_op`` puts the
+    prefetch on the same observability surface as every other collective:
+    under jit a ``comm/zero3_params_allgather`` instant (with real payload
+    bytes) lands on the trace bus per compile, and eager executions bracket
+    the PR 5 ``_InflightCollectives`` table / heartbeat hooks.
+
+    ``specs``: dict leaf-name -> PartitionSpec (None entries skipped, e.g.
+    expert-parallel weights whose data-axis sharding is EP, not ZeRO).
+    No mesh/specs (CPU tests, no registry) -> identity.
+    """
+    if mesh is None or specs is None:
+        return params
+    import jax
+    from jax.sharding import NamedSharding
+
+    out = {}
+    for k, v in params.items():
+        s = specs.get(k)
+        out[k] = v if s is None else jax.lax.with_sharding_constraint(v, NamedSharding(mesh, s))
+    return out
+
+
 def init_distributed(dist_backend="xla",
                      auto_mpi_discovery=True,
                      distributed_port=29500,

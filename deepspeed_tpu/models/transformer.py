@@ -105,6 +105,13 @@ class TransformerConfig:
     # payload + per-block scales instead of fp32 — 4x less ICI traffic —
     # with a straight-through gradient to the fp32 masters.
     quantized_weights: bool = False
+    # Explicit ZeRO-3 gather/compute overlap (set by the engine from
+    # zero_optimization.overlap_comm at stage 3): the scan double-buffers the
+    # NEXT layer's gathered params in the carry — layer l+1's all-gather is
+    # issued at the top of iteration l, so the collective overlaps layer l's
+    # compute explicitly instead of relying on XLA's latency-hiding
+    # scheduler. Bit-identical loss vs the implicit path (test-enforced).
+    overlap_gather: bool = False
 
     def __post_init__(self):
         if self.moe_impl not in ("einsum", "grouped"):
@@ -383,23 +390,39 @@ def _attention(cfg: TransformerConfig, q, k, v):
 
 def _qwz_target_specs(cfg: TransformerConfig, layer):
     """ZeRO++ qwZ: the per-layer compute layout each big weight is gathered
-    into — derived from ``partition_rules`` (dropping the stacked layer dim,
-    which the per-layer slice no longer has), so the two never drift. MoE
-    expert weights (data axis in their TP spec = expert parallelism, not a
-    ZeRO gather) and 1-D vectors are skipped."""
+    into (``layer`` holds per-layer slices — the stacked dim is already
+    gone). 1-D vectors and expert-parallel weights are skipped; the spec
+    derivation itself is shared with overlap_comm (``_layer_gather_spec``)."""
     rules = partition_rules(cfg)
     out = {}
     for k, v in layer.items():
         if np.ndim(v) < 2:
             continue
-        full = rules.spec_for(f"blocks/{k}", np.ndim(v) + 1)
-        entries = list(full)[1:]  # drop the stacked-L/pipe dim
-        flat = [a for e in entries if e is not None
-                for a in (e if isinstance(e, (tuple, list)) else (e, ))]
-        if DATA_AXIS in flat:
-            continue
-        out[k] = P(*entries)
+        spec = _layer_gather_spec(rules, k, np.ndim(v))
+        if spec is not None:
+            out[k] = spec
     return out
+
+
+def _layer_gather_spec(rules: PartitionRules, key: str, per_layer_ndim: int):
+    """Gathered compute layout for ONE stacked-blocks leaf: its TP spec with
+    the stacked-L/pipe dim dropped — replicated over the ZeRO data axes,
+    still sharded over 'model'. Returns None when the spec's data axes are
+    expert parallelism (MoE expert weights), not a ZeRO shard to gather.
+    Shared by the qwZ and overlap_comm planes so their layouts cannot
+    drift from ``partition_rules`` or from each other."""
+    full = rules.spec_for(f"blocks/{key}", per_layer_ndim + 1)
+    entries = list(full)[1:]  # drop the stacked-L/pipe dim
+    flat = [a for e in entries if e is not None
+            for a in (e if isinstance(e, (tuple, list)) else (e, ))]
+    return None if DATA_AXIS in flat else P(*entries)
+
+
+def _zero3_gather_specs(cfg: TransformerConfig, blocks):
+    """Per-leaf gathered layouts for the explicit overlap_comm schedule
+    (stacked [L, ...] input; None entries are left unconstrained)."""
+    rules = partition_rules(cfg)
+    return {k: _layer_gather_spec(rules, k, np.ndim(v) - 1) for k, v in blocks.items()}
 
 
 def _qwz_layer_view(cfg: TransformerConfig, layer):
@@ -657,6 +680,50 @@ def forward_hidden(cfg: TransformerConfig, params: Dict[str, Any], input_ids: ja
 
     use_layer_keys = cfg.moe_num_experts > 0 and rng is not None
     layer_keys = jax.random.split(rng, cfg.num_layers) if use_layer_keys else None
+
+    # Explicit overlap_comm schedule (ZeRO-3): double-buffer the gathered
+    # next-layer params in the scan carry. Layer l+1's all-gather (a
+    # resharding constraint, routed through comm.zero3_params_allgather so
+    # the trace bus / in-flight table see it) is issued BEFORE layer l's
+    # compute in program order — the explicit analog of the reference's
+    # overlap_comm side stream. Values are untouched (same slices, same
+    # math), so the loss is bit-identical to the implicit path. PLD drops
+    # layers at runtime (prefetching a dropped layer's params would waste
+    # the gather) and qwZ owns its own quantized gather — both keep the
+    # plain scan.
+    if cfg.overlap_gather and pld_keep is None and not cfg.quantized_weights:
+        from ..parallel import groups as _groups
+
+        mesh = _groups.get_mesh() if _groups.is_initialized() else None
+        specs = _zero3_gather_specs(cfg, params["blocks"]) if mesh is not None else None
+        from ..comm.comm import zero3_params_allgather
+
+        blocks = params["blocks"]
+        L = cfg.num_layers
+
+        def fetch(i):
+            layer = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False), blocks)
+            return zero3_params_allgather(layer, specs=specs, mesh=mesh)
+
+        def overlap_body(carry, xs):
+            x, cur = carry
+            if use_layer_keys:
+                i, key = xs
+            else:
+                i, key = xs, None
+            # last iteration: no next layer — reuse cur instead of issuing a
+            # redundant gather whose result the scan would discard
+            nxt = lax.cond(i + 1 < L, lambda: fetch(jnp.minimum(i + 1, L - 1)), lambda: cur)
+            y, aux = block_fn(x, cur, sin, cos, key)
+            return (y, nxt), jnp.asarray(aux, jnp.float32)
+
+        idx = jnp.arange(L, dtype=jnp.int32)
+        xs = (idx, layer_keys) if use_layer_keys else idx
+        (x, _), l_auxs = lax.scan(overlap_body, (x, fetch(jnp.int32(0))), xs)
+        x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
+                  cfg.norm, cfg.norm_eps)
+        return x, jnp.sum(l_auxs)
 
     xs_list = [params["blocks"]]
     if use_layer_keys:

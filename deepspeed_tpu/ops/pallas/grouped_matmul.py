@@ -42,15 +42,47 @@ def _fit_block(dim: int, preferred: int) -> int:
     return max(b, 1)
 
 
-@functools.partial(jax.jit, static_argnames=("block_t", "block_k", "block_n", "interpret"))
-def gmm(lhs, rhs, block_expert, block_t=128, block_k=512, block_n=512, interpret=False):
+def _resolve_gmm_tiles(K: int, N: int, block_k=None, block_n=None):
+    """K/N tile resolution: explicit caller value > kernel-config registry
+    (per chip/topology/shape bucket) > the 512 default. ``block_t`` is NOT
+    tunable here — it is a dispatcher contract (block_expert's shape)."""
+    from ...autotuning.kernel_config import shape_bucket, tuned_tile
+
+    bucket = shape_bucket(K=K, N=N)
+    bk = block_k if block_k is not None else tuned_tile("grouped_matmul", bucket, "block_k", 512)
+    bn = block_n if block_n is not None else tuned_tile("grouped_matmul", bucket, "block_n", 512)
+    return int(bk), int(bn)
+
+
+def gmm_reference(lhs, rhs, block_expert, block_t=128):
+    """jnp gather oracle for :func:`gmm` — the numerics reference the kernel
+    is tested against (and the always-available fallback contract the
+    ``tools/check_kernel_configs.py`` gate demands of every tuned kernel)."""
+    expert_per_row = jnp.repeat(block_expert, block_t)
+    out = jnp.einsum("tk,tkn->tn", lhs.astype(jnp.float32),
+                     rhs[expert_per_row].astype(jnp.float32))
+    return out.astype(lhs.dtype)
+
+
+def gmm(lhs, rhs, block_expert, block_t=128, block_k=None, block_n=None, interpret=False):
     """Grouped matmul ``out[i*bt:(i+1)*bt] = lhs[i*bt:(i+1)*bt] @
     rhs[block_expert[i]]``.
 
     lhs: [T, K] block-aligned expert-sorted rows; rhs: [E, K, N] stacked
     expert weights; block_expert: [T//block_t] int32 (non-decreasing).
     Returns [T, N] in lhs.dtype; fp32 accumulation.
+
+    Registry tiles resolve HERE, outside the jit: resolving inside would key
+    the compiled-executable cache on ``block_k=None`` and freeze the
+    first-seen tiles — a later kernel-config install would be silently
+    ignored for already-traced shapes.
     """
+    block_k, block_n = _resolve_gmm_tiles(lhs.shape[1], rhs.shape[2], block_k, block_n)
+    return _gmm(lhs, rhs, block_expert, block_t, block_k, block_n, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("block_t", "block_k", "block_n", "interpret"))
+def _gmm(lhs, rhs, block_expert, block_t, block_k, block_n, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -97,9 +129,7 @@ def gmm(lhs, rhs, block_expert, block_t=128, block_k=512, block_n=512, interpret
                           interpret=interpret)(block_expert, lhs, rhs)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("num_experts", "block_t", "block_k", "block_n", "interpret"))
-def tgmm(lhs, dy, block_expert, num_experts, block_t=128, block_k=512, block_n=512,
+def tgmm(lhs, dy, block_expert, num_experts, block_t=128, block_k=None, block_n=None,
          interpret=False):
     """Per-expert weight gradient ``out[e] = sum_{i: be[i]=e}
     lhs_block_i^T @ dy_block_i`` → [E, K, N] (fp32).
@@ -107,7 +137,15 @@ def tgmm(lhs, dy, block_expert, num_experts, block_t=128, block_k=512, block_n=5
     ``block_expert`` must be non-decreasing AND cover every expert in
     [0, num_experts) at least once (block-aligned dispatch guarantees both);
     otherwise an absent expert's output block would never be written.
+    Registry tiles resolve outside the jit (see :func:`gmm`).
     """
+    block_k, block_n = _resolve_gmm_tiles(lhs.shape[1], dy.shape[1], block_k, block_n)
+    return _tgmm(lhs, dy, block_expert, num_experts, block_t, block_k, block_n, interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_experts", "block_t", "block_k", "block_n", "interpret"))
+def _tgmm(lhs, dy, block_expert, num_experts, block_t, block_k, block_n, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -183,7 +221,7 @@ def _gm_bwd(opts, res, dy):
 _gm.defvjp(_gm_fwd, _gm_bwd)
 
 
-def grouped_matmul(lhs, rhs, block_expert, block_t=128, block_k=512, block_n=512,
+def grouped_matmul(lhs, rhs, block_expert, block_t=128, block_k=None, block_n=None,
                    interpret=False):
     """Differentiable grouped matmul: gmm forward; backward dx via gmm
     against the transposed expert weights, dw via tgmm. ``block_expert`` is

@@ -21,14 +21,71 @@ reference, tests/unit/inference/v2/kernels).
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
+def _contiguity_ok(seq_idx, S: int) -> bool:
+    """True when the tiled grid's layout contract holds: same-sequence
+    tokens contiguous, at most S runs plus the trailing pad run. Traced
+    ``seq_idx`` (the jitted ragged step) is covered by the SplitFuse batch
+    layout invariant itself (``ragged_wrapper.finalize``)."""
+    if seq_idx is None or isinstance(seq_idx, jax.core.Tracer):
+        return True
+    s = np.asarray(seq_idx)
+    runs = 1 + int(np.count_nonzero(s[1:] != s[:-1])) if s.size else 1
+    return runs <= S + 1
+
+
+def _resolve_q_tile(T: int, S: int, seq_idx=None) -> int:
+    """Resolve the q-tile through the kernel-config registry
+    (``autotuning/kernel_config.py``), falling back to the shape heuristic:
+    tile only batches with real multi-token chunks (T well beyond the seq
+    count — pure-decode batches have one token per sequence, where tiling
+    pays q-DMA for masked rows and buys no KV-stream amortization).
+
+    The tiled grid requires same-sequence tokens to be CONTIGUOUS in the
+    batch (the SplitFuse/ragged layout invariant — ``ragged_wrapper.finalize``
+    packs per-sequence chunks back to back). When ``seq_idx`` is concrete the
+    contract is verified here and tiling is demoted to per-token on
+    violation; traced callers (the jitted ragged step) are covered by the
+    layout invariant itself.
+    """
+    from ...autotuning.kernel_config import shape_bucket, tuned_tile
+
+    # DS_TPU_PAGED_Q_TILE: operator kill switch / override. The tiled grid's
+    # Mosaic lowering surfaces failures at the OUTER jit compile on the
+    # serving path (the in-wrapper ladder can't catch them there) — =1 pins
+    # the proven per-token grid without authoring a kernel_config.json.
+    env = os.environ.get("DS_TPU_PAGED_Q_TILE")
+    if env:
+        try:
+            qt = max(1, int(env))
+        except ValueError:
+            qt = 1
+        return qt if _contiguity_ok(seq_idx, S) else 1
+
+    prefill_ish = T >= 64 and T >= 2 * max(S, 1)
+    default = 8 if prefill_ish else 1
+    # lookup order: exact (T, S) bucket, then — for prefill-ish shapes
+    # ONLY — the T-only bucket the sweep records (S here is block-table
+    # CAPACITY, which varies per deployment, so T generalizes over it). A
+    # pure-decode shape (one token per sequence) must never inherit a
+    # prefill-tuned tile from the T-only key: every tile would carry qt-1
+    # masked slots for zero KV amortization.
+    fallback = int(tuned_tile("paged_attention", shape_bucket(T=T), "q_tile",
+                              default)) if prefill_ish else default
+    qt = int(tuned_tile("paged_attention", shape_bucket(T=T, S=S), "q_tile", fallback))
+    if qt > 1 and not _contiguity_ok(seq_idx, S):
+        return 1
+    return max(qt, 1)
+
+
 def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, window=None,
-                    alibi=None, k_scale=None, v_scale=None):
+                    alibi=None, k_scale=None, v_scale=None, q_tile=None):
     """q: [T, nq, d]; k_pool/v_pool: [pool_len, nkv, d] (one layer,
     pool_len = num_blocks*block_size, may include one trailing scratch slot);
     block_tables: [S, max_blocks]; seq_idx/pos: [T].
@@ -39,9 +96,14 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: i
     scales [nkv, pool_len] hold one fp32 absmax/127 factor per (kv-head,
     slot); dequant happens at the kernel's tile read, so only int8 bytes
     stream from HBM.
+    ``q_tile``: tokens per q-tile grid row (None = kernel-config registry,
+    then shape heuristic). q_tile > 1 packs contiguous same-sequence tokens
+    into one grid row so each KV block streams from HBM once per TILE
+    instead of once per token — the prefill-chunk amortization win.
     Returns [T, nq, d]."""
     T, nq, d = q.shape
     nkv = k_pool.shape[1]
+    S = block_tables.shape[0]
     if window is not None:
         window = int(window)
     if jax.default_backend() != "tpu" or nq < 8 or d % 128 != 0:
@@ -54,17 +116,32 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: i
                          "nq>=8, d%128==0) — serving through the DENSE gather fallback")
         return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
                                          window=window, alibi=alibi, k_scale=k_scale, v_scale=v_scale)
-    try:
-        return _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx.astype(jnp.int32), pos.astype(jnp.int32),
-                             block_size=block_size, window=window,
-                             alibi=tuple(np.asarray(alibi).tolist()) if alibi is not None else None,
-                             k_scale=k_scale, v_scale=v_scale)
-    except Exception as e:  # pragma: no cover — kernel bring-up safety net
+    if q_tile is None:
+        q_tile = _resolve_q_tile(T, S, seq_idx)
+    elif q_tile > 1 and not _contiguity_ok(seq_idx, S):
+        # an explicit q_tile must not bypass the layout contract: a
+        # non-contiguous batch would overflow the tiled grid's static tile
+        # bound and silently scatter tokens into the wrong tiles
         from ...utils.logging import warning_once
 
-        warning_once(f"pallas paged attention unavailable ({type(e).__name__}: {e}); using gather fallback")
-        return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
-                                         window=window, alibi=alibi, k_scale=k_scale, v_scale=v_scale)
+        warning_once(f"paged attention: q_tile={q_tile} requested but seq_idx is not "
+                     "sequence-contiguous — demoting to the per-token grid")
+        q_tile = 1
+    alibi_t = tuple(np.asarray(alibi).tolist()) if alibi is not None else None
+    # failure ladder: q-tiled -> per-token -> gather oracle. A tiling that
+    # fails Mosaic on some generation costs ONE rung, never the fused path.
+    for qt in dict.fromkeys((int(q_tile), 1)):
+        try:
+            return _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx.astype(jnp.int32),
+                                 pos.astype(jnp.int32), block_size=block_size, window=window,
+                                 alibi=alibi_t, k_scale=k_scale, v_scale=v_scale, q_tile=qt)
+        except Exception as e:  # pragma: no cover — kernel bring-up safety net
+            from ...utils.logging import warning_once
+
+            warning_once(f"pallas paged attention (q_tile={qt}) unavailable "
+                         f"({type(e).__name__}: {e}); trying next rung")
+    return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
+                                     window=window, alibi=alibi, k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int,
@@ -98,9 +175,18 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, blo
     return out.reshape(T, nq, d).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "interpret", "window", "alibi"))
+def _slopes_rows(alibi, reps):
+    """Per-head alibi slopes as kernel rows [len(alibi)*reps, 1], built from
+    Python floats: each ``jnp.full`` embeds a SCALAR constant, which Pallas
+    accepts — a closure-captured ``jnp.asarray(tuple)`` array is rejected at
+    kernel trace ("captures constants ... pass them as inputs"), which
+    silently broke the per-token alibi path before this helper."""
+    return jnp.concatenate([jnp.full((reps, 1), float(a), jnp.float32) for a in alibi], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret", "window", "alibi", "q_tile"))
 def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, interpret: bool = False,
-                  window=None, alibi=None, k_scale=None, v_scale=None):
+                  window=None, alibi=None, k_scale=None, v_scale=None, q_tile: int = 1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -119,6 +205,12 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
         ks2 = k_scale[:, :n_pool_blocks * block_size]
         vs2 = v_scale[:, :n_pool_blocks * block_size]
     scale = 1.0 / math.sqrt(d)
+
+    if q_tile and q_tile > 1:
+        return _paged_q_tiled(pl, pltpu, q, k4, v4, block_tables, seq_idx, pos,
+                              ks2 if quant else None, vs2 if quant else None,
+                              block_size=block_size, q_tile=int(q_tile), window=window,
+                              alibi=alibi, interpret=interpret)
 
     grid = (T, max_blocks)
 
@@ -172,8 +264,7 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
             s = jnp.concatenate(s_heads, axis=0)  # [nq, bs]
             kpos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, (nq, block_size), 1)
             if alibi is not None:
-                slopes = jnp.asarray(alibi, jnp.float32)[:, None]
-                s = s + slopes * (kpos - my_pos).astype(jnp.float32)
+                s = s + _slopes_rows(alibi, 1) * (kpos - my_pos).astype(jnp.float32)
             vis = kpos <= my_pos
             if window is not None:
                 vis = jnp.logical_and(vis, my_pos - kpos < window)
@@ -222,3 +313,159 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
     )
     return pl.pallas_call(kernel, grid_spec=grid_spec, out_shape=jax.ShapeDtypeStruct((T, nq, d), q.dtype),
                           interpret=interpret)(seq_idx, pos, block_tables, *operands)
+
+
+def _paged_q_tiled(pl, pltpu, q, k4, v4, block_tables, seq_idx, pos, ks2, vs2,
+                   block_size: int, q_tile: int, window, alibi, interpret: bool):
+    """Q-tiled grid: ``(n_tiles, max_blocks)`` where each tile packs up to
+    ``q_tile`` CONTIGUOUS same-sequence tokens, so every KV block streams
+    from HBM once per *tile* instead of once per token — a 256-token prefill
+    chunk at q_tile=8 reads each of its KV blocks 32x instead of 256x.
+
+    Tile assembly happens in jnp-land (traced, static shapes): a segmented
+    tiling over the ragged batch — tiles never span a sequence boundary, so
+    one block-table row serves the whole grid row. ``n_tiles`` is the static
+    upper bound ceil(T/q_tile) + S + 1 (interior splits + one ragged tail
+    tile per sequence run + the trailing pad run); unused tiles carry
+    ``max_pos = -1`` and every kv step skips them. Ragged tile tails ride the
+    existing per-token ``pl.when``/position masking (invalid slots get
+    pos = -1, masking every context position). int8-KV dequant, alibi and
+    sliding window are preserved bit-for-bit from the per-token grid.
+    """
+    T, nq, d = q.shape
+    nkv = k4.shape[2]
+    g = nq // nkv
+    S, max_blocks = block_tables.shape
+    qt = int(q_tile)
+    quant = ks2 is not None
+    scale = 1.0 / math.sqrt(d)
+    n_tiles = -(-T // qt) + S + 1
+
+    # --- segmented tile descriptors (contiguity contract: see paged_attention) ---
+    tok = jnp.arange(T, dtype=jnp.int32)
+    newrun = jnp.concatenate([jnp.ones((1, ), bool), seq_idx[1:] != seq_idx[:-1]])
+    run_start = jax.lax.associative_scan(jnp.maximum, jnp.where(newrun, tok, 0))
+    within = tok - run_start                      # offset inside this token's run
+    tile_id = jnp.cumsum((within % qt == 0).astype(jnp.int32)) - 1   # [T]
+    slot = within % qt
+
+    tile_tok = jnp.zeros((n_tiles, qt), jnp.int32).at[tile_id, slot].set(tok)
+    valid = jnp.zeros((n_tiles, qt), bool).at[tile_id, slot].set(True)
+    pos_t = jnp.where(valid, pos[tile_tok], -1)                      # [n_tiles, qt]
+    tile_seq = jnp.where(valid[:, 0], seq_idx[tile_tok[:, 0]], 0)    # [n_tiles]
+    tile_max = jnp.max(pos_t, axis=1)                                # -1 for empty tiles
+    tile_min = jnp.min(jnp.where(valid, pos_t, jnp.int32(2**30)), axis=1)
+
+    # head-major tile layout [n_tiles, nq, qt, d]: the kernel's row view
+    # (nq*qt, d) then keeps each kv-head's g*qt query rows contiguous
+    q_t = q[tile_tok.reshape(-1)].reshape(n_tiles, qt, nq, d).transpose(0, 2, 1, 3)
+
+    R = nq * qt
+    grid = (n_tiles, max_blocks)
+
+    def q_map(i, j, seq_ref, max_ref, min_ref, bt_ref):
+        return (i, 0, 0, 0)
+
+    def kv_map(i, j, seq_ref, max_ref, min_ref, bt_ref):
+        # clamp j into the tile's live range (same Mosaic idiom as the
+        # per-token grid: skipped steps re-use the resident block)
+        hi = jnp.maximum(max_ref[i], 0) // block_size
+        jj = jnp.minimum(j, hi)
+        if window is not None:
+            lo = jnp.maximum(jnp.maximum(min_ref[i], 0) - (window - 1), 0) // block_size
+            jj = jnp.maximum(jj, jnp.minimum(lo, hi))
+        return (bt_ref[seq_ref[i], jj], 0, 0, 0)
+
+    def pos_map(i, j, seq_ref, max_ref, min_ref, bt_ref):
+        return (i, 0)
+
+    def scale_map(i, j, seq_ref, max_ref, min_ref, bt_ref):
+        return (0, kv_map(i, j, seq_ref, max_ref, min_ref, bt_ref)[0])
+
+    def kernel(seq_ref, max_ref, min_ref, bt_ref, q_ref, k_ref, v_ref, pos_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+        else:
+            o_ref, acc_ref, m_ref, l_ref = rest
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, -1e30)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        my_max = max_ref[i]
+        in_window = j * block_size <= my_max  # empty tile: my_max = -1, always skipped
+        if window is not None:
+            in_window = jnp.logical_and(
+                in_window, (j + 1) * block_size - 1 > min_ref[i] - window)
+
+        @pl.when(in_window)
+        def _compute():
+            qr = q_ref[0].astype(jnp.float32).reshape(R, d) * scale  # rows r = h*qt + t
+            kb = k_ref[0].astype(jnp.float32)  # [bs, nkv, d]
+            vb = v_ref[0].astype(jnp.float32)
+            if quant:  # dequant at the VMEM tile — HBM only streamed int8
+                kb = kb * ks_ref[...].T[:, :, None]
+                vb = vb * vs_ref[...].T[:, :, None]
+            s_heads = []
+            for n in range(nkv):
+                s_heads.append(jax.lax.dot(qr[n * g * qt:(n + 1) * g * qt], kb[:, n, :].T))
+            s = jnp.concatenate(s_heads, axis=0)  # [R, bs]
+            pos_vec = pos_ref[0]                  # [qt]; -1 on invalid slots
+            my_pos = jnp.broadcast_to(pos_vec[None, :], (nq, qt)).reshape(R, 1)
+            kpos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, (R, block_size), 1)
+            if alibi is not None:
+                s = s + _slopes_rows(alibi, qt) * (kpos - my_pos).astype(jnp.float32)
+            vis = kpos <= my_pos
+            if window is not None:
+                vis = jnp.logical_and(vis, my_pos - kpos < window)
+            s = jnp.where(vis, s, -1e30)
+            m_prev = m_ref[:]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            ctx_heads = []
+            for n in range(nkv):
+                ctx_heads.append(jax.lax.dot(p[n * g * qt:(n + 1) * g * qt], vb[:, n, :]))
+            acc_ref[:] = acc_ref[:] * alpha + jnp.concatenate(ctx_heads, axis=0)
+            m_ref[:] = m_new
+
+        @pl.when(j == max_blocks - 1)
+        def _finalize():
+            out = acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+            o_ref[0] = out.reshape(nq, qt, d).astype(o_ref.dtype)
+
+    in_specs = [
+        pl.BlockSpec((1, nq, qt, d), q_map),
+        pl.BlockSpec((1, block_size, nkv, d), kv_map),
+        pl.BlockSpec((1, block_size, nkv, d), kv_map),
+        pl.BlockSpec((1, qt), pos_map),
+    ]
+    operands = [q_t, k4, v4, pos_t]
+    if quant:
+        in_specs += [pl.BlockSpec((nkv, block_size), scale_map),
+                     pl.BlockSpec((nkv, block_size), scale_map)]
+        operands += [ks2, vs2]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, nq, qt, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((R, d), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+        ],
+    )
+    out_t = pl.pallas_call(kernel, grid_spec=grid_spec,
+                           out_shape=jax.ShapeDtypeStruct((n_tiles, nq, qt, d), q.dtype),
+                           interpret=interpret)(tile_seq, tile_max, tile_min, block_tables,
+                                                *operands)
+    # scatter tiles back to token order
+    flat = out_t.transpose(0, 2, 1, 3).reshape(n_tiles * qt, nq, d)
+    return flat[tile_id * qt + slot]

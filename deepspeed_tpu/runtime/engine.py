@@ -230,6 +230,39 @@ class DeepSpeedEngine:
                 f"like models.transformer.TransformerLM); {hint}")
         if wants_model_qwz:
             log_dist("ZeRO++ qwZ: per-layer weight gathers quantized to int8 (model-level)", ranks=[0])
+        # Explicit ZeRO-3 gather/compute overlap: an EXPLICIT
+        # zero_optimization.overlap_comm=true in the user's JSON makes the
+        # scan double-buffer next-layer param gathers (transformer.py). The
+        # zero-config default (True at stage 3, reference parity) keeps the
+        # legacy implicit XLA overlap — flipping every stage-3 run's schedule
+        # silently would change memory behavior without consent. Mutually
+        # exclusive with qwZ/hpZ, which own their own gather paths. Synced
+        # (set or cleared) like quantized_weights above.
+        raw_overlap = (config.param_dict.get("zero_optimization") or {}).get("overlap_comm")
+        if raw_overlap is True and config.zero_optimization_stage != 3:
+            # reference overlap_comm is primarily a stage-1/2 grad-reduction
+            # knob; on TPU that overlap is XLA-scheduled — say so instead of
+            # silently ignoring a ported config's setting
+            logger.warning(f"zero_optimization.overlap_comm=true at stage "
+                           f"{config.zero_optimization_stage}: gradient-reduction overlap is "
+                           "XLA-scheduled on TPU; the explicit gather schedule applies at "
+                           "stage 3 only — knob has no effect here")
+        if raw_overlap is True and config.zero_optimization_stage == 3 \
+                and (wants_model_qwz or self._hpz):
+            logger.warning("zero_optimization.overlap_comm=true: ZeRO++ "
+                           f"({'qwZ' if wants_model_qwz else 'hpZ'}) owns its own gather "
+                           "schedule — the explicit double-buffered overlap is disabled")
+        wants_overlap = (config.zero_optimization_stage == 3 and raw_overlap is True
+                         and not wants_model_qwz and not self._hpz)
+        if mcfg is not None and hasattr(mcfg, "overlap_gather"):
+            mcfg.overlap_gather = wants_overlap
+        elif wants_overlap:
+            logger.warning("zero_optimization.overlap_comm=true: model has no overlap_gather "
+                           "flag; keeping XLA's implicit latency-hiding overlap")
+            wants_overlap = False
+        if wants_overlap:
+            log_dist("ZeRO-3 overlap_comm: explicit double-buffered next-layer param "
+                     "all-gather schedule enabled", ranks=[0])
         if self._hpz:
             log_dist(f"ZeRO++ hpZ: secondary weight shard over the {self.mesh.shape[DATA_AXIS]}-wide "
                      f"'data' group, {self.mesh.shape.get(DATA_REPL_AXIS, 1)} groups"

@@ -27,6 +27,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from tier-1 (-m 'not slow')")
+
+
 def pytest_addoption(parser):
     parser.addoption("--smoke", action="store_true", default=False,
                      help="run only the ~5-minute smoke subset (tests/smoke.txt): "

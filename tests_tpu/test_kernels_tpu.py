@@ -308,6 +308,45 @@ def test_paged_attention_int8_kv_on_chip():
                                atol=2e-2, rtol=2e-2)
 
 
+def test_paged_attention_q_tiled_on_chip():
+    """Q-tiled paged kernel on real TPU (the PR 10 prefill-amortization
+    grid): mixed prefill+decode batch with ragged tile tails vs the gather
+    reference, bf16 and int8-KV, Mosaic-compiled (the interpret-mode parity
+    matrix in tests/test_kernel_tuning.py cannot see lowering bugs)."""
+    rng = np.random.default_rng(17)
+    nq, nkv, d, bs, NB = 16, 16, 128, 128, 8
+    pool_len = NB * bs
+    # seq 0: 21-token prefill chunk (ragged tail at q_tile=8); seq 1: decode
+    seq_idx = jnp.asarray([0] * 21 + [1] * 3, jnp.int32)
+    pos = jnp.asarray(list(range(40, 61)) + [100, 101, 102], jnp.int32)
+    T = int(seq_idx.shape[0])
+    q = jnp.asarray(rng.normal(size=(T, nq, d)), jnp.bfloat16)
+    tables = jnp.asarray(rng.permutation(NB).reshape(2, 4), jnp.int32)
+
+    kf = rng.normal(size=(pool_len, nkv, d)).astype(np.float32)
+    vf = rng.normal(size=(pool_len, nkv, d)).astype(np.float32)
+    k_pool = jnp.asarray(kf, jnp.bfloat16)
+    v_pool = jnp.asarray(vf, jnp.bfloat16)
+    ref = paged_attention_reference(q, k_pool, v_pool, tables, seq_idx, pos, bs)
+    for qt in (8, 16):
+        out = _pallas_paged(q, k_pool, v_pool, tables, seq_idx, pos, block_size=bs, q_tile=qt)
+        np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                                   atol=5e-2, rtol=5e-2, err_msg=f"q_tile={qt}")
+
+    # int8-KV through the tiled grid
+    ks = np.maximum(np.abs(kf).max(-1) / 127.0, 1e-8)
+    vs = np.maximum(np.abs(vf).max(-1) / 127.0, 1e-8)
+    k8 = jnp.asarray(np.round(kf / ks[..., None]), jnp.int8)
+    v8 = jnp.asarray(np.round(vf / vs[..., None]), jnp.int8)
+    ksT, vsT = jnp.asarray(ks.T), jnp.asarray(vs.T)
+    ref8 = paged_attention_reference(q, k8, v8, tables, seq_idx, pos, bs,
+                                     k_scale=ksT, v_scale=vsT)
+    out8 = _pallas_paged(q, k8, v8, tables, seq_idx, pos, block_size=bs, q_tile=8,
+                         k_scale=ksT, v_scale=vsT)
+    np.testing.assert_allclose(np.asarray(out8, np.float32), np.asarray(ref8, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
 def test_v2_engine_serving_on_chip_bf16_and_int8():
     """Engine-level on-chip smoke of the composed ragged program (embed +
     quantized scatter + paged kernel + multi-step decode scan) — the exact

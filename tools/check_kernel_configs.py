@@ -1,0 +1,157 @@
+"""Static check: tuned Pallas kernels consult the kernel-config registry,
+never hardcode tile sizes, and keep a reference-oracle fallback.
+
+The tuning pass (``autotuning/kernel_config.py``) only works if every tuned
+``pallas_call`` site actually ASKS the registry for its tiles — a hardcoded
+``block_q=1024`` default silently pins the kernel to one chip generation and
+rots the persisted sweep (the op_builder lesson from the reference: tuned
+kernels are a subsystem, not a constant). This AST walk (no package imports,
+runs anywhere; tier-1 via ``tests/test_kernel_tuning.py``) enforces, for
+every module in ``TUNED_KERNELS``:
+
+  1. each public entrypoint's tile parameters default to ``None`` (the
+     registry-resolution sentinel) — an int literal default is the rot;
+  2. the module calls ``tuned_tile(...)`` (the one registry API);
+  3. the module defines or imports a ``*reference*`` oracle — every tuned
+     kernel keeps a numerics fallback/oracle path. (The interpret-mode
+     parity tests in ``tests/test_kernel_tuning.py`` & friends prove the
+     oracle is real; kernels whose wrappers run eagerly — flash, paged —
+     additionally call it as a runtime fallback.)
+
+Drift catch: any OTHER module under ``ops/pallas`` that contains a
+``pallas_call`` and gives a tile-named parameter (block_q/block_k/block_n/
+q_tile) an int default >= 8 must either join TUNED_KERNELS or the justified
+ALLOWLIST below.
+"""
+
+import ast
+import os
+import sys
+
+PALLAS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                          "deepspeed_tpu", "ops", "pallas")
+
+# module -> {entrypoint: [tile params that must default to None]}
+TUNED_KERNELS = {
+    "flash_attention.py": {"flash_attention": ["block_q", "block_k"]},
+    "paged_attention.py": {"paged_attention": ["q_tile"]},
+    "grouped_matmul.py": {"gmm": ["block_k", "block_n"],
+                          "tgmm": ["block_k", "block_n"],
+                          "grouped_matmul": ["block_k", "block_n"]},
+}
+
+# tile-named params the drift catch watches in NEW/untuned kernels
+TILE_PARAM_NAMES = {"block_q", "block_k", "block_n", "q_tile"}
+
+# untuned kernels with hardcoded tiles, each with a reason they are exempt:
+ALLOWLIST = {
+    # evoformer: AF2 side workload, shapes fixed by the pair representation —
+    # not on the serving/training hot path the tuner targets
+    "evoformer_attention.py",
+    # block-sparse: the BLOCK is the sparsity layout's semantic unit (from the
+    # SparsityConfig), not a free performance tile
+    "block_sparse_attention.py",
+}
+
+
+def _int_default(node):
+    return isinstance(node, ast.Constant) and isinstance(node.value, int) \
+        and not isinstance(node.value, bool)
+
+
+def _arg_defaults(fn: ast.FunctionDef):
+    """{param_name: default_node} over positional + kw-only args."""
+    out = {}
+    pos = fn.args.args
+    for arg, dflt in zip(pos[len(pos) - len(fn.args.defaults):], fn.args.defaults):
+        out[arg.arg] = dflt
+    for arg, dflt in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if dflt is not None:
+            out[arg.arg] = dflt
+    return out
+
+
+def _module_calls(tree, name_contains):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            called = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if name_contains in called:
+                return True
+    return False
+
+
+def _has_reference_oracle(tree):
+    """A ``*reference*`` oracle is defined or imported at module level."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and "reference" in node.name:
+            return True
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if "reference" in (alias.asname or alias.name):
+                    return True
+    return False
+
+
+def check(pallas_dir=PALLAS_DIR):
+    """Return a list of violation strings (empty = clean)."""
+    problems = []
+    for fname in sorted(os.listdir(pallas_dir)):
+        if not fname.endswith(".py") or fname == "__init__.py":
+            continue
+        path = os.path.join(pallas_dir, fname)
+        with open(path) as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+        fns = {n.name: n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+        if fname in TUNED_KERNELS:
+            for entry, params in TUNED_KERNELS[fname].items():
+                fn = fns.get(entry)
+                if fn is None:
+                    problems.append(f"{fname}: tuned entrypoint {entry}() missing")
+                    continue
+                defaults = _arg_defaults(fn)
+                for p in params:
+                    d = defaults.get(p)
+                    if d is None and p not in defaults:
+                        problems.append(f"{fname}: {entry}() lost its '{p}' tile parameter")
+                    elif _int_default(d):
+                        problems.append(
+                            f"{fname}: {entry}(..., {p}={d.value}) hardcodes a tile size — "
+                            f"default must be None (resolved via tuned_tile)")
+            if not _module_calls(tree, "tuned_tile"):
+                problems.append(f"{fname}: never consults the kernel-config registry "
+                                "(no tuned_tile(...) call)")
+            if not _has_reference_oracle(tree):
+                problems.append(f"{fname}: no reference-oracle fallback (define/import and "
+                                "call a '*reference*' implementation)")
+        elif fname not in ALLOWLIST and "pallas_call" in src:
+            for name, fn in fns.items():
+                for p, d in _arg_defaults(fn).items():
+                    if p in TILE_PARAM_NAMES and _int_default(d) and d.value >= 8:
+                        problems.append(
+                            f"{fname}: {name}(..., {p}={d.value}) — new kernel hardcodes a "
+                            "tile size; route it through autotuning/kernel_config.tuned_tile "
+                            "or add a justified ALLOWLIST entry")
+    return problems
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    path = argv[0] if argv else PALLAS_DIR
+    problems = check(path)
+    if problems:
+        print("check_kernel_configs: FAILED")
+        for p in problems:
+            print("  " + p)
+        return 1
+    print(f"check_kernel_configs: {len(TUNED_KERNELS)} tuned kernels registry-routed, "
+          "reference fallbacks present, no hardcoded tiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,9 @@ PUBLIC_COLLECTIVES = (
     "send",
     "recv",
     "barrier",
+    # the explicit ZeRO-3 overlap gather (zero_optimization.overlap_comm)
+    # must stay on the same observability surface as the torch-parity ops
+    "zero3_params_allgather",
 )
 
 DEFAULT_COMM_PY = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
