@@ -933,6 +933,48 @@ def run_bench():
         h.shutdown()
         _free_engine(h_engine, "state")
 
+    # --cache: memory & KV-cache observability plane (ISSUE 11) — the
+    # cache_pressure workload runs a Zipf corpus ~4x an undersized block
+    # pool and reports the measured hit rate against the MRC estimator's 1x
+    # prediction (its live accuracy check), block-lifecycle percentiles and
+    # fragmentation, plus the process-wide HBM attribution captured while
+    # the engine is live. Outside the headline timed window;
+    # DS_TPU_BENCH_CACHE=0 skips, failure never costs the headline.
+    cache_line = memory_line = None
+    if os.environ.get("DS_TPU_BENCH_CACHE", "1") != "0":
+        try:
+            from tools.serving_load import cache_pressure_bench
+
+            cp = cache_pressure_bench(on_tpu)
+            snap = cp["telemetry"]
+            cache_line = {
+                "mrc": cp["mrc"],
+                "mrc_predicted_1x": cp["mrc_predicted_1x"],
+                "measured_hit_rate": cp["measured_hit_rate"],
+                "mrc_abs_err_1x": cp["mrc_abs_err_1x"],
+                "block_age_p50_s": snap["block_age_s"]["p50"],
+                "evicted_block_age_p50_s": snap["evicted_block_age_s"]["p50"],
+                "reuse_interval_p50_s": snap["reuse_interval_s"]["p50"],
+                "fragmentation": snap["fragmentation"],
+                "evictions": cp["evictions"],
+                "evicted_tokens": cp["evicted_tokens"],
+                "cow_bytes": cp["cow_bytes"],
+            }
+            memory_line = cp["memory"]
+            mrc_line = " ".join(f"{k}={v}" for k, v in cp["mrc"].items())
+            print(f"# cache: measured_hit={cp['measured_hit_rate']} "
+                  f"mrc[{mrc_line}] err_1x={cp['mrc_abs_err_1x']} "
+                  f"evicted_age_p50={cache_line['evicted_block_age_p50_s']}s", flush=True)
+            sect = memory_line.get("sections", {})
+            print("# memory: " + " ".join(f"{k}={v / 2**20:.1f}MiB"
+                                          for k, v in sorted(sect.items()))
+                  + (f" unattributed={memory_line['unattributed_bytes'] / 2**20:.1f}MiB"
+                     if memory_line.get("unattributed_bytes") is not None else ""),
+                  flush=True)
+        except Exception as e:
+            print(f"# WARNING: cache bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+
     # --kernels: raw-speed microbench A/Bs (q-tiled paged attention, explicit
     # ZeRO-3 overlap, tuned-vs-default flash tiles). Outside the headline
     # timed window; DS_TPU_BENCH_KERNELS=0 skips, failure never costs the
@@ -1014,6 +1056,10 @@ def run_bench():
         line["checkpoint"] = ckpt_line
     if health_line is not None:
         line["health"] = health_line
+    if cache_line is not None:
+        line["cache"] = cache_line
+    if memory_line is not None:
+        line["memory"] = memory_line
     if not on_tpu:
         line["tpu_unavailable_reason"] = tpu_error or "no TPU device visible"
     if gate_note:

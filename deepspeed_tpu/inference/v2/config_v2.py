@@ -21,6 +21,29 @@ class DSStateManagerConfig:
 
 
 @dataclass
+class CacheTelemetryConfig:
+    """``ragged.prefix_cache.telemetry`` block: the memory & KV-cache
+    observability plane (``ragged/cache_telemetry.py``) — per-block
+    lifecycle accounting (allocate/publish/hit/evict/free, refcount
+    classes, block-age / reuse-interval / eviction-victim-age histograms,
+    occupancy + fragmentation gauges) and the online SHARDS miss-ratio-curve
+    estimator predicting the hit rate at {0.5x..8x} the current pool size.
+    Off by default with the PR 5 zero-overhead contract: absent/disabled ⇒
+    no telemetry objects anywhere, no threads, no per-block allocations —
+    every hook site is one ``is not None`` check (test-enforced in
+    ``tests/test_cache_telemetry.py``)."""
+    enabled: bool = False
+    # SHARDS key-sampling rate in (0, 1]: 1.0 tracks every chunk (exact
+    # stack distances), lower rates bound memory/CPU on hot admission paths
+    mrc_sample_rate: float = 0.25
+    # hard cap on tracked sampled keys; past it the coldest is dropped (its
+    # next access reads as a cold miss — an under-estimate, never a promise)
+    mrc_max_tracked: int = 4096
+    # capacity multipliers the MRC is evaluated at (x current pool blocks)
+    mrc_capacity_mults: tuple = (0.5, 1.0, 2.0, 4.0, 8.0)
+
+
+@dataclass
 class PrefixCacheConfig:
     """``ragged.prefix_cache`` block: block-granular KV reuse across requests
     (PagedAttention sharing + RadixAttention LRU tree). Off by default —
@@ -33,6 +56,10 @@ class PrefixCacheConfig:
     # minimum hit size (in blocks, COW tail included) worth taking: tiny
     # hits fragment the pool for negligible prefill savings
     min_hit_blocks: int = 1
+    # memory & cache observability plane (block lifecycle + MRC estimator);
+    # rides the prefix cache because the radix tree is what gives block
+    # reuse a lifecycle worth accounting
+    telemetry: CacheTelemetryConfig = field(default_factory=CacheTelemetryConfig)
 
 
 @dataclass

@@ -18,6 +18,7 @@ import numpy as np
 
 from ...monitor.flight import get_flight_recorder
 from ...monitor.health import get_health
+from ...monitor.memory import get_memory, tree_device_bytes
 from ...monitor.metrics import get_metrics
 from ...monitor.trace import get_tracer, observe_latency
 from ...utils.logging import log_dist
@@ -88,6 +89,17 @@ class InferenceEngineV2:
         # speculative-decoding lifetime totals (two int adds per verify
         # step; the gauge feeding off them only updates when metrics are on)
         self._spec_totals = {"drafted": 0, "accepted": 0}
+        # HBM attribution (monitor/memory.py): this engine's params + KV
+        # block pool enter the process-wide ledger. Weakly owned — a
+        # discarded engine self-prunes from the registry. A draft engine
+        # referenced by our speculative config re-files its bytes under
+        # `spec_draft_engine` so the decomposition names the sidecar cost.
+        self._memory_role = None
+        get_memory().register(f"engine_v2-{id(self)}",
+                              lambda eng: eng._memory_sections(), self)
+        draft = getattr(ic.speculative, "draft_engine", None)
+        if draft is not None and hasattr(draft, "set_memory_role"):
+            draft.set_memory_role("spec_draft_engine")
         # live-health plane: serving heartbeats (`serving` watchdog source,
         # armed per forward) + a /healthz section. One boolean per call when
         # the plane is off.
@@ -111,6 +123,34 @@ class InferenceEngineV2:
                         "available_blocks": eng.available_blocks}
 
             self._health.set_state_provider("serving", _serving_state)
+            if self.state_manager.cache_telemetry is not None:
+                # cache observability rides the same weakref discipline:
+                # MRC + refcount-class + occupancy gauges on /metrics, a
+                # full telemetry snapshot in every forensic dump. Names and
+                # labels are per-engine — a multi-replica gateway must show
+                # every replica's curve, not whichever registered last —
+                # and a collected engine self-unregisters its providers.
+                tag = f"cache_telemetry-{id(self):x}"
+                labels = {"engine": f"{id(self):x}"}
+
+                def _cache_rows():
+                    eng = ref()
+                    tel = eng.state_manager.cache_telemetry if eng is not None else None
+                    if tel is None:
+                        get_health().set_gauge_provider(tag, None)
+                        return []
+                    return tel.gauge_rows(labels=labels)
+
+                def _cache_dump():
+                    eng = ref()
+                    tel = eng.state_manager.cache_telemetry if eng is not None else None
+                    if tel is None:
+                        get_health().set_dump_provider(tag, None)
+                        return {"engine": "collected"}
+                    return tel.snapshot()
+
+                self._health.set_gauge_provider(tag, _cache_rows)
+                self._health.set_dump_provider(tag, _cache_dump)
         log_dist(
             f"InferenceEngineV2 ready: blocks={self.num_kv_blocks}x{bs} "
             f"kv={self.state_manager.kv_cache.memory_bytes()/2**20:.0f}MiB "
@@ -757,6 +797,28 @@ class InferenceEngineV2:
     def prefix_cache(self):
         """The :class:`PrefixKVCache` radix tree (None when disabled)."""
         return self.state_manager.prefix_cache
+
+    @property
+    def cache_telemetry(self):
+        """The :class:`CacheTelemetry` plane (None unless the
+        ``ragged.prefix_cache.telemetry`` block is enabled)."""
+        return self.state_manager.cache_telemetry
+
+    # -- HBM attribution (monitor/memory.py) ----------------------------
+    def _memory_sections(self):
+        # per-host shard bytes (the pools shard over the model axis under
+        # TP — the global logical size would over-count on multi-host)
+        kv_bytes = tree_device_bytes(self.state_manager.kv_cache.pools())
+        if self._memory_role is not None:
+            return {self._memory_role: tree_device_bytes(self.params) + kv_bytes}
+        return {"params": tree_device_bytes(self.params),
+                "kv_block_pool": kv_bytes}
+
+    def set_memory_role(self, role: Optional[str]) -> None:
+        """Re-file this engine's bytes under one named section (a
+        speculative draft engine reports as ``spec_draft_engine`` instead
+        of inflating the primary ``params``/``kv_block_pool`` rows)."""
+        self._memory_role = role
 
     def probe_prefix(self, prompt_tokens):
         """PURE prefix lookup (no references taken, no LRU touch, no stats):

@@ -1,4 +1,5 @@
 from .blocked_allocator import BlockedAllocator
+from .cache_telemetry import CacheTelemetry, MRCEstimator, chunk_key
 from .kv_cache import BlockedKVCache
 from .prefix_cache import PrefixKVCache, PrefixMatch
 from .ragged_manager import DSStateManager

@@ -35,6 +35,10 @@ class BlockedAllocator:
         self._free = num_blocks
         # holders per block: 0 = on the free list
         self._refcount = np.zeros(num_blocks, dtype=np.int64)
+        # cache-telemetry hook (``ragged/cache_telemetry.py``): None (the
+        # default) keeps every lifecycle event at a single attribute check —
+        # the zero-overhead-off contract
+        self.telemetry = None
 
     @property
     def free_blocks(self) -> int:
@@ -51,6 +55,11 @@ class BlockedAllocator:
             raise ValueError(f"invalid block id {b}")
         return int(self._refcount[b])
 
+    def refcount_snapshot(self) -> np.ndarray:
+        """Copy of the whole refcount table (telemetry's pool decomposition
+        reads it; a copy so callers can never corrupt the free-list math)."""
+        return self._refcount.copy()
+
     def allocate(self, num_blocks: int) -> np.ndarray:
         """Pop ``num_blocks`` block ids at refcount 1; raises ValueError when
         exhausted (reference ``blocked_allocator.py:50``)."""
@@ -64,6 +73,8 @@ class BlockedAllocator:
             self._head = self._next[self._head]
         self._free -= num_blocks
         self._refcount[out] = 1
+        if self.telemetry is not None:
+            self.telemetry.on_allocate(out)
         return out
 
     def incref(self, blocks: Union[int, Iterable[int]]) -> None:
@@ -77,6 +88,7 @@ class BlockedAllocator:
         """Drop one reference per block; a block returns to the free list only
         at refcount zero. Releasing an already-free block (double free) or a
         never-allocated id raises instead of corrupting the free list."""
+        freed = [] if self.telemetry is not None else None
         for b in self._as_ids(blocks):
             if self._refcount[b] == 0:
                 raise ValueError(f"double free of block {b}: block is already on the free list")
@@ -85,6 +97,10 @@ class BlockedAllocator:
                 self._next[b] = self._head
                 self._head = b
                 self._free += 1
+                if freed is not None:
+                    freed.append(b)
+        if freed:
+            self.telemetry.on_free(freed)
 
     # the historical name: one holder dropping its reference. Kept as an
     # exact alias so pre-refcount callers get the loud double-free guard

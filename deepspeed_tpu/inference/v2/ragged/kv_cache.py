@@ -95,6 +95,16 @@ class BlockedKVCache:
     def refcount(self, block) -> int:
         return self._allocator.refcount(block)
 
+    def refcount_snapshot(self):
+        """Copy of the whole refcount table (cache telemetry's pool
+        decomposition)."""
+        return self._allocator.refcount_snapshot()
+
+    def set_telemetry(self, telemetry) -> None:
+        """Arm (or with None, disarm) the allocator's lifecycle hooks —
+        the facade's only sanctioned route to them."""
+        self._allocator.telemetry = telemetry
+
     def copy_block(self, src: int, dst: int) -> None:
         """Device-side copy of one block's KV slots ``src`` → ``dst`` (the
         copy-on-write primitive: a sequence that must write into a SHARED
@@ -133,3 +143,9 @@ class BlockedKVCache:
         if self.quantized:
             n += 2 * self.k_scale.size * 4
         return n
+
+    def block_bytes(self) -> int:
+        """Device bytes one block occupies across all layers (K + V, scales
+        included on the int8 layout) — the unit of the prefix cache's
+        ``cow_bytes`` accounting and the MRC's capacity math."""
+        return self.memory_bytes() // self.num_blocks

@@ -29,6 +29,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ....monitor.metrics import get_metrics
+from .cache_telemetry import chunk_key
+
 
 class _Node:
     """One radix-tree edge = one full KV block: ``chunk`` (block_size token
@@ -68,7 +71,8 @@ class PrefixKVCache:
     is deterministic under test/bench replay.
     """
 
-    def __init__(self, kv_cache, min_hit_blocks: int = 1, eviction: str = "lru"):
+    def __init__(self, kv_cache, min_hit_blocks: int = 1, eviction: str = "lru",
+                 telemetry=None):
         if eviction != "lru":
             raise ValueError(f"unknown eviction policy {eviction!r}: 'lru'")
         if min_hit_blocks < 1:
@@ -77,6 +81,9 @@ class PrefixKVCache:
         self.block_size = kv_cache.block_size
         self.min_hit_blocks = int(min_hit_blocks)
         self.eviction = eviction
+        # block-lifecycle + MRC observability (``cache_telemetry.py``); None
+        # keeps every hook below at a single attribute check
+        self._telemetry = telemetry
         self._root = _Node(chunk=(), block=-1, parent=None)
         self._n_nodes = 0
         self._clock = 0  # monotonic LRU clock
@@ -87,8 +94,13 @@ class PrefixKVCache:
         # RLock: acquire() reaches evict() through _reserve_with_eviction.
         # Uncontended cost is ~100ns per op, noise against a forward.
         self._tree_lock = threading.RLock()
+        # evicted_tokens/cow_bytes: eviction used to count blocks only, so
+        # token-level cache-pressure math (serving_load, the MRC accuracy
+        # check) had to approximate — both also ride the Prometheus
+        # registry as cache/evicted_tokens + cache/cow_bytes counters
         self.stats = {"lookups": 0, "hits": 0, "cached_tokens": 0, "cow_copies": 0,
-                      "insertions": 0, "evictions": 0}
+                      "insertions": 0, "evictions": 0, "evicted_tokens": 0,
+                      "cow_bytes": 0}
 
     # -- queries -----------------------------------------------------------
     @property
@@ -189,19 +201,31 @@ class PrefixKVCache:
         allocation can trigger eviction, so eviction can never reclaim the
         blocks this very hit depends on."""
         tokens = np.asarray(tokens, dtype=np.int64).reshape(-1)
+        bs = self.block_size
         with self._tree_lock:
             self.stats["lookups"] += 1
             m = match if match is not None else self._match_locked(tokens)
+            if self._telemetry is not None:
+                # MRC demand feed: EVERY usable full-block chunk of the
+                # prompt is one reference (path-chained keys), hit or miss —
+                # cold misses belong in the miss-ratio denominator. Fed
+                # before the early return so refused hits still count.
+                key, keys = 0, []
+                for i in range((tokens.size - 1) // bs):
+                    key = chunk_key(key, tokens[i * bs:(i + 1) * bs])
+                    keys.append(key)
+                self._telemetry.record_lookup(keys, len(m.shared_blocks))
             if m.n_cached_tokens == 0:
                 return [], 0, 0
             # touch the matched path (LRU) and pin the shared run
             node = self._root
-            bs = self.block_size
             for i, b in enumerate(m.shared_blocks):
                 node = node.children[tuple(int(t) for t in np.asarray(tokens[i * bs:(i + 1) * bs]))]
                 self._touch(node)
             if m.shared_blocks:
                 self.kv_cache.incref(m.shared_blocks)
+                if self._telemetry is not None:
+                    self._telemetry.on_hit(m.shared_blocks)
             blocks = list(m.shared_blocks)
             n_cached = len(m.shared_blocks) * bs
             if m.cow_src is not None:
@@ -214,6 +238,9 @@ class PrefixKVCache:
                     blocks.append(dst)
                     n_cached += m.cow_tokens
                     self.stats["cow_copies"] += 1
+                    self.stats["cow_bytes"] += self.kv_cache.block_bytes()
+                    get_metrics().counter("cache/cow_bytes").inc(
+                        self.kv_cache.block_bytes())
             if n_cached == 0:
                 return [], 0, 0
             self.stats["hits"] += 1
@@ -250,10 +277,14 @@ class PrefixKVCache:
         if full <= getattr(seq, "published_blocks", 0):
             return 0
         with self._tree_lock:
+            tel = self._telemetry
             node = self._root
             inserted = 0
+            key, new_keys = 0, []
             for b in range(full):
                 chunk = tuple(int(t) for t in seq.token_history[b * bs:(b + 1) * bs])
+                if tel is not None:
+                    key = chunk_key(key, chunk)
                 child = node.children.get(chunk)
                 if child is None:
                     child = _Node(chunk=chunk, block=seq.kv_blocks[b], parent=node)
@@ -263,9 +294,18 @@ class PrefixKVCache:
                     self.stats["insertions"] += 1
                     self._touch(child)
                     inserted += 1
+                    if tel is not None:
+                        tel.on_publish(child.block)
+                        new_keys.append(key)
                 elif child.block != seq.kv_blocks[b]:
                     break  # a different writer owns this path from here down
                 node = child
+            if tel is not None and new_keys:
+                # capacity-consuming, non-demand MRC accesses: a request's
+                # uncached suffix / generated blocks entering the tree push
+                # reusable chains deeper in the modeled LRU stack without
+                # inflating the predicted hit rate
+                tel.record_inserts(new_keys)
             seq.published_blocks = full
             return inserted
 
@@ -298,6 +338,10 @@ class PrefixKVCache:
         live sequences merely lose the tree's reference."""
         with self._tree_lock:
             nodes = list(self._iter_nodes())
+            if self._telemetry is not None and nodes:
+                # a flush is not LRU pressure: drop the tree-held flags
+                # without recording eviction-victim ages
+                self._telemetry.on_tree_clear([n.block for n in nodes])
             for node in nodes:
                 self.kv_cache.release(node.block)
             self._root.children = {}
@@ -328,5 +372,11 @@ class PrefixKVCache:
     def _remove(self, node) -> None:
         assert not node.children, "only leaves are evictable"
         del node.parent.children[node.chunk]
+        # token-granular eviction accounting (tree nodes are FULL blocks by
+        # construction, so each eviction discards exactly block_size tokens)
+        self.stats["evicted_tokens"] += self.block_size
+        get_metrics().counter("cache/evicted_tokens").inc(self.block_size)
+        if self._telemetry is not None:
+            self._telemetry.on_evict(node.block)  # victim age BEFORE the free
         self.kv_cache.release(node.block)
         self._n_nodes -= 1

@@ -17,6 +17,7 @@ import numpy as np
 
 from ...config import DeepSpeedInferenceConfig  # noqa: F401  (parity import)
 from .blocked_allocator import BlockedAllocator  # noqa: F401
+from .cache_telemetry import CacheTelemetry
 from .kv_cache import BlockedKVCache
 from .prefix_cache import PrefixKVCache
 from .sequence_descriptor import DSSequenceDescriptor
@@ -32,10 +33,32 @@ class DSStateManager:
         self.kv_cache = BlockedKVCache(num_layers, num_kv_heads, head_dim, num_blocks, block_size, dtype=dtype,
                                        sharding=kv_sharding)
         self.prefix_cache: Optional[PrefixKVCache] = None
+        # memory & cache observability plane (``ragged.prefix_cache.telemetry``
+        # block): when absent/off, NO telemetry object exists anywhere and
+        # every hook in the allocator/tree stays one `is not None` check —
+        # the zero-overhead contract tests/test_cache_telemetry.py enforces
+        self.cache_telemetry: Optional[CacheTelemetry] = None
+        tel_cfg = getattr(prefix_cache_config, "telemetry", None) \
+            if prefix_cache_config is not None else None
         if prefix_cache_config is not None and getattr(prefix_cache_config, "enabled", False):
+            if tel_cfg is not None and getattr(tel_cfg, "enabled", False):
+                self.cache_telemetry = CacheTelemetry(self.kv_cache, config=tel_cfg)
+                self.cache_telemetry.occupancy_provider = self._occupancy
+                self.kv_cache.set_telemetry(self.cache_telemetry)
             self.prefix_cache = PrefixKVCache(self.kv_cache,
                                               min_hit_blocks=prefix_cache_config.min_hit_blocks,
-                                              eviction=prefix_cache_config.eviction)
+                                              eviction=prefix_cache_config.eviction,
+                                              telemetry=self.cache_telemetry)
+        elif tel_cfg is not None and getattr(tel_cfg, "enabled", False):
+            # the telemetry plane rides the prefix cache (blocks only have a
+            # reuse lifecycle once the radix tree shares them) — an enabled
+            # telemetry block under a disabled cache would otherwise vanish
+            # silently and cost someone a dashboard-debugging session
+            from ....utils.logging import logger
+
+            logger.warning("ragged.prefix_cache.telemetry.enabled=True ignored: "
+                           "the prefix cache itself is disabled — enable "
+                           "ragged.prefix_cache to arm cache telemetry")
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
 
     # -- queries -----------------------------------------------------------
@@ -57,6 +80,20 @@ class DSStateManager:
         if self.prefix_cache is not None:
             free += self.prefix_cache.evictable_blocks
         return free
+
+    def _occupancy(self):
+        """(used_token_slots, allocated_blocks) over live sequences — the
+        cache telemetry's fragmentation numerator/denominator. Tree-held
+        blocks are full by construction and excluded; the slack measured
+        here is exactly partial tails + decode-horizon headroom."""
+        used = allocated = 0
+        bs = self.block_size
+        # list(): the health exporter thread calls this mid-scrape while the
+        # replica driver mutates _seqs — iterating the live dict would raise
+        for seq in list(self._seqs.values()):
+            allocated += len(seq.kv_blocks)
+            used += min(seq.seen_tokens + seq.in_flight_tokens, len(seq.kv_blocks) * bs)
+        return used, allocated
 
     def query(self, uid: Optional[int] = None):
         """Reference ``engine_v2.query``-backing lookup: per-sequence state

@@ -13,3 +13,4 @@ from .metrics import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS_MS)
 from .flight import get_flight_recorder, FlightRecorder  # noqa: F401
 from .health import get_health, configure_health, HealthPlane  # noqa: F401
+from .memory import get_memory, hbm_report, tree_device_bytes, MemoryAttribution  # noqa: F401

@@ -141,6 +141,15 @@ class HealthPlane:
                                         capacity=knob("flight_capacity", None))
         get_tracer().set_mirror(get_flight_recorder())
         self._configure_comm_watch(True)
+        # HBM attribution rides every armed health plane: labelled
+        # memory/hbm_bytes{section=...} gauges on /metrics and a `memory`
+        # section in every forensic dump (engines register their byte
+        # providers at construction; with none registered the rows are
+        # simply absent)
+        from .memory import get_memory, hbm_report
+
+        self.set_gauge_provider("memory", get_memory().gauge_rows)
+        self.set_dump_provider("memory", hbm_report)
         self.enabled = True
 
         if any(v and v > 0 for v in self._deadlines.values()):

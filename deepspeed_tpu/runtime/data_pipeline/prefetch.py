@@ -120,7 +120,9 @@ def _worker(loader, prepare_fn, place_fn, gas, start_step, out_q, stop, name):
             placed = place_fn(batch) if place_fn is not None else batch
             reg = get_metrics()
             if reg.enabled:
-                reg.histogram("data/prefetch_assemble_ms").observe((time.perf_counter() - t0) * 1e3)
+                # train/ namespace per tools/check_metric_names.py (the old
+                # data/ prefix predated the approved prefix set)
+                reg.histogram("train/prefetch_assemble_ms").observe((time.perf_counter() - t0) * 1e3)
             tr = get_tracer()
             if tr.enabled:
                 tr.complete(f"{name}/assemble", t0, time.perf_counter() - t0, tid="data",

@@ -329,6 +329,14 @@ class DeepSpeedEngine:
         #     partition_parameters.py:762) ---
         self._rng = jax.random.PRNGKey(seed)
         self.state = self._init_state(example_batch)
+        # HBM attribution ledger (monitor/memory.py): params + optimizer/ZeRO
+        # shard bytes enter the process-wide decomposition hbm_report()
+        # serves (weakly owned; destroy() unregisters explicitly)
+        from ..monitor.memory import get_memory
+
+        self._memory_reg_name = f"train_engine-{id(self)}"
+        get_memory().register(self._memory_reg_name,
+                              lambda eng: eng._memory_sections(), self)
 
         # --- host offload optimizer (after state init: needs the params) ---
         self.host_optimizer = None
@@ -2251,6 +2259,18 @@ class DeepSpeedEngine:
         hidden re-slicing)."""
         self._data_post_process_func = fn
 
+    def _memory_sections(self):
+        """HBM attribution provider: live device bytes of the train state,
+        split params vs optimizer/ZeRO shards (host-offloaded masters live
+        in host RAM and are deliberately NOT HBM rows)."""
+        from ..monitor.memory import tree_device_bytes
+
+        state = self.state
+        if not isinstance(state, dict):
+            return {}
+        return {"params": tree_device_bytes(state.get("params")),
+                "optimizer": tree_device_bytes(state.get("opt_state"))}
+
     def destroy(self):
         """Release compiled executables, device state, accumulated grads and
         host optimizer masters (reference ``destroy`` — lets a process build
@@ -2282,6 +2302,9 @@ class DeepSpeedEngine:
         for pf in self._prefetchers:
             pf.close()  # stop workers + drop their queued device batches
         self._prefetchers = []
+        from ..monitor.memory import get_memory
+
+        get_memory().unregister(self._memory_reg_name)
         self._compiled = {}
         self.state = None
         self._grad_acc_buffer = None

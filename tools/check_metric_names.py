@@ -1,0 +1,160 @@
+"""Static check: metric-namespace discipline across the whole package.
+
+Companion to ``check_timed_ops.py`` / ``check_kv_blocks.py`` (same lesson:
+structural invariants rot silently unless CI asserts them). Four
+observability PRs accumulated metric names by convention only — and the
+convention had already drifted twice (``compile/*``, ``data/*``) before
+this gate pinned it. The rule: every ``counter`` / ``gauge`` / ``histogram``
+registration uses a ``subsystem/name`` snake_case literal whose subsystem
+comes from the approved prefix set:
+
+    train / serving / gateway / health / comm / checkpoint / cache / memory
+
+AST-checked with no package imports, so the gate runs anywhere:
+
+  * a literal first argument must match
+    ``^(<prefix>)/[a-z0-9_]+$`` exactly;
+  * an f-string first argument must START with an approved ``prefix/`` run
+    of snake_case (``f"health/stall_{source}_total"`` passes), and every
+    literal fragment must stay in the snake_case charset — dynamic
+    interpolation is for per-class/per-source suffixes, never the prefix;
+  * a fully dynamic name (a variable) is allowed ONLY in the allowlisted
+    plumbing modules that forward caller-validated names
+    (``monitor/trace.py``'s ``observe_latency`` tail,
+    ``serving/reqtrace.py``'s stage table). Anywhere else it is a
+    violation: pass the literal to the registration site, where this gate
+    can see it;
+  * ``observe_latency(..., hist_name="...", gauges={"...": ...})`` call
+    sites are validated too — that plumbing registers whatever it is
+    handed.
+
+A tier-1 test (``tests/test_cache_telemetry.py``) runs this on every CI
+pass.
+"""
+
+import ast
+import os
+import re
+import sys
+
+DEFAULT_PKG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                               "deepspeed_tpu")
+
+APPROVED_PREFIXES = ("train", "serving", "gateway", "health", "comm",
+                     "checkpoint", "cache", "memory")
+
+REGISTRATION_CALLS = ("counter", "gauge", "histogram")
+
+# modules whose registration sites legitimately take a VARIABLE name: they
+# are plumbing that forwards names already validated at the (literal)
+# caller site this gate checks
+DYNAMIC_ALLOWED = (
+    os.path.join("monitor", "trace.py"),
+    os.path.join("serving", "reqtrace.py"),
+)
+
+_FULL_NAME = re.compile(r"^(%s)/[a-z0-9_]+$" % "|".join(APPROVED_PREFIXES))
+_FSTRING_HEAD = re.compile(r"^(%s)/[a-z0-9_]*$" % "|".join(APPROVED_PREFIXES))
+_SNAKE_FRAGMENT = re.compile(r"^[a-z0-9_/]*$")
+
+
+def _literal_ok(name):
+    return bool(_FULL_NAME.match(name))
+
+
+def _joined_str_ok(node):
+    """f-string names: approved-prefix literal head, snake_case fragments."""
+    parts = node.values
+    if not parts or not isinstance(parts[0], ast.Constant) \
+            or not isinstance(parts[0].value, str):
+        return False
+    if not _FSTRING_HEAD.match(parts[0].value):
+        return False
+    for p in parts[1:]:
+        if isinstance(p, ast.Constant):
+            if not isinstance(p.value, str) or not _SNAKE_FRAGMENT.match(p.value):
+                return False
+    return True
+
+
+def _name_arg_violation(arg, rel, allow_dynamic):
+    """Reason string when a metric-name expression breaks the rule, else None."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        if not _literal_ok(arg.value):
+            return f"metric name {arg.value!r} not <approved-prefix>/snake_case"
+        return None
+    if isinstance(arg, ast.JoinedStr):
+        if not _joined_str_ok(arg):
+            return "f-string metric name must start with an approved 'prefix/' literal"
+        return None
+    if allow_dynamic:
+        return None
+    return "non-literal metric name outside the allowlisted plumbing modules"
+
+
+def find_violations(pkg_dir=DEFAULT_PKG_DIR):
+    """[(relpath, lineno, snippet, why)] for every off-convention
+    registration under the package tree."""
+    violations = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, pkg_dir)
+            allow_dynamic = rel in DYNAMIC_ALLOWED
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+            lines = src.splitlines()
+
+            def flag(node, why):
+                snippet = lines[node.lineno - 1].strip() if node.lineno <= len(lines) else ""
+                violations.append((rel, node.lineno, snippet, why))
+
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                # direct registrations: <registry>.counter/gauge/histogram(name)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in REGISTRATION_CALLS and node.args):
+                    why = _name_arg_violation(node.args[0], rel, allow_dynamic)
+                    if why:
+                        flag(node, why)
+                # plumbing call sites: hist_name= / gauges={...} keywords
+                for kw in node.keywords:
+                    if kw.arg == "hist_name" and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        if not _literal_ok(kw.value.value):
+                            flag(node, f"hist_name {kw.value.value!r} not "
+                                       "<approved-prefix>/snake_case")
+                    elif kw.arg == "gauges" and isinstance(kw.value, ast.Dict):
+                        for key in kw.value.keys:
+                            if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+                                    and not _literal_ok(key.value):
+                                flag(node, f"gauges key {key.value!r} not "
+                                           "<approved-prefix>/snake_case")
+    return violations
+
+
+def check(pkg_dir=DEFAULT_PKG_DIR):
+    """Return the violation list (empty = every registration is in-namespace)."""
+    return find_violations(pkg_dir)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    pkg_dir = argv[0] if argv else DEFAULT_PKG_DIR
+    bad = check(pkg_dir)
+    if bad:
+        print(f"check_metric_names: off-convention metric registrations in {pkg_dir}:")
+        for rel, lineno, snippet, why in bad:
+            print(f"  {rel}:{lineno}: {why}\n      {snippet}")
+        return 1
+    print("check_metric_names: every metric registration uses an approved "
+          "subsystem/name literal")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

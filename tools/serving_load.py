@@ -334,6 +334,120 @@ def shared_prefix_ab(on_tpu, n_requests=None, seed=0):
     return result
 
 
+def cache_pressure_bench(on_tpu, n_requests=None, seed=0, corpus_mult=4.0):
+    """Cache-pressure workload + the MRC estimator's live accuracy check
+    (ISSUE 11): a Zipf shared-prefix corpus deliberately sized at
+    ``corpus_mult``x the KV block pool, so the radix tree runs under real
+    eviction pressure, driven ONE REQUEST AT A TIME (the router_prefix_ab
+    discipline: each request's prefix is published before the next looks
+    up, so hit accounting measures CACHE behavior, not racing admissions —
+    which is also the reference-stream model the estimator assumes).
+
+    Reports the measured full-block hit rate vs the estimator's predicted
+    hit rate at 1x capacity (``mrc_abs_err_1x`` is the acceptance metric:
+    within 0.05 absolute, asserted in tests/test_cache_telemetry.py), the
+    full predicted curve at {0.5x..8x}, the block-lifecycle snapshot
+    (block age, eviction-victim age, fragmentation), and the process HBM
+    attribution while the engine is live."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.inference.v2 import (CacheTelemetryConfig, DSStateManagerConfig,
+                                            DynamicSplitFuseScheduler, InferenceEngineV2,
+                                            PrefixCacheConfig, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.monitor.memory import hbm_report
+
+    if on_tpu:
+        n = n_requests or 128
+        cfg = TransformerConfig(vocab_size=32000, hidden_size=1024, num_layers=6,
+                                num_heads=8, num_kv_heads=8, intermediate_size=2816,
+                                max_seq_len=2048, norm="rmsnorm", positions="rotary",
+                                mlp="swiglu", dtype=jnp.bfloat16, attention_impl="flash")
+        sm = DSStateManagerConfig(max_tracked_sequences=16, max_ragged_batch_size=512,
+                                  max_ragged_sequence_count=16, max_context=768)
+        block, pool = 128, 96
+        shape = dict(prefix_len=512, suffix_lo=16, suffix_hi=64, new_lo=8, new_hi=32)
+        budget = 512
+    else:
+        n = n_requests or 96
+        cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                                num_kv_heads=2, intermediate_size=128, max_seq_len=256,
+                                dtype=jnp.float32, attention_impl="reference")
+        sm = DSStateManagerConfig(max_tracked_sequences=8, max_ragged_batch_size=64,
+                                  max_ragged_sequence_count=8, max_context=64)
+        block, pool = 8, 48
+        shape = dict(prefix_len=40, suffix_lo=4, suffix_hi=10, new_lo=3, new_hi=6)
+        budget = 64
+    # corpus sized at corpus_mult x the pool: reuse only survives eviction
+    # for the Zipf head, exactly the regime the MRC exists to size
+    pool_tokens = pool * block
+    n_prefixes = max(2, int(round(corpus_mult * pool_tokens / shape["prefix_len"])))
+    icfg = RaggedInferenceEngineConfig(
+        kv_block_size=block, num_kv_blocks=pool,
+        kv_dtype="int8" if on_tpu else jnp.float32, state_manager=sm,
+        use_pallas_kernels="auto" if on_tpu else "never",
+        prefix_cache=PrefixCacheConfig(
+            enabled=True,
+            # the CPU smoke trace is a few hundred chunk refs over a 48-block
+            # pool — SHARDS sampling noise at that scale swamps the signal,
+            # so the smoke tracks every chunk (the sampled path is validated
+            # against exact LRU in tests/test_cache_telemetry.py); at TPU
+            # scale the trace is long enough for the production sample rate
+            telemetry=CacheTelemetryConfig(enabled=True,
+                                           mrc_sample_rate=0.25 if on_tpu else 1.0)))
+    engine = InferenceEngineV2(TransformerLM(cfg), icfg)
+    tel = engine.cache_telemetry
+    wl = make_shared_prefix_workload(n, n_prefixes=n_prefixes, rate_rps=None,
+                                     seed=seed, uid_base=0, zipf_a=1.2, **shape)
+    # warmup compiles the shape buckets on an all-unique stream, then the
+    # measured pass starts from a cold, zeroed cache
+    warm = make_shared_prefix_workload(max(4, n // 8), n_prefixes=n_prefixes,
+                                       rate_rps=None, seed=seed + 7, uid_base=90_000,
+                                       unique=True, **shape)
+    sched = DynamicSplitFuseScheduler(engine, token_budget=budget)
+    for r in warm:
+        sched.submit(r["uid"], r["prompt"], max_new_tokens=r["max_new_tokens"])
+        sched.run()
+    engine.prefix_cache.clear()
+    engine.prefix_cache.stats.update({k: 0 for k in engine.prefix_cache.stats})
+    tel.reset()
+
+    t0 = time.time()
+    for r in wl:  # strictly sequential: publish-before-next-lookup
+        sched.submit(r["uid"], r["prompt"], max_new_tokens=r["max_new_tokens"])
+        sched.run()
+    span = time.time() - t0
+
+    pc = engine.prefix_cache
+    snap = tel.snapshot()
+    measured = tel.mrc.observed_hit_rate
+    predicted_1x = tel.mrc.predict().get(1.0)
+    result = {
+        "config": "cache_pressure",
+        "n_requests": n,
+        "corpus_mult": corpus_mult,
+        "n_prefixes": n_prefixes,
+        "pool_blocks": pool,
+        "block_size": block,
+        "rps": round(n / span, 2),
+        # the live accuracy check: the estimator's 1x prediction vs the real
+        # cache's full-block hit rate over the SAME reference stream
+        "measured_hit_rate": round(measured, 4) if measured is not None else None,
+        "mrc_predicted_1x": round(predicted_1x, 4) if predicted_1x is not None else None,
+        "mrc_abs_err_1x": (round(abs(measured - predicted_1x), 4)
+                           if measured is not None and predicted_1x is not None else None),
+        "mrc": snap["mrc"],
+        "request_hit_rate": round(pc.hit_rate, 4),
+        "evictions": pc.stats["evictions"],
+        "evicted_tokens": pc.stats["evicted_tokens"],
+        "cow_copies": pc.stats["cow_copies"],
+        "cow_bytes": pc.stats["cow_bytes"],
+        "telemetry": snap,
+        # HBM attribution while the engine is live: the bench's memory{...}
+        "memory": hbm_report(),
+    }
+    return result
+
+
 def speculative_ab(on_tpu, n_requests=None, seed=0, k=4, mode="ngram", min_match=None):
     """Speculative-decoding A/B on the Zipf shared-prefix workload: the same
     request stream runs spec-off then spec-on (greedy → token-identical,
@@ -805,6 +919,8 @@ def main():
         out = speculative_ab(on_tpu)
     elif "gateway" in sys.argv[1:]:
         out = gateway_bench(on_tpu)
+    elif "cache_pressure" in sys.argv[1:]:
+        out = cache_pressure_bench(on_tpu)
     else:
         out = serving_load_bench(on_tpu)
     out["on_tpu"] = on_tpu
