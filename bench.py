@@ -975,6 +975,48 @@ def run_bench():
             print(f"# WARNING: cache bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
 
+    # --chaos: resilience drills (ISSUE 12) — the seeded training storm
+    # (kill/stall/straggle/preempt/collective-delay with warm-remesh
+    # restarts) and the serving replica-kill drill, reporting the drill
+    # VERDICTS plus recovery-time p50 per arm. Outside the headline timed
+    # window (the headline arms no chaos at all — the fire() points are
+    # no-ops); DS_TPU_BENCH_CHAOS=0 skips, failure never costs the headline.
+    chaos_line = None
+    if os.environ.get("DS_TPU_BENCH_CHAOS", "1") != "0":
+        try:
+            from deepspeed_tpu.parallel import groups as _groups
+            from tools.chaos_drill import serving_drill, training_drill
+
+            _groups.reset()
+            tr = training_drill(seed=7, steps=6)
+            _groups.reset()
+            sv = serving_drill(seed=3, n_requests=12, n_replicas=2)
+            _groups.reset()
+            chaos_line = {
+                "training": {
+                    "verdicts": {k: tr[k] for k in ("loss_parity", "resumed_tags_valid",
+                                                    "stall_dumps_match")},
+                    "events": tr["events"],
+                    "restarts": tr["restarts"],
+                    "warm_resumes": tr["warm_resumes"],
+                    "recovery_ms_p50": tr["recovery_ms_p50"],
+                },
+                "serving": {
+                    "verdicts": {k: sv[k] for k in ("zero_unreported", "retry_after_on_503",
+                                                    "replica_failure_counted",
+                                                    "readyz_flipped", "recovered")},
+                    "recovery_ms": sv["recovery_ms"],
+                },
+            }
+            print(f"# chaos: train[parity={tr['loss_parity']} tags_valid="
+                  f"{tr['resumed_tags_valid']} dumps={tr['stall_dumps_match']} "
+                  f"recover_p50={tr['recovery_ms_p50']}ms] serve[unreported="
+                  f"{0 if sv['zero_unreported'] else 'SOME'} "
+                  f"recover={sv['recovery_ms']}ms]", flush=True)
+        except Exception as e:
+            print(f"# WARNING: chaos bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+
     # --kernels: raw-speed microbench A/Bs (q-tiled paged attention, explicit
     # ZeRO-3 overlap, tuned-vs-default flash tiles). Outside the headline
     # timed window; DS_TPU_BENCH_KERNELS=0 skips, failure never costs the
@@ -1056,6 +1098,8 @@ def run_bench():
         line["checkpoint"] = ckpt_line
     if health_line is not None:
         line["health"] = health_line
+    if chaos_line is not None:
+        line["chaos"] = chaos_line
     if cache_line is not None:
         line["cache"] = cache_line
     if memory_line is not None:

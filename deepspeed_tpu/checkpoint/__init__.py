@@ -1,6 +1,6 @@
-from .ds_to_universal import ds_to_universal, UNIVERSAL_LAYOUT_VERSION
-from .universal_checkpoint import (load_universal_checkpoint, read_universal_checkpoint,
-                                   load_hp_checkpoint_state)
+from .ds_to_universal import ds_to_universal, universal_state_from_tree, UNIVERSAL_LAYOUT_VERSION
+from .universal_checkpoint import (apply_universal_state, load_universal_checkpoint,
+                                   read_universal_checkpoint, load_hp_checkpoint_state)
 from .zero_to_fp32 import (get_fp32_state_dict_from_zero_checkpoint,
                            convert_zero_checkpoint_to_fp32_state_dict, load_state_dict_from_zero_checkpoint)
 from .reshape_meg_2d import get_mpu_ranks, meg_2d_parallel_map, reshape_meg_2d_parallel

@@ -52,16 +52,23 @@ def _extract_adam_moments(opt_leaves_dict, params_tree):
     return None, None
 
 
-def ds_to_universal(checkpoint_dir, output_dir, tag=None):
-    """Convert; returns the number of parameters written (reference main)."""
+def universal_state_from_tree(tree):
+    """The in-memory core of the conversion: a checkpoint-state tree (the
+    exact shape ``engine._ckpt_state`` produces — ``module`` params,
+    numbered ``optimizer`` leaves, optional ``host_optimizer`` subtree,
+    ``scalars``, sidecar counters) to the per-parameter universal layout
+
+        ``({param_path: {"fp32", "exp_avg"?, "exp_avg_sq"?}}, meta)``
+
+    This is the reshape math the disk converter (:func:`ds_to_universal`)
+    and the elastic live remesh (``elasticity/remesh.py`` — snapshot a
+    LIVE engine, re-shard onto a new topology without touching disk) both
+    resolve through, so warm-remesh parity is pinned to the same code the
+    pp2×tp2 → pp1×tp4 bit-exactness test already proves.
+    """
     import jax
 
-    path = _resolve_tag(checkpoint_dir, tag)
-    tree = _restore_arrays(path)
     module = tree["module"]
-    zero_dir = os.path.join(output_dir, "zero")
-    os.makedirs(zero_dir, exist_ok=True)
-
     flat = _flat_paths(module)
     # Per-key Adam moments may come from TWO sources: the host_optimizer
     # subtree (ZeRO-Offload — full offload owns every key; twin-flow
@@ -99,37 +106,74 @@ def ds_to_universal(checkpoint_dir, output_dir, tag=None):
         logger.warning(f"optimizer moments found for {len(mu_by_key)}/{len(flat)} params; "
                        "universal ckpt will carry weights only")
 
+    sd = {}
     for key, leaf in flat:
-        pdir = os.path.join(zero_dir, key.replace("/", "."))
-        os.makedirs(pdir, exist_ok=True)
-        fp32 = masters[key] if key in masters else np.asarray(jax.device_get(leaf), np.float32)
-        np.save(os.path.join(pdir, "fp32.npy"), fp32)
+        entry = {"fp32": masters[key] if key in masters
+                 else np.asarray(jax.device_get(leaf), np.float32)}
         if has_optimizer:
-            np.save(os.path.join(pdir, "exp_avg.npy"), mu_by_key[key])
-            np.save(os.path.join(pdir, "exp_avg_sq.npy"), nu_by_key[key])
+            entry["exp_avg"] = mu_by_key[key]
+            entry["exp_avg_sq"] = nu_by_key[key]
+        sd[key] = entry
 
     meta = {
         "universal_layout_version": UNIVERSAL_LAYOUT_VERSION,
         "param_paths": [k for k, _ in flat],
         "has_optimizer": has_optimizer,
     }
+    # scalar optax-chain leaves (adam's bias-correction `count`, loss-scale
+    # internals) are topology-free but NOT per-parameter: carry them by flat
+    # index so a restore is bit-exact against a native resume — without the
+    # count, a restored adam re-runs warmup bias correction and the first
+    # post-restore step silently diverges
+    opt = tree.get("optimizer") or {}
+    scalar_leaves = {}
+    for idx in sorted(opt, key=lambda s: int(s) if str(s).isdigit() else -1):
+        leaf = opt[idx]
+        if leaf is not None and np.ndim(leaf) == 0:
+            scalar_leaves[str(idx)] = np.asarray(jax.device_get(leaf))
+    if scalar_leaves:
+        meta["optimizer_scalar_leaves"] = scalar_leaves
     scalars = tree.get("scalars", {})
     for k in ("step", "loss_scale", "good_steps"):
         if k in scalars:
             meta[k] = np.asarray(jax.device_get(scalars[k])).item()
+    # non-array sidecar counters when present in the tree (a live
+    # ``_ckpt_state`` tree carries them inline; the disk path merges the
+    # meta.pkl sidecar in before calling here)
+    for k in ("global_steps", "global_samples", "skipped_steps", "lr_scheduler", "ds_version"):
+        if tree.get(k) is not None:
+            meta[k] = tree[k]
+    return sd, meta
+
+
+def ds_to_universal(checkpoint_dir, output_dir, tag=None):
+    """Convert; returns the number of parameters written (reference main)."""
+    path = _resolve_tag(checkpoint_dir, tag)
+    tree = _restore_arrays(path)
     # carry non-array sidecar meta (global_steps etc.) from the source ckpt
     src_meta = os.path.join(path, "meta.pkl")
     if os.path.exists(src_meta):
         with open(src_meta, "rb") as f:
             side = pickle.load(f)
+        tree = dict(tree)
         for k in ("global_steps", "global_samples", "skipped_steps", "lr_scheduler", "ds_version"):
-            if k in side:
-                meta[k] = side[k]
+            if k in side and tree.get(k) is None:
+                tree[k] = side[k]
+
+    sd, meta = universal_state_from_tree(tree)
+    zero_dir = os.path.join(output_dir, "zero")
+    os.makedirs(zero_dir, exist_ok=True)
+    for key, entry in sd.items():
+        pdir = os.path.join(zero_dir, key.replace("/", "."))
+        os.makedirs(pdir, exist_ok=True)
+        for field in ("fp32", "exp_avg", "exp_avg_sq"):
+            if field in entry:
+                np.save(os.path.join(pdir, f"{field}.npy"), entry[field])
     with open(os.path.join(output_dir, "universal_meta.pkl"), "wb") as f:
         pickle.dump(meta, f)
-    logger.info(f"universal checkpoint: {len(flat)} params -> {output_dir} "
-                f"(optimizer={'yes' if has_optimizer else 'no'})")
-    return len(flat)
+    logger.info(f"universal checkpoint: {len(sd)} params -> {output_dir} "
+                f"(optimizer={'yes' if meta['has_optimizer'] else 'no'})")
+    return len(sd)
 
 
 def main():

@@ -48,11 +48,23 @@ def load_universal_checkpoint(engine, universal_dir, load_optimizer_states=True)
     are written back into the optax chain state when the layouts line up
     (reference reshards the flat shards; XLA resharding does it here).
     """
+    sd, meta = read_universal_checkpoint(universal_dir)
+    meta = apply_universal_state(engine, sd, meta, load_optimizer_states=load_optimizer_states)
+    logger.info(f"loaded universal checkpoint from {universal_dir} "
+                f"(step={meta.get('step')}, optimizer={meta.get('has_optimizer')})")
+    return meta
+
+
+def apply_universal_state(engine, sd, meta, load_optimizer_states=True):
+    """The in-memory half of :func:`load_universal_checkpoint`: overlay an
+    already-materialized universal state (``{path: {fp32, exp_avg?,
+    exp_avg_sq?}}``, ``meta``) onto ``engine`` under its CURRENT mesh. The
+    elastic live remesh (``elasticity/remesh.py``) calls this directly with
+    a host snapshot, skipping disk entirely; the disk loader reads the npy
+    layout and resolves through the same code."""
     import jax
 
     from ..runtime.zero.partition import path_str
-
-    sd, meta = read_universal_checkpoint(universal_dir)
 
     def pick(kp, leaf):
         key = path_str(kp)
@@ -71,6 +83,38 @@ def load_universal_checkpoint(engine, universal_dir, load_optimizer_states=True)
         nu = [np.asarray(sd[k]["exp_avg_sq"], np.float32) for k in keys if k in sd and "exp_avg_sq" in sd[k]]
         if len(mu) == len(keys):
             engine.state["opt_state"] = _overlay_adam_moments(engine, mu, nu)
+            # scalar chain leaves (adam `count` et al.) restore by flat
+            # index: the optax chain structure is a function of the
+            # optimizer config, not the mesh, so indices line up across
+            # topologies. Without this the restored adam re-runs
+            # bias-correction warmup and the first post-restore step
+            # diverges from a native resume. ONLY alongside a successful
+            # moments restore — a restored count over fresh zero moments
+            # would be worse than a clean warmup. Per-leaf replacement (no
+            # whole-tree host round trip: the moments above are 2x param
+            # bytes, and device_get of non-addressable multi-host shards
+            # would raise); each scalar keeps its live leaf's sharding so
+            # the compiled step's signature is unchanged.
+            scalar_leaves = meta.get("optimizer_scalar_leaves") or {}
+            if scalar_leaves:
+                import jax.numpy as jnp
+
+                leaves, treedef = jax.tree_util.tree_flatten(engine.state["opt_state"])
+                overlaid = 0
+                for idx_str, val in scalar_leaves.items():
+                    i = int(idx_str)
+                    if 0 <= i < len(leaves) and np.ndim(leaves[i]) == 0:
+                        old = leaves[i]
+                        new = jnp.asarray(val, getattr(old, "dtype", None))
+                        if isinstance(old, jax.Array):
+                            new = jax.device_put(new, old.sharding)
+                        leaves[i] = new
+                        overlaid += 1
+                    else:
+                        logger.warning(f"universal checkpoint scalar opt leaf {i} does "
+                                       f"not line up with this optimizer chain; skipped")
+                if overlaid:
+                    engine.state["opt_state"] = jax.tree_util.tree_unflatten(treedef, leaves)
         else:
             logger.warning("universal checkpoint moments incomplete; optimizer state not restored")
 
@@ -86,18 +130,22 @@ def load_universal_checkpoint(engine, universal_dir, load_optimizer_states=True)
                     hsd["masters"][k] = sd[k]["fp32"].reshape(-1)
             engine.host_optimizer.load_state_dict(hsd)
 
+    # scalars are device_put with the live leaf's OWN sharding: an unsharded
+    # jnp scalar here changes the compiled step's input signature and costs
+    # a silent recompile on the first post-restore step — exactly the warm
+    # time a live remesh exists to save
+    import jax.numpy as jnp
+
     for k in ("step", "good_steps"):
         if k in meta:
-            import jax.numpy as jnp
-
-            engine.state[k] = jnp.asarray(meta[k], engine.state[k].dtype)
+            engine.state[k] = jax.device_put(
+                jnp.asarray(meta[k], engine.state[k].dtype), engine.state[k].sharding)
     if "loss_scale" in meta:
-        import jax.numpy as jnp
-
-        engine.state["loss_scale"] = jnp.asarray(meta["loss_scale"], jnp.float32)
+        engine.state["loss_scale"] = jax.device_put(
+            jnp.asarray(meta["loss_scale"], jnp.float32), engine.state["loss_scale"].sharding)
     engine.global_steps = int(meta.get("global_steps", engine.global_steps))
-    logger.info(f"loaded universal checkpoint from {universal_dir} "
-                f"(step={meta.get('step')}, optimizer={meta.get('has_optimizer')})")
+    if meta.get("lr_scheduler") and getattr(engine, "lr_scheduler", None) is not None:
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
     return meta
 
 

@@ -23,6 +23,7 @@ from .backend import XlaBackend
 from . import functional as _functional
 from .functional import ReduceOp, axis_index, axis_size  # noqa: F401 — pure helpers, no comm payload
 from ..monitor.trace import get_tracer
+from ..runtime.resilience import chaos
 from ..utils.logging import logger, log_dist
 from ..utils.comms_logging import CommsLogger, calc_bw_log
 
@@ -202,15 +203,20 @@ def timed_op(func):
         watch = inflight_collectives
         prof = comms_logger.enabled and (comms_logger.prof_all or name in comms_logger.prof_ops)
         timing = prof or tracer.enabled
-        if not (timing or watch.enabled):
+        chaotic = chaos.armed("comm/collective")
+        if not (timing or watch.enabled or chaotic):
             return func(*args, **kwargs)
-        msg_size = _msg_bytes(args, kwargs)
         if _has_tracer(args, kwargs):
             # under jit the call only records into the step program: nothing
-            # can block here, so it is neither timed nor held in flight
+            # can block here, so it is neither timed nor held in flight (and
+            # a chaos delay/kill here would poison the compile, not the
+            # transfer — the chaos bracket covers CONCRETE calls only)
             if tracer.enabled:
-                tracer.instant(f"comm/{name}", tid="comm", msg_size=msg_size, traced=True)
+                tracer.instant(f"comm/{name}", tid="comm",
+                               msg_size=_msg_bytes(args, kwargs), traced=True)
             return func(*args, **kwargs)
+        msg_size = _msg_bytes(args, kwargs)
+        chaos.fire("comm/collective", {"op": name})
         token = watch.enter(name, msg_size) if watch.enabled else None
         try:
             if not timing:
@@ -485,6 +491,9 @@ def _watched_host_op(op, fn):
     peer wedges first (the step-boundary resilience vote rides
     ``all_gather_host``). Register them in the in-flight table while the
     health plane watches."""
+    # chaos bracket: collective-delay/kill storms land on the host plane
+    # here — these are the blocking ops a dead peer wedges first
+    chaos.fire("comm/host_collective", {"op": op})
     watch = inflight_collectives
     if not watch.enabled:
         return fn()

@@ -2,3 +2,6 @@ from .elasticity import (compute_elastic_config, get_compatible_gpus_v01, get_co
                          elasticity_enabled, ensure_immutable_elastic_config, ElasticityError,
                          ElasticityConfigError, ElasticityIncompatibleWorldSize)
 from .elastic_agent import ElasticAgent
+from . import remesh
+from .remesh import (HostSnapshot, capture_snapshot, restore_snapshot,
+                     publish_snapshot, latest_snapshot, clear_snapshots)

@@ -7,29 +7,61 @@ math re-resolve for the surviving world size.
 
 TPU analog: slice membership is fixed per jax.distributed init, so elasticity
 means *restart the step loop on a re-initialized mesh* — the agent wraps the
-user's train function, detects device/process loss (RuntimeError from a dead
-ICI peer), recomputes the elastic batch config for the new chip count, and
-re-invokes with checkpoint resume. The checkpoint-based resume is exactly the
-recovery path the reference uses, minus torch-elastic's rendezvous store
-(jax.distributed re-init plays that role)."""
+user's train function, detects device/process loss (a retryable exception
+from a dead ICI peer), recomputes the elastic batch config for the new chip
+count, and re-invokes with checkpoint (or warm host-snapshot) resume. The
+checkpoint-based resume is exactly the recovery path the reference uses,
+minus torch-elastic's rendezvous store (jax.distributed re-init plays that
+role)."""
 
 import time
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 from .elasticity import compute_elastic_config, ElasticityIncompatibleWorldSize
 from ..utils.logging import logger
 
 
+def default_retryable_exceptions() -> Tuple[type, ...]:
+    """Worker-loss exception types worth a restart. XLA/jax surface peer
+    loss inconsistently across versions and transports — a dead ICI peer
+    can come back as a plain ``RuntimeError``, a ``jaxlib``
+    ``XlaRuntimeError``, or a ``jax.errors.JaxRuntimeError`` — so the set
+    is built from whatever this jax exposes (getattr, not try/except: the
+    absence of a symbol is expected version skew, not a failure)."""
+    import jax
+
+    retryable = [RuntimeError]
+    errors_mod = getattr(jax, "errors", None)
+    for name in ("JaxRuntimeError", "XlaRuntimeError"):
+        exc = getattr(errors_mod, name, None)
+        if isinstance(exc, type) and issubclass(exc, BaseException) \
+                and not issubclass(exc, RuntimeError):
+            retryable.append(exc)
+    return tuple(retryable)
+
+
 class ElasticAgent:
 
     def __init__(self, ds_config: dict, max_restarts: int = 3, restart_delay_s: float = 5.0,
-                 backoff_factor: float = 1.0):
+                 backoff_factor: float = 1.0, retryable_exceptions=None,
+                 restart_window_s: float = 0.0):
         self.ds_config = ds_config
         self.max_restarts = max_restarts
         self.restart_delay_s = restart_delay_s
         # exponential restart backoff (delay * factor**(restart-1)): a
         # re-crashing worker on a sick host shouldn't hot-loop the fleet
         self.backoff_factor = backoff_factor
+        # which exception types count as recoverable worker loss (anything
+        # else — a real bug, an OOM loop — propagates immediately)
+        self.retryable_exceptions = (tuple(retryable_exceptions)
+                                     if retryable_exceptions is not None
+                                     else default_retryable_exceptions())
+        # restart-budget decay: an attempt that stayed healthy for at least
+        # this long before failing RESETS restart_count — a transient blip
+        # every few hours must not consume the lifetime budget a crash loop
+        # is meant to exhaust (torch-elastic's rolling-window semantics).
+        # 0 = never decay (the old behavior).
+        self.restart_window_s = float(restart_window_s)
         self.restart_count = 0
 
     def resolve_batch_config(self, world_size: int):
@@ -50,7 +82,7 @@ class ElasticAgent:
     def run(self, train_fn: Callable[[dict], None], world_size_fn: Optional[Callable[[], int]] = None):
         """Invoke ``train_fn(batch_config)`` with elastic restarts (reference
         ``_invoke_run:118`` polling loop collapsed to exception-driven
-        restarts — XLA surfaces peer loss as a RuntimeError)."""
+        restarts — peer loss surfaces as one of ``retryable_exceptions``)."""
         if world_size_fn is None:
             import jax
 
@@ -63,9 +95,16 @@ class ElasticAgent:
                 raise RuntimeError(f"no elastic config for world size {world}: {e}")
             logger.info(f"elastic agent: starting with world={world} config={cfg} "
                         f"(restart {self.restart_count}/{self.max_restarts})")
+            t_start = time.monotonic()
             try:
                 return train_fn(cfg)
-            except RuntimeError as e:
+            except self.retryable_exceptions as e:
+                healthy_s = time.monotonic() - t_start
+                if (self.restart_window_s > 0 and self.restart_count > 0
+                        and healthy_s >= self.restart_window_s):
+                    logger.info(f"elastic agent: attempt ran healthy for {healthy_s:.1f}s "
+                                f"(>= window {self.restart_window_s}s); restart budget reset")
+                    self.restart_count = 0
                 self.restart_count += 1
                 if self.restart_count > self.max_restarts:
                     logger.error(f"elastic agent: exceeded {self.max_restarts} restarts; giving up")

@@ -140,6 +140,12 @@ class CheckpointConfig(DeepSpeedConfigModel):
     # bit-rot). Costs a full read-back of the payload per save — turn off for
     # huge checkpoints where the size-only manifest check is enough
     manifest_digests: bool = True
+    # elastic warm remesh (elasticity/remesh.py): every committed save also
+    # publishes a host-RAM universal-layout snapshot, so a topology-change
+    # restart under run_resilient(warm_remesh=True) re-shards from memory
+    # instead of reading the checkpoint payload back. Costs one fp32 copy of
+    # params + both Adam moments in host RAM while armed.
+    remesh_snapshot: bool = False
 
 
 class PipelineConfig(DeepSpeedConfigModel):

@@ -46,6 +46,7 @@ import time
 from ...monitor.health import get_health
 from ...monitor.metrics import get_metrics
 from ...monitor.trace import get_tracer
+from ..resilience import chaos
 
 _END = object()  # worker sentinel: wrapped loader exhausted
 _WORKER_SEQ = itertools.count()  # unique heartbeat-source suffix per worker
@@ -109,6 +110,9 @@ def _worker(loader, prepare_fn, place_fn, gas, start_step, out_q, stop, name):
             # merely parked on a full queue keeps touching via put()'s
             # bounded-wait loop below
             hb.touch(hb_src)
+            # chaos injection point: a stall here goes stale against the
+            # prefetch deadline; a kill surfaces at the consumer's next()
+            chaos.fire("prefetch/item", {"name": name, "step": step})
             t0 = time.perf_counter()
             try:
                 mbs = [next(it) for _ in range(gas)]

@@ -51,6 +51,7 @@ from ..utils.timer import (SynchronizedWallClockTimer, NoopTimer, ThroughputTime
 
 # reference `latest` tag file semantics; the pointer itself is only ever
 # WRITTEN by the resilience saver (tools/check_ckpt_commit.py gate)
+from .resilience import chaos  # noqa: E402
 from .resilience.saver import LATEST_FILE  # noqa: E402
 
 
@@ -1364,6 +1365,10 @@ class DeepSpeedEngine:
             # host straggles on input/assembly/python work, and a forced
             # block here would serialize the async step pipeline)
             self._last_step_wall_ms = (time.perf_counter() - t_in) * 1e3
+        # chaos injection point: a storm's kill/stall/straggle/preempt land
+        # HERE, at the step boundary — the one place the engine's state is
+        # consistent enough to restart from (no-op-when-unhooked fire())
+        chaos.fire("engine/step", {"engine": self, "step": self.global_steps})
         if self._resilience_active:
             self._poll_resilience()
         if health_on:
@@ -1916,6 +1921,32 @@ class DeepSpeedEngine:
         if self._metrics.enabled:
             self._metrics.histogram("train/ckpt_blocked_ms").observe(
                 (time.perf_counter() - t0) * 1e3)
+        if ok and self.config.checkpoint_config.remesh_snapshot:
+            # elastic warm remesh: publish a host universal-layout snapshot
+            # alongside the save, so a topology-change restart re-shards
+            # from RAM (run_resilient(warm_remesh=True)) instead of reading
+            # this checkpoint back. On the async single-host path `state`
+            # is already host numpy — the capture reuses it and costs fp32
+            # casts, not a second device_get. Single-host only: multi-host
+            # arrays are not fully addressable (device_get would raise on
+            # every save — the same constraint that routes the multi-host
+            # payload through orbax above), so the knob is inert there.
+            if jax.process_count() > 1:
+                if not getattr(self, "_remesh_multihost_warned", False):
+                    self._remesh_multihost_warned = True
+                    logger.warning("checkpoint.remesh_snapshot is single-host only "
+                                   "(multi-host arrays are not fully addressable); "
+                                   "warm resume will use the disk path")
+            else:
+                try:
+                    from ..elasticity import remesh
+
+                    remesh.publish_snapshot(remesh.capture_snapshot(self, state=state),
+                                            scope=save_dir)
+                except Exception as e:  # noqa: BLE001 — a failed snapshot only
+                    # costs the warm path; the durable save above already landed
+                    logger.warning(f"remesh snapshot capture failed: {e!r}; "
+                                   f"warm resume will fall back to disk")
         if ok:
             # a refused commit must NOT reset the auto-save cadence — the
             # next retry should come promptly, not a full interval away
@@ -2279,6 +2310,12 @@ class DeepSpeedEngine:
             # a trace window reaching the final step has no later train_batch
             # to close it — flush the artifact before tearing state down
             self.stop_device_trace()
+        if self._health.enabled:
+            # the step loop is over: disarm its heartbeat BEFORE the writer
+            # join below — a slow final checkpoint join past the engine
+            # deadline is the saver's problem (it has its own source), not a
+            # bogus "engine stalled" forensic dump
+            self._health.disarm("engine")
         # join any in-flight async checkpoint write: tearing down state under
         # a live writer would hand tensorstore a half-freed tree. The join is
         # BOUNDED: a writer wedged in storage I/O must not hang destroy()
@@ -2293,7 +2330,6 @@ class DeepSpeedEngine:
                     self._health.dump("destroy")
                 except Exception as e:
                     logger.warning(f"health: destroy() dump failed: {e!r}")
-            self._health.disarm("engine")
             self._health.set_state_provider("engine", None)
             self._health.set_state_provider("saver", None)
         if self._preemption is not None:

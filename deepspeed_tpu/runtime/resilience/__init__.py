@@ -12,10 +12,14 @@ The robustness layer the reference gets from the Nebula service
   plus step/wall-clock auto-save cadence;
 * :mod:`runner` — ``run_resilient`` wraps :class:`ElasticAgent` with
   resume-from-newest-valid-tag;
-* :mod:`fault_injection` — the test harness that drives crash-mid-write,
-  torn-manifest, and killed-writer scenarios.
+* :mod:`fault_injection` — the saver-stage face of the chaos registry
+  (crash-mid-write, torn-manifest, killed-writer scenarios);
+* :mod:`chaos` — the generalized injection-point registry + the seeded
+  :class:`~.chaos.ChaosSchedule` storm generator the drills compose.
 """
 
+from . import chaos  # noqa: F401
+from .chaos import ChaosKill, ChaosSchedule, ChaosSpec, InjectedFault  # noqa: F401
 from .errors import CheckpointCorruptError, TrainingPreempted  # noqa: F401
 from .manifest import (build_manifest, is_committed, read_manifest, verify_manifest,  # noqa: F401
                        write_manifest, MANIFEST_FILE)

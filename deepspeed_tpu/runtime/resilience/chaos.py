@@ -1,0 +1,285 @@
+"""Chaos plane: a seeded, deterministic fault scheduler over injection
+points registered across the whole stack.
+
+:mod:`fault_injection` started life as five checkpoint-stage points; this
+module is its generalization — ONE hook registry any subsystem can expose a
+``fire()`` point into, plus :class:`ChaosSchedule`, the deterministic storm
+generator the chaos drills (``tools/chaos_drill.py``) compose with
+``run_resilient`` + the stall watchdog. Production code only ever calls
+:func:`fire` — a no-op dictionary probe while nothing is hooked
+(``tools/check_chaos_points.py`` statically pins production modules to that
+shape: no conditional imports, no test-only branches).
+
+Registered production points (the names ``fire`` is called with):
+
+=====================  ======================================================
+``before_arrays`` ...  the five saver stage boundaries (via
+                       :mod:`fault_injection`, unchanged names)
+``engine/step``        the training step boundary (``ctx``: engine, step)
+``comm/collective``    eager device-collective bracket (``ctx``: op)
+``comm/host_collective``  blocking host-plane gather/broadcast (``ctx``: op)
+``serving/driver``     each serving replica driver loop (``ctx``: replica)
+``prefetch/item``      the prefetch worker, once per assembled batch
+=====================  ======================================================
+
+:class:`ChaosSchedule` draws one pseudo-random number per (spec, fire index)
+from ``crc32(seed|kind|source|index)`` — PYTHONHASHSEED-proof and
+independent of wall clock, so two runs with the same seed produce the same
+event log (the training drill's determinism bar). Event kinds:
+
+* ``kill`` — raise :class:`ChaosKill` (a ``RuntimeError``: exactly what the
+  elastic agent's retryable set catches) at the fired point;
+* ``stall`` — sleep ``duration_s`` (> the watchdog deadline: the drill
+  asserts one forensic dump per stall);
+* ``straggle`` — sleep ``duration_s`` (< the deadline: latency skew only);
+* ``collective_delay`` — sleep at a comm bracket;
+* ``preempt`` — request preemption on the engine in ``ctx`` (the SIGTERM
+  path without the signal), ending the attempt in a final blocking save +
+  clean ``TrainingPreempted`` exit.
+"""
+
+import threading
+import time
+import zlib
+
+from ...monitor.metrics import get_metrics
+from ...utils.logging import logger
+
+
+class InjectedFault(RuntimeError):
+    """Base of every chaos-injected failure."""
+
+
+class ChaosKill(InjectedFault):
+    """Simulated worker death at an injection point (retryable by the
+    elastic agent: it subclasses RuntimeError on purpose)."""
+
+
+KINDS = ("kill", "stall", "straggle", "preempt", "collective_delay")
+
+_lock = threading.Lock()
+# point -> {token: hook}; insertion-ordered, so hooks run in install order
+_hooks = {}
+_next_token = 0
+
+
+class Handle:
+    """Removal handle for one installed hook; also a context manager, so
+    a test can scope an injection to exactly one block::
+
+        with chaos.inject("engine/step", hook):
+            ...
+    """
+
+    __slots__ = ("point", "_token")
+
+    def __init__(self, point, token):
+        self.point = point
+        self._token = token
+
+    def remove(self):
+        """Uninstall the hook (idempotent)."""
+        with _lock:
+            bucket = _hooks.get(self.point)
+            if bucket is not None:
+                bucket.pop(self._token, None)
+                if not bucket:
+                    _hooks.pop(self.point, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.remove()
+        return False
+
+
+def inject(point, hook):
+    """Register ``hook(ctx)`` to run whenever ``point`` fires. Returns a
+    :class:`Handle` (``.remove()`` / context manager)."""
+    global _next_token
+    with _lock:
+        token = _next_token
+        _next_token += 1
+        _hooks.setdefault(str(point), {})[token] = hook
+    return Handle(str(point), token)
+
+
+def clear(points=None):
+    """Remove every hook (``points=None``) or just the named points."""
+    with _lock:
+        if points is None:
+            _hooks.clear()
+        else:
+            for p in points:
+                _hooks.pop(p, None)
+
+
+def armed(point=None):
+    """True when any hook (or a hook on ``point``) is installed."""
+    if point is None:
+        return bool(_hooks)
+    return point in _hooks
+
+
+def fire(point, ctx=None):
+    """Run the hooks registered on ``point`` (no-op with none installed:
+    one falsy check on the module dict, no locking, no allocations). Hooks
+    run in the CALLING thread — a raising hook is indistinguishable from
+    the instrumented code failing there, a sleeping hook from it wedging."""
+    if not _hooks:
+        return
+    with _lock:
+        bucket = _hooks.get(point)
+        hooks = list(bucket.values()) if bucket else ()
+    for hook in hooks:
+        hook(ctx)
+
+
+class ChaosSpec:
+    """One fault stream: ``kind`` events at ``source`` with probability
+    ``rate`` per fire. ``duration_s`` parameterizes the sleep kinds;
+    ``start_after`` skips the first N fires (grace period — e.g. don't
+    kill before the first checkpoint exists); ``max_events`` bounds the
+    stream (0 = unbounded)."""
+
+    __slots__ = ("kind", "source", "rate", "duration_s", "start_after", "max_events")
+
+    def __init__(self, kind, source, rate, duration_s=0.0, start_after=0, max_events=0):
+        if kind not in KINDS:
+            raise ValueError(f"unknown chaos kind {kind!r}; valid: {KINDS}")
+        if not 0.0 <= float(rate) <= 1.0:
+            raise ValueError(f"rate must be in [0, 1], got {rate}")
+        self.kind = kind
+        self.source = str(source)
+        self.rate = float(rate)
+        self.duration_s = float(duration_s)
+        self.start_after = int(start_after)
+        self.max_events = int(max_events)
+
+    def __repr__(self):
+        return (f"ChaosSpec({self.kind!r}, {self.source!r}, rate={self.rate}, "
+                f"duration_s={self.duration_s}, start_after={self.start_after}, "
+                f"max_events={self.max_events})")
+
+
+def _draw(seed, kind, source, index):
+    """Deterministic u in [0, 1) for one (spec, fire-index) decision."""
+    key = f"{seed}|{kind}|{source}|{index}".encode()
+    return zlib.crc32(key) / 2**32
+
+
+class ChaosSchedule:
+    """Seeded storm of :class:`ChaosSpec` streams over the injection
+    points. ``install()`` registers one hook per distinct source;
+    decisions are pure functions of ``(seed, kind, source, fire index)``,
+    so a deterministic run produces a deterministic event log
+    (:meth:`event_log` — what the drill compares across two runs)."""
+
+    def __init__(self, seed, specs):
+        self.seed = int(seed)
+        self.specs = list(specs)
+        self.events = []  # [{kind, source, index, step?, duration_s}]
+        self._counters = {}  # source -> fires seen
+        self._spec_counts = {}  # id(spec) -> events emitted
+        self._handles = []
+        self._mutex = threading.Lock()  # serving points fire from N threads
+
+    # ------------------------------------------------------------------
+    def install(self):
+        if self._handles:
+            return self
+        by_source = {}
+        for spec in self.specs:
+            by_source.setdefault(spec.source, []).append(spec)
+        for source, specs in by_source.items():
+            self._handles.append(
+                inject(source, self._make_hook(source, specs)))
+        return self
+
+    def uninstall(self):
+        for h in self._handles:
+            h.remove()
+        self._handles = []
+        return self
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # ------------------------------------------------------------------
+    def _make_hook(self, source, specs):
+        def hook(ctx):
+            with self._mutex:
+                n = self._counters.get(source, 0)
+                self._counters[source] = n + 1
+                due = []
+                for spec in specs:
+                    if n < spec.start_after:
+                        continue
+                    count = self._spec_counts.get(id(spec), 0)
+                    if spec.max_events and count >= spec.max_events:
+                        continue
+                    if _draw(self.seed, spec.kind, spec.source, n) < spec.rate:
+                        self._spec_counts[id(spec)] = count + 1
+                        event = {"kind": spec.kind, "source": source, "index": n,
+                                 "duration_s": spec.duration_s}
+                        step = (ctx or {}).get("step") if isinstance(ctx, dict) else None
+                        if step is not None:
+                            event["step"] = int(step)
+                        self.events.append(event)
+                        due.append(spec)
+            # actions OUTSIDE the mutex: a sleeping stall must not serialize
+            # unrelated points, and a raising kill must not poison the lock.
+            # Sleep kinds run FIRST, then preempt, then kill: a stall and a
+            # kill drawn on the same fire both take effect (sleep-then-die)
+            # instead of the kill eating a recorded stall — and preempt
+            # orders before kill because an UNARMED preempt degrades to a
+            # raise itself, which must not preempt the sleeps either
+            order = {"kill": 2, "preempt": 1}
+            for spec in sorted(due, key=lambda s: order.get(s.kind, 0)):
+                self._act(spec, source, ctx)
+        return hook
+
+    def _act(self, spec, source, ctx):
+        get_metrics().counter(f"health/chaos_{spec.kind}_total").inc()
+        if spec.kind == "kill":
+            logger.warning(f"chaos: injected kill at {source}")
+            raise ChaosKill(f"chaos kill at {source}")
+        if spec.kind in ("stall", "straggle", "collective_delay"):
+            time.sleep(spec.duration_s)
+            return
+        if spec.kind == "preempt":
+            engine = (ctx or {}).get("engine") if isinstance(ctx, dict) else None
+            handler = getattr(engine, "_preemption", None)
+            if handler is not None:
+                logger.warning(f"chaos: injected preemption at {source}")
+                handler.request()
+            else:
+                # no handler to flip: a preempt against an unarmed engine
+                # degrades to a kill so the storm still exercises a restart
+                logger.warning(f"chaos: preempt at {source} with no preemption "
+                               f"handler; degrading to kill")
+                raise ChaosKill(f"chaos preempt (unarmed) at {source}")
+
+    # ------------------------------------------------------------------
+    def event_log(self):
+        """Stable tuple view of the events for determinism comparison —
+        ``(source, index, kind, step)``, sorted. Sorted because different
+        SOURCES fire from different threads (the saver stages fire in the
+        writer thread): per-source order is deterministic, cross-source
+        interleaving is scheduling."""
+        with self._mutex:
+            return sorted((e["source"], e["index"], e["kind"], e.get("step"))
+                          for e in self.events)
+
+    def counts(self):
+        """Events emitted per kind (``{kind: n}``)."""
+        with self._mutex:
+            out = {}
+            for e in self.events:
+                out[e["kind"]] = out.get(e["kind"], 0) + 1
+            return out

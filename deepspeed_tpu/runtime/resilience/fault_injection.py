@@ -13,40 +13,45 @@ Hooks run *in the writer thread*, so raising :class:`InjectedCrash` is
 exactly a killed writer as far as the foreground step loop can tell. A hook
 may also block (e.g. on a ``threading.Event``) to hold a save in flight
 while a test asserts non-blocking behavior.
+
+The registry itself lives in :mod:`chaos` (this module is the
+saver-stage-validated face of it): :func:`inject` / :func:`crash_at` return
+a removal :class:`~.chaos.Handle` usable as a context manager, so a test's
+hook is scoped to its block instead of leaking through a module global
+until someone remembers :func:`clear`::
+
+    with fault_injection.crash_at("before_manifest"):
+        engine.save_checkpoint(d, tag="doomed")
+        engine.flush_checkpoints()
 """
 
-import threading
+from . import chaos
 
 POINTS = ("before_arrays", "after_arrays", "before_manifest", "after_manifest", "before_latest")
 
-_lock = threading.Lock()
-_hooks = {}
 
-
-class InjectedCrash(RuntimeError):
+class InjectedCrash(chaos.InjectedFault):
     """Simulated writer death."""
 
 
 def inject(point, hook):
-    """Register ``hook(ctx)`` to run when the saver reaches ``point``."""
+    """Register ``hook(ctx)`` to run when the saver reaches ``point``.
+    Returns a removal handle (also a context manager)."""
     if point not in POINTS:
         raise ValueError(f"unknown injection point {point!r}; valid: {POINTS}")
-    with _lock:
-        _hooks.setdefault(point, []).append(hook)
+    return chaos.inject(point, hook)
 
 
 def crash_at(point):
-    """Convenience: kill the writer at ``point``."""
-    inject(point, lambda ctx: (_ for _ in ()).throw(InjectedCrash(f"injected crash at {point}")))
+    """Convenience: kill the writer at ``point``. Returns the handle."""
+    return inject(point, lambda ctx: (_ for _ in ()).throw(InjectedCrash(f"injected crash at {point}")))
 
 
 def clear():
-    with _lock:
-        _hooks.clear()
+    """Remove every hook on the saver stage points (the chaos registry's
+    other points — engine/comm/serving/prefetch — are left alone)."""
+    chaos.clear(points=POINTS)
 
 
 def fire(point, ctx=None):
-    with _lock:
-        hooks = list(_hooks.get(point, ()))
-    for hook in hooks:
-        hook(ctx)
+    chaos.fire(point, ctx)

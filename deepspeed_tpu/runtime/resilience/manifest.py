@@ -20,6 +20,12 @@ MANIFEST_VERSION = 1
 from .errors import CheckpointCorruptError
 
 
+def _metrics():
+    from ...monitor.metrics import get_metrics  # lazy: manifest stays import-light
+
+    return get_metrics()
+
+
 def _iter_files(ckpt_path):
     """Relative (posix) paths of every payload file under the checkpoint
     dir, manifest excluded."""
@@ -140,4 +146,7 @@ def is_committed(ckpt_path, deep=False):
         verify_manifest(ckpt_path, deep=deep)
         return True
     except CheckpointCorruptError:
+        # the probing face of verify_manifest: False IS the answer, but the
+        # rate of torn tags encountered is health signal, not noise
+        _metrics().counter("health/ckpt_verify_failed_total").inc()
         return False

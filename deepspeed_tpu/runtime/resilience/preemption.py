@@ -15,6 +15,12 @@ import threading
 from ...utils.logging import logger
 
 
+def _metrics():
+    from ...monitor.metrics import get_metrics  # lazy: signal path stays import-light
+
+    return get_metrics()
+
+
 class PreemptionHandler:
     """Flag-setting signal trap, chainable and restorable.
 
@@ -68,8 +74,11 @@ class PreemptionHandler:
                 else:
                     logger.warning(f"preemption trap for signal {sig} was overridden after "
                                    f"install; leaving the current handler in place")
-            except (ValueError, TypeError):  # non-main thread / exotic prev
-                pass
+            except (ValueError, TypeError):
+                # non-main thread / exotic prev: the trap stays installed —
+                # counted, because a trap that outlives its engine is exactly
+                # the kind of leak a fleet debugger needs a number for
+                _metrics().counter("health/preemption_uninstall_skipped_total").inc()
         # keep self._prev: if a later handler's chain still points here (it
         # restored us as ITS prev), _on_signal forwards through it
         self._installed = False

@@ -3,47 +3,127 @@
 The reference's restart story is torch-elastic re-rendezvous + user resume
 code; here the agent already re-resolves the elastic batch config per
 attempt, and this wrapper adds the missing half: every (re)start receives
-the newest *valid* checkpoint tag (manifest-verified, torn tags skipped),
-so an injected worker failure or a preemption exit resumes exactly where
-the last durable version left off.
+the newest *valid* resume point — preferring a live host snapshot (the
+elastic warm remesh, ``elasticity/remesh.py``) over the newest
+manifest-verified disk tag over a cold start — so an injected worker
+failure or a preemption exit resumes exactly where the last durable
+version left off, without a disk read when the process still holds the
+state in host RAM.
 """
 
+import time
 from typing import Callable, Optional
 
 from .errors import TrainingPreempted
-from .saver import find_latest_valid
+from .saver import find_latest_valid, tag_step
+from ...monitor.metrics import get_metrics
 from ...utils.logging import logger
+
+
+class ResumePoint(tuple):
+    """``(tag, path)`` — unpacks exactly like the historical 2-tuple — plus
+    ``snapshot``, the warm-remesh :class:`~...elasticity.remesh.HostSnapshot`
+    when one at least as new as the disk tag is available (None otherwise).
+    The fallback ladder a ``train_fn`` should implement::
+
+        tag, path = resume
+        if resume.snapshot is not None:
+            remesh.restore_snapshot(engine, resume.snapshot)   # warm: no disk
+        elif tag is not None:
+            engine.load_checkpoint(save_dir, tag=tag)          # disk
+        # else: cold start
+    """
+
+    def __new__(cls, tag=None, path=None, snapshot=None):
+        self = super().__new__(cls, (tag, path))
+        self.snapshot = snapshot
+        return self
+
+    @property
+    def tag(self):
+        return self[0]
+
+    @property
+    def path(self):
+        return self[1]
 
 
 def run_resilient(train_fn: Callable, ds_config: dict, save_dir: Optional[str] = None,
                   max_restarts: int = 3, restart_delay_s: float = 5.0, backoff_factor: float = 2.0,
-                  world_size_fn: Optional[Callable[[], int]] = None, deep_verify: bool = False):
-    """Run ``train_fn(batch_config, resume_from)`` under elastic restarts.
+                  world_size_fn: Optional[Callable[[], int]] = None, deep_verify: bool = False,
+                  retryable_exceptions=None, restart_window_s: float = 0.0,
+                  warm_remesh: bool = False):
+    """Run ``train_fn(batch_config, resume)`` under elastic restarts.
 
     ``batch_config`` is the re-resolved elastic batch triad for the current
-    world size; ``resume_from`` is ``(tag, path)`` of the newest valid
-    checkpoint under ``save_dir`` (``(None, None)`` on a cold start) —
-    re-evaluated at every attempt, so a restart picks up checkpoints the
-    failed attempt committed. A :class:`TrainingPreempted` escape is a clean
-    shutdown, not a failure: it is returned (not re-raised) so supervising
-    code can requeue the job.
+    world size; ``resume`` is a :class:`ResumePoint` — ``(tag, path)`` of
+    the newest valid checkpoint under ``save_dir`` (``(None, None)`` on a
+    cold start), re-evaluated at every attempt so a restart picks up
+    checkpoints the failed attempt committed. With ``warm_remesh`` the
+    published host snapshot (``elasticity.remesh``) rides along as
+    ``resume.snapshot`` whenever it is at least as new as the disk tag:
+    the restart re-shards from host RAM instead of reading the checkpoint
+    payload — including onto a DIFFERENT world size, since the snapshot is
+    topology-free universal layout. ``retryable_exceptions`` /
+    ``restart_window_s`` pass through to the agent (which exception types
+    count as worker loss, and the healthy-run budget reset). A
+    :class:`TrainingPreempted` escape is a clean shutdown, not a failure:
+    it is returned (not re-raised) so supervising code can requeue the job.
     """
     from ...elasticity import ElasticAgent
 
     agent = ElasticAgent(ds_config, max_restarts=max_restarts, restart_delay_s=restart_delay_s,
-                         backoff_factor=backoff_factor)
+                         backoff_factor=backoff_factor,
+                         retryable_exceptions=retryable_exceptions,
+                         restart_window_s=restart_window_s)
 
     def attempt(batch_config):
-        resume = (None, None)
+        tag = path = None
         if save_dir is not None:
-            resume = find_latest_valid(save_dir, deep=deep_verify)
-            if resume[0] is not None:
-                logger.info(f"run_resilient: resuming from valid tag {resume[0]} "
-                            f"(restart {agent.restart_count}/{max_restarts})")
-        return train_fn(batch_config, resume)
+            tag, path = find_latest_valid(save_dir, deep=deep_verify)
+        snapshot = None
+        if warm_remesh:
+            from ...elasticity import remesh
+
+            # scope-checked: only a snapshot stamped for THIS job's save_dir
+            # (or an explicitly hand-published scope-less one) is eligible —
+            # a previous job's snapshot in the same process must not
+            # warm-resume an unrelated run
+            snap = remesh.latest_snapshot(scope=save_dir)
+            if save_dir is None and snap is not None and snap.scope is not None:
+                # a dir-less run has no identity to match: a JOB-stamped
+                # snapshot (auto-published by some engine's save path) must
+                # not leak into it — only hand-published scope-less
+                # snapshots qualify here
+                snap = None
+            # the snapshot wins only when at least as new as the durable tag
+            # (a crash can postdate the last publish; the disk must win then);
+            # a non-step-style tag has no comparable step — the warm copy wins
+            disk_step = tag_step(tag) if tag is not None else None
+            if snap is not None and (disk_step is None or snap.step >= disk_step):
+                snapshot = snap
+        resume = ResumePoint(tag, path, snapshot=snapshot)
+        if snapshot is not None:
+            get_metrics().counter("checkpoint/warm_remesh_resumes_total").inc()
+            logger.info(f"run_resilient: warm-remesh resume from host snapshot "
+                        f"(step {snapshot.step}; disk tag {tag or 'none'} stays fallback; "
+                        f"restart {agent.restart_count}/{max_restarts})")
+        elif tag is not None:
+            logger.info(f"run_resilient: resuming from valid tag {tag} "
+                        f"(restart {agent.restart_count}/{max_restarts})")
+        t0 = time.perf_counter()
+        try:
+            return train_fn(batch_config, resume)
+        finally:
+            # recovery-time accounting for the chaos drill / bench: how long
+            # each restarted attempt ran (the drill derives time-to-recover
+            # from the attempt boundaries)
+            get_metrics().histogram("checkpoint/attempt_wall_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
 
     try:
         return agent.run(attempt, world_size_fn=world_size_fn)
     except TrainingPreempted as e:
+        get_metrics().counter("health/preempted_total").inc()
         logger.warning(f"run_resilient: clean preemption exit (final tag {e.tag})")
         return e

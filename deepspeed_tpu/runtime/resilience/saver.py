@@ -89,6 +89,9 @@ def tag_order_key(save_dir, tag):
     try:
         man_mtime = os.path.getmtime(os.path.join(path, MANIFEST_FILE))
     except OSError:
+        # manifest absent = torn/in-flight tag: the dir-mtime ordering below
+        # is the designed fallback; counted so the swallow stays observable
+        get_metrics().counter("health/ckpt_order_fallback_total").inc()
         man_mtime = None
     if man_mtime is not None:
         hit = _ORDER_KEY_CACHE.get(path)
@@ -97,9 +100,12 @@ def tag_order_key(save_dir, tag):
     try:
         key = float(read_manifest(path).get("created_unix", -1.0))
     except CheckpointCorruptError:
+        get_metrics().counter("health/ckpt_order_fallback_total").inc()
         try:
             return os.path.getmtime(path)
         except OSError:
+            # the tag vanished under us (concurrent GC): oldest-possible key
+            get_metrics().counter("health/ckpt_order_fallback_total").inc()
             return -1.0
     if man_mtime is not None:
         if len(_ORDER_KEY_CACHE) > 1024:  # GC'd tags leave entries behind
@@ -355,6 +361,9 @@ class ResilientSaver:
         never saw) the raise still gets the truth from ``flush()``."""
         self.saves_failed += 1
         get_metrics().counter("checkpoint/saves_failed").inc()
+        # mirrored into the health/ namespace: save failures sit next to
+        # stalls/stragglers on the one dashboard an operator actually watches
+        get_metrics().counter("health/ckpt_save_failed_total").inc()
         if err is not None:
             self.last_error = err
         if msg:
@@ -369,7 +378,9 @@ class ResilientSaver:
         try:
             self.checkpoint_engine.commit(tag)
         except Exception:
-            pass  # the abandoned write's error must not mask the recorded one
+            # the abandoned write's error must not mask the recorded one —
+            # but it must not vanish either
+            get_metrics().counter("health/ckpt_abandoned_commit_total").inc()
 
     # ------------------------------------------------------------------
     def _background_write(self, state, save_dir, tag, save_latest):
@@ -395,7 +406,8 @@ class ResilientSaver:
                 tracer.complete("checkpoint/async_write", t0, time.perf_counter() - t0,
                                 tid="checkpoint", args={"tag": str(tag), "committed": bool(ok)})
         except BaseException as e:  # noqa: BLE001 — a dead writer must never kill training
-            self.last_error = e  # failure counters already bumped in the commit path
+            self.last_error = e  # checkpoint/ failure counters bumped in the commit path
+            get_metrics().counter("health/ckpt_writer_death_total").inc()
             flight.record("saver", "write_error", tag=str(tag), error=repr(e))
             if tracer.enabled:
                 tracer.complete("checkpoint/async_write", t0, time.perf_counter() - t0,

@@ -169,7 +169,10 @@ class AdmissionController:
         driver's exit path (crash or stop). Without this, requests admitted
         onto a replica whose driver died would wait out the full client
         timeout, and a stranded full queue would pin readiness to False for
-        the whole gateway."""
+        the whole gateway. Failures here are counted under
+        ``gateway/replica_failed_requests_total`` — DISTINCT from the shed
+        counters, so a dashboard can tell "replica died under its queue"
+        from "queue full, client backed off"."""
         reqs = []
         with self._lock:
             for (r, c), q in self._queues.items():
@@ -177,6 +180,8 @@ class AdmissionController:
                     reqs.extend(q)
                     q.clear()
                     self._queued_uncached[(r, c)] = 0
+        if reqs:
+            get_metrics().counter("gateway/replica_failed_requests_total").inc(len(reqs))
         for req in reqs:
             req.stream.finish(reason="error", error=reason)
             if self.reqtrace is not None:

@@ -99,6 +99,11 @@ class GatewayConfig:
     max_new_tokens_cap: int = 0
     # HTTP handler wait bound for one request end-to-end, seconds
     request_timeout_s: float = 120.0
+    # Retry-After seconds advertised on every 429/503 (shed, draining, dead
+    # replica): the client-visible half of "this failure is retryable here
+    # (429) or elsewhere (503)" — load balancers and well-behaved clients
+    # key their backoff on it
+    retry_after_s: int = 1
     # (seq_bucket, decode_steps) pairs pre-compiled per replica at start()
     # via engine.warmup; empty = no warmup
     warmup: Tuple = ()

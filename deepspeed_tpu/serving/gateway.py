@@ -341,8 +341,14 @@ class ServingGateway:
                     self.wfile.write(body)
 
             def _json(self, code, obj, rid=None):
+                # every retryable refusal carries Retry-After: 429 = back
+                # off and retry HERE, 503 = this instance is going away /
+                # has no live replica — retry ELSEWHERE (the LB sees the
+                # same signal via /readyz)
+                extra = ((("Retry-After", str(outer.config.retry_after_s)), )
+                         if code in (429, 503) else ())
                 self._respond(code, "application/json",
-                              json.dumps(obj).encode("utf-8"), rid=rid)
+                              json.dumps(obj).encode("utf-8"), rid=rid, extra=extra)
 
             def do_GET(self):
                 rid, _tp = extract_request_id(self.headers)

@@ -28,6 +28,7 @@ from ..monitor.flight import get_flight_recorder
 from ..monitor.health import get_health
 from ..monitor.metrics import get_metrics
 from ..inference.v2 import DynamicSplitFuseScheduler
+from ..runtime.resilience import chaos
 
 
 class TokenStream:
@@ -284,11 +285,26 @@ class EngineReplica:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
         self.started = False
-        for req in list(self._streams.values()):
-            req.stream.finish(reason="error", error="replica_stopped")
-            if self._reqtrace is not None:
-                self._reqtrace.finalize(req)
-        self._streams.clear()
+        self._fail_active("replica_stopped")
+
+    def restart(self):
+        """Bring a dead replica back into rotation (chaos drill / operator
+        recovery): only valid once the previous driver thread has exited —
+        a live driver is left alone. Active state was already failed on the
+        way down (crash handler or :meth:`stop`); the engine and scheduler
+        are reused, warmup is not repeated, and the first fresh heartbeat
+        re-arms liveness for the router."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._fail_active("replica_stopped")  # belt-and-braces: crash paths
+        self._stop.clear()
+        self._wake.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"dstpu-serving-{self.name}", daemon=True)
+        self.started = True
+        self._thread.start()
+        get_metrics().counter("gateway/replica_restarts_total").inc()
+        return self
 
     # -- driver loop --------------------------------------------------------
     def _run(self):
@@ -296,6 +312,9 @@ class EngineReplica:
         src = self.heartbeat_source
         try:
             while not self._stop.is_set():
+                # chaos injection point: a storm's replica kill lands here,
+                # between scheduler steps (no-op-when-unhooked fire())
+                chaos.fire("serving/driver", {"replica": self.name})
                 busy = False
                 self._process_cancellations()
                 if not self.paused:
@@ -312,6 +331,17 @@ class EngineReplica:
                         hb.disarm(src)
                     self._wake.wait(self.IDLE_WAIT_S)
                     self._wake.clear()
+        except BaseException:  # noqa: BLE001 — driver death is a replica
+            # failure, distinct from shed in the metrics: the counter is what
+            # lets an operator tell "queue full" from "replica died" on a
+            # dashboard. Every request this driver was actively serving is
+            # failed HERE (the loop-level crash window the _step handler
+            # cannot see), so no admitted request goes unreported.
+            get_metrics().counter("gateway/replica_failures_total").inc()
+            get_flight_recorder().record("serving", "replica_driver_death",
+                                         replica=self.name)
+            self._fail_active("replica_stopped")
+            raise
         finally:
             # the driver is the ONLY consumer of this replica's admission
             # queues: on the way out (clean stop or crash) fail whatever is
@@ -321,6 +351,26 @@ class EngineReplica:
             self._admission.fail_for(self.name, "replica_stopped")
             if hb.enabled:
                 hb.release(src)
+
+    def _fail_active(self, error):
+        """Fail every request currently on the scheduler (driver death /
+        stop): cancel its engine sequence so the KV reservation frees,
+        finish its stream so the waiting client gets an immediate terminal
+        frame, and finalize its trace record."""
+        for uid, req in list(self._streams.items()):
+            try:
+                if self._scheduler.cancel(uid):
+                    self._scheduler.discard_result(uid)
+            except Exception as e:  # noqa: BLE001 — a poisoned engine must
+                # not keep the remaining streams from being failed/reported
+                get_flight_recorder().record("serving", "cancel_error",
+                                             replica=self.name, uid=uid,
+                                             error=repr(e))
+            req.stream.finish(reason="error", error=error)
+            if self._reqtrace is not None:
+                self._reqtrace.finalize(req)
+        self._streams.clear()
+        self._inflight = 0
 
     def _process_cancellations(self):
         with self._cancel_lock:

@@ -26,7 +26,7 @@ from deepspeed_tpu.runtime.resilience import (apply_retention, find_latest_valid
                                               read_latest, verify_manifest, AutoSaveTrigger,
                                               CheckpointCorruptError, TrainingPreempted,
                                               MANIFEST_FILE)
-from deepspeed_tpu.runtime.resilience import fault_injection
+from deepspeed_tpu.runtime.resilience import chaos, fault_injection
 
 
 def _model():
@@ -66,9 +66,13 @@ def _params(engine):
 
 @pytest.fixture(autouse=True)
 def _clean_injection():
-    fault_injection.clear()
+    # belt-and-braces only: every injection below is scoped through its
+    # handle / context manager (the ISSUE 12 migration off module-global
+    # hook leakage); the registry-wide clear just guarantees isolation if
+    # a future test forgets
+    chaos.clear()
     yield
-    fault_injection.clear()
+    chaos.clear()
 
 
 # ----------------------------------------------------------------------
@@ -96,15 +100,15 @@ def test_async_save_does_not_block_step_loop(tmp_path):
     """While the writer is held mid-write, save_checkpoint has already
     returned and training continues; release -> commit lands."""
     gate = threading.Event()
-    fault_injection.inject("before_manifest", lambda ctx: gate.wait(timeout=30))
     engine = _engine(_config(async_save=True))
     engine.train_batch(_batch())
-    engine.save_checkpoint(str(tmp_path), tag="held")
-    assert engine._ckpt_saver.in_flight          # writer parked on the gate
-    assert read_latest(str(tmp_path)) is None    # not yet advertised
-    engine.train_batch(_batch(1))                # step loop unaffected
-    gate.set()
-    assert engine.flush_checkpoints(raise_on_error=True)
+    with fault_injection.inject("before_manifest", lambda ctx: gate.wait(timeout=30)):
+        engine.save_checkpoint(str(tmp_path), tag="held")
+        assert engine._ckpt_saver.in_flight          # writer parked on the gate
+        assert read_latest(str(tmp_path)) is None    # not yet advertised
+        engine.train_batch(_batch(1))                # step loop unaffected
+        gate.set()
+        assert engine.flush_checkpoints(raise_on_error=True)
     assert read_latest(str(tmp_path)) == "held"
     assert is_committed(str(tmp_path / "held"), deep=True)
 
@@ -115,9 +119,9 @@ def test_killed_writer_mid_save_keeps_previous_latest(tmp_path):
     assert read_latest(str(tmp_path)) == "good"
 
     # the writer dies after the payload, before the manifest commit
-    fault_injection.crash_at("before_manifest")
-    engine.save_checkpoint(str(tmp_path), tag="doomed")  # async
-    engine.flush_checkpoints()
+    with fault_injection.crash_at("before_manifest"):
+        engine.save_checkpoint(str(tmp_path), tag="doomed")  # async
+        engine.flush_checkpoints()
     assert engine._ckpt_saver.last_error is not None
     assert read_latest(str(tmp_path)) == "good"          # pointer never moved
     assert not is_committed(str(tmp_path / "doomed"))
@@ -249,19 +253,19 @@ def test_payload_in_caller_backgrounds_only_commit(tmp_path):
     (no device references cross the thread boundary) and the parked writer
     owns only the manifest/latest/GC stages."""
     gate = threading.Event()
-    fault_injection.inject("before_manifest", lambda ctx: gate.wait(timeout=30))
     engine = _engine(_config(async_save=True))
     saver = engine._ckpt_saver
     state = engine._ckpt_state(None)
-    assert saver.save(state, str(tmp_path), "t", blocking=False, payload_in_caller=True)
-    # payload dispatched synchronously: the snapshot is down (meta sidecar +
-    # orbax's arrays tree, still tmp-named until commit finalizes it)
-    assert os.path.isfile(str(tmp_path / "t" / "meta.pkl"))
-    assert any(d.startswith("arrays") for d in os.listdir(str(tmp_path / "t")))
-    assert saver.in_flight                                # commit parked on the gate
-    assert read_latest(str(tmp_path)) is None
-    gate.set()
-    assert saver.flush(raise_on_error=True)
+    with fault_injection.inject("before_manifest", lambda ctx: gate.wait(timeout=30)):
+        assert saver.save(state, str(tmp_path), "t", blocking=False, payload_in_caller=True)
+        # payload dispatched synchronously: the snapshot is down (meta sidecar +
+        # orbax's arrays tree, still tmp-named until commit finalizes it)
+        assert os.path.isfile(str(tmp_path / "t" / "meta.pkl"))
+        assert any(d.startswith("arrays") for d in os.listdir(str(tmp_path / "t")))
+        assert saver.in_flight                            # commit parked on the gate
+        assert read_latest(str(tmp_path)) is None
+        gate.set()
+        assert saver.flush(raise_on_error=True)
     assert read_latest(str(tmp_path)) == "t"
     man = verify_manifest(str(tmp_path / "t"), deep=True)
     assert man["tree"]  # spec captured at submit time, not from donated state
@@ -360,15 +364,14 @@ def test_blocking_lead_manifest_failure_votes_false(tmp_path):
     def boom(ctx):
         raise OSError("manifest disk full")
 
-    fault_injection.inject("before_manifest", boom)
-
     def gate(local_ok):
         votes.append(local_ok)
         return all(votes)
 
-    with pytest.raises(OSError):
-        saver.save(engine._ckpt_state(None), str(tmp_path), "t", blocking=True,
-                   commit_gate=gate)
+    with fault_injection.inject("before_manifest", boom):
+        with pytest.raises(OSError):
+            saver.save(engine._ckpt_state(None), str(tmp_path), "t", blocking=True,
+                       commit_gate=gate)
     assert votes == [True, False]
     assert read_latest(str(tmp_path)) is None
 
@@ -458,10 +461,9 @@ def test_retention_keeps_exactly_n_plus_archival(tmp_path):
 
 def test_retention_sweeps_stale_torn_dirs(tmp_path):
     engine = _engine(_config(async_save=True, num_of_version_in_retention=2))
-    fault_injection.crash_at("before_manifest")
-    engine.save_checkpoint(str(tmp_path), tag="torn1")
-    engine.flush_checkpoints()
-    fault_injection.clear()
+    with fault_injection.crash_at("before_manifest"):
+        engine.save_checkpoint(str(tmp_path), tag="torn1")
+        engine.flush_checkpoints()
     for i in (1, 2, 3):
         engine.global_steps = i
         engine.save_checkpoint(str(tmp_path), blocking=True)  # global_step1..3
@@ -651,13 +653,12 @@ def test_autosave_async_failure_retries_promptly(tmp_path):
     the next step boundary, not a full interval later."""
     engine = _engine(_config(async_save=True, save_interval_steps=3))
     engine.set_checkpoint_dir(str(tmp_path))
-    fault_injection.crash_at("before_manifest")
-    for i in range(3):
-        engine.train_batch(_batch(i))  # auto-save fires at step 3, writer dies
-    engine.flush_checkpoints()
-    assert engine._ckpt_saver.last_error is not None
-    assert read_latest(str(tmp_path)) is None
-    fault_injection.clear()
+    with fault_injection.crash_at("before_manifest"):
+        for i in range(3):
+            engine.train_batch(_batch(i))  # auto-save fires at step 3, writer dies
+        engine.flush_checkpoints()
+        assert engine._ckpt_saver.last_error is not None
+        assert read_latest(str(tmp_path)) is None
     engine.train_batch(_batch(3))  # step 4: prompt retry, not step 6
     engine.flush_checkpoints()
     assert read_latest(str(tmp_path)) == "global_step4"
@@ -798,10 +799,9 @@ def test_flush_status_tracks_most_recent_save(tmp_path):
     """One failed save must not poison flush() forever: status is reset by
     the next submitted save."""
     engine = _engine(_config(async_save=True))
-    fault_injection.crash_at("before_manifest")
-    engine.save_checkpoint(str(tmp_path), tag="doomed")
-    assert engine.flush_checkpoints() is False
-    fault_injection.clear()
+    with fault_injection.crash_at("before_manifest"):
+        engine.save_checkpoint(str(tmp_path), tag="doomed")
+        assert engine.flush_checkpoints() is False
     engine.save_checkpoint(str(tmp_path), tag="fine")
     assert engine.flush_checkpoints(raise_on_error=True) is True
     assert read_latest(str(tmp_path)) == "fine"

@@ -1,0 +1,418 @@
+"""Chaos drills: prove the resilience stack under scripted failure storms.
+
+Two arms, both built from production parts only — ``run_resilient`` + the
+stall watchdog for training, the serving gateway + closed-loop HTTP load
+for serving — with faults driven through the seeded
+:class:`~deepspeed_tpu.runtime.resilience.chaos.ChaosSchedule` (never ad-hoc
+monkeypatching: the drill exercises exactly the injection points production
+code ships with).
+
+**Training arm** (:func:`training_drill`): run N steps undisturbed, then the
+same N steps under a kill/stall/straggle/preempt/collective-delay storm with
+per-step checkpointing, warm-remesh restarts and the watchdog armed. The
+verdicts are the ROADMAP bar:
+
+  * ``loss_parity`` — the per-step loss curve of the stormed run (last
+    completed execution of each step) is BIT-IDENTICAL to the undisturbed
+    run;
+  * ``resumed_tags_valid`` — every disk tag a restart resumed from was
+    manifest-valid under DEEP verification (no torn checkpoint was ever
+    trusted);
+  * ``stall_dumps_match`` — every injected stall produced exactly one
+    forensic dump, and each dump names the stalled source;
+  * determinism — two drills with the same seed produce the same event log
+    (compare :func:`training_drill` ``event_log`` fields).
+
+**Serving arm** (:func:`serving_drill`): closed-loop HTTP load (blocking
+mode, so every terminal is an HTTP status) while a chaos kill takes a
+replica driver down mid-traffic; the drill restarts it, then exercises a
+drain/undrain cycle. Verdicts:
+
+  * ``zero_unreported`` — every request terminated in exactly one of
+    {200 + tokens, 429, 503, 504}; nothing hung, nothing vanished;
+  * ``retry_after_on_503`` — every 503 carried ``Retry-After``;
+  * ``replica_failure_counted`` — the driver death bumped
+    ``gateway/replica_failures_total`` (distinct from shed);
+  * ``readyz_flipped`` — ``/readyz`` went 503 during drain and recovered.
+
+CLI::
+
+    python tools/chaos_drill.py training --seed 7 --steps 8
+    python tools/chaos_drill.py serving  --seed 7 --requests 24
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# training arm
+# ---------------------------------------------------------------------------
+def _train_model():
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    return TransformerLM(TransformerConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                                           num_heads=2, intermediate_size=32, max_seq_len=16,
+                                           dtype=jnp.float32, attention_impl="reference"))
+
+
+def _train_config(save_every=1, preemption=True):
+    return {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10**9,
+        "tpu": {"mesh": {"data": 8}},
+        # async saves keep the step boundary fast (host snapshot only), so
+        # the engine-stall deadline can stay tight without blocking-save
+        # wall time tripping it; the preemption final save is still blocking
+        "checkpoint": {"save_interval_steps": save_every, "preemption_save": preemption,
+                       "remesh_snapshot": True, "async_save": True},
+    }
+
+
+def default_training_storm(seed, stall_duration_s=0.75):
+    """The standard kill/stall/straggle/preempt/collective-delay mix. Kills
+    and stalls start only after step 1 (a checkpoint exists, the engine
+    heartbeat is armed); one preempt exercises the clean-exit + requeue
+    path; a saver-stage kill produces a genuinely torn tag the resume scan
+    must skip."""
+    from deepspeed_tpu.runtime.resilience.chaos import ChaosSchedule, ChaosSpec
+
+    return ChaosSchedule(seed, [
+        ChaosSpec("kill", "engine/step", rate=0.22, start_after=1, max_events=2),
+        ChaosSpec("stall", "engine/step", rate=0.18, duration_s=stall_duration_s,
+                  start_after=1, max_events=2),
+        ChaosSpec("straggle", "engine/step", rate=0.30, duration_s=0.02),
+        ChaosSpec("preempt", "engine/step", rate=0.10, start_after=2, max_events=1),
+        ChaosSpec("collective_delay", "comm/host_collective", rate=0.15,
+                  duration_s=0.02, max_events=6),
+        ChaosSpec("kill", "after_arrays", rate=0.25, max_events=1),
+    ])
+
+
+def training_drill(seed=0, steps=8, workdir=None, storm=None, deadline_s=0.5,
+                   max_requeues=4, max_restarts=16):
+    """Run the training chaos drill; returns the verdicts dict (see module
+    docstring). ``workdir`` must be a fresh directory (checkpoints + dumps
+    land under it); a temp dir is created when absent."""
+    import tempfile
+
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import remesh
+    from deepspeed_tpu.monitor.health import configure_health, get_health
+    from deepspeed_tpu.monitor.metrics import configure_metrics, get_metrics
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.runtime.resilience import (TrainingPreempted, is_committed,
+                                                  run_resilient)
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_drill_")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    dump_dir = os.path.join(workdir, "dumps")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    os.makedirs(dump_dir, exist_ok=True)
+
+    rng = np.random.default_rng(seed)
+    batches = [{"input_ids": rng.integers(0, 64, size=(8, 16), dtype=np.int32)}
+               for _ in range(steps)]
+
+    def build_engine():
+        groups.reset()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=_train_model(),
+                                                   config=_train_config())
+        return engine
+
+    # -- undisturbed reference run (no storm, no checkpoint dir) ------------
+    engine = build_engine()
+    want = [float(engine.train_batch(b)) for b in batches]
+    engine.destroy()
+
+    # -- stormed run --------------------------------------------------------
+    remesh.clear_snapshots()
+    configure_metrics(enabled=True)
+    health = configure_health(enabled=True, deadlines={"engine": deadline_s},
+                              watchdog_poll_s=0.03, dump_dir=dump_dir,
+                              dump_on_destroy=False)
+    storm = storm or default_training_storm(seed, stall_duration_s=max(0.6, 3 * deadline_s))
+    state = {"losses": {}, "resumes": [], "warm_resumes": 0, "recovery_ms": [],
+             "t_down": None, "restarts": 0}
+
+    ds_config = dict(_train_config())
+    ds_config["elasticity"] = {"enabled": True, "max_train_batch_size": 8,
+                               "micro_batch_sizes": [1], "min_gpus": 1, "max_gpus": 64,
+                               "min_time": 0, "version": 0.2}
+
+    def train_fn(batch_config, resume):
+        eng = build_engine()
+        try:
+            eng.set_checkpoint_dir(ckpt_dir)
+            tag, _path = resume
+            start = 0
+            if resume.snapshot is not None:
+                remesh.restore_snapshot(eng, resume.snapshot)
+                start = eng.global_steps
+                state["warm_resumes"] += 1
+                state["resumes"].append(("snapshot", resume.snapshot.step))
+            elif tag is not None:
+                eng.load_checkpoint(ckpt_dir, tag=tag)
+                start = eng.global_steps
+                state["resumes"].append(("disk", tag))
+            for i in range(start, steps):
+                loss = float(eng.train_batch(batches[i]))
+                # train_batch advanced global_steps to i+1; last write wins —
+                # the step's FINAL execution is what the curve compares
+                state["losses"][i] = loss
+                if state["t_down"] is not None:
+                    state["recovery_ms"].append((time.perf_counter() - state["t_down"]) * 1e3)
+                    state["t_down"] = None
+            # no explicit flush: destroy() below disarms the engine heartbeat
+            # FIRST and then joins the writer, so a slow final commit cannot
+            # trip a bogus engine-stall dump
+            return [state["losses"].get(i) for i in range(steps)]
+        except BaseException:
+            state["t_down"] = time.perf_counter()
+            state["restarts"] += 1
+            raise
+        finally:
+            eng.destroy()
+
+    with storm:
+        requeues = 0
+        while True:
+            out = run_resilient(train_fn, ds_config, save_dir=ckpt_dir,
+                                max_restarts=max_restarts, restart_delay_s=0.0,
+                                backoff_factor=1.0, deep_verify=True, warm_remesh=True)
+            if isinstance(out, TrainingPreempted) and len(state["losses"]) < steps:
+                # a preempted job is REQUEUED by the cluster scheduler; the
+                # drill plays that role (bounded)
+                requeues += 1
+                if requeues > max_requeues:
+                    break
+                continue
+            break
+    # let any in-flight watchdog pass finish before counting dumps
+    time.sleep(0.1)
+    health.shutdown()
+
+    # a PREEMPTED step trains + checkpoints but unwinds train_batch before
+    # returning its loss (the clean-exit contract), so its loss is
+    # unobservable and the resume starts past it — the curve legitimately
+    # has a gap there. The bar is: every OBSERVED step bit-identical, the
+    # FINAL loss bit-identical (the run converged to the same place), and
+    # gaps only where a preempt fired.
+    got = [state["losses"].get(i) for i in range(steps)]
+    observed = [(g, w) for g, w in zip(got, want) if g is not None]
+    n_preempts = storm.counts().get("preempt", 0)
+    loss_parity = (got[-1] is not None
+                   and all(g == w for g, w in observed)
+                   and (steps - len(observed)) <= n_preempts)
+
+    # every disk tag a restart trusted must be deeply manifest-valid
+    resumed_disk = [t for kind, t in state["resumes"] if kind == "disk"]
+    resumed_tags_valid = all(
+        is_committed(os.path.join(ckpt_dir, t), deep=True) for t in resumed_disk)
+
+    # one forensic dump per injected stall, each naming the stalled source
+    n_stalls = storm.counts().get("stall", 0)
+    dumps = sorted(f for f in os.listdir(dump_dir) if f.startswith("health_stall_"))
+    dumps_named = 0
+    for f in dumps:
+        with open(os.path.join(dump_dir, f)) as fh:
+            header = json.loads(fh.readline())
+        if "engine" in header.get("reason", ""):
+            dumps_named += 1
+    stall_dumps_match = (len(dumps) == n_stalls == dumps_named)
+
+    counts = storm.counts()
+    rec = state["recovery_ms"]
+    return {
+        "arm": "training",
+        "seed": seed,
+        "steps": steps,
+        "loss_parity": bool(loss_parity),
+        "resumed_tags_valid": bool(resumed_tags_valid),
+        "stall_dumps_match": bool(stall_dumps_match),
+        "stall_dumps": len(dumps),
+        "events": counts,
+        "event_log": storm.event_log(),
+        "restarts": state["restarts"],
+        "requeues": requeues,
+        "warm_resumes": state["warm_resumes"],
+        "resumes": state["resumes"],
+        "recovery_ms_p50": (round(float(np.percentile(rec, 50)), 1) if rec else None),
+        "workdir": workdir,
+    }
+
+
+# ---------------------------------------------------------------------------
+# serving arm
+# ---------------------------------------------------------------------------
+def serving_drill(seed=0, n_requests=24, n_replicas=2, kill_after_fires=20,
+                  concurrency=4, rate_rps=None, timeout_s=60.0):
+    """Run the serving chaos drill; returns the verdicts dict. A chaos kill
+    takes one replica driver down under closed-loop blocking HTTP load; the
+    drill restarts it once it is observed dead, then runs a drain/undrain
+    cycle against ``/readyz``."""
+    import urllib.request
+    import urllib.error
+
+    from deepspeed_tpu.monitor.metrics import configure_metrics, get_metrics
+    from deepspeed_tpu.runtime.resilience.chaos import ChaosSchedule, ChaosSpec
+    from tools.serving_load import build_gateway, make_workload, run_http_load
+
+    configure_metrics(enabled=True)
+    reg = get_metrics()
+    fail_c = reg.counter("gateway/replica_failures_total")
+    base_failures = fail_c.value
+    gw = build_gateway(n_replicas=n_replicas, prefix_cache=True,
+                      request_timeout_s=timeout_s)
+    storm = ChaosSchedule(seed, [
+        ChaosSpec("kill", "serving/driver", rate=1.0,
+                  start_after=kill_after_fires, max_events=1),
+    ])
+
+    def readyz():
+        try:
+            with urllib.request.urlopen(f"{gw.url}/readyz", timeout=5) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    result = {"arm": "serving", "seed": seed, "n_requests": n_requests,
+              "n_replicas": n_replicas}
+    try:
+        # warm the compile buckets BEFORE the storm so the kill lands on
+        # steady-state decode, not first-compile
+        warm = make_workload(4, prompt_lo=8, prompt_hi=16, new_lo=3, new_hi=6,
+                             rate_rps=None, seed=seed, uid_base=0)
+        run_http_load(gw.config.host, gw.port, warm, concurrency=2, stream=False,
+                      timeout_s=timeout_s)
+
+        wl = make_workload(n_requests, prompt_lo=8, prompt_hi=24, new_lo=4, new_hi=10,
+                           rate_rps=rate_rps, seed=seed + 1, uid_base=1000)
+        load_out = {}
+
+        def load():
+            load_out["agg"], load_out["recs"] = run_http_load(
+                gw.config.host, gw.port, wl, concurrency=concurrency,
+                stream=False, timeout_s=timeout_s)
+
+        storm.install()
+        t_load = threading.Thread(target=load, name="chaos-drill-load")
+        t_load.start()
+        # monitor: restart the replica the storm killed. The loop outlives
+        # the load if the kill lands on an idle driver right after the last
+        # request — the drill's restart/recovery verdicts still apply
+        t_kill = t_recover = None
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            dead = [r for r in gw.replicas if not r.alive]
+            if dead and t_kill is None:
+                t_kill = time.perf_counter()
+            if dead:
+                # restart immediately: the dead driver's exit path already
+                # drained its queues (fail_for runs in its finally before
+                # the thread exits), so there is nothing to wait out — and
+                # any artificial pause here would be reported as recovery
+                # time the SYSTEM never spent
+                for r in dead:
+                    r.restart()
+                if all(r.alive for r in gw.replicas):
+                    t_recover = time.perf_counter()
+            if not t_load.is_alive() and (t_recover is not None or not storm.events):
+                break
+            time.sleep(0.02)
+        t_load.join(timeout=timeout_s)
+        storm.uninstall()
+
+        recs = load_out.get("recs", [])
+        ok_done = [r for r in recs if r["status"] == 200 and not r["error"] and r["tokens"]]
+        retryable = [r for r in recs if r["status"] in (429, 503, 504)]
+        unreported = [r for r in recs if r not in ok_done and r not in retryable]
+        s503 = [r for r in recs if r["status"] == 503]
+        result.update({
+            "killed": bool(storm.events),
+            "kill_observed": t_kill is not None,
+            "completed": len(ok_done),
+            "n_503": len(s503),
+            "n_504": sum(1 for r in recs if r["status"] == 504),
+            "n_429": sum(1 for r in recs if r["status"] == 429),
+            "zero_unreported": not unreported,
+            "unreported": [{"uid": r["uid"], "status": r["status"], "error": r["error"]}
+                           for r in unreported],
+            "retry_after_on_503": all(r.get("retry_after") for r in s503),
+            "replica_failure_counted": fail_c.value > base_failures,
+            "recovery_ms": (round((t_recover - t_kill) * 1e3, 1)
+                            if t_kill is not None and t_recover is not None else None),
+        })
+
+        # drain / undrain: /readyz must flip and recover, and a drained
+        # gateway must refuse with a retryable 503
+        ready_before = readyz()
+        gw.drain(True)
+        ready_drained = readyz()
+        # a drained gateway must refuse with a RETRYABLE 503 (Retry-After
+        # present), not a bare one — this is the deterministic 503 probe,
+        # independent of whether the kill above caught requests in a queue
+        req = urllib.request.Request(
+            f"{gw.url}/v1/generate", method="POST",
+            data=json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                drained_status, drained_retry = r.status, r.headers.get("Retry-After")
+        except urllib.error.HTTPError as e:
+            drained_status, drained_retry = e.code, e.headers.get("Retry-After")
+        result["drained_503_retry_after"] = (drained_status == 503
+                                             and bool(drained_retry))
+        gw.drain(False)
+        ready_after = readyz()
+        result["readyz_flipped"] = (ready_before == 200 and ready_drained == 503
+                                    and ready_after == 200)
+        # post-recovery traffic completes again on the restarted fleet
+        tail = make_workload(4, prompt_lo=8, prompt_hi=16, new_lo=3, new_hi=6,
+                             rate_rps=None, seed=seed + 2, uid_base=9000)
+        tail_agg, tail_recs = run_http_load(gw.config.host, gw.port, tail,
+                                            concurrency=2, stream=False,
+                                            timeout_s=timeout_s)
+        result["recovered_completions"] = tail_agg["completed"]
+        result["recovered"] = tail_agg["completed"] == len(tail_recs)
+    finally:
+        storm.uninstall()
+        gw.stop()
+    return result
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="Chaos drills over the resilience stack")
+    p.add_argument("arm", choices=("training", "serving"))
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--workdir", default=None)
+    args = p.parse_args(argv)
+    if args.arm == "training":
+        out = training_drill(seed=args.seed, steps=args.steps, workdir=args.workdir)
+    else:
+        out = serving_drill(seed=args.seed, n_requests=args.requests,
+                            n_replicas=args.replicas)
+    print(json.dumps(out, indent=2, default=repr))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

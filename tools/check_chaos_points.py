@@ -1,0 +1,231 @@
+"""Static check: the chaos plane stays a production-safe no-op.
+
+Companion to ``check_timed_ops.py`` / ``check_heartbeats.py`` /
+``check_ckpt_commit.py`` (same lesson: structural invariants rot silently
+unless CI asserts them). Two rules, both AST-only (no package imports, runs
+anywhere):
+
+1. **fire()-only access.** Production modules (everything under
+   ``deepspeed_tpu/`` except the implementing package
+   ``runtime/resilience/``) may reach :mod:`chaos` / :mod:`fault_injection`
+   ONLY through no-op-when-unhooked points: a module-top-level import of
+   the module object plus calls to ``fire`` (and the ``armed`` guard).
+   Conditional imports (inside ``if``/``try``/function bodies) and calls to
+   the hook-installing surface (``inject``/``crash_at``/``clear``/
+   ``ChaosSchedule``…) are violations — they are how "test-only branches"
+   creep into the hot path and how a storm ends up armed in production by
+   accident.
+
+2. **No silent swallows.** Every ``except`` handler in ``elasticity/`` and
+   ``runtime/resilience/`` must re-raise, raise, or increment a named
+   ``health/`` counter (``…counter("health/…").inc()``) — directly or via
+   a helper function defined in the same module whose body increments one.
+   The resilience plane is the code that runs while everything else is on
+   fire; an exception it eats without a number is a forensic dead end.
+"""
+
+import ast
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PKG = os.path.join(_HERE, os.pardir, "deepspeed_tpu")
+
+CHAOS_MODULES = {"chaos", "fault_injection"}
+# the only attributes production code may touch on the chaos module object
+ALLOWED_ATTRS = {"fire", "armed"}
+EXCEPT_DIRS = (
+    os.path.join(_PKG, "elasticity"),
+    os.path.join(_PKG, "runtime", "resilience"),
+)
+# the implementing package: exempt from rule 1 (it IS the registry) but
+# covered by rule 2
+_IMPL_DIR = os.path.join(_PKG, "runtime", "resilience")
+
+
+def _iter_py_files(target):
+    if os.path.isfile(target):
+        yield target
+        return
+    for root, _dirs, files in os.walk(target):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def _rel(path):
+    return os.path.relpath(path, os.path.join(_HERE, os.pardir))
+
+
+# ---------------------------------------------------------------------------
+# rule 1: fire()-only access from production modules
+# ---------------------------------------------------------------------------
+def _chaos_import_aliases(tree, violations, path):
+    """Names that refer to a chaos module in this file; flags conditional
+    imports (any import of chaos that is not a direct module-body child)."""
+    aliases = set()
+    module_body = set(map(id, tree.body))
+
+    for node in ast.walk(tree):
+        names = []
+        if isinstance(node, ast.Import):
+            names = [(a.name.rsplit(".", 1)[-1], a.asname or a.name.split(".")[0])
+                     for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            mod_leaf = (node.module or "").rsplit(".", 1)[-1]
+            for a in node.names:
+                if a.name in CHAOS_MODULES:
+                    names.append((a.name, a.asname or a.name))
+                elif mod_leaf in CHAOS_MODULES:
+                    # `from ...chaos import X`: importing members directly —
+                    # only `fire`/`armed` are acceptable points
+                    if a.name not in ALLOWED_ATTRS:
+                        violations.append(
+                            f"{_rel(path)}:{node.lineno} imports {a.name!r} from the "
+                            f"chaos plane — production modules may only use "
+                            f"{sorted(ALLOWED_ATTRS)} (hook installation is test/"
+                            f"drill-only API)")
+                    names.append((a.name, a.asname or a.name))
+        if not names:
+            continue
+        chaos_names = [(leaf, bound) for leaf, bound in names if leaf in CHAOS_MODULES
+                       or leaf in ALLOWED_ATTRS]
+        if not chaos_names:
+            continue
+        if id(node) not in module_body:
+            violations.append(
+                f"{_rel(path)}:{node.lineno} conditional/nested import of the chaos "
+                f"plane — chaos must be imported at module top level so fire() "
+                f"points are unconditionally present (no test-only branches)")
+        for leaf, bound in chaos_names:
+            if leaf in CHAOS_MODULES:
+                aliases.add(bound)
+    return aliases
+
+
+def _check_fire_only(path, tree, violations):
+    aliases = _chaos_import_aliases(tree, violations, path)
+    if not aliases:
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+                and node.value.id in aliases:
+            if node.attr not in ALLOWED_ATTRS:
+                violations.append(
+                    f"{_rel(path)}:{node.lineno} production access to chaos plane "
+                    f"attribute {node.attr!r} — only {sorted(ALLOWED_ATTRS)} are "
+                    f"no-op-when-unhooked; hook installation belongs in tests/"
+                    f"drills")
+
+
+# ---------------------------------------------------------------------------
+# rule 2: no silent swallows in elasticity/ + runtime/resilience/
+# ---------------------------------------------------------------------------
+def _is_health_counter_inc(node):
+    """Matches ``<anything>.counter("health/…")….inc(…)``."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "inc"):
+        return False
+    target = node.func.value
+    # unwrap chained attributes between counter() and inc() (there are none
+    # today, but `.labels(...)`-style chains are the obvious future shape)
+    while isinstance(target, ast.Attribute):
+        target = target.value
+    if not (isinstance(target, ast.Call) and isinstance(target.func, (ast.Attribute, ast.Name))):
+        return False
+    fname = target.func.attr if isinstance(target.func, ast.Attribute) else target.func.id
+    if fname != "counter" or not target.args:
+        return False
+    arg = target.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value.startswith("health/")
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        return (isinstance(head, ast.Constant) and isinstance(head.value, str)
+                and head.value.startswith("health/"))
+    return False
+
+
+def _body_has_escape(body_nodes, helper_ok):
+    """True when the statement list contains a raise, a health-counter
+    increment, or a call to a known counting helper."""
+    for stmt in body_nodes:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Raise):
+                return True
+            if _is_health_counter_inc(sub):
+                return True
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                fname = f.attr if isinstance(f, ast.Attribute) else \
+                    (f.id if isinstance(f, ast.Name) else None)
+                if fname in helper_ok:
+                    return True
+    return False
+
+
+def _counting_helpers(tree):
+    """Module functions whose body raises or increments a health/ counter —
+    one level of resolution for handlers that delegate (``_record_failure``)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if _is_health_counter_inc(sub):
+                    out.add(node.name)
+                    break
+    return out
+
+
+def _check_excepts(path, tree, violations):
+    helpers = _counting_helpers(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _body_has_escape(node.body, helpers):
+            continue
+        what = ast.unparse(node.type) if node.type is not None else "<bare>"
+        violations.append(
+            f"{_rel(path)}:{node.lineno} `except {what}` neither re-raises nor "
+            f"increments a named health/ counter — a silent swallow in the "
+            f"resilience plane is a forensic dead end")
+
+
+# ---------------------------------------------------------------------------
+def check(pkg_dir=None, except_dirs=None):
+    """Return a list of human-readable violations (empty == clean)."""
+    pkg_dir = pkg_dir or _PKG
+    impl = os.path.abspath(_IMPL_DIR) if pkg_dir == _PKG else \
+        os.path.join(os.path.abspath(pkg_dir), "runtime", "resilience")
+    violations = []
+    for path in _iter_py_files(pkg_dir):
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        if not os.path.abspath(path).startswith(impl):
+            _check_fire_only(path, tree, violations)
+    for target in (except_dirs if except_dirs is not None
+                   else (EXCEPT_DIRS if pkg_dir == _PKG else
+                         [os.path.join(pkg_dir, "elasticity"),
+                          os.path.join(pkg_dir, "runtime", "resilience")])):
+        for path in _iter_py_files(target):
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            _check_excepts(path, tree, violations)
+    return violations
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    violations = check(argv[0] if argv else None)
+    if violations:
+        print("check_chaos_points: FAILED")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print("check_chaos_points: chaos plane is fire()-only and the resilience "
+          "plane swallows nothing silently")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

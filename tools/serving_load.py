@@ -530,7 +530,7 @@ def _http_generate(host, port, r, stream, timeout_s, slo_class):
         body["slo_class"] = slo_class
     rec = {"uid": r["uid"], "status": None, "tokens": [], "ttft_ms": None,
            "tpot_ms": None, "latency_ms": None, "error": None,
-           "request_id": None}
+           "request_id": None, "retry_after": None}
     t_send = time.time()
     conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
     try:
@@ -542,6 +542,7 @@ def _http_generate(host, port, r, stream, timeout_s, slo_class):
         resp = conn.getresponse()
         rec["status"] = resp.status
         rec["request_id"] = resp.getheader("X-Request-Id")
+        rec["retry_after"] = resp.getheader("Retry-After")
         if resp.status != 200:
             payload = json.loads(resp.read() or b"{}")
             rec["error"] = payload.get("error")
