@@ -380,6 +380,39 @@ def bench_kernels(on_tpu: bool) -> dict:
         print(f"# WARNING: kernels.paged_attention bench failed "
               f"({type(e).__name__}: {str(e)[:160]})", flush=True)
 
+    # --- paged attention decode: flash-decode KV-split on vs off ---
+    try:
+        from deepspeed_tpu.ops.pallas.paged_attention import (_pallas_paged,
+                                                              _resolve_kv_splits)
+
+        # the SHARED decode-shaped case (one token per sequence at the end
+        # of a fully-live long context — the shape where the per-token
+        # grid's single softmax chain is the latency floor): the bench
+        # measures exactly the shape tune_paged_decode records
+        n_seqs = 4
+        q, k_pool, v_pool, tables, seq_idx, pos, bs, mb = \
+            KernelAutotuner.paged_decode_case(on_tpu, n_seqs=n_seqs)
+        ks = _resolve_kv_splits(n_seqs, n_seqs, mb)
+        if ks <= 1:
+            ks = 8
+
+        def decode(kv_splits):
+            return lambda: _pallas_paged(q, k_pool, v_pool, tables, seq_idx, pos,
+                                         block_size=bs, q_tile=1, kv_splits=kv_splits,
+                                         interpret=not on_tpu)
+
+        t1 = timeit(decode(1))
+        ts = timeit(decode(ks))
+        out["paged_decode_split"] = {
+            "kv_splits": ks, "context_tokens": mb * bs, "decode_rows": n_seqs,
+            "split_off_tok_s": round(n_seqs / t1, 1),
+            "split_on_tok_s": round(n_seqs / ts, 1),
+            "speedup": round(t1 / ts, 3),
+        }
+    except Exception as e:
+        print(f"# WARNING: kernels.paged_decode_split bench failed "
+              f"({type(e).__name__}: {str(e)[:160]})", flush=True)
+
     # --- ZeRO-3 overlap_comm: explicit vs implicit step time ---
     try:
         import deepspeed_tpu
@@ -672,10 +705,11 @@ def run_bench():
         except Exception as e:
             print(f"# WARNING: gateway bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
-    # speculative decoding (PR 9): spec-on/off A/B on the shared-prefix
+    # speculative decoding (PR 9/13): spec-on/off A/B on the shared-prefix
     # workload — acceptance rate + decode tok/s both arms + greedy token
-    # parity. DS_TPU_BENCH_SPEC=0 skips; a failure costs this block only,
-    # never the headline serving numbers.
+    # parity — plus the K × tree-width sweep grid with per-drafter-mode
+    # accept rates. DS_TPU_BENCH_SPEC=0 skips; a failure costs this block
+    # only, never the headline serving numbers.
     if os.environ.get("DS_TPU_BENCH_SPEC", "1") != "0":
         try:
             from tools.serving_load import speculative_ab
@@ -683,13 +717,33 @@ def run_bench():
             sp = speculative_ab(on_tpu)
             serving["speculative"] = {k: sp[k] for k in
                                       ("accept_rate", "decode_tok_s_on", "decode_tok_s_off",
-                                       "speedup", "k", "min_match", "spec_rounds",
-                                       "drafted_tokens", "token_parity") if k in sp}
+                                       "speedup", "k", "min_match", "tree_width",
+                                       "spec_rounds", "drafted_tokens", "token_parity")
+                                      if k in sp}
             print(f"# speculative: accept_rate={sp.get('accept_rate')} decode_tok_s "
                   f"on/off={sp.get('decode_tok_s_on')}/{sp.get('decode_tok_s_off')} "
-                  f"(k={sp.get('k')}, parity={sp.get('token_parity')})", flush=True)
+                  f"(k={sp.get('k')}, width={sp.get('tree_width')}, "
+                  f"parity={sp.get('token_parity')})", flush=True)
         except Exception as e:
             print(f"# WARNING: speculative bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+        # the sweep is its own failure domain: the headline A/B above must
+        # survive a sweep-only regression (and vice versa)
+        try:
+            from tools.serving_load import speculative_sweep
+
+            sw = speculative_sweep(on_tpu)
+            serving.setdefault("speculative", {})["sweep"] = {
+                "grid": sw["grid"], "decode_tok_s_off": sw["decode_tok_s_off"],
+                "best_accept_rate_by_mode": sw["best_accept_rate_by_mode"],
+                "all_parity": sw["all_parity"]}
+            best = max(sw["grid"], key=lambda c: c["decode_tok_s"], default=None)
+            if best:
+                print(f"# speculative sweep: best cell mode={best['mode']} k={best['k']} "
+                      f"width={best['tree_width']} accept={best['accept_rate']} "
+                      f"tok/s={best['decode_tok_s']} (parity={sw['all_parity']})", flush=True)
+        except Exception as e:
+            print(f"# WARNING: speculative sweep phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
     serving.update(backend_stamp(on_tpu))
     print(json.dumps(serving))

@@ -337,6 +337,58 @@ class KernelAutotuner:
         # lookup reaches (exact (T, S) entries can still be hand-recorded)
         return self.sweep("paged_attention", shape_bucket(T=T), cands, build)
 
+    @staticmethod
+    def paged_decode_case(on_tpu: bool, n_seqs=4, max_blocks=None, block_size=None,
+                          nq=8, d=128):
+        """The canonical decode-shaped microbench case (one token per
+        sequence, every row at the END of a fully-live ``max_blocks``-block
+        context): ``(q, k_pool, v_pool, tables, seq_idx, pos, block_size,
+        max_blocks)``. SHARED by :meth:`tune_paged_decode` and
+        ``bench.py``'s ``paged_decode_split`` A/B so the bench can never
+        quietly measure a different shape than the tuner records."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        mb = max_blocks or (64 if on_tpu else 32)
+        bs = block_size or (128 if on_tpu else 16)
+        if not on_tpu:
+            nq, d = 4, 32
+        rng = np.random.default_rng(0)
+        dt = jnp.bfloat16 if on_tpu else jnp.float32
+        n_blocks = n_seqs * mb
+        k_pool = jnp.asarray(rng.normal(size=(n_blocks * bs, nq, d)), dt)
+        v_pool = jnp.asarray(rng.normal(size=(n_blocks * bs, nq, d)), dt)
+        tables = jnp.arange(n_blocks, dtype=jnp.int32).reshape(n_seqs, mb)
+        q = jnp.asarray(rng.normal(size=(n_seqs, nq, d)), dt)
+        seq_idx = jnp.arange(n_seqs, dtype=jnp.int32)
+        pos = jnp.full((n_seqs, ), mb * bs - 1, jnp.int32)  # fully-live long context
+        return q, k_pool, v_pool, tables, seq_idx, pos, bs, mb
+
+    def tune_paged_decode(self, n_seqs=None, max_blocks=None, block_size=None, nq=8, d=128,
+                          candidates=None):
+        """Sweep the flash-decode ``kv_splits`` factor on a DECODE-shaped
+        batch (one token per sequence, long block table): the split is the
+        only knob that parallelizes a single long-context decode row across
+        the KV axis, and its winner depends on chip generation (megacore
+        count, DMA depth) and context length. Records under the B-only
+        bucket (B = block-table capacity) — the key ``_resolve_kv_splits``
+        falls back to for any decode batch size."""
+        from ..ops.pallas.paged_attention import _pallas_paged
+
+        on_tpu = self._on_tpu()
+        q, k_pool, v_pool, tables, seq_idx, pos, bs, mb = self.paged_decode_case(
+            on_tpu, n_seqs=n_seqs or 4, max_blocks=max_blocks, block_size=block_size,
+            nq=nq, d=d)
+        cands = candidates or [{"kv_splits": ks}
+                               for ks in ((1, 4, 8, 16) if on_tpu else (1, 2, 4, 8))]
+
+        def build(c):
+            return lambda: _pallas_paged(q, k_pool, v_pool, tables, seq_idx, pos,
+                                         block_size=bs, q_tile=1, kv_splits=c["kv_splits"],
+                                         interpret=not on_tpu)
+
+        return self.sweep("paged_attention", shape_bucket(B=mb), cands, build)
+
     def tune_grouped(self, T=None, K=None, N=None, E=4, candidates=None):
         import jax
         import jax.numpy as jnp
@@ -366,7 +418,7 @@ class KernelAutotuner:
         return self.sweep("grouped_matmul", shape_bucket(K=K, N=N), cands, build)
 
     def tune_all(self, kernels: Sequence[str] = ("flash_attention", "paged_attention",
-                                                 "grouped_matmul")) -> str:
+                                                 "paged_decode", "grouped_matmul")) -> str:
         """Run every requested sweep, persist ``kernel_config.json`` into
         ``output_dir`` (next to the config sweep's ``best_config.json``) and
         return the artifact path."""
@@ -374,6 +426,8 @@ class KernelAutotuner:
             self.tune_flash()
         if "paged_attention" in kernels:
             self.tune_paged()
+        if "paged_decode" in kernels:
+            self.tune_paged_decode()
         if "grouped_matmul" in kernels:
             self.tune_grouped()
         path = self.registry.save(os.path.join(self.output_dir, CONFIG_FILENAME))

@@ -139,8 +139,13 @@ def universal_state_from_tree(tree):
             meta[k] = np.asarray(jax.device_get(scalars[k])).item()
     # non-array sidecar counters when present in the tree (a live
     # ``_ckpt_state`` tree carries them inline; the disk path merges the
-    # meta.pkl sidecar in before calling here)
-    for k in ("global_steps", "global_samples", "skipped_steps", "lr_scheduler", "ds_version"):
+    # meta.pkl sidecar in before calling here). curriculum / random-ltd
+    # scheduler state rides along: a warm remesh of a data-efficiency run
+    # must resume at the restored step's difficulty / sequence budget, not
+    # restart the schedule from scratch (silent divergence from native
+    # resume otherwise — the lr_scheduler lesson repeated)
+    for k in ("global_steps", "global_samples", "skipped_steps", "lr_scheduler",
+              "curriculum_scheduler", "random_ltd_scheduler", "ds_version"):
         if tree.get(k) is not None:
             meta[k] = tree[k]
     return sd, meta
@@ -156,7 +161,8 @@ def ds_to_universal(checkpoint_dir, output_dir, tag=None):
         with open(src_meta, "rb") as f:
             side = pickle.load(f)
         tree = dict(tree)
-        for k in ("global_steps", "global_samples", "skipped_steps", "lr_scheduler", "ds_version"):
+        for k in ("global_steps", "global_samples", "skipped_steps", "lr_scheduler",
+                  "curriculum_scheduler", "random_ltd_scheduler", "ds_version"):
             if k in side and tree.get(k) is None:
                 tree[k] = side[k]
 

@@ -146,6 +146,17 @@ def apply_universal_state(engine, sd, meta, load_optimizer_states=True):
     engine.global_steps = int(meta.get("global_steps", engine.global_steps))
     if meta.get("lr_scheduler") and getattr(engine, "lr_scheduler", None) is not None:
         engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    # data-efficiency scheduler state (ROADMAP 5c): a warm remesh must
+    # resume curriculum difficulty / random-ltd sequence budget exactly
+    # where the snapshot left them — without this a data-efficiency run
+    # restarting onto a new topology silently re-ran its schedule from
+    # step 0 while the optimizer continued from the restored step
+    if meta.get("curriculum_scheduler") and getattr(engine, "curriculum_scheduler",
+                                                    None) is not None:
+        engine.curriculum_scheduler.load_state_dict(meta["curriculum_scheduler"])
+    if meta.get("random_ltd_scheduler") and getattr(engine, "random_ltd_scheduler",
+                                                    None) is not None:
+        engine.random_ltd_scheduler.load_state_dict(meta["random_ltd_scheduler"])
     return meta
 
 

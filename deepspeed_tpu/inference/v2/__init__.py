@@ -9,4 +9,5 @@ from .scheduling_utils import SchedulingError, SchedulingResult
 from .scheduler import DynamicSplitFuseScheduler
 from .inference_utils import (ActivationType, DtypeEnum, NormTypeEnum, ceil_div,
                               elem_size, is_gated)
+from .sampling import SamplingParams
 from .speculative import Drafter, DraftModelDrafter, NgramDrafter, build_drafter

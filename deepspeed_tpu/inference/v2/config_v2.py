@@ -75,7 +75,20 @@ class SpeculativeConfig:
     acceptance rate is low."""
 
     mode: str = "off"  # 'off' | 'ngram' (self-speculative prompt lookup) | 'draft_model'
-    k: int = 4         # draft tokens verified per speculative step
+    k: int = 4         # draft tokens verified per speculative step (per branch)
+    # token-tree verification: candidate branches verified per round (1 =
+    # linear, the PR 9 behavior). Each extra branch costs k verify tokens
+    # and any ONE matching lifts the round's acceptance — the lever for
+    # workloads where a single n-gram guess is weak. Greedy only: sampled
+    # requests fall back to one linear branch (rejection-sampling verify).
+    tree_width: int = 1
+    # spec-burst backoff: after this many CONSECUTIVE zero-accept verify
+    # rounds a request stops drafting (its verify FLOPs were pure waste)
+    # and rides the plain multi-step decode burst; 0 disables backoff
+    backoff_after: int = 8
+    # while backed off, re-probe (draft again) every this many rounds so a
+    # stream that BECOMES repetitive gets speculation back
+    reprobe_every: int = 32
     # ngram drafter: shortest suffix n-gram worth matching (higher = fewer,
     # better-grounded drafts) and the longest tried first
     min_match: int = 2

@@ -234,7 +234,7 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------------
     def put(self, batch_uids: List[int], batch_tokens: List[np.ndarray], do_checks: bool = True,
-            sample: Optional[str] = None, block: bool = True) -> np.ndarray:
+            sample: Optional[str] = None, block: bool = True, sampling=None) -> np.ndarray:
         """Run one ragged forward (reference ``put:107``). ``batch_tokens[i]``
         are the new tokens of sequence ``batch_uids[i]`` (whole prompt for
         prefill, one token for decode). Returns last-token logits
@@ -246,7 +246,14 @@ class InferenceEngineV2:
         ``block=False`` returns the device array without a host fetch, so a
         scheduler that doesn't need the values (e.g. speculative admission,
         or a benchmark on a high-latency relay) can pipeline several steps
-        into the device queue."""
+        into the device queue.
+
+        ``sampling``: per-sequence :class:`SamplingParams` list (None
+        entries = greedy rows). With any temperature > 0 the returned
+        tokens are drawn from the tempered/top-p distribution ON DEVICE
+        (``sampling.sample_tokens``), keyed by (seed, token position) so a
+        fixed seed replays the same stream; all-greedy lists keep the
+        byte-identical argmax program."""
         hb = self._health
         # normalize ONCE, before any breadcrumb math: both arguments may be
         # single-pass iterables, and _put's re-asarray of the converted rows
@@ -254,18 +261,18 @@ class InferenceEngineV2:
         batch_uids = list(batch_uids)
         batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
         if not hb.enabled:
-            return self._put(batch_uids, batch_tokens, do_checks, sample, block)
+            return self._put(batch_uids, batch_tokens, do_checks, sample, block, sampling)
         # operation-style heartbeat: `serving` is watched exactly while a
         # forward is in flight, so a wedged device call trips the watchdog
         hb.begin("serving")
         get_flight_recorder().record("serving", "put", seqs=len(batch_uids),
                                      tokens=int(sum(t.size for t in batch_tokens)))
         try:
-            return self._put(batch_uids, batch_tokens, do_checks, sample, block)
+            return self._put(batch_uids, batch_tokens, do_checks, sample, block, sampling)
         finally:
             hb.end("serving")
 
-    def _put(self, batch_uids, batch_tokens, do_checks, sample, block):
+    def _put(self, batch_uids, batch_tokens, do_checks, sample, block, sampling=None):
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
         batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
@@ -302,11 +309,29 @@ class InferenceEngineV2:
             descs.append(seq)
         rb = self.batch.finalize()
 
-        fn = self._get_compiled(rb.token_ids.shape[0], rb.block_tables.shape[0], sample)
+        from .sampling import all_greedy, pack_sampling
+
         kv = self.state_manager.kv_cache
-        # ONE descriptor upload per forward (reference single pinned-buffer
-        # upload; each separate array would be its own RPC on a tunnel)
-        out, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
+        if sampling is not None and not all_greedy(sampling):
+            if sample is None:
+                # sample=None means "give me logits" — silently returning
+                # sampled token ids instead would hand a logits consumer an
+                # int32 vector
+                raise ValueError("put(sample=None) returns logits; pass sample='greedy' "
+                                 "with a sampling list to draw tokens on device")
+            # sampled rows draw on device (greedy rows argmax via temp 0);
+            # sample='greedy' callers without sampling keep the original
+            # compiled program byte-for-byte
+            fn = self._get_compiled(rb.token_ids.shape[0], rb.block_tables.shape[0],
+                                    "sample")
+            samp_f, seeds = pack_sampling(sampling, batch_uids, rb.block_tables.shape[0])
+            out, pools = fn(self.params, jnp.asarray(rb.packed()), jnp.asarray(samp_f),
+                            jnp.asarray(seeds), kv.pools())
+        else:
+            fn = self._get_compiled(rb.token_ids.shape[0], rb.block_tables.shape[0], sample)
+            # ONE descriptor upload per forward (reference single pinned-buffer
+            # upload; each separate array would be its own RPC on a tunnel)
+            out, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
         kv.update(*pools)
         for seq in descs:
             seq.post_forward()
@@ -331,7 +356,7 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------------
     def decode(self, batch_uids: List[int], first_tokens, n_steps: int, block: bool = True,
-               eos_token_ids=None) -> np.ndarray:
+               eos_token_ids=None, sampling=None) -> np.ndarray:
         """Run ``n_steps`` greedy decode steps ON DEVICE in one compiled
         program (a ``lax.scan`` feeding each step's argmax back as the next
         token), for sequences already tracked by the engine.
@@ -350,20 +375,28 @@ class InferenceEngineV2:
         ``DSStateManager.rollback_to`` before publish, so the radix tree
         never receives post-eos garbage paths and the tail blocks return to
         the pool immediately instead of idling until flush.
+
+        ``sampling``: per-sequence :class:`SamplingParams` (None = greedy
+        rows). The sampled scan draws each fed-back token from the
+        tempered/top-p distribution on device, keyed by (seed, position);
+        all-greedy lists keep the original argmax scan program.
         """
         batch_uids = list(batch_uids)
         hb = self._health
         if not hb.enabled:
-            return self._decode(batch_uids, first_tokens, n_steps, block, eos_token_ids)
+            return self._decode(batch_uids, first_tokens, n_steps, block, eos_token_ids,
+                                sampling)
         hb.begin("serving")
         get_flight_recorder().record("serving", "decode", seqs=len(batch_uids),
                                      steps=int(n_steps))
         try:
-            return self._decode(batch_uids, first_tokens, n_steps, block, eos_token_ids)
+            return self._decode(batch_uids, first_tokens, n_steps, block, eos_token_ids,
+                                sampling)
         finally:
             hb.end("serving")
 
-    def _decode(self, batch_uids, first_tokens, n_steps, block, eos_token_ids=None):
+    def _decode(self, batch_uids, first_tokens, n_steps, block, eos_token_ids=None,
+                sampling=None):
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
         uids = list(batch_uids)
@@ -407,11 +440,20 @@ class InferenceEngineV2:
             self._decode_batch.insert_sequence(seq, toks)
         rb = self._decode_batch.finalize()
 
-        fn = self._get_compiled_decode(rb.token_ids.shape[0], n_steps)
+        from .sampling import all_greedy, pack_sampling
+
         kv = self.state_manager.kv_cache
-        # start positions already ride inside packed() (each decode row is
-        # one token at its position) — no separate seq_start_len upload
-        toks, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
+        s_bucket = rb.token_ids.shape[0]
+        if sampling is not None and not all_greedy(sampling):
+            fn = self._get_compiled_decode(s_bucket, n_steps, sampled=True)
+            samp_f, seeds = pack_sampling(sampling, uids, s_bucket)
+            toks, pools = fn(self.params, jnp.asarray(rb.packed()), jnp.asarray(samp_f),
+                             jnp.asarray(seeds), kv.pools())
+        else:
+            fn = self._get_compiled_decode(s_bucket, n_steps)
+            # start positions already ride inside packed() (each decode row
+            # is one token at its position) — no separate seq_start_len upload
+            toks, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
         kv.update(*pools)
         toks = toks[:S]  # on-device slice before any host fetch
         pc = self.state_manager.prefix_cache
@@ -457,7 +499,8 @@ class InferenceEngineV2:
                                        "blocked": bool(block)})
         return toks
 
-    def _ragged_step(self, params, packed, pools, t_bucket, s_bucket, gather_k: int = 0):
+    def _ragged_step(self, params, packed, pools, t_bucket, s_bucket, gather_k: int = 0,
+                     tree_meta=None):
         """One ragged forward over the pool tuple (2 = bf16 pools, 4 = int8
         pools + scales). The SINGLE builder both compiled paths share —
         quant/non-quant variation lives in the tuple arity, not in four
@@ -468,11 +511,63 @@ class InferenceEngineV2:
         contiguous in the packed layout, so the positions are
         ``last_idx - gather_k .. last_idx``) instead of only the last
         token. Returns logits ``[S * (gather_k + 1), V]`` row-major per
-        sequence."""
+        sequence.
+
+        ``tree_meta``: token-tree verification — one int32 ``[3 * T]``
+        operand carrying per-token [logical pos_ids | branch id | depth]
+        rows for the flattened draft tree. Each tree node occupies its own
+        KV SLOT (``pos`` = start + flat node index, so sibling branches
+        never collide in the cache) but its LOGICAL position is
+        start + depth; visibility is ancestors-only — committed context,
+        the shared root (depth 0), and earlier nodes of the token's OWN
+        branch. The mask/ctx-position arrays built here feed
+        ``ragged_forward``'s tree kwargs; with ``tree_meta`` None this is
+        byte-identical to the plain causal step."""
         from .ragged.ragged_wrapper import unpack_descriptors
 
         token_ids, seq_idx, pos, valid, tables, last_idx = unpack_descriptors(
             packed, t_bucket, s_bucket, self._max_blocks_per_seq)
+        extra = {}
+        if tree_meta is not None:
+            assert gather_k, "tree_meta requires the gather_k verify layout"
+            T = t_bucket
+            k1 = gather_k + 1
+            pos_ids = tree_meta[0:T]
+            branch = tree_meta[T:2 * T]
+            depth = tree_meta[2 * T:3 * T]
+            C = self._max_blocks_per_seq * self.config.kv_block_size
+            # chunk-local flat node index from the packed layout alone:
+            # every verify chunk is exactly k1 tokens ending at last_idx
+            node_idx = jnp.arange(T, dtype=jnp.int32) - (last_idx[seq_idx] - gather_k)
+            start = pos - node_idx                    # committed length, per token
+            ctx_p = jnp.arange(C, dtype=jnp.int32)[None, :]
+            j = ctx_p - start[:, None]                # ctx slot's flat node index
+            jj = jnp.clip(j, 0, gather_k)
+            # per-sequence node tables scattered from this batch's own rows
+            b_tbl = jnp.zeros((s_bucket, k1), jnp.int32).at[seq_idx, node_idx].set(
+                branch, mode="drop")
+            d_tbl = jnp.zeros((s_bucket, k1), jnp.int32).at[seq_idx, node_idx].set(
+                depth, mode="drop")
+            cb = jnp.take_along_axis(b_tbl[seq_idx], jj, axis=1)   # [T, C]
+            cd = jnp.take_along_axis(d_tbl[seq_idx], jj, axis=1)
+            in_tree = (j >= 0) & (j <= gather_k)
+            # ancestor visibility: committed prefix | root (depth 0) | an
+            # EARLIER node of my own branch — a sibling branch's KV sits at
+            # an earlier slot but must stay invisible
+            vis_tree = in_tree & (cd <= depth[:, None]) & ((cd == 0) | (cb == branch[:, None]))
+            mask = (ctx_p < start[:, None]) | vis_tree
+            window = getattr(self.model_config, "sliding_window", None)
+            if window:
+                ctx_pid_t = jnp.where(in_tree, start[:, None] + cd, ctx_p)
+                mask = mask & (pos_ids[:, None] - ctx_pid_t < int(window))
+            # ctx logical positions per sequence (alibi distances)
+            start_s = pos[jnp.maximum(last_idx, 0)] - gather_k     # [S]
+            js = ctx_p - start_s[:, None]
+            jjs = jnp.clip(js, 0, gather_k)
+            ds = jnp.take_along_axis(d_tbl, jjs, axis=1)
+            ctx_pid = jnp.where((js >= 0) & (js <= gather_k), start_s[:, None] + ds,
+                                jnp.broadcast_to(ctx_p, (s_bucket, C)))
+            extra = {"pos_ids": pos_ids, "attn_mask": mask, "ctx_pos_ids": ctx_pid}
         if gather_k:
             idx = last_idx[:, None] - gather_k + jnp.arange(gather_k + 1, dtype=jnp.int32)
             # padding rows carry last_idx 0 — clamp their (negative) indices;
@@ -482,12 +577,13 @@ class InferenceEngineV2:
         out = ragged_forward(self.model_config, self.config.kv_block_size, params,
                              token_ids, seq_idx, pos, valid, tables, last_idx,
                              pools[0], pools[1], use_pallas=self._use_pallas,
-                             modules=self._modules, **scales)
+                             modules=self._modules, **scales, **extra)
         return out[0], tuple(out[1:])  # logits, new pool tuple
 
     # ------------------------------------------------------------------
     def speculate_decode(self, batch_uids: List[int], first_tokens, draft_tokens,
-                         k: Optional[int] = None, eos_token_ids=None) -> List[np.ndarray]:
+                         k: Optional[int] = None, eos_token_ids=None,
+                         sampling=None) -> List[np.ndarray]:
         """One speculative verify step over tracked, in-decode sequences:
         feed ``[next_token, d_1..d_K]`` as ONE ragged chunk per sequence
         (the packed-batch path already supports multi-token chunks), accept
@@ -512,75 +608,148 @@ class InferenceEngineV2:
         receives post-eos paths (the same contract as :meth:`decode`'s
         eos rewind).
 
-        Compiled once per (token-bucket, seq-bucket, K); rollback is free —
-        accepted tokens just advance ``seen_tokens``, rejected drafts
-        release block-table tail refs via the PR 3 refcount machinery."""
+        ``draft_tokens[i]`` may also be a LIST of candidate branches
+        (token-tree verification): the branches flatten into one ragged
+        chunk — root (the pending token) + every branch at its own KV
+        slots, ancestors-only attention via the tree mask in
+        ``_ragged_step`` — and the DEEPEST branch matching the target's own
+        argmax at each step wins; the winner's KV compacts to the canonical
+        contiguous positions and every rejected branch rolls back, so a
+        rejected sibling can never reach the radix tree. Tree verification
+        is greedy-only.
+
+        ``sampling``: per-sequence :class:`SamplingParams` (None entries =
+        greedy rows). With any temperature > 0 the verify step switches to
+        speculative REJECTION sampling (``sampling.spec_verify_draws``):
+        draft ``d_i`` survives with probability ``p_i(d_i)`` under the
+        target's tempered/top-p distribution and a rejection resamples the
+        normalized residual — the committed stream is distributed exactly
+        as direct sampling, so speculation stays a pure throughput lever
+        at any temperature. Linear drafts only.
+
+        Compiled once per (token-bucket, seq-bucket, K, tree, sampled);
+        rollback is free — accepted tokens just advance ``seen_tokens``,
+        rejected drafts release block-table tail refs via the PR 3
+        refcount machinery."""
         batch_uids = list(batch_uids)
         hb = self._health
         if not hb.enabled:
-            return self._speculate(batch_uids, first_tokens, draft_tokens, k, eos_token_ids)
+            return self._speculate(batch_uids, first_tokens, draft_tokens, k, eos_token_ids,
+                                   sampling)
         hb.begin("serving")
         get_flight_recorder().record("serving", "speculate", seqs=len(batch_uids),
                                      k=int(k) if k is not None else -1)
         try:
-            return self._speculate(batch_uids, first_tokens, draft_tokens, k, eos_token_ids)
+            return self._speculate(batch_uids, first_tokens, draft_tokens, k, eos_token_ids,
+                                   sampling)
         finally:
             hb.end("serving")
 
-    def _speculate(self, batch_uids, first_tokens, draft_tokens, k, eos_token_ids=None):
+    def _speculate(self, batch_uids, first_tokens, draft_tokens, k, eos_token_ids=None,
+                   sampling=None):
+        from .sampling import all_greedy, pack_sampling
+
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
         uids = list(batch_uids)
         S = len(uids)
         firsts = [np.asarray(t, np.int32).reshape(-1) for t in first_tokens]
-        drafts = [np.asarray(d, np.int32).reshape(-1) for d in draft_tokens]
+        # normalize drafts to per-sequence branch LISTS (a bare array is one
+        # linear branch — the PR 9 call surface unchanged)
+        branches: List[List[np.ndarray]] = []
+        for d in draft_tokens:
+            bl = [np.asarray(b, np.int32).reshape(-1) for b in d] \
+                if isinstance(d, (list, tuple)) else [np.asarray(d, np.int32).reshape(-1)]
+            branches.append([b for b in bl if b.size])
+        tree = any(len(bl) > 1 for bl in branches)
+        sampled = not all_greedy(sampling)
+        if tree and sampled:
+            raise ValueError("token-tree verification is greedy-only; a sampled request "
+                             "verifies one linear draft via rejection sampling")
         if k is None:
-            k = max((d.size for d in drafts), default=0)
+            k = max((b.size for bl in branches for b in bl), default=0)
         k = int(k)
         if k < 1:
             raise ValueError("speculate_decode needs k >= 1 (use decode() for plain steps)")
         assert all(t.size == 1 for t in firsts), \
             "speculate_decode takes exactly one pending next token per sequence"
-        if any(d.size > k for d in drafts):
+        if any(b.size > k for bl in branches for b in bl):
             raise ValueError(f"draft longer than k={k}")
+        W = max((len(bl) for bl in branches), default=1) if tree else 1
+        n_new = 1 + W * k  # fed chunk length: root + every (padded) branch
         if len(set(uids)) != len(uids) or S > self.batch.max_seqs:
             raise SchedulingError(SchedulingResult.BatchSequenceLimitExceeded)
-        if S * (k + 1) > self.batch.max_tokens:
+        if S * n_new > self.batch.max_tokens:
             raise SchedulingError(SchedulingResult.TokenLimitExceeded)
         seqs = []
         for uid in uids:
             seq = self.state_manager.get_sequence(uid)
             if seq is None:
                 raise SchedulingError(SchedulingResult.EngineSequenceLimitExceeded)
-            if seq.seen_tokens + k + 1 > self._max_context:
+            if seq.seen_tokens + n_new > self._max_context:
                 raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
             seqs.append(seq)
-        if sum(s.blocks_needed(k + 1) for s in seqs) > self.state_manager.available_blocks:
+        if sum(s.blocks_needed(n_new) for s in seqs) > self.state_manager.available_blocks:
             raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
 
-        # one uniform (k+1)-token chunk per sequence; short drafts pad by
-        # repeating their last token (repetitive streams make that a live
-        # guess; a wrong pad is simply rejected like any wrong draft)
-        chunks = []
-        for f, d in zip(firsts, drafts):
-            pad = np.full(k - d.size, int(d[-1]) if d.size else int(f[0]), np.int32)
-            chunks.append(np.concatenate([f, d, pad]))
+        # uniform chunks; short drafts/branch lists pad by repeating their
+        # last token (branch 0 clones for missing branches): pads ride the
+        # forward like any draft and only ever COMMIT when they equal the
+        # target's own choice, so parity is unconditional
+        chunks, padded = [], []
+        for f, bl in zip(firsts, branches):
+            if tree:
+                bl = list(bl) or [np.full(k, int(f[0]), np.int32)]
+                while len(bl) < W:
+                    bl.append(bl[0])
+                pb = [np.concatenate([b, np.full(k - b.size,
+                                                 int(b[-1]) if b.size else int(f[0]),
+                                                 np.int32)]) for b in bl]
+                padded.append(pb)
+                chunks.append(np.concatenate([f] + pb))
+            else:
+                d = bl[0] if bl else np.empty(0, np.int32)
+                pad = np.full(k - d.size, int(d[-1]) if d.size else int(f[0]), np.int32)
+                padded.append([np.concatenate([d, pad])])
+                chunks.append(np.concatenate([f, d, pad]))
         starts = [s.seen_tokens for s in seqs]
         self.batch.clear()
         for seq, c in zip(seqs, chunks):
             # note BEFORE the forward, like _put: history mirrors the fed
-            # chunk and rollback_to truncates it together with seen_tokens
+            # chunk; commit_speculative/rollback_to reconcile it afterwards
             self.state_manager.note_tokens(seq, c)
-            self.state_manager.allocate_blocks(seq, k + 1)
-            seq.pre_forward(k + 1)
+            self.state_manager.allocate_blocks(seq, n_new)
+            seq.pre_forward(n_new)
             self.batch.insert_sequence(seq, c)
         rb = self.batch.finalize()
+        t_bucket, s_bucket = rb.token_ids.shape[0], rb.block_tables.shape[0]
 
-        fn = self._get_compiled_verify(rb.token_ids.shape[0], rb.block_tables.shape[0], k)
         kv = self.state_manager.kv_cache
-        out, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
+        fn = self._get_compiled_verify(t_bucket, s_bucket, n_new - 1, tree=tree,
+                                       sampled=sampled)
+        if tree:
+            # per-token tree metadata rows [pos_ids | branch | depth]: node
+            # 0 is the shared root at depth 0; branch b's nodes carry depth
+            # 1..k and LOGICAL position start + depth (their KV slots stay
+            # flat — the mask in _ragged_step keeps siblings invisible)
+            meta = np.zeros((3, t_bucket), np.int32)
+            depth_row = np.concatenate([[0]] + [np.arange(1, k + 1)] * W).astype(np.int32)
+            branch_row = np.concatenate([[0]] + [np.full(k, b) for b in range(W)]).astype(np.int32)
+            cur = 0
+            for start in starts:
+                meta[0, cur:cur + n_new] = start + depth_row
+                meta[1, cur:cur + n_new] = branch_row
+                meta[2, cur:cur + n_new] = depth_row
+                cur += n_new
+            out, pools = fn(self.params, jnp.asarray(rb.packed()),
+                            jnp.asarray(meta.reshape(-1)), kv.pools())
+        elif sampled:
+            samp_f, seeds = pack_sampling(sampling, uids, s_bucket)
+            out, pools = fn(self.params, jnp.asarray(rb.packed()),
+                            jnp.asarray(samp_f), jnp.asarray(seeds), kv.pools())
+        else:
+            out, pools = fn(self.params, jnp.asarray(rb.packed()), kv.pools())
         kv.update(*pools)
-        out = np.asarray(out[:S])  # [S, k+1] greedy argmax at every chunk position
 
         if eos_token_ids is None or isinstance(eos_token_ids, (int, np.integer)):
             eos_list = [eos_token_ids] * S
@@ -590,26 +759,77 @@ class InferenceEngineV2:
         results = []
         drafted = accepted = 0
         accepts = []
-        for seq, c, row, start, d, eos in zip(seqs, chunks, out, starts, drafts, eos_list):
-            # accept-longest-prefix: chunk[i+1] survives iff it equals the
-            # model's argmax after consuming chunk[..i]
-            neq = np.nonzero(c[1:] != row[:k])[0]
-            a = int(neq[0]) if neq.size else k
+        if sampled:
+            acc_m = np.asarray(out[0][:S]).astype(bool)  # [S, k] accept bits
+            nxt_m = np.asarray(out[1][:S])               # [S, k+1] resample/bonus
+        else:
+            rows = np.asarray(out[:S])  # [S, n_new] greedy argmax per position
+        for i, (seq, c, start, bl, eos) in enumerate(zip(seqs, chunks, starts, branches,
+                                                         eos_list)):
+            src_dst = None
+            if sampled:
+                d = padded[i][0]
+                rej = np.nonzero(~acc_m[i])[0]
+                a = int(rej[0]) if rej.size else k
+                committed = list(c[1:1 + a]) + [int(nxt_m[i, a])]
+                path = c[1:1 + a]
+                real = int(bl[0].size) if bl else 0
+            elif tree:
+                row = rows[i]
+                # deepest-argmax-path walk: branch b's node at depth t+1 is
+                # accepted iff its token equals the argmax at its PARENT
+                # node (root for t=0); ties keep the first branch, so a
+                # padded branch-0 clone can never displace the original
+                a, bwin = -1, 0
+                for b in range(W):
+                    pb = padded[i][b]
+                    parents = np.concatenate(
+                        [[0], 1 + b * k + np.arange(k - 1)]).astype(np.int64)
+                    neq = np.nonzero(pb != row[parents])[0]
+                    a_b = int(neq[0]) if neq.size else k
+                    if a_b > a:
+                        a, bwin = a_b, b
+                path = padded[i][bwin][:a]
+                bonus = int(row[0] if a == 0 else row[1 + bwin * k + a - 1])
+                committed = list(path) + [bonus]
+                if bwin != 0 and a > 0:
+                    # winner's KV sits at its flat tree slots — move it to
+                    # the canonical contiguous positions before rollback
+                    src_dst = [(start + 1 + bwin * k + t, start + 1 + t)
+                               for t in range(a)]
+                real = int(bl[bwin].size) if bwin < len(bl) else 0
+                drafted += sum(int(b.size) for b in bl)
+            else:
+                row = rows[i]
+                neq = np.nonzero(c[1:] != row[:k])[0]
+                a = int(neq[0]) if neq.size else k
+                committed = list(row[:a + 1])
+                path = row[:a]
+                real = int(bl[0].size) if bl else 0
             if eos is not None:
-                # an eos among the ACCEPTED drafts ends the stream there:
+                # an eos among the ACCEPTED tokens ends the stream there:
                 # commit through the eos only, so the post-eos accepted
                 # tail (KV + history) is rolled back with the rejects and
                 # never published (the bonus-position eos needs nothing —
                 # its KV was never materialized)
-                hit = np.nonzero(row[:a] == eos)[0]
+                hit = np.nonzero(np.asarray(path)[:a] == eos)[0]
                 if hit.size:
                     a = int(hit[0])
-            seq.post_forward()                                    # seen = start + k + 1
-            self.state_manager.rollback_to(seq, start + 1 + a)    # keep fed + accepted
-            self.state_manager.publish_sequence(seq)              # accepted full blocks → tree
-            results.append(row[:a + 1].copy())  # accepted drafts + 1 bonus token
-            drafted += int(d.size)
-            accepted += min(a, int(d.size))  # pads excluded from the honest rate
+                    committed = committed[:a + 1]
+                    if src_dst is not None:
+                        src_dst = src_dst[:a]
+            seq.post_forward()                       # seen = start + n_new
+            if tree:
+                self.state_manager.commit_speculative(
+                    seq, start + 1 + a,
+                    [int(c[0])] + [int(t) for t in committed[:a]], src_dst)
+            else:
+                self.state_manager.rollback_to(seq, start + 1 + a)
+            self.state_manager.publish_sequence(seq)  # accepted full blocks → tree
+            results.append(np.asarray(committed, np.int32))
+            if not tree:
+                drafted += real
+            accepted += min(a, real)  # pads excluded from the honest rate
             accepts.append(a)
         self._spec_totals["drafted"] += drafted
         self._spec_totals["accepted"] += accepted
@@ -621,60 +841,115 @@ class InferenceEngineV2:
                 m.counter("serving/spec_rejected_tokens").inc(drafted - accepted)
                 m.gauge("serving/spec_accept_rate").set(
                     self._spec_totals["accepted"] / max(1, self._spec_totals["drafted"]))
-            committed = int(sum(len(r) for r in results))
+            committed_n = int(sum(len(r) for r in results))
             observe_latency(t0, "serving/spec_verify",
                             hist_name="serving/spec_verify_ms",
                             gauges={"serving/spec_tokens_per_sec":
-                                    lambda dt: committed / max(dt, 1e-9)},
+                                    lambda dt: committed_n / max(dt, 1e-9)},
                             span_args={"seqs": S, "k": k, "drafted": drafted,
+                                       "tree_width": W, "sampled": bool(sampled),
                                        "accepted": accepts[:16],
                                        "uids": [int(u) for u in uids[:16]]})
         return results
 
-    def _get_compiled_verify(self, t_bucket: int, s_bucket: int, k: int):
-        key = ("verify", t_bucket, s_bucket, k)
+    def _get_compiled_verify(self, t_bucket: int, s_bucket: int, k: int,
+                             tree: bool = False, sampled: bool = False):
+        key = ("verify", t_bucket, s_bucket, k, bool(tree), bool(sampled))
         if key not in self._compiled:
             step_fn = self._ragged_step
+            mb = self._max_blocks_per_seq
 
-            def fwd(params, packed, pools):
-                logits, pools = step_fn(params, packed, pools, t_bucket, s_bucket,
-                                        gather_k=k)
-                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return toks.reshape(s_bucket, k + 1), pools
+            if sampled:
+                from .sampling import spec_verify_draws
 
-            self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
+                def fwd(params, packed, samp_f, seeds, pools):
+                    logits, pools = step_fn(params, packed, pools, t_bucket, s_bucket,
+                                            gather_k=k)
+                    lg = logits.reshape(s_bucket, k + 1, -1)
+                    last = packed[4 * t_bucket + s_bucket * mb:
+                                  4 * t_bucket + s_bucket * mb + s_bucket]
+                    idx = jnp.maximum(
+                        last[:, None] - k + jnp.arange(k + 1, dtype=jnp.int32), 0)
+                    chunk = packed[0:t_bucket][idx]                 # fed token rows
+                    starts = packed[2 * t_bucket:3 * t_bucket][jnp.maximum(last, 0)] - k
+                    accept, nxt = spec_verify_draws(lg, chunk, samp_f[:, 0], samp_f[:, 1],
+                                                    seeds, starts)
+                    return (accept.astype(jnp.int32), nxt), pools
+
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(4, ))
+            elif tree:
+                def fwd(params, packed, tree_meta, pools):
+                    logits, pools = step_fn(params, packed, pools, t_bucket, s_bucket,
+                                            gather_k=k, tree_meta=tree_meta)
+                    toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return toks.reshape(s_bucket, k + 1), pools
+
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(3, ))
+            else:
+                def fwd(params, packed, pools):
+                    logits, pools = step_fn(params, packed, pools, t_bucket, s_bucket,
+                                            gather_k=k)
+                    toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return toks.reshape(s_bucket, k + 1), pools
+
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
             log_dist(f"compiled speculative verify bucket tokens={t_bucket} "
-                     f"seqs={s_bucket} k={k}", ranks=[0])
+                     f"seqs={s_bucket} k={k} tree={tree} sampled={sampled}", ranks=[0])
         return self._compiled[key]
 
-    def _get_compiled_decode(self, s_bucket: int, n_steps: int):
-        key = ("decode", s_bucket, n_steps)
+    def _get_compiled_decode(self, s_bucket: int, n_steps: int, sampled: bool = False):
+        key = ("decode", s_bucket, n_steps, bool(sampled))
         if key not in self._compiled:
             from .ragged.ragged_wrapper import unpack_descriptors
 
             max_blocks = self._max_blocks_per_seq
             step_fn = self._ragged_step
 
-            def fwd(params, packed, pools):
-                token_ids = unpack_descriptors(packed, s_bucket, s_bucket, max_blocks)[0]
+            if sampled:
+                from .sampling import sample_tokens
 
-                def step(carry, t):
-                    toks, pl = carry
-                    # feed the greedy tokens back into the packed descriptor
-                    # and advance positions in-scan from the packed starts
-                    # (packed layout: [T ids][T seq_idx][T pos]...)
-                    stepped = packed.at[0:s_bucket].set(toks) \
-                                    .at[2 * s_bucket:3 * s_bucket].add(t)
-                    logits, pl = step_fn(params, stepped, pl, s_bucket, s_bucket)
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    return (nxt, pl), nxt
+                def fwd(params, packed, samp_f, seeds, pools):
+                    token_ids = unpack_descriptors(packed, s_bucket, s_bucket, max_blocks)[0]
+                    pos_row = packed[2 * s_bucket:3 * s_bucket]
 
-                (_, pools), out = jax.lax.scan(
-                    step, (token_ids, pools), jnp.arange(n_steps, dtype=jnp.int32))
-                return out.T, pools  # [S, n_steps]
+                    def step(carry, t):
+                        toks, pl = carry
+                        stepped = packed.at[0:s_bucket].set(toks) \
+                                        .at[2 * s_bucket:3 * s_bucket].add(t)
+                        logits, pl = step_fn(params, stepped, pl, s_bucket, s_bucket)
+                        # draw keyed by the NEW token's absolute position —
+                        # the same stream the sampled put path would produce
+                        nxt = sample_tokens(logits, samp_f[:, 0], samp_f[:, 1], seeds,
+                                            pos_row + t + 1)
+                        return (nxt, pl), nxt
 
-            self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
-            log_dist(f"compiled multi-step decode bucket seqs={s_bucket} steps={n_steps}", ranks=[0])
+                    (_, pools), out = jax.lax.scan(
+                        step, (token_ids, pools), jnp.arange(n_steps, dtype=jnp.int32))
+                    return out.T, pools  # [S, n_steps]
+
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(4, ))
+            else:
+                def fwd(params, packed, pools):
+                    token_ids = unpack_descriptors(packed, s_bucket, s_bucket, max_blocks)[0]
+
+                    def step(carry, t):
+                        toks, pl = carry
+                        # feed the greedy tokens back into the packed descriptor
+                        # and advance positions in-scan from the packed starts
+                        # (packed layout: [T ids][T seq_idx][T pos]...)
+                        stepped = packed.at[0:s_bucket].set(toks) \
+                                        .at[2 * s_bucket:3 * s_bucket].add(t)
+                        logits, pl = step_fn(params, stepped, pl, s_bucket, s_bucket)
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                        return (nxt, pl), nxt
+
+                    (_, pools), out = jax.lax.scan(
+                        step, (token_ids, pools), jnp.arange(n_steps, dtype=jnp.int32))
+                    return out.T, pools  # [S, n_steps]
+
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
+            log_dist(f"compiled multi-step decode bucket seqs={s_bucket} steps={n_steps} "
+                     f"sampled={sampled}", ranks=[0])
         return self._compiled[key]
 
     def warmup(self, seq_buckets: Iterable[int], decode_steps) -> List[dict]:
@@ -713,7 +988,7 @@ class InferenceEngineV2:
             s_bucket = next_bucket(int(want), self.batch.seq_buckets)
             for n_steps in decode_steps:
                 n_steps = int(n_steps)
-                key = ("decode", s_bucket, n_steps)
+                key = ("decode", s_bucket, n_steps, False)
                 if key in self._compiled:
                     results.append({"seqs": s_bucket, "steps": n_steps, "seconds": 0.0, "cached": True})
                     continue
@@ -871,16 +1146,33 @@ class InferenceEngineV2:
     def _get_compiled(self, t_bucket: int, s_bucket: int, sample: Optional[str] = None):
         key = (t_bucket, s_bucket, sample)
         if key not in self._compiled:
-            if sample not in (None, "greedy"):
-                raise ValueError(f"unsupported sample mode {sample!r}: None | 'greedy'")
+            if sample not in (None, "greedy", "sample"):
+                raise ValueError(f"unsupported sample mode {sample!r}: None | 'greedy' | 'sample'")
             step_fn = self._ragged_step
+            mb = self._max_blocks_per_seq
 
-            def fwd(params, packed, pools):
-                logits, pools = step_fn(params, packed, pools, t_bucket, s_bucket)
-                out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
-                return out, pools
+            if sample == "sample":
+                from .sampling import sample_tokens
 
-            self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
+                def fwd(params, packed, samp_f, seeds, pools):
+                    logits, pools = step_fn(params, packed, pools, t_bucket, s_bucket)
+                    last = packed[4 * t_bucket + s_bucket * mb:
+                                  4 * t_bucket + s_bucket * mb + s_bucket]
+                    # key each draw by the sampled token's OWN position:
+                    # replay-deterministic for a fixed (seed, prompt) and
+                    # independent of batch composition
+                    ctr = packed[2 * t_bucket:3 * t_bucket][jnp.maximum(last, 0)] + 1
+                    toks = sample_tokens(logits, samp_f[:, 0], samp_f[:, 1], seeds, ctr)
+                    return toks, pools
+
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(4, ))
+            else:
+                def fwd(params, packed, pools):
+                    logits, pools = step_fn(params, packed, pools, t_bucket, s_bucket)
+                    out = jnp.argmax(logits, axis=-1).astype(jnp.int32) if sample == "greedy" else logits
+                    return out, pools
+
+                self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
             log_dist(f"compiled ragged forward bucket tokens={t_bucket} seqs={s_bucket} "
                      f"sample={sample}", ranks=[0])
         return self._compiled[key]

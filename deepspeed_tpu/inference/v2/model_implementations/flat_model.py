@@ -35,11 +35,22 @@ from ....models.transformer import TransformerConfig, apply_rope, mlp_activation
 def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, Any], token_ids, seq_idx, pos, valid,
                    block_tables, last_idx, k_pool, v_pool, use_pallas: bool = False,
                    unroll: bool = True, modules: Dict[str, Any] = None,
-                   k_scale=None, v_scale=None):
+                   k_scale=None, v_scale=None, pos_ids=None, attn_mask=None,
+                   ctx_pos_ids=None):
     """Returns (last-token logits [S_pad, V], k_pool, v_pool).
 
     token_ids/seq_idx/pos/valid: [T_pad]; block_tables: [S_pad, max_blocks];
     last_idx: [S_pad]; k_pool/v_pool: [L, NB*bs, nkv, d] (donated).
+
+    ``pos_ids``/``attn_mask``/``ctx_pos_ids``: token-tree verification
+    (``engine_v2.speculate_decode`` with branched drafts). ``pos`` stays the
+    KV SLOT position (each tree node scatters into its own slot);
+    ``pos_ids`` is the LOGICAL position (committed length + tree depth) that
+    rotary/learned/alibi positions must see; ``attn_mask`` [T, C] is the
+    ancestor-visibility mask replacing causal masking (a sibling branch at
+    an earlier slot must stay invisible); ``ctx_pos_ids`` [S, C] gives every
+    context slot its logical position for alibi distances. All three default
+    to None = the plain causal forward, byte-identical to before.
 
     ``unroll``: trace the layer loop as straight-line code instead of
     ``lax.scan``. scan dynamic-slices each layer's weights out of the
@@ -79,8 +90,9 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
     nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     pool_len = k_pool.shape[1]
 
-    x = embedding(params, token_ids, pos)  # [T, H]
-    sin, cos = rope_table(cfg, pos) if cfg.positions == "rotary" else (None, None)
+    pid = pos if pos_ids is None else pos_ids
+    x = embedding(params, token_ids, pid)  # [T, H]
+    sin, cos = rope_table(cfg, pid) if cfg.positions == "rotary" else (None, None)
 
     # flat KV slot of each token; padding tokens dropped via OOB scatter.
     # The pools ride the layer scan as CARRY over a layers-flattened view
@@ -123,9 +135,12 @@ def ragged_forward(cfg: TransformerConfig, block_size: int, params: Dict[str, An
         v_flat = v_flat.at[slot_l].set(v.astype(v_flat.dtype), mode="drop")
 
         tables_l = block_tables + l * NB  # layer l's blocks in the flat pool
-        # scales only passed in int8 mode, so full-precision third-party
-        # attention implementations keep the original 6-arg call signature
+        # scales/tree kwargs only passed when active, so full-precision
+        # causal third-party attention implementations keep the original
+        # 6-arg call signature
         scales = {"k_scale": ks_flat, "v_scale": vs_flat} if quant else {}
+        if attn_mask is not None:
+            scales = dict(scales, pos_ids=pid, mask=attn_mask, ctx_pos_ids=ctx_pos_ids)
         ctx = attention(q, k_flat, v_flat, tables_l, seq_idx, pos, **scales)
 
         attn_out = linear(ctx.reshape(T, nq * d), blk["wo"], bias("bo"))

@@ -34,11 +34,13 @@ class DenseBlockedAttention(DSSelfAttentionBase):
     def supports_config(config: DSSelfAttentionConfig) -> bool:
         return config.num_heads % max(config.num_kv_heads, 1) == 0
 
-    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None, v_scale=None):
+    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None, v_scale=None,
+                 pos_ids=None, mask=None, ctx_pos_ids=None):
         cfg = self.config
         return paged_attention_reference(q, k_flat, v_flat, tables_l, seq_idx, pos,
                                          cfg.block_size, window=cfg.sliding_window,
-                                         alibi=_alibi(cfg), k_scale=k_scale, v_scale=v_scale)
+                                         alibi=_alibi(cfg), k_scale=k_scale, v_scale=v_scale,
+                                         pos_ids=pos_ids, mask=mask, ctx_pos_ids=ctx_pos_ids)
 
 
 @DSSelfAttentionRegistry.register_module
@@ -54,8 +56,20 @@ class PallasPagedAttention(DSSelfAttentionBase):
         return (config.num_heads % max(config.num_kv_heads, 1) == 0
                 and config.head_dim % 2 == 0)
 
-    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None, v_scale=None):
+    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None, v_scale=None,
+                 pos_ids=None, mask=None, ctx_pos_ids=None):
         cfg = self.config
+        if mask is not None:
+            # token-tree verification: the Pallas grids know only the causal
+            # (+window) mask — the tree's ancestor mask routes the verify
+            # forward through the gather oracle. A verify chunk is k+1
+            # tokens per sequence, so the dense gather costs one prefill-
+            # chunk-sized pass per round, not a per-token hot path.
+            return paged_attention_reference(q, k_flat, v_flat, tables_l, seq_idx, pos,
+                                             cfg.block_size, window=cfg.sliding_window,
+                                             alibi=_alibi(cfg), k_scale=k_scale,
+                                             v_scale=v_scale, pos_ids=pos_ids, mask=mask,
+                                             ctx_pos_ids=ctx_pos_ids)
         if self.implementation_config.get("interpret", False):
             import jax.numpy as jnp
 

@@ -21,11 +21,16 @@ class DSSelfAttentionBase(DSModuleBase):
     """Ragged paged attention (reference ``interfaces/attention_base.py``).
 
     ``__call__(q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None,
-    v_scale=None)`` with q: [T, nq, d]; k_flat/v_flat: flat layer-offset KV
-    pool views [(L*NB*bs), nkv, d]; tables_l: [S, max_blocks] block tables
-    already offset to layer l; seq_idx/pos: [T]; k_scale/v_scale: int8-KV
-    dequant factors [nkv, (L*NB*bs)] (None = full-precision pools).
-    Returns context [T, nq, d].
+    v_scale=None, pos_ids=None, mask=None)`` with q: [T, nq, d];
+    k_flat/v_flat: flat layer-offset KV pool views [(L*NB*bs), nkv, d];
+    tables_l: [S, max_blocks] block tables already offset to layer l;
+    seq_idx/pos: [T]; k_scale/v_scale: int8-KV dequant factors
+    [nkv, (L*NB*bs)] (None = full-precision pools). ``pos_ids``: logical
+    positions for rotary/alibi when they differ from the KV slot positions
+    (token-tree verification assigns tree nodes distinct KV slots but
+    depth-based logical positions); ``mask``: explicit [T, C] visibility
+    (C = table capacity in tokens) REPLACING the causal mask — the tree
+    attention mask. Returns context [T, nq, d].
     """
 
     @staticmethod
@@ -33,7 +38,8 @@ class DSSelfAttentionBase(DSModuleBase):
         return DSSelfAttentionConfig
 
     @abstractmethod
-    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None, v_scale=None):
+    def __call__(self, q, k_flat, v_flat, tables_l, seq_idx, pos, k_scale=None, v_scale=None,
+                 pos_ids=None, mask=None, ctx_pos_ids=None):
         ...
 
 

@@ -125,6 +125,29 @@ class BlockedKVCache:
                 sc = sc.at[:, :, d:d + bs].set(sc[:, :, s:s + bs])
                 setattr(self, name, sc.reshape(nkv, -1))
 
+    def compact_slots(self, src_slots, dst_slots) -> None:
+        """Device-side KV move of individual token slots ``src → dst``
+        across every layer — the token-tree verification commit: an
+        accepted branch's nodes were verified at their FLAT tree slots and
+        must land at the sequence's canonical contiguous positions before
+        decoding continues. All reads happen before any write (one gather,
+        one scatter), and the tree layout guarantees dst < src with the two
+        ranges disjoint, so the move is alias-safe. Eager jnp ops like
+        :meth:`copy_block` — a handful of slots per verify round."""
+        src = jnp.asarray(src_slots, jnp.int32).reshape(-1)
+        dst = jnp.asarray(dst_slots, jnp.int32).reshape(-1)
+        if src.size == 0:
+            return
+        self.k_pool = self.k_pool.at[:, dst].set(self.k_pool[:, src])
+        self.v_pool = self.v_pool.at[:, dst].set(self.v_pool[:, src])
+        if self.quantized:
+            nkv = self.num_kv_heads
+            span = self.num_blocks * self.block_size
+            for name in ("k_scale", "v_scale"):
+                sc = getattr(self, name).reshape(nkv, self.num_layers, span)
+                sc = sc.at[:, :, dst].set(sc[:, :, src])
+                setattr(self, name, sc.reshape(nkv, -1))
+
     def pools(self):
         """The donated pool tuple the compiled forwards thread through:
         (k, v) full-precision, (k, v, k_scale, v_scale) quantized."""

@@ -230,6 +230,39 @@ class DSStateManager:
             seq.shared_blocks = min(seq.shared_blocks, keep - 1)
         return len(tail)
 
+    def commit_speculative(self, seq: DSSequenceDescriptor, n_tokens: int,
+                           committed_tokens=None, src_positions=None) -> int:
+        """Tree-verification commit: the branched cousin of a plain
+        :meth:`rollback_to`. The verify forward materialized the WHOLE
+        flattened token tree (every branch at its own flat slot) and noted
+        the flat chunk into ``token_history``; the accepted path is in
+        general NOT the flat prefix, so three things must happen together
+        (same plane, same call — exactly why rollback_to is single-homed):
+
+        1. when ``src_positions`` is given, the winning branch's KV moves
+           from its flat tree slots to the canonical contiguous positions
+           (``BlockedKVCache.compact_slots`` — dst strictly below src, both
+           inside blocks this sequence exclusively owns: publish only ever
+           shares FULL blocks, and the tree region starts past the last
+           published boundary);
+        2. ``rollback_to(n_tokens)`` releases the rejected remainder;
+        3. ``committed_tokens`` overwrites the history tail so the radix
+           tree can only ever see the VERIFIED stream — a rejected sibling
+           branch's tokens must never be publishable.
+
+        Returns rollback_to's released-reference count."""
+        if src_positions:
+            bs = self.block_size
+            src = [seq.kv_blocks[p // bs] * bs + p % bs for p, _ in src_positions]
+            dst = [seq.kv_blocks[p // bs] * bs + p % bs for _, p in src_positions]
+            self.kv_cache.compact_slots(src, dst)
+        released = self.rollback_to(seq, n_tokens)
+        if committed_tokens is not None and seq.history_valid:
+            m = len(committed_tokens)
+            if m and len(seq.token_history) >= n_tokens >= m:
+                seq.token_history[n_tokens - m:n_tokens] = [int(t) for t in committed_tokens]
+        return released
+
     def flush_sequence(self, uid: int) -> None:
         """Release a finished sequence's block references (reference
         ``flush:228``): publish completed full blocks first (the tree takes

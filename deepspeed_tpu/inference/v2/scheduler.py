@@ -29,18 +29,23 @@ from .scheduling_utils import SchedulingResult
 
 class _Request:
     __slots__ = ("uid", "prompt", "max_new_tokens", "eos_token_id", "fed", "generated", "done",
-                 "charged_blocks", "shared_blocks")
+                 "charged_blocks", "shared_blocks", "sampling")
 
-    def __init__(self, uid, prompt, max_new_tokens, eos_token_id):
+    def __init__(self, uid, prompt, max_new_tokens, eos_token_id, sampling=None):
         self.uid = uid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.sampling = sampling  # SamplingParams | None (= greedy)
         self.fed = 0          # prompt tokens already given to the engine
         self.generated: List[int] = []
         self.done = False
         self.charged_blocks = 0  # lifetime KV reservation charged at admission
         self.shared_blocks = 0   # blocks arriving shared from the prefix cache
+
+    @property
+    def sampled(self) -> bool:
+        return self.sampling is not None and not self.sampling.greedy
 
     @property
     def prefilling(self) -> bool:
@@ -97,8 +102,14 @@ class DynamicSplitFuseScheduler:
         if self._drafter is not None and self._spec is None:
             from .config_v2 import SpeculativeConfig
             self._spec = SpeculativeConfig(mode="ngram")  # injected drafter, default k
-        self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0, "rejected": 0}
+        self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0, "rejected": 0,
+                           "backoffs": 0}
         self._spec_by_uid: Dict[int, Dict[str, int]] = {}
+        # spec-burst backoff: consecutive zero-accept verify rounds per uid
+        # (a hopeless drafter must stop burning k+1 verify tokens per round
+        # forever); past `backoff_after` the uid stops drafting and rides
+        # the plain decode burst, re-probed every `reprobe_every` rounds
+        self._spec_zero: Dict[int, int] = {}
         # incremental prompt+generated context per speculating uid: generated
         # only ever APPENDS for a live request, so each round copies just the
         # delta instead of re-concatenating the whole stream (O(new tokens),
@@ -110,10 +121,13 @@ class DynamicSplitFuseScheduler:
         # (per-chunk prefill spans). None (the default) adds zero work.
         self.step_observer = None
 
-    def submit(self, uid: int, prompt, max_new_tokens: int = 32, eos_token_id=None):
+    def submit(self, uid: int, prompt, max_new_tokens: int = 32, eos_token_id=None,
+               sampling=None):
         if uid in self._active or any(r.uid == uid for r in self._pending):
             raise ValueError(f"uid {uid} already queued")
-        req = _Request(uid, prompt, max_new_tokens, eos_token_id)
+        if sampling is not None:
+            sampling.validate()  # raises ValueError on out-of-range knobs
+        req = _Request(uid, prompt, max_new_tokens, eos_token_id, sampling=sampling)
         if req.prompt.size == 0:
             raise ValueError(f"uid {uid}: empty prompt")
         if req.max_new_tokens <= 0:
@@ -218,6 +232,7 @@ class DynamicSplitFuseScheduler:
         if self._drafter is not None:
             self._drafter.finish(req.uid)
             self._spec_ctx.pop(req.uid, None)
+            self._spec_zero.pop(req.uid, None)
         self.engine.flush(req.uid)
         self._reserved_blocks -= req.charged_blocks
         self._active.pop(req.uid, None)
@@ -296,8 +311,11 @@ class DynamicSplitFuseScheduler:
         # per-request eos rides down so the engine rewinds a mid-scan eos hit's
         # horizon overshoot before publishing (post-eos KV never enters the tree)
         eos = [r.eos_token_id for r in decoding]
-        toks = np.asarray(self.engine.decode(uids, first, horizon,
-                                             eos_token_ids=eos))  # [S, horizon]
+        # sampling rides down only when some row actually samples — an
+        # all-greedy burst keeps the original argmax scan program
+        samp = [r.sampling for r in decoding] if any(r.sampled for r in decoding) else None
+        toks = np.asarray(self.engine.decode(uids, first, horizon, eos_token_ids=eos,
+                                             sampling=samp))  # [S, horizon]
         for req, row in zip(decoding, toks):
             for tok in row.tolist():
                 self._append_token(req, int(tok))
@@ -324,58 +342,129 @@ class DynamicSplitFuseScheduler:
         self._spec_ctx[req.uid] = (buf, filled)
         return buf[:n]
 
+    def _spec_not_drafting(self, uid: int) -> bool:
+        """Backoff decision for one uid, advancing its counter while parked:
+        past ``backoff_after`` consecutive zero-accept rounds a request
+        stops drafting; every ``reprobe_every`` parked rounds one probe
+        round drafts again (a stream that turned repetitive gets its
+        speculation back), and any accepted token resets the counter."""
+        n = getattr(self._spec, "backoff_after", 0)
+        z = self._spec_zero.get(uid, 0)
+        if not n or z < n:
+            return False
+        m = max(1, getattr(self._spec, "reprobe_every", 32))
+        if (z - n) % m == m - 1:
+            return False  # probe round
+        self._spec_zero[uid] = z + 1  # parked round consumed
+        return True
+
     def _spec_burst(self, decoding: List[_Request]) -> int:
-        """Speculative steady state: draft up to K tokens per sequence, then
-        ONE batched verify forward commits the longest argmax-agreeing
-        prefix per sequence (plus a bonus token) and rolls rejected KV back.
-        Returns committed tokens, or 0 when this round cannot speculate —
-        the caller then falls back to the plain multi-step decode burst
-        (drafters came up empty, a sequence is too close to max_context, or
-        the transient k+1-token KV demand exceeds what the pool can cover)."""
+        """Speculative steady state: draft up to K tokens (or a
+        ``tree_width``-branch token tree) per sequence, then ONE batched
+        verify forward commits the deepest target-agreeing path per
+        sequence (plus a bonus token) and rolls rejected KV back. Returns
+        committed tokens, or 0 when this round cannot speculate — the
+        caller then falls back to the plain multi-step decode burst
+        (drafters came up empty / everyone is backed off, a sequence is too
+        close to max_context, or the transient KV demand exceeds what the
+        pool can cover). Sampled requests force linear drafts (tree
+        verification is greedy-only; the rejection-sampling verify keeps
+        the output distribution exact). Backed-off requests skip drafting
+        and decode alongside in a separate burst."""
         k = self._spec.k
         eng = self.engine
-        if len(decoding) * (k + 1) > min(self.token_budget, eng.config.state_manager.max_ragged_batch_size):
+        width = max(1, int(getattr(self._spec, "tree_width", 1)))
+        if any(r.sampled for r in decoding):
+            width = 1
+        drafting = [r for r in decoding if not self._spec_not_drafting(r.uid)]
+        if not drafting:
+            return 0
+        # cheap pre-draft feasibility: if not even a LINEAR round fits the
+        # token budget, don't pay the O(context) drafter scans every loop
+        # (the PR 9 guard; the width-dependent check below re-validates with
+        # this round's actual branch shapes)
+        if len(drafting) * (k + 1) > min(self.token_budget,
+                                         eng.config.state_manager.max_ragged_batch_size):
+            return 0
+        items = [(r.uid, self._spec_context(r)) for r in drafting]
+        dmap = self._drafter.draft_branches_many(items, k, width)
+        branches: Dict[int, List[np.ndarray]] = {}
+        for r in drafting:
+            bl = [np.asarray(b, np.int32).reshape(-1)[:k] for b in dmap.get(r.uid, ())]
+            branches[r.uid] = [b for b in bl if b.size][:width]
+        spec_reqs = [r for r in drafting if branches[r.uid]]
+        if not spec_reqs:
+            return 0
+        # verify-chunk shape: root + W branches of the CONFIGURED k (the
+        # engine pads short drafts) — keying the compiled program on this
+        # round's actual max draft length would recompile the verify
+        # forward every time the drafter's match length fluctuated; wmax
+        # still varies, but it is bounded by tree_width (<= width programs
+        # per bucket, vs k*width)
+        wmax = max(len(branches[r.uid]) for r in spec_reqs)
+        n_new = 1 + wmax * k
+        if len(spec_reqs) * n_new > min(self.token_budget,
+                                        eng.config.state_manager.max_ragged_batch_size):
             return 0
         seqs = []
-        for r in decoding:
+        for r in spec_reqs:
             seq = eng.state_manager.get_sequence(r.uid)
-            if seq is None or seq.seen_tokens + k + 1 > eng.max_context:
+            if seq is None or seq.seen_tokens + n_new > eng.max_context:
                 return 0
             seqs.append(seq)
         # the verify chunk may transiently need blocks beyond the request's
         # lifetime reservation (near its final tokens): refuse up front
         # rather than strand the composed batch mid-run
-        if sum(s.blocks_needed(k + 1) for s in seqs) > eng.available_blocks:
+        if sum(s.blocks_needed(n_new) for s in seqs) > eng.available_blocks:
             return 0
-        items = [(r.uid, self._spec_context(r)) for r in decoding]
-        dmap = self._drafter.draft_many(items, k)
-        drafts = [np.asarray(dmap.get(r.uid, ()), np.int32).reshape(-1)[:k]
-                  for r in decoding]
-        if not any(d.size for d in drafts):
-            return 0
-        uids = [r.uid for r in decoding]
-        firsts = [np.asarray([r.generated[-1]], np.int32) for r in decoding]
+        uids = [r.uid for r in spec_reqs]
+        firsts = [np.asarray([r.generated[-1]], np.int32) for r in spec_reqs]
+        samp = [r.sampling for r in spec_reqs] if any(r.sampled for r in spec_reqs) else None
         # per-request eos rides down (decode()'s contract): an eos inside
         # the accepted run truncates the commit there, so the tree never
         # receives post-eos paths even when acceptance carries past it
-        outs = eng.speculate_decode(uids, firsts, drafts, k,
-                                    eos_token_ids=[r.eos_token_id for r in decoding])
+        outs = eng.speculate_decode(
+            uids, firsts,
+            [branches[r.uid] if len(branches[r.uid]) > 1 else branches[r.uid][0]
+             for r in spec_reqs],
+            k, eos_token_ids=[r.eos_token_id for r in spec_reqs], sampling=samp)
         self.spec_stats["rounds"] += 1
+        backoff_n = getattr(self._spec, "backoff_after", 0)
         committed = 0
-        for req, d, new in zip(decoding, drafts, outs):
+        for req, new in zip(spec_reqs, outs):
+            bl = branches[req.uid]
+            drafted_n = sum(int(b.size) for b in bl)
             a = len(new) - 1  # accepted positions (pads included)
-            acc = min(a, int(d.size))
-            self.spec_stats["drafted"] += int(d.size)
+            acc = min(a, max(int(b.size) for b in bl))
+            self.spec_stats["drafted"] += drafted_n
             self.spec_stats["accepted"] += acc
-            self.spec_stats["rejected"] += int(d.size) - acc
+            self.spec_stats["rejected"] += drafted_n - acc
             rec = self._spec_by_uid.setdefault(req.uid, {"drafted": 0, "accepted": 0})
-            rec["drafted"] += int(d.size)
+            rec["drafted"] += drafted_n
             rec["accepted"] += acc
+            if acc > 0:
+                self._spec_zero.pop(req.uid, None)
+            else:
+                z = self._spec_zero.get(req.uid, 0) + 1
+                self._spec_zero[req.uid] = z
+                if backoff_n and z == backoff_n:
+                    # entering backoff: this drafter stops paying verify
+                    # FLOPs for a stream it keeps missing
+                    self.spec_stats["backoffs"] += 1
+                    from ...monitor.metrics import get_metrics
+
+                    get_metrics().counter("serving/spec_disabled_total").inc()
             committed += len(new)
             for tok in new:
                 self._append_token(req, int(tok))
                 if req.done:
                     break  # eos/max_new inside the burst: _finish rewound the rest
+        # requests that sat this round out (backed off / empty drafts) still
+        # make progress: one plain multi-step burst alongside the verify
+        resting = [r for r in decoding if r.uid not in {q.uid for q in spec_reqs}
+                   and not r.done]
+        if resting:
+            committed += self._decode_burst(resting)
         return committed
 
     def step(self) -> int:
@@ -429,11 +518,17 @@ class DynamicSplitFuseScheduler:
 
         if not uids:
             return 0
+        # sampling rides down only when a sampled row's OUTPUT matters this
+        # step (its last prompt chunk or a decode row): an all-greedy batch
+        # keeps the original argmax program
+        samp = None
+        if any(self._active[u].sampled for u in uids):
+            samp = [self._active[u].sampling for u in uids]
         if self.step_observer is None:
-            toks = self.engine.put(uids, chunks, sample="greedy")
+            toks = self.engine.put(uids, chunks, sample="greedy", sampling=samp)
         else:
             t0 = time.perf_counter()
-            toks = self.engine.put(uids, chunks, sample="greedy")
+            toks = self.engine.put(uids, chunks, sample="greedy", sampling=samp)
             self.step_observer(uids, [c.size for c in chunks], t0,
                                time.perf_counter() - t0)
         n = sum(c.size for c in chunks)

@@ -7,7 +7,7 @@ ragged forward, and acceptance is decided by the target model's own argmax
 throughput, never correctness.
 """
 
-from typing import Dict, Iterable, Tuple
+from typing import Dict, Iterable, List, Tuple
 
 import numpy as np
 
@@ -15,7 +15,11 @@ import numpy as np
 class Drafter:
     """Base drafter. Subclasses implement :meth:`draft`; stateful drafters
     (the draft-model path) may also override :meth:`draft_many` to batch
-    their own forwards, and :meth:`finish` to drop per-request state."""
+    their own forwards, and :meth:`finish` to drop per-request state.
+    Branch-capable drafters (token-tree verification) additionally override
+    :meth:`draft_branches` to propose several candidate continuations per
+    round — the default wraps the linear draft as a one-branch tree, so
+    every existing drafter keeps working unchanged under a tree scheduler."""
 
     name = "base"
 
@@ -29,6 +33,30 @@ class Drafter:
         """Batched entry the scheduler actually calls: ``{uid: drafts}`` for
         every ``(uid, context)``. Default maps :meth:`draft`."""
         return {uid: self.draft(uid, ctx, k) for uid, ctx in items}
+
+    def draft_branches(self, uid: int, context: np.ndarray, k: int,
+                       width: int) -> List[np.ndarray]:
+        """Up to ``width`` candidate branches, each up to ``k`` tokens (the
+        token tree ``speculate_decode`` verifies in ONE forward — accept =
+        deepest branch matching the target's own argmax path). Default:
+        the linear draft as a single branch."""
+        d = np.asarray(self.draft(uid, context, k), np.int32).reshape(-1)
+        return [d] if d.size else []
+
+    def draft_branches_many(self, items: Iterable[Tuple[int, np.ndarray]], k: int,
+                            width: int) -> Dict[int, List[np.ndarray]]:
+        """Batched branch drafting. A drafter WITHOUT a branch-capable
+        :meth:`draft_branches` override routes through its own (possibly
+        batched) :meth:`draft_many`, so the draft-model path keeps its one
+        multi-sequence decode scan per round instead of degrading to
+        per-request forwards."""
+        if width <= 1 or type(self).draft_branches is Drafter.draft_branches:
+            out = {}
+            for uid, d in self.draft_many(items, k).items():
+                d = np.asarray(d, np.int32).reshape(-1)
+                out[uid] = [d] if d.size else []
+            return out
+        return {uid: self.draft_branches(uid, ctx, k, width) for uid, ctx in items}
 
     def finish(self, uid: int) -> None:
         """The request is done (finished or cancelled) — release any

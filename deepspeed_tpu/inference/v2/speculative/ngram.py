@@ -33,6 +33,18 @@ class NgramDrafter(Drafter):
         self.max_history = int(max_history)
 
     def draft(self, uid: int, context: np.ndarray, k: int) -> np.ndarray:
+        branches = self.draft_branches(uid, context, k, 1)
+        return branches[0] if branches else np.empty(0, np.int32)
+
+    def draft_branches(self, uid: int, context: np.ndarray, k: int, width: int):
+        """Top-``width`` DISTINCT continuations as tree branches: longer
+        suffix matches first (more specific), most-recent occurrence first
+        within a match length (locality), duplicates collapsed — branch 0
+        is exactly what :meth:`draft` proposed before trees existed, so
+        width=1 keeps the PR 9 drafting stream bit-identical. On the
+        low-accept workloads a single guess covers one hypothesis; the
+        verify forward prices extra branches at k tokens each, and any ONE
+        of them matching lifts the round's acceptance."""
         ctx = np.asarray(context, np.int32).reshape(-1)
         if self.max_history and ctx.size > self.max_history:
             ctx = ctx[-self.max_history:]
@@ -41,6 +53,7 @@ class NgramDrafter(Drafter):
         # itself (an identity match would propose the suffix again with no
         # new information)
         hay = ctx[:m - 1]
+        out, seen = [], set()
         for n in range(min(self.max_ngram, m - 1), self.min_match - 1, -1):
             if hay.size < n:
                 continue
@@ -50,7 +63,13 @@ class NgramDrafter(Drafter):
             # a hit at i proposes ctx[i+n : i+n+k]; it must have at least
             # one continuation token inside the stream
             hits = hits[hits + n < m]
-            if hits.size:
-                i = int(hits[-1])
-                return ctx[i + n:i + n + k].copy()
-        return np.empty(0, np.int32)
+            for i in hits[::-1]:
+                cand = ctx[int(i) + n:int(i) + n + k].copy()
+                key = cand.tobytes()
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(cand)
+                if len(out) >= width:
+                    return out
+        return out

@@ -84,8 +84,49 @@ def _resolve_q_tile(T: int, S: int, seq_idx=None) -> int:
     return max(qt, 1)
 
 
+def _resolve_kv_splits(T: int, S: int, max_blocks: int, q_tile: int = 1) -> int:
+    """Resolve the flash-decode KV-split factor through the kernel-config
+    registry, falling back to the shape heuristic. The split applies ONLY to
+    the per-token grid (``q_tile == 1`` — decode-shaped rows): a prefill tile
+    already amortizes its KV stream across the tile's tokens, while a decode
+    row walks its whole context serially — partitioning the KV blocks across
+    a second grid axis lets the online-softmax chains of a long context run
+    independently (megacore-parallel on chip) at the cost of one
+    log-sum-exp merge over ``kv_splits`` partials.
+
+    ``DS_TPU_PAGED_KV_SPLITS``: operator kill switch / override — ``1`` pins
+    the proven single-chain grid (the same escape hatch as
+    ``DS_TPU_PAGED_Q_TILE``), any higher value forces that split factor.
+    Lookup order mirrors ``q_tile``: exact ``(B, T)`` bucket, then the
+    ``B``-only bucket the decode sweep records (B = block-table capacity —
+    the KV length is what the split amortizes over; T is just the decode
+    batch size of the moment)."""
+    from ...autotuning.kernel_config import shape_bucket, tuned_tile
+
+    if q_tile > 1 or max_blocks < 8 or T > 2 * max(S, 1):
+        # tiled prefill rows keep the single chain; a short table has no KV
+        # axis worth splitting (each split must own >= a few blocks); and a
+        # batch with real multi-token chunks (T well past the seq count —
+        # e.g. a non-contiguous prefill demoted to the per-token grid) must
+        # not inherit the split's T x kv_splits partial buffers
+        return 1
+    env = os.environ.get("DS_TPU_PAGED_KV_SPLITS")
+    if env:
+        try:
+            ks = max(1, int(env))
+        except ValueError:
+            ks = 1
+        return min(ks, max_blocks)
+    default = min(8, max(1, max_blocks // 4))
+    fallback = int(tuned_tile("paged_attention", shape_bucket(B=max_blocks), "kv_splits",
+                              default))
+    ks = int(tuned_tile("paged_attention", shape_bucket(B=max_blocks, T=T), "kv_splits",
+                        fallback))
+    return max(1, min(ks, max_blocks))
+
+
 def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, window=None,
-                    alibi=None, k_scale=None, v_scale=None, q_tile=None):
+                    alibi=None, k_scale=None, v_scale=None, q_tile=None, kv_splits=None):
     """q: [T, nq, d]; k_pool/v_pool: [pool_len, nkv, d] (one layer,
     pool_len = num_blocks*block_size, may include one trailing scratch slot);
     block_tables: [S, max_blocks]; seq_idx/pos: [T].
@@ -100,6 +141,11 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: i
     then shape heuristic). q_tile > 1 packs contiguous same-sequence tokens
     into one grid row so each KV block streams from HBM once per TILE
     instead of once per token — the prefill-chunk amortization win.
+    ``kv_splits``: flash-decode KV partitioning for the per-token (decode)
+    grid — each split runs a partial online softmax over its share of the
+    KV blocks on its own grid row (megacore-parallel on chip) and the
+    partials merge with the standard log-sum-exp combine. None = registry,
+    then heuristic; ignored whenever the q-tiled grid is taken.
     Returns [T, nq, d]."""
     T, nq, d = q.shape
     nkv = k_pool.shape[1]
@@ -128,27 +174,44 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: i
                      "sequence-contiguous — demoting to the per-token grid")
         q_tile = 1
     alibi_t = tuple(np.asarray(alibi).tolist()) if alibi is not None else None
-    # failure ladder: q-tiled -> per-token -> gather oracle. A tiling that
-    # fails Mosaic on some generation costs ONE rung, never the fused path.
-    for qt in dict.fromkeys((int(q_tile), 1)):
+    max_blocks = block_tables.shape[1]
+    if kv_splits is None:
+        kv_splits = _resolve_kv_splits(T, S, max_blocks, q_tile=int(q_tile))
+    kv_splits = max(1, min(int(kv_splits), max_blocks))
+    # failure ladder: q-tiled -> kv-split decode -> per-token -> gather
+    # oracle. A tiling/split that fails Mosaic on some generation costs ONE
+    # rung, never the fused path. The split rung only exists on the
+    # per-token (decode) grid — a tiled prefill row keeps its single chain.
+    rungs = [(int(q_tile), 1)] if q_tile > 1 else []
+    rungs += [(1, kv_splits), (1, 1)]
+    for qt, ks in dict.fromkeys(rungs):
         try:
             return _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx.astype(jnp.int32),
                                  pos.astype(jnp.int32), block_size=block_size, window=window,
-                                 alibi=alibi_t, k_scale=k_scale, v_scale=v_scale, q_tile=qt)
+                                 alibi=alibi_t, k_scale=k_scale, v_scale=v_scale, q_tile=qt,
+                                 kv_splits=ks)
         except Exception as e:  # pragma: no cover — kernel bring-up safety net
             from ...utils.logging import warning_once
 
-            warning_once(f"pallas paged attention (q_tile={qt}) unavailable "
+            warning_once(f"pallas paged attention (q_tile={qt}, kv_splits={ks}) unavailable "
                          f"({type(e).__name__}: {e}); trying next rung")
     return paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size,
                                      window=window, alibi=alibi, k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int,
-                              window=None, alibi=None, k_scale=None, v_scale=None):
+                              window=None, alibi=None, k_scale=None, v_scale=None,
+                              pos_ids=None, mask=None, ctx_pos_ids=None):
     """Gather-based oracle: materializes each sequence's context. ``alibi``:
     per-head slopes [nq] (Bloom). ``k_scale``/``v_scale``: int8-KV
-    dequantization factors [nkv, pool_len] (see ``paged_attention``)."""
+    dequantization factors [nkv, pool_len] (see ``paged_attention``).
+    ``pos_ids``: logical positions for alibi distances when they differ
+    from the KV slot positions (token-tree verification); ``mask``: explicit
+    [T, C] visibility replacing the causal/window mask — the tree attention
+    mask (the caller owns window semantics inside it); ``ctx_pos_ids``:
+    [S, C] logical position of every context slot (tree nodes sit at flat
+    slots but depth-based logical positions — alibi distances must use the
+    logical ones)."""
     T, nq, d = q.shape
     nkv = k_pool.shape[1]
     g = nq // nkv
@@ -163,12 +226,18 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_idx, pos, blo
         ctxv = ctxv * jnp.transpose(v_scale)[ctx_slots][..., None]
     qr = (q.astype(jnp.float32) / math.sqrt(d)).reshape(T, nkv, g, d)
     s = jnp.einsum("tngd,tcnd->tngc", qr, ctxk[seq_idx])
+    pid = pos if pos_ids is None else pos_ids
     if alibi is not None:
-        rel = (jnp.arange(C, dtype=jnp.float32)[None, :] - pos[:, None].astype(jnp.float32))
+        ctx_pid = (jnp.arange(C, dtype=jnp.int32)[None, :] if ctx_pos_ids is None
+                   else ctx_pos_ids[seq_idx])
+        rel = ctx_pid.astype(jnp.float32) - pid[:, None].astype(jnp.float32)
         s = s + jnp.asarray(alibi, jnp.float32).reshape(nkv, g)[None, :, :, None] * rel[:, None, None, :]
-    causal = jnp.arange(C, dtype=jnp.int32)[None, :] <= pos[:, None]
-    if window is not None:
-        causal = causal & (pos[:, None] - jnp.arange(C, dtype=jnp.int32)[None, :] < window)
+    if mask is not None:
+        causal = mask
+    else:
+        causal = jnp.arange(C, dtype=jnp.int32)[None, :] <= pos[:, None]
+        if window is not None:
+            causal = causal & (pos[:, None] - jnp.arange(C, dtype=jnp.int32)[None, :] < window)
     s = jnp.where(causal[:, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("tngc,tcnd->tngd", p, ctxv[seq_idx])
@@ -184,9 +253,11 @@ def _slopes_rows(alibi, reps):
     return jnp.concatenate([jnp.full((reps, 1), float(a), jnp.float32) for a in alibi], axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "interpret", "window", "alibi", "q_tile"))
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret", "window", "alibi",
+                                             "q_tile", "kv_splits"))
 def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int, interpret: bool = False,
-                  window=None, alibi=None, k_scale=None, v_scale=None, q_tile: int = 1):
+                  window=None, alibi=None, k_scale=None, v_scale=None, q_tile: int = 1,
+                  kv_splits: int = 1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -211,6 +282,11 @@ def _pallas_paged(q, k_pool, v_pool, block_tables, seq_idx, pos, block_size: int
                               ks2 if quant else None, vs2 if quant else None,
                               block_size=block_size, q_tile=int(q_tile), window=window,
                               alibi=alibi, interpret=interpret)
+    if kv_splits and kv_splits > 1:
+        return _paged_kv_split(pl, pltpu, q, k4, v4, block_tables, seq_idx, pos,
+                               ks2 if quant else None, vs2 if quant else None,
+                               block_size=block_size, kv_splits=int(kv_splits),
+                               window=window, alibi=alibi, interpret=interpret)
 
     grid = (T, max_blocks)
 
@@ -469,3 +545,167 @@ def _paged_q_tiled(pl, pltpu, q, k4, v4, block_tables, seq_idx, pos, ks2, vs2,
     # scatter tiles back to token order
     flat = out_t.transpose(0, 2, 1, 3).reshape(n_tiles * qt, nq, d)
     return flat[tile_id * qt + slot]
+
+
+def _paged_kv_split(pl, pltpu, q, k4, v4, block_tables, seq_idx, pos, ks2, vs2,
+                    block_size: int, kv_splits: int, window, alibi, interpret: bool):
+    """Flash-decode KV-split grid: ``(kv_splits, T, blocks_per_split)``.
+
+    A decode row's online softmax is a serial chain over its whole context
+    — on a long context that chain is the decode latency floor. Partition
+    the KV blocks: split ``s`` owns block range
+    ``[s * blocks_per_split, (s+1) * blocks_per_split)`` and computes an
+    independent partial (un-normalized accumulator + its running max ``m``
+    and mass ``l``); the partials merge afterwards with the standard
+    log-sum-exp combine
+
+        m* = max_s m_s;  out = Σ_s e^{m_s - m*} acc_s / Σ_s e^{m_s - m*} l_s
+
+    which is exactly the two-pass algebra of the online softmax, so the
+    result is bit-comparable (within f32 association) to the single chain.
+    The split axis leads the grid and is declared ``parallel`` — on chip the
+    independent chains distribute across megacores; the per-token grid can
+    never parallelize one token's context. Splits wholly beyond a token's
+    live range (or wholly below its sliding window) contribute
+    ``m = -inf, l = 0`` and vanish in the merge. int8 dequant-at-tile,
+    alibi and window masking are inherited unchanged from the per-token
+    grid."""
+    T, nq, d = q.shape
+    nkv = k4.shape[2]
+    g = nq // nkv
+    S, max_blocks = block_tables.shape
+    ks_n = int(kv_splits)
+    per = -(-max_blocks // ks_n)
+    quant = ks2 is not None
+    scale = 1.0 / math.sqrt(d)
+    grid = (ks_n, T, per)
+
+    def q_map(s, t, j, seq_ref, pos_ref, bt_ref):
+        return (t, 0, 0)
+
+    def o_map(s, t, j, seq_ref, pos_ref, bt_ref):
+        return (s, t, 0, 0)
+
+    def kv_map(s, t, j, seq_ref, pos_ref, bt_ref):
+        # clamp into the token's live range (the Mosaic skip-refetch idiom
+        # of the per-token grid): dead steps re-present a resident block
+        hi = pos_ref[t] // block_size
+        jj = jnp.minimum(s * per + j, hi)
+        if window is not None:
+            lo = jnp.maximum(pos_ref[t] - (window - 1), 0) // block_size
+            jj = jnp.maximum(jj, jnp.minimum(lo, hi))
+        return (bt_ref[seq_ref[t], jj], 0, 0, 0)
+
+    def scale_map(s, t, j, seq_ref, pos_ref, bt_ref):
+        return (0, kv_map(s, t, j, seq_ref, pos_ref, bt_ref)[0])
+
+    def kernel(seq_ref, pos_ref, bt_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_o_ref, l_o_ref, acc_ref, m_ref, l_ref = rest
+        else:
+            o_ref, m_o_ref, l_o_ref, acc_ref, m_ref, l_ref = rest
+        s_id = pl.program_id(0)
+        t = pl.program_id(1)
+        j = pl.program_id(2)
+        jb = s_id * per + j  # absolute block index this step covers
+        my_pos = pos_ref[t]
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, -1e30)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        in_window = jnp.logical_and(jb * block_size <= my_pos, jb < max_blocks)
+        if window is not None:
+            in_window = jnp.logical_and(
+                in_window, (jb + 1) * block_size - 1 > my_pos - window)
+
+        @pl.when(in_window)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32) * scale  # [nq, d]
+            kb = k_ref[0].astype(jnp.float32)  # [bs, nkv, d]
+            vb = v_ref[0].astype(jnp.float32)
+            if quant:  # dequant at the VMEM tile — HBM only streamed int8
+                kb = kb * ks_ref[...].T[:, :, None]
+                vb = vb * vs_ref[...].T[:, :, None]
+            s_heads = []
+            for n in range(nkv):
+                s_heads.append(jax.lax.dot(qb[n * g:(n + 1) * g], kb[:, n, :].T))
+            sc = jnp.concatenate(s_heads, axis=0)  # [nq, bs]
+            kpos = jb * block_size + jax.lax.broadcasted_iota(jnp.int32, (nq, block_size), 1)
+            if alibi is not None:
+                sc = sc + _slopes_rows(alibi, 1) * (kpos - my_pos).astype(jnp.float32)
+            vis = kpos <= my_pos
+            if window is not None:
+                vis = jnp.logical_and(vis, my_pos - kpos < window)
+            sc = jnp.where(vis, sc, -1e30)
+            m_prev = m_ref[:]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+            p = jnp.exp(sc - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            ctx_heads = []
+            for n in range(nkv):
+                ctx_heads.append(jax.lax.dot(p[n * g:(n + 1) * g], vb[:, n, :]))
+            acc_ref[:] = acc_ref[:] * alpha + jnp.concatenate(ctx_heads, axis=0)
+            m_ref[:] = m_new
+
+        @pl.when(j == per - 1)
+        def _finalize():
+            # un-normalized partial + its softmax stats: the merge below
+            # owns the division, so the kernel never divides by a dead
+            # split's zero mass
+            o_ref[0, 0] = acc_ref[:]
+            m_o_ref[0, 0] = m_ref[:]
+            l_o_ref[0, 0] = l_ref[:]
+
+    in_specs = [
+        pl.BlockSpec((1, nq, d), q_map),
+        pl.BlockSpec((1, block_size, nkv, d), kv_map),
+        pl.BlockSpec((1, block_size, nkv, d), kv_map),
+    ]
+    operands = [q, k4, v4]
+    if quant:
+        in_specs += [pl.BlockSpec((nkv, block_size), scale_map),
+                     pl.BlockSpec((nkv, block_size), scale_map)]
+        operands += [ks2, vs2]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, 1, nq, d), o_map),
+                   pl.BlockSpec((1, 1, nq, 1), o_map),
+                   pl.BlockSpec((1, 1, nq, 1), o_map)],
+        scratch_shapes=[
+            pltpu.VMEM((nq, d), jnp.float32),
+            pltpu.VMEM((nq, 1), jnp.float32),
+            pltpu.VMEM((nq, 1), jnp.float32),
+        ],
+    )
+    kwargs = {}
+    if not interpret:
+        # the split axis is the parallelism the kernel exists for: declare
+        # it so Mosaic may distribute independent chains across megacores
+        kwargs["compiler_params"] = _parallel_params(pltpu, ("parallel", "arbitrary",
+                                                            "arbitrary"))
+    acc, m, l = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((ks_n, T, nq, d), jnp.float32),
+                   jax.ShapeDtypeStruct((ks_n, T, nq, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((ks_n, T, nq, 1), jnp.float32)],
+        interpret=interpret, **kwargs)(seq_idx, pos, block_tables, *operands)
+    # log-sum-exp merge over splits (the flash-decode combine)
+    m_star = jnp.max(m, axis=0, keepdims=True)
+    w = jnp.exp(m - m_star)  # dead splits: exp(-1e30 - m*) == 0
+    out = jnp.sum(acc * w, axis=0) / jnp.maximum(jnp.sum(l * w, axis=0), 1e-30)
+    return out.astype(q.dtype)
+
+
+def _parallel_params(pltpu, semantics):
+    """``dimension_semantics`` across jax versions (CompilerParams vs the
+    older TPUCompilerParams spelling); None when neither exists — the call
+    then simply compiles without the megacore hint."""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(pltpu, "TPUCompilerParams", None)
+    return cls(dimension_semantics=tuple(semantics)) if cls is not None else None

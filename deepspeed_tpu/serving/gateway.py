@@ -184,11 +184,17 @@ class ServingGateway:
     # -- programmatic entry (what the HTTP handler calls) ---------------------
     def submit(self, prompt, max_new_tokens: int = 16, slo_class: Optional[str] = None,
                eos_token_id=None, rid: Optional[str] = None,
-               traceparent: Optional[str] = None):
+               traceparent: Optional[str] = None, temperature=None, top_p=None,
+               seed=None):
         """Validate -> route -> admit. Returns ``(200, GatewayRequest)`` or
         ``(status, error_dict)`` with status 400/429/503. ``rid`` is the
         (already-sanitized) client request id — generated when absent, so
-        every refusal carries one too."""
+        every refusal carries one too.
+
+        ``temperature``/``top_p``/``seed``: per-request sampling
+        (``SamplingParams``) — absent/temperature-0 keeps the greedy fast
+        path; out-of-range values are a 400 at the door, never a replica
+        error."""
         rt = self.reqtrace
         rid = sanitize_request_id(rid) or new_request_id()
         cls = slo_class or self.config.default_slo_class
@@ -209,13 +215,25 @@ class ServingGateway:
         if cls not in self.config.slo_classes:
             return refuse(400, {"error": "unknown_slo_class", "slo_class": cls,
                                 "known": sorted(self.config.slo_classes)})
+        sampling = None
+        if temperature is not None or top_p is not None or seed is not None:
+            from ..inference.v2.sampling import SamplingParams
+
+            try:
+                sampling = SamplingParams(
+                    temperature=float(temperature) if temperature is not None else 0.0,
+                    top_p=float(top_p) if top_p is not None else 1.0,
+                    seed=int(seed) if seed is not None else None).validate()
+            except (TypeError, ValueError) as e:
+                return refuse(400, {"error": "invalid_sampling", "detail": str(e)})
         try:
             max_new_tokens = int(max_new_tokens)
             with self._uid_lock:
                 uid = self._next_uid
                 self._next_uid += 1
             req = GatewayRequest(uid, prompt, max_new_tokens, cls,
-                                 eos_token_id=eos_token_id, rid=rid, ctx=ctx)
+                                 eos_token_id=eos_token_id, rid=rid, ctx=ctx,
+                                 sampling=sampling)
             if ctx is not None:
                 # stamped here (not at admission) so too_large/shed records
                 # — exactly the always-retained tail — carry the real size
@@ -391,7 +409,10 @@ class ServingGateway:
                         max_new_tokens=body.get("max_new_tokens", 16),
                         slo_class=body.get("slo_class"),
                         eos_token_id=body.get("eos_token_id"),
-                        rid=rid, traceparent=traceparent)
+                        rid=rid, traceparent=traceparent,
+                        temperature=body.get("temperature"),
+                        top_p=body.get("top_p"),
+                        seed=body.get("seed"))
                     if status != 200:
                         self._json(status, result, rid=rid)
                         return

@@ -120,15 +120,16 @@ class GatewayRequest:
 
     __slots__ = ("uid", "prompt", "max_new_tokens", "slo_class", "eos_token_id",
                  "stream", "replica_name", "t_admitted", "cached_tokens",
-                 "uncached_tokens", "ttft_ms", "tpot_ms", "rid", "ctx")
+                 "uncached_tokens", "ttft_ms", "tpot_ms", "rid", "ctx", "sampling")
 
     def __init__(self, uid, prompt, max_new_tokens, slo_class, eos_token_id=None,
-                 rid=None, ctx=None):
+                 rid=None, ctx=None, sampling=None):
         self.uid = int(uid)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.slo_class = str(slo_class)
         self.eos_token_id = eos_token_id
+        self.sampling = sampling  # SamplingParams | None (= greedy)
         self.stream = TokenStream(capacity=self.max_new_tokens)
         self.replica_name = None
         self.t_admitted = None
@@ -399,7 +400,8 @@ class EngineReplica:
             try:
                 self._scheduler.submit(req.uid, req.prompt,
                                        max_new_tokens=req.max_new_tokens,
-                                       eos_token_id=req.eos_token_id)
+                                       eos_token_id=req.eos_token_id,
+                                       sampling=req.sampling)
             except Exception as e:  # validation said yes, scheduler said no
                 req.stream.finish(reason="error", error=f"{type(e).__name__}: {e}")
                 if self._reqtrace is not None:

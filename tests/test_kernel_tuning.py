@@ -15,7 +15,8 @@ from deepspeed_tpu.autotuning.kernel_config import (CONFIG_FILENAME, KernelAutot
                                                     KernelConfigRegistry, set_kernel_config_path,
                                                     shape_bucket, topology_key, tuned_tile)
 from deepspeed_tpu.models.transformer import alibi_slopes
-from deepspeed_tpu.ops.pallas.paged_attention import (_pallas_paged, _resolve_q_tile,
+from deepspeed_tpu.ops.pallas.paged_attention import (_pallas_paged, _resolve_kv_splits,
+                                                      _resolve_q_tile,
                                                       paged_attention_reference)
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
@@ -116,6 +117,115 @@ def test_qtiled_decode_only_with_pad_run():
                             q_tile=qt)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5,
                                    err_msg=f"q_tile={qt}")
+
+
+# ---------------------------------------------------------------------------
+# flash-decode KV-split: interpret-mode parity matrix vs the gather oracle
+# ---------------------------------------------------------------------------
+
+def _decode_batch(rng, nq, d, bs, blocks_per_seq):
+    """Decode-shaped batch: one token per sequence at varied live depths —
+    seq 0 fully live (the long-context row the split exists for), the rest
+    mid-context — plus the trailing pad run ragged_wrapper.finalize emits."""
+    seq_idx = np.asarray([0, 1, 2, 0, 0], np.int32)
+    pos = np.asarray([blocks_per_seq * bs - 1, bs + 3, 2 * bs + 7, 0, 0], np.int32)
+    T = seq_idx.size
+    q = jnp.asarray(rng.normal(size=(T, nq, d)), jnp.float32)
+    return q, jnp.asarray(seq_idx), jnp.asarray(pos)
+
+
+@pytest.mark.parametrize("kv_splits", [2, 4])
+@pytest.mark.parametrize("case", ["plain", "int8", "alibi", "window", "window_alibi",
+                                  "int8_window", "gqa"])
+def test_kv_split_parity_matrix(case, kv_splits):
+    """The KV-split decode grid (partial softmax per split + log-sum-exp
+    merge) must match the gather oracle on every kernel feature the
+    per-token grid supports — int8 dequant, alibi, sliding window, GQA,
+    partially-live contexts, pad rows — and the per-token grid must agree
+    too (the split changed the schedule, not the math)."""
+    import zlib
+
+    nkv, g = (2, 4) if case == "gqa" else (2, 2)
+    int8 = case.startswith("int8")
+    rng, nq, kp, vp, tables, scales = _paged_setup(seed=zlib.crc32(case.encode()), nkv=nkv,
+                                                   g=g, int8=int8, blocks_per_seq=8)
+    d, bs = 32, 16
+    q, seq_idx, pos = _decode_batch(rng, nq, d, bs, blocks_per_seq=8)
+    kw = dict(scales)
+    if "alibi" in case:
+        kw["alibi"] = tuple(alibi_slopes(nq).tolist())
+    if "window" in case:
+        kw["window"] = 21
+    ref = paged_attention_reference(q, kp, vp, tables, seq_idx, pos, bs, **kw)
+    out = _pallas_paged(q, kp, vp, tables, seq_idx, pos, block_size=bs, interpret=True,
+                        q_tile=1, kv_splits=kv_splits, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    out1 = _pallas_paged(q, kp, vp, tables, seq_idx, pos, block_size=bs, interpret=True,
+                         q_tile=1, kv_splits=1, **kw)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_kv_split_non_dividing_factor_and_single_block():
+    """A split factor that does not divide the table (ceil rounding leaves
+    the last split short) and a context living entirely inside split 0 must
+    both merge correctly — dead splits carry (m=-inf, l=0) and vanish."""
+    rng, nq, kp, vp, tables, _ = _paged_setup(seed=5, n_seqs=2, blocks_per_seq=6)
+    d, bs = 32, 16
+    q = jnp.asarray(rng.normal(size=(2, nq, d)), jnp.float32)
+    seq_idx = jnp.asarray([0, 1], jnp.int32)
+    pos = jnp.asarray([6 * bs - 1, 2], jnp.int32)  # full table; single-block
+    ref = paged_attention_reference(q, kp, vp, tables, seq_idx, pos, bs)
+    for ks in (3, 4, 6):  # 6 blocks: 3 divides, 4 leaves a short tail, 6 = 1 block/split
+        out = _pallas_paged(q, kp, vp, tables, seq_idx, pos, block_size=bs, interpret=True,
+                            q_tile=1, kv_splits=ks)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5,
+                                   err_msg=f"kv_splits={ks}")
+
+
+def test_resolve_kv_splits_contract_and_registry(tmp_path):
+    """kv_splits resolution: decode-shaped rows with a long table split,
+    prefill tiles and short tables never do; the registry (exact (B, T)
+    bucket, then the B-only sweep bucket) beats the heuristic; the
+    DS_TPU_PAGED_KV_SPLITS kill switch beats everything."""
+    # heuristic: long-table decode splits, tiled prefill / short table never
+    assert _resolve_kv_splits(4, 4, 64) == 8
+    assert _resolve_kv_splits(4, 4, 4) == 1
+    assert _resolve_kv_splits(256, 4, 64, q_tile=8) == 1
+    # registry override for this topology
+    reg = KernelConfigRegistry(str(tmp_path / CONFIG_FILENAME))
+    reg.record("paged_attention", shape_bucket(B=64), {"kv_splits": 4})
+    reg.record("paged_attention", shape_bucket(B=64, T=8), {"kv_splits": 2})
+    reg.save()
+    set_kernel_config_path(str(tmp_path / CONFIG_FILENAME))
+    assert _resolve_kv_splits(8, 8, 64) == 2      # exact (B, T) bucket wins
+    assert _resolve_kv_splits(4, 4, 64) == 4      # B-only sweep bucket
+    assert _resolve_kv_splits(4, 4, 32) == 8      # untouched bucket: heuristic
+    # kill switch: =1 pins the single-chain grid, higher values force
+    os.environ["DS_TPU_PAGED_KV_SPLITS"] = "1"
+    try:
+        assert _resolve_kv_splits(4, 4, 64) == 1
+        os.environ["DS_TPU_PAGED_KV_SPLITS"] = "16"
+        assert _resolve_kv_splits(4, 4, 64) == 16
+        # forced factor still clamps to the table (8 blocks cap 16 -> 8)
+        assert _resolve_kv_splits(4, 4, 8) == 8
+        # and a too-short table stays single-chain even under the override
+        assert _resolve_kv_splits(4, 4, 4) == 1
+    finally:
+        del os.environ["DS_TPU_PAGED_KV_SPLITS"]
+
+
+def test_tune_paged_decode_records_reachable_bucket(tmp_path):
+    """The decode sweep's winner must land under the B-only bucket the live
+    ``_resolve_kv_splits`` fallback actually reads — a sweep recording an
+    unreachable key is a silent no-op (the PR 10 tune_paged lesson)."""
+    tuner = KernelAutotuner(str(tmp_path), steps=1, warmup=0)
+    best = tuner.tune_paged_decode(n_seqs=2, max_blocks=16,
+                                   candidates=[{"kv_splits": 1}, {"kv_splits": 4}])
+    assert best is not None and best["kv_splits"] in (1, 4)
+    path = tuner.registry.save(os.path.join(str(tmp_path), CONFIG_FILENAME))
+    set_kernel_config_path(path)
+    assert _resolve_kv_splits(2, 2, 16) == best["kv_splits"]
+    assert _resolve_kv_splits(8, 8, 16) == best["kv_splits"]  # any decode batch size
 
 
 def test_explicit_q_tile_demoted_on_noncontiguous_batch():

@@ -391,7 +391,7 @@ def test_v2_warmup_precompiles_decode():
         compiles = [e for e in get_tracer().drain()
                     if e.get("name") == "jax_compile" and e.get("args", {}).get("source") == "warmup"]
         configure_tracer(enabled=False)
-    assert ("decode", 4, 4) in eng._compiled
+    assert ("decode", 4, 4, False) in eng._compiled  # (seqs, steps, sampled)
     assert res == [{"seqs": 4, "steps": 4, "seconds": res[0]["seconds"], "cached": False}]
     assert compiles and compiles[0]["args"]["seqs"] == 4
     assert eng.warmup([4], [4])[0]["cached"] is True  # idempotent

@@ -614,3 +614,51 @@ def test_check_chaos_points_catches_drift(tmp_path):
     assert any("clear" in b for b in bad)
     assert any("silent swallow" in b.lower() or "health/" in b for b in bad)
     assert not any("fine.py" in b for b in bad)
+
+
+def test_warm_remesh_carries_curriculum_and_random_ltd_state():
+    """ROADMAP 5c leftover: a warm remesh of a data-efficiency run must
+    resume curriculum difficulty and the random-LTD sequence budget exactly
+    — without the universal meta carrying them, a restored engine silently
+    re-ran its schedule from step 0 against an optimizer resumed at step N."""
+    from deepspeed_tpu.elasticity import remesh
+
+    cfg = _config()
+    cfg["curriculum_learning"] = {
+        "enabled": True, "curriculum_type": "seqlen",
+        "min_difficulty": 4, "max_difficulty": 16,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 4},
+    }
+    cfg["data_efficiency"] = {
+        "enabled": True,
+        "data_routing": {"enabled": True, "random_ltd": {
+            "enabled": True, "total_layer_num": 1, "random_ltd_layer_num": 1,
+            "random_ltd_layer_id": [0], "model_mask_name": None,
+            "model_type": "decoder", "hidden_state_order": "batch_seq_dim",
+            "random_ltd_schedule": {"min_value": 4, "max_value": 16,
+                                    "schedule_type": "fixed_linear",
+                                    "schedule_config": {"total_curriculum_step": 4,
+                                                        "difficulty_step": 4}},
+        }},
+    }
+    engine = _engine(cfg)
+    assert engine.curriculum_scheduler is not None
+    assert engine.random_ltd_scheduler is not None
+    for i in range(3):
+        engine.train_batch(_batch(i))
+    cur_state = dict(engine.curriculum_scheduler.state_dict())
+    ltd_state = dict(engine.random_ltd_scheduler.state_dict())
+    snap = remesh.capture_snapshot(engine)
+    engine.destroy()
+    assert snap.meta.get("curriculum_scheduler") == cur_state
+    assert snap.meta.get("random_ltd_scheduler") == ltd_state
+
+    warm = _engine(cfg)  # fresh: schedules back at step 0
+    assert warm.curriculum_scheduler.state_dict() != cur_state
+    remesh.restore_snapshot(warm, snap)
+    assert warm.curriculum_scheduler.state_dict() == cur_state, \
+        "curriculum difficulty not restored through the universal meta"
+    assert warm.random_ltd_scheduler.state_dict() == ltd_state, \
+        "random-ltd schedule not restored through the universal meta"
+    warm.destroy()

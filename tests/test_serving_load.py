@@ -124,3 +124,20 @@ def test_scheduler_finished_property(engine):
     assert sched.finished == frozenset()
     sched.run()
     assert sched.finished == frozenset({900, 901})
+
+
+@pytest.mark.slow
+def test_speculative_sweep_grid_shape_and_parity():
+    """The K × tree-width sweep: every cell replays the identical request
+    stream (token parity vs the shared spec-off baseline) and reports a
+    per-cell accept rate; the per-mode best-accept summary covers every
+    swept mode."""
+    from tools.serving_load import speculative_sweep
+
+    out = speculative_sweep(False, ks=(2, ), widths=(1, 2), n_requests=5)
+    assert len(out["grid"]) == 2
+    assert out["all_parity"], "a sweep cell broke greedy token parity"
+    for cell in out["grid"]:
+        assert {"mode", "k", "tree_width", "accept_rate", "decode_tok_s",
+                "speedup", "token_parity"} <= set(cell)
+    assert set(out["best_accept_rate_by_mode"]) == {"ngram"}

@@ -510,7 +510,7 @@ def test_spec_zero_overhead_when_config_absent(tiny_model):
         sched.submit(0, np.arange(12, dtype=np.int32) % 128, max_new_tokens=6)
         sched.run()
         assert sched.spec_stats == {"rounds": 0, "drafted": 0, "accepted": 0,
-                                    "rejected": 0}
+                                    "rejected": 0, "backoffs": 0}
         assert sched._spec_by_uid == {} and sched.spec_summary(0) is None
         assert eng._spec_totals == {"drafted": 0, "accepted": 0}
         assert not any(k[0] == "verify" for k in eng._compiled), \
@@ -584,3 +584,317 @@ def test_check_spec_rollback_catches_drift(tmp_path):
     bad = check((str(d), ))
     assert {(rel, line) for rel, line, _why, _s in bad} == \
         {("rogue.py", 2), ("rogue.py", 3), ("rogue.py", 4), ("rogue.py", 5)}
+
+
+# ---------------------------------------------------------------------------
+# PR 13: token-tree verification, spec-burst backoff, speculative sampling
+# ---------------------------------------------------------------------------
+
+def test_ngram_draft_branches_top_n_distinct():
+    """draft_branches returns up to ``width`` DISTINCT continuations —
+    longest match first, most recent occurrence first — and branch 0 is
+    exactly what the linear draft() proposes (width=1 back-compat)."""
+    d = NgramDrafter(min_match=1, max_ngram=2)
+    # stream: "5 -> [1,2]" most recently, "5 -> [3,4]" earlier, "5 -> [1,2]" dup
+    ctx = np.asarray([5, 3, 4, 9, 5, 1, 2, 9, 5, 1, 2, 7, 5], np.int32)
+    bs = d.draft_branches(0, ctx, k=2, width=3)
+    assert [b.tolist() for b in bs][:2] == [[1, 2], [3, 4]]
+    assert len({b.tobytes() for b in bs}) == len(bs), "duplicate branch emitted"
+    lin = d.draft(0, ctx, 2)
+    assert lin.tolist() == bs[0].tolist()
+    # width=1 returns only the linear proposal
+    assert [b.tolist() for b in d.draft_branches(0, ctx, 2, 1)] == [[1, 2]]
+    # a drafter WITHOUT a branch override wraps its linear drafts
+    class _Lin(Drafter):
+        def draft(self, uid, context, k):
+            return np.asarray([1, 2], np.int32)
+
+    out = _Lin().draft_branches_many([(7, ctx)], 2, 4)
+    assert [b.tolist() for b in out[7]] == [[1, 2]]
+
+
+def test_tree_verify_deepest_path_wins_and_parity(tiny_model):
+    """Engine-level token-tree verification: with the true continuation
+    hidden among junk branches (NOT at branch 0), the deepest-argmax-path
+    walk must find it, commit it at the canonical KV positions (the
+    compaction move), and keep greedy parity bit-exact — with the prefix
+    cache on and off, and with the pool pristine after flush."""
+    model, params = tiny_model
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 128, size=20, dtype=np.int32)
+    ref = _greedy_reference(model, params, prompt, 12)
+    k = 3
+    for cache_on in (False, True):
+        eng = _engine(model, params, cache_on=cache_on)
+        got = [int(np.asarray(eng.put([5], [prompt], sample="greedy")).reshape(-1)[0])]
+        rounds = 0
+        while len(got) < 12:
+            oracle = np.asarray(ref[len(got):len(got) + k], np.int32)
+            junk = (oracle + 7) % 128
+            # oracle at branch index 1: a tie-broken branch-0 walk would
+            # commit junk — the deepest path must win regardless of order
+            outs = eng.speculate_decode([5], [np.asarray([got[-1]], np.int32)],
+                                        [[junk, oracle, (oracle + 3) % 128]], k)
+            got.extend(int(t) for t in outs[0])
+            rounds += 1
+        assert got[:12] == ref, f"tree verification broke greedy parity (cache={cache_on})"
+        assert rounds <= -(-11 // k) + 1, "oracle branch must commit ~k+1/round"
+        assert eng.query(5).seen_tokens == prompt.size + len(got) - 1
+        eng.flush(5)
+        sm = eng.state_manager
+        tree = eng.prefix_cache.n_cached_blocks if eng.prefix_cache else 0
+        assert sm.free_blocks + tree == sm.kv_cache.total_blocks, \
+            "tree verify leaked blocks"
+
+
+def test_tree_rejected_branches_never_enter_radix_tree(tiny_model):
+    """The PR 9 tree-pollution regression extended to TREE drafts: junk
+    sibling branches materialize KV at their flat slots every round, but
+    after finish+flush the radix tree must hold EXACTLY the committed
+    greedy chain — a rejected branch's tokens in the tree would poison
+    prefix reuse for every later request."""
+    model, params = tiny_model
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 128, size=20, dtype=np.int32)
+    ref = _greedy_reference(model, params, prompt, 10)
+    k = 3
+    eng = _engine(model, params, cache_on=True)
+    got = [int(np.asarray(eng.put([1], [prompt], sample="greedy")).reshape(-1)[0])]
+    while len(got) < 10:
+        oracle = np.asarray(ref[len(got):len(got) + k], np.int32)
+        outs = eng.speculate_decode([1], [np.asarray([got[-1]], np.int32)],
+                                    [[(oracle + 11) % 128, oracle]], k)
+        got.extend(int(t) for t in outs[0])
+    assert got[:10] == ref
+    eng.flush(1)
+    pc = eng.prefix_cache
+    bs = eng.config.kv_block_size
+    real_history = list(prompt) + got[:-1]  # last token pending, never materialized
+    full = len(real_history) // bs
+    assert pc.n_cached_blocks == full, \
+        f"tree holds {pc.n_cached_blocks} blocks, only {full} real full blocks exist"
+    node = pc._root
+    for b in range(full):
+        chunk = tuple(int(t) for t in real_history[b * bs:(b + 1) * bs])
+        assert chunk in node.children, f"real chunk {b} missing from the radix tree"
+        node = node.children[chunk]
+    assert not node.children, "a rejected branch leaked into the radix tree"
+
+
+def test_tree_accept_beats_linear_on_same_stream(tiny_model):
+    """Scheduler-level: the SAME request stream under the ngram drafter at
+    tree_width 4 must accept at least as many draft tokens as width 1 (any
+    one of the extra hypotheses matching lifts the round), with the greedy
+    output bit-identical to spec-off in both arms."""
+    model, params = tiny_model
+    rng = np.random.default_rng(11)
+    motif = rng.integers(0, 128, size=6, dtype=np.int32)
+    reqs = []
+    for i in range(3):
+        filler = rng.integers(0, 128, size=4, dtype=np.int32)
+        reqs.append((i, np.concatenate([motif, filler, motif, motif])))
+    base, _ = _run_sched(_engine(model, params), list(reqs), max_new=14)
+    accepted = {}
+    for width in (1, 4):
+        eng = _engine(model, params)
+        sched = DynamicSplitFuseScheduler(
+            eng, token_budget=48,
+            speculative=SpeculativeConfig(mode="ngram", k=3, min_match=1,
+                                          tree_width=width, backoff_after=0))
+        for uid, p in reqs:
+            sched.submit(uid, p, max_new_tokens=14)
+        out = sched.run()
+        assert out == base, f"width={width} broke greedy parity"
+        accepted[width] = sched.spec_stats["accepted"]
+        assert sched.spec_stats["drafted"] > 0
+    assert accepted[4] >= accepted[1], \
+        f"tree acceptance {accepted[4]} < linear {accepted[1]} on the same stream"
+
+
+def test_spec_backoff_parks_hopeless_drafter(tiny_model):
+    """A drafter that never lands a token must stop burning verify FLOPs:
+    after ``backoff_after`` consecutive zero-accept rounds the request
+    stops drafting (drafter no longer consulted except re-probes), rides
+    the plain decode burst, and the backoff is counted — with greedy
+    parity untouched."""
+    from deepspeed_tpu.monitor.metrics import configure_metrics, get_metrics
+
+    model, params = tiny_model
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, 128, size=16, dtype=np.int32)
+    base, _ = _run_sched(_engine(model, params), [(0, prompt)], max_new=24)
+
+    class _CountingJunk(_JunkDrafter):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def draft(self, uid, context, k):
+            self.calls += 1
+            return super().draft(uid, context, k)
+
+    configure_metrics(enabled=True)
+    get_metrics().reset()
+    try:
+        drafter = _CountingJunk()
+        eng = _engine(model, params)
+        sched = DynamicSplitFuseScheduler(
+            eng, token_budget=32,
+            speculative=SpeculativeConfig(mode="ngram", k=2, backoff_after=3,
+                                          reprobe_every=8),
+            drafter=drafter)
+        sched.submit(0, prompt, max_new_tokens=24)
+        out = sched.run()
+        assert out[0] == base[0], "backoff broke greedy parity"
+        assert sched.spec_stats["backoffs"] == 1
+        assert sched.spec_stats["accepted"] == 0
+        # the drafter was consulted for the backoff_after rounds plus at
+        # most the occasional re-probe — far fewer than one call per token
+        assert drafter.calls <= 3 + 24 // 8 + 2, \
+            f"drafter still consulted {drafter.calls}x after backoff"
+        snap = get_metrics().snapshot()
+        assert snap.get("counters", {}).get("serving/spec_disabled_total") == 1
+    finally:
+        configure_metrics(enabled=False)
+    # an accepting drafter must NEVER back off: oracle via draft model
+    deng = _engine(model, params)
+    eng2 = _engine(model, params)
+    sched2 = DynamicSplitFuseScheduler(
+        eng2, token_budget=32,
+        speculative=SpeculativeConfig(mode="ngram", k=2, backoff_after=3, reprobe_every=8),
+        drafter=DraftModelDrafter(deng))
+    sched2.submit(0, prompt, max_new_tokens=24)
+    out2 = sched2.run()
+    assert out2[0] == base[0]
+    assert sched2.spec_stats["backoffs"] == 0
+    assert sched2.spec_stats["accepted"] > 0
+
+
+def test_speculative_sampling_matches_direct_distribution():
+    """The rejection-sampling verify step is distribution-exact: over many
+    seeds, the committed token at the first draft position (accept the
+    draft w.p. p(d), else the normalized residual) must match the target's
+    own tempered/top-p distribution — chi-square against exact
+    probabilities, plus the hard structural checks (nothing outside the
+    nucleus; temperature 0 IS argmax)."""
+    from deepspeed_tpu.inference.v2.sampling import _filtered, spec_verify_draws
+
+    rng = np.random.default_rng(0)
+    V, k, S = 16, 3, 2000
+    base = jnp.asarray(rng.normal(size=(1, k + 1, V)) * 2.0, jnp.float32)
+    chunk1 = jnp.asarray(rng.integers(0, V, size=(1, k + 1)), jnp.int32)
+    temps = jnp.full((S, ), 0.8, jnp.float32)
+    tops = jnp.full((S, ), 0.9, jnp.float32)
+    probs = np.asarray(jax.nn.softmax(_filtered(base, temps[:1], tops[:1]), -1))[0, 0]
+    lg = jnp.broadcast_to(base, (S, k + 1, V))
+    ch = jnp.broadcast_to(chunk1, (S, k + 1))
+    fn = jax.jit(spec_verify_draws)
+    counts = np.zeros(V)
+    tot = 0
+    for _ in range(10):
+        seeds = jnp.asarray(rng.integers(0, 2**31, size=S), jnp.int32)
+        acc, nxt = fn(lg, ch, temps, tops, seeds, jnp.zeros(S, jnp.int32))
+        acc, nxt = np.asarray(acc), np.asarray(nxt)
+        committed0 = np.where(acc[:, 0].astype(bool), int(chunk1[0, 1]), nxt[:, 0])
+        np.add.at(counts, committed0, 1)
+        tot += S
+    emp = counts / tot
+    mask = probs > 0
+    assert (emp[~mask] == 0).all(), "sampled a token OUTSIDE the top-p nucleus"
+    chi2 = tot * np.sum((emp[mask] - probs[mask]) ** 2 / probs[mask])
+    dof = int(mask.sum()) - 1
+    # p < 1e-6 rejection threshold for ~dof degrees of freedom: loose
+    # enough to never flake, tight enough to catch a broken residual
+    assert chi2 < dof + 12 * np.sqrt(2 * dof), \
+        f"speculative sampling diverges from direct sampling (chi2={chi2:.1f}, dof={dof})"
+    # temperature 0 rows are EXACT greedy, draws untouched
+    acc0, nxt0 = fn(lg, ch, jnp.zeros(S, jnp.float32), tops, seeds, jnp.zeros(S, jnp.int32))
+    gr = np.asarray(jnp.argmax(lg, -1))
+    assert (np.asarray(nxt0) == gr).all()
+    assert (np.asarray(acc0).astype(bool) == (np.asarray(ch)[:, 1:] == gr[:, :k])).all()
+
+
+def test_sampled_spec_deterministic_and_budgeted(tiny_model):
+    """Engine-level speculative sampling: a fixed (seed, prompt) replays
+    the SAME stream across runs (position-keyed draws), a different seed
+    diverges, and the KV pool stays clean — sampling changes the
+    distribution lever, never the block accounting."""
+    from deepspeed_tpu.inference.v2 import SamplingParams
+
+    model, params = tiny_model
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, 128, size=16, dtype=np.int32)
+
+    def run(seed):
+        eng = _engine(model, params)
+        sp = SamplingParams(temperature=0.9, top_p=0.95, seed=seed)
+        got = [int(np.asarray(eng.put([9], [prompt], sample="greedy",
+                                      sampling=[sp])).reshape(-1)[0])]
+        while len(got) < 10:
+            drafts = np.asarray([got[-1]] * 2, np.int32)
+            outs = eng.speculate_decode([9], [np.asarray([got[-1]], np.int32)],
+                                        [drafts], 2, sampling=[sp])
+            got.extend(int(t) for t in outs[0])
+        eng.flush(9)
+        assert eng.state_manager.free_blocks == eng.state_manager.kv_cache.total_blocks
+        return got[:10]
+
+    a, b, c = run(42), run(42), run(43)
+    assert a == b, "same seed must replay the same sampled stream"
+    assert a != c, "different seeds should diverge (vanishingly unlikely otherwise)"
+
+
+def test_sampled_decode_scan_matches_put_loop(tiny_model):
+    """The sampled multi-step decode scan and the per-token sampled put
+    loop must produce the IDENTICAL stream for one (seed, prompt): draws
+    are keyed by token position, not by which compiled program runs."""
+    from deepspeed_tpu.inference.v2 import SamplingParams
+
+    model, params = tiny_model
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, 128, size=14, dtype=np.int32)
+
+    def run(use_scan):
+        eng = _engine(model, params)
+        sp = SamplingParams(temperature=0.9, top_p=0.95, seed=123)
+        got = [int(np.asarray(eng.put([2], [prompt], sample="greedy",
+                                      sampling=[sp])).reshape(-1)[0])]
+        if use_scan:
+            rows = np.asarray(eng.decode([2], [np.asarray([got[-1]], np.int32)], 8,
+                                         sampling=[sp]))
+            got.extend(int(t) for t in rows[0])
+        else:
+            while len(got) < 9:
+                t = np.asarray(eng.put([2], [np.asarray([got[-1]], np.int32)],
+                                       sample="greedy", sampling=[sp])).reshape(-1)[0]
+                got.append(int(t))
+        eng.flush(2)
+        return got[:9]
+
+    assert run(True) == run(False)
+
+
+def test_sampling_params_validation():
+    from deepspeed_tpu.inference.v2 import SamplingParams
+
+    SamplingParams().validate()
+    SamplingParams(temperature=0.7, top_p=0.5, seed=1).validate()
+    for bad in (SamplingParams(temperature=-0.1), SamplingParams(temperature=float("nan")),
+                SamplingParams(temperature=1e6), SamplingParams(top_p=0.0),
+                SamplingParams(top_p=1.5), SamplingParams(seed=2**40)):
+        with pytest.raises(ValueError):
+            bad.validate()
+
+
+def test_tree_plus_sampling_refused(tiny_model):
+    from deepspeed_tpu.inference.v2 import SamplingParams
+
+    model, params = tiny_model
+    eng = _engine(model, params)
+    prompt = np.arange(12, dtype=np.int32) % 128
+    first = int(np.asarray(eng.put([4], [prompt], sample="greedy")).reshape(-1)[0])
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng.speculate_decode([4], [np.asarray([first], np.int32)],
+                             [[np.asarray([1, 2], np.int32), np.asarray([3, 4], np.int32)]],
+                             2, sampling=[SamplingParams(temperature=0.8, seed=0)])
+    eng.flush(4)

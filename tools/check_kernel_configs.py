@@ -34,14 +34,14 @@ PALLAS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
 # module -> {entrypoint: [tile params that must default to None]}
 TUNED_KERNELS = {
     "flash_attention.py": {"flash_attention": ["block_q", "block_k"]},
-    "paged_attention.py": {"paged_attention": ["q_tile"]},
+    "paged_attention.py": {"paged_attention": ["q_tile", "kv_splits"]},
     "grouped_matmul.py": {"gmm": ["block_k", "block_n"],
                           "tgmm": ["block_k", "block_n"],
                           "grouped_matmul": ["block_k", "block_n"]},
 }
 
 # tile-named params the drift catch watches in NEW/untuned kernels
-TILE_PARAM_NAMES = {"block_q", "block_k", "block_n", "q_tile"}
+TILE_PARAM_NAMES = {"block_q", "block_k", "block_n", "q_tile", "kv_splits"}
 
 # untuned kernels with hardcoded tiles, each with a reason they are exempt:
 ALLOWLIST = {

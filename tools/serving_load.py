@@ -448,7 +448,8 @@ def cache_pressure_bench(on_tpu, n_requests=None, seed=0, corpus_mult=4.0):
     return result
 
 
-def speculative_ab(on_tpu, n_requests=None, seed=0, k=4, mode="ngram", min_match=None):
+def speculative_ab(on_tpu, n_requests=None, seed=0, k=4, mode="ngram", min_match=None,
+                   tree_width=1):
     """Speculative-decoding A/B on the Zipf shared-prefix workload: the same
     request stream runs spec-off then spec-on (greedy → token-identical,
     asserted here and in tests/test_speculative.py). Decode tok/s counts
@@ -478,10 +479,11 @@ def speculative_ab(on_tpu, n_requests=None, seed=0, k=4, mode="ngram", min_match
 
     wl = make_shared_prefix_workload(n, rate_rps=None, seed=seed, uid_base=0, **shape)
     result = {"config": "speculative_ab", "n_requests": n, "k": k, "mode": mode,
-              "min_match": min_match}
+              "min_match": min_match, "tree_width": int(tree_width)}
     tokens = {}
     for spec_on in (False, True):
-        spec = SpeculativeConfig(mode=mode, k=k, min_match=min_match) if spec_on else None
+        spec = SpeculativeConfig(mode=mode, k=k, min_match=min_match,
+                                 tree_width=int(tree_width)) if spec_on else None
         engine = build_engine(on_tpu, prefix_cache=True, speculative=spec)
         # warmup compiles every bucket (incl. the verify bucket) so the
         # measured pass times scheduling + speculation, not XLA
@@ -508,6 +510,66 @@ def speculative_ab(on_tpu, n_requests=None, seed=0, k=4, mode="ngram", min_match
     result["speedup"] = round(result["decode_tok_s_on"] /
                               max(1e-9, result["decode_tok_s_off"]), 3)
     return result
+
+
+def speculative_sweep(on_tpu, ks=None, widths=None, modes=("ngram", ), n_requests=None,
+                      seed=0):
+    """K × tree-width sweep over the Zipf shared-prefix workload with
+    per-drafter-mode accept-rate reporting: one shared spec-off baseline,
+    then one spec-on arm per (mode, k, width) cell — the grid that answers
+    "is the extra verify compute of deeper drafts / wider trees paying for
+    itself on THIS traffic". Greedy token parity is asserted in every cell
+    (each arm replays the identical request stream)."""
+    from deepspeed_tpu.inference.v2 import SpeculativeConfig
+
+    ks = tuple(ks or ((2, 4, 8) if on_tpu else (2, 4)))
+    widths = tuple(widths or ((1, 2, 4) if on_tpu else (1, 2)))
+    if on_tpu:
+        n = n_requests or 16
+        shape = dict(n_prefixes=4, prefix_len=256, suffix_lo=16, suffix_hi=64,
+                     new_lo=48, new_hi=96)
+        budget, min_match = 512, 2
+    else:
+        n = n_requests or 8
+        shape = dict(n_prefixes=3, prefix_len=24, suffix_lo=4, suffix_hi=10,
+                     new_lo=14, new_hi=22)
+        budget, min_match = 48, 1
+    wl = make_shared_prefix_workload(n, rate_rps=None, seed=seed, uid_base=0, **shape)
+
+    def run_arm(spec):
+        engine = build_engine(on_tpu, prefix_cache=True, speculative=spec)
+        run_splitfuse(engine, [dict(r, uid=r["uid"] + 90_000) for r in wl],
+                      token_budget=budget)  # warmup: compile every bucket
+        engine.prefix_cache.clear()
+        engine.prefix_cache.stats.update({s: 0 for s in engine.prefix_cache.stats})
+        stats = {}
+        done, span = run_splitfuse(engine, wl, token_budget=budget, stats_out=stats)
+        gen = sum(len(t) for _, t in done.values())
+        return ({u: t for u, (_, t) in sorted(done.items())},
+                round(gen / span, 1), stats.get("spec", {}))
+
+    base_tokens, base_tok_s, _ = run_arm(None)
+    grid = []
+    for mode in modes:
+        for k in ks:
+            for w in widths:
+                toks, tok_s, sp = run_arm(SpeculativeConfig(
+                    mode=mode, k=k, min_match=min_match, tree_width=w))
+                grid.append({
+                    "mode": mode, "k": int(k), "tree_width": int(w),
+                    "accept_rate": round(sp.get("accepted", 0) / max(1, sp.get("drafted", 0)), 3),
+                    "drafted": sp.get("drafted", 0), "accepted": sp.get("accepted", 0),
+                    "rounds": sp.get("rounds", 0), "backoffs": sp.get("backoffs", 0),
+                    "decode_tok_s": tok_s,
+                    "speedup": round(tok_s / max(1e-9, base_tok_s), 3),
+                    "token_parity": toks == base_tokens,
+                })
+    by_mode = {m: max((c["accept_rate"] for c in grid if c["mode"] == m), default=0.0)
+               for m in modes}
+    return {"config": "speculative_sweep", "n_requests": n,
+            "decode_tok_s_off": base_tok_s, "grid": grid,
+            "best_accept_rate_by_mode": by_mode,
+            "all_parity": all(c["token_parity"] for c in grid)}
 
 
 # ---------------------------------------------------------------------------
@@ -916,8 +978,10 @@ def main():
 
     if "shared_prefix" in sys.argv[1:]:
         out = shared_prefix_ab(on_tpu)
+    elif "speculative_sweep" in sys.argv[1:]:
+        out = speculative_sweep(on_tpu)
     elif "speculative" in sys.argv[1:]:
-        out = speculative_ab(on_tpu)
+        out = {"ab": speculative_ab(on_tpu), "sweep": speculative_sweep(on_tpu)}
     elif "gateway" in sys.argv[1:]:
         out = gateway_bench(on_tpu)
     elif "cache_pressure" in sys.argv[1:]:
