@@ -64,6 +64,33 @@ def backend_stamp(on_tpu: bool) -> dict:
     return {"backend": "tpu" if on_tpu else "cpu", "chip": chip}
 
 
+def backend_of(line: dict):
+    """Backend stamp of a bench JSON line: explicit ``backend`` wins, the
+    pre-r06 ``on_tpu`` field is the fallback, neither -> None."""
+    b = line.get("backend")
+    if b is None and "on_tpu" in line:
+        b = "tpu" if line.get("on_tpu") else "cpu"
+    return b
+
+
+def comparability_refusal(base: dict, cur: dict):
+    """Why a base-vs-cur ratio would be MEANINGLESS (None = comparable):
+    missing backend stamps, cross-backend, or cross-chip. The shared
+    refusal core of :func:`compare_to_baseline` and
+    ``tools/perf_sentinel.py``'s round-trajectory verdicts — the r04/r05
+    lesson (CPU-fallback rounds silently ratioed against on-chip rounds)
+    machine-checked in one place."""
+    b_backend = backend_of(base)
+    c_backend = backend_of(cur)
+    if b_backend is None:
+        return "baseline carries no backend stamp (pre-r06 format without on_tpu)"
+    if b_backend != c_backend:
+        return f"cross-backend comparison: baseline={b_backend} current={c_backend}"
+    if base.get("chip") and cur.get("chip") and base["chip"] != cur["chip"]:
+        return f"cross-chip comparison: baseline={base['chip']} current={cur['chip']}"
+    return None
+
+
 def compare_to_baseline(line: dict, baseline_path: str) -> dict:
     """Headline-vs-previous-round comparison that REFUSES cross-backend
     ratios. Accepts a raw bench JSON line or the driver's ``BENCH_rXX.json``
@@ -79,17 +106,10 @@ def compare_to_baseline(line: dict, baseline_path: str) -> dict:
         base = base["parsed"]
     if not isinstance(base, dict):
         return {"refused": "baseline is not a bench JSON object"}
-    b_backend = base.get("backend")
-    if b_backend is None and "on_tpu" in base:
-        b_backend = "tpu" if base.get("on_tpu") else "cpu"
-    cur = line.get("backend")
-    if b_backend is None:
-        return {"refused": "baseline carries no backend stamp (pre-r06 format without on_tpu)"}
-    if b_backend != cur:
-        return {"refused": f"cross-backend comparison: baseline={b_backend} current={cur}"}
-    if (base.get("chip") and line.get("chip") and base["chip"] != line["chip"]):
-        return {"refused": f"cross-chip comparison: baseline={base['chip']} "
-                           f"current={line['chip']}"}
+    refusal = comparability_refusal(base, line)
+    if refusal is not None:
+        return {"refused": refusal}
+    b_backend = backend_of(base)
     if (base.get("metric") and line.get("metric") and base["metric"] != line["metric"]):
         # bench prints TWO stamped lines (serving + train headline) — a
         # ratio across metrics is as meaningless as one across backends
@@ -180,6 +200,10 @@ def bench_serving(on_tpu: bool):
         for uid in range(1, ns):  # full-batch KV residency
             eng.put([uid], [warm_prompt], sample="greedy")
         tok = [np.asarray([int(first[0])], np.int32)] * ns
+        # the timed phase's batched 1-token put (all seqs) and the widest
+        # decode scan — the recompile sentinel flags any bucket this rung
+        # misses as a steady-state recompile below
+        eng.put(list(range(ns)), tok, sample="greedy")
         eng.decode(list(range(ns)), tok, horizon)  # compile the widest decode scan
         for uid in range(ns):
             eng.flush(uid)
@@ -190,6 +214,15 @@ def bench_serving(on_tpu: bool):
         try:
             engine = warm_rung(ns, k8)
             n_seqs, kv_int8 = ns, k8
+            # the rung warmed every bucket the timed phases hit with REAL
+            # traffic — declare the sentinel boundary and attach the serving
+            # ledger so the TTFT/decode phases are wall-clock attributed and
+            # any steady-state recompile below is flagged, not silent
+            from deepspeed_tpu.monitor.goodput import get_goodput as _gp
+
+            if _gp().enabled:
+                engine.goodput_ledger = _gp().serving_ledger("bench")
+                engine.declare_gp_warmed()
             break
         except Exception as e:
             print(f"# WARNING: serving config n_seqs={ns} kv={'int8' if k8 else 'bf16'} failed "
@@ -316,6 +349,10 @@ def bench_serving(on_tpu: bool):
     }
     if prefix_line is not None:
         out["prefix_cache"] = prefix_line
+    if engine.goodput_ledger is not None:
+        # freeze the wall clock: the ledger's report covers the serving
+        # phases, not the unrelated bench minutes that follow
+        engine.goodput_ledger.stop()
     _free_engine(engine, "state_manager", "params")
     return out
 
@@ -572,6 +609,14 @@ def run_bench():
         configure_tracer(enabled=True, path=trace_path)
         configure_metrics(enabled=True)
         _dist.configure(enabled=True, prof_all=True)
+
+    # goodput ledger + recompile sentinel (monitor/goodput.py): armed for
+    # every bench child — the final JSON's `goodput` block attributes the
+    # bench's own wall clock (compile vs compute vs input wait) and proves
+    # the steady-state phases recompiled nothing
+    from deepspeed_tpu.monitor.goodput import configure_goodput
+
+    configure_goodput(enabled=True)
 
     try:
         on_tpu = any(d.platform == "tpu" for d in jax.devices())
@@ -1162,6 +1207,33 @@ def run_bench():
         line["tpu_unavailable_reason"] = tpu_error or "no TPU device visible"
     if gate_note:
         line["kernel_gate_warning"] = gate_note
+    # goodput block: every bench second attributed (training ledger spans
+    # the whole child; the serving ledger covers the timed serving phases),
+    # plus the sentinel's steady-state-recompile verdict
+    try:
+        from deepspeed_tpu.monitor.goodput import conservation_ok, get_goodput
+
+        rep = get_goodput().report()
+        gp_line = {"unexpected_compiles": {
+            src: sc["unexpected_compiles"] for src, sc in rep["sentinel"].items()}}
+        for scope, led_rep in [("train", rep["train"])] + sorted(rep["serving"].items()):
+            if led_rep is None:
+                continue
+            gp_line[scope] = {
+                "wall_s": led_rep["wall_s"],
+                "fractions": led_rep["fractions"],
+                "unattributed_s": led_rep["unattributed_s"],
+                "conserved": conservation_ok(led_rep),
+            }
+        line["goodput"] = gp_line
+        tr_fr = gp_line.get("train", {}).get("fractions", {})
+        top = sorted(((v, k) for k, v in tr_fr.items() if v > 0), reverse=True)[:4]
+        print("# goodput: train[" + " ".join(f"{k}={v:.0%}" for v, k in top)
+              + "] unexpected_compiles=" + " ".join(
+                  f"{s}:{n}" for s, n in gp_line["unexpected_compiles"].items()),
+              flush=True)
+    except Exception as e:  # the headline line never forfeits to telemetry
+        print(f"# WARNING: goodput block failed ({type(e).__name__}: {e})", flush=True)
     if trace_path:
         from deepspeed_tpu.comm.comm import comms_logger
         from deepspeed_tpu.monitor.trace import get_tracer
@@ -1401,6 +1473,14 @@ if __name__ == "__main__":
     # write vs async host-snapshot + background writer) to the final JSON
     if "--ckpt" in sys.argv:
         os.environ["DS_TPU_BENCH_CKPT"] = "1"
+    # --history [DIR] [--out V.json] [--threshold R] [--strict]: don't run a
+    # bench — read the BENCH_r*.json round trajectory on disk through
+    # tools/perf_sentinel.py and print its regression verdicts
+    if "--history" in sys.argv:
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from perf_sentinel import main as _sentinel_main
+
+        sys.exit(_sentinel_main(sys.argv[sys.argv.index("--history") + 1:]))
     if os.environ.get("DS_TPU_BENCH_CHILD") == "1":
         run_bench()
     else:

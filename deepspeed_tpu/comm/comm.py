@@ -485,6 +485,13 @@ def barrier(group=None):
     _ensure().barrier()
 
 
+# goodput's exposed-comm feed: fn(op, seconds) set by monitor/goodput.py
+# while the ledger is armed (None = one global read + branch per host op).
+# The host-plane collectives already BLOCK the caller, so timing them here
+# adds no sync the call wasn't paying.
+goodput_comm_hook = None
+
+
 def _watched_host_op(op, fn):
     """Host-plane collectives (key-value-store gather/broadcast) BLOCK the
     calling thread until every process arrives — they are the ops a dead
@@ -493,15 +500,21 @@ def _watched_host_op(op, fn):
     health plane watches."""
     # chaos bracket: collective-delay/kill storms land on the host plane
     # here — these are the blocking ops a dead peer wedges first
-    chaos.fire("comm/host_collective", {"op": op})
-    watch = inflight_collectives
-    if not watch.enabled:
-        return fn()
-    token = watch.enter(op)
+    hook = goodput_comm_hook
+    t0 = time.perf_counter() if hook is not None else 0.0
     try:
-        return fn()
+        chaos.fire("comm/host_collective", {"op": op})
+        watch = inflight_collectives
+        if not watch.enabled:
+            return fn()
+        token = watch.enter(op)
+        try:
+            return fn()
+        finally:
+            watch.exit(token)
     finally:
-        watch.exit(token)
+        if hook is not None:
+            hook(op, time.perf_counter() - t0)
 
 
 def broadcast_object_list(object_list, src=0, group=None):

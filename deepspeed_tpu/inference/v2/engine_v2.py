@@ -9,6 +9,7 @@ donated through, so steady-state decode reuses a single compiled program and
 the only host→device traffic is the packed batch descriptor arrays.
 """
 
+import functools
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -17,16 +18,40 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...monitor.flight import get_flight_recorder
+from ...monitor.goodput import get_goodput
 from ...monitor.health import get_health
 from ...monitor.memory import get_memory, tree_device_bytes
 from ...monitor.metrics import get_metrics
-from ...monitor.trace import get_tracer, observe_latency
+from ...monitor.trace import (get_tracer, observe_latency, pop_compile_source,
+                              push_compile_source)
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
 from .model_implementations.flat_model import ragged_forward
 from .ragged.ragged_manager import DSStateManager
 from .ragged.ragged_wrapper import RaggedBatchWrapper, next_bucket
 from .scheduling_utils import SchedulingError, SchedulingResult
+
+
+def _serving_compile_scope(method):
+    """Label this thread's XLA compiles as ``serving`` for the duration of
+    a forward — the compile listener (monitor/trace.py) attributes each
+    compile event to the thread-local source, so a serving engine compiling
+    from a replica thread counts under ``serving/compile_events``, not
+    ``train/`` (the pre-goodput drift). Pushed only when something is
+    listening: one enabled check otherwise."""
+
+    @functools.wraps(method)
+    def wrapped(self, *args, **kwargs):
+        if not (self.goodput_ledger is not None or get_metrics().enabled
+                or get_tracer().enabled):
+            return method(self, *args, **kwargs)
+        prev = push_compile_source("serving")
+        try:
+            return method(self, *args, **kwargs)
+        finally:
+            pop_compile_source(prev)
+
+    return wrapped
 
 
 class InferenceEngineV2:
@@ -100,6 +125,16 @@ class InferenceEngineV2:
         draft = getattr(ic.speculative, "draft_engine", None)
         if draft is not None and hasattr(draft, "set_memory_role"):
             draft.set_memory_role("spec_draft_engine")
+        # goodput ledger + recompile sentinel (monitor/goodput.py): the
+        # owning replica (or a direct caller) attaches a serving ledger
+        # post-warmup via `goodput_ledger`; `_gp_warmed` is this engine's
+        # own warmup boundary — compiled-cache misses after it are flagged
+        # by the sentinel. All None/False by default: one attribute check
+        # per forward when the plane is off.
+        self.goodput_ledger = None
+        self._gp_warmed = False
+        self._gp_last_uids = None
+        self.gp_rid_resolver = None
         # live-health plane: serving heartbeats (`serving` watchdog source,
         # armed per forward) + a /healthz section. One boolean per call when
         # the plane is off.
@@ -260,18 +295,29 @@ class InferenceEngineV2:
         # is then a free no-op
         batch_uids = list(batch_uids)
         batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
-        if not hb.enabled:
+        gl = self.goodput_ledger
+        if gl is None and not hb.enabled:
             return self._put(batch_uids, batch_tokens, do_checks, sample, block, sampling)
-        # operation-style heartbeat: `serving` is watched exactly while a
-        # forward is in flight, so a wedged device call trips the watchdog
-        hb.begin("serving")
-        get_flight_recorder().record("serving", "put", seqs=len(batch_uids),
-                                     tokens=int(sum(t.size for t in batch_tokens)))
+        if gl is not None:
+            self._gp_last_uids = batch_uids
+            gp_cat = ("prefill_active" if any(t.size > 1 for t in batch_tokens)
+                      else "decode_active")
+            t_gp = time.perf_counter()
+        if hb.enabled:
+            # operation-style heartbeat: `serving` is watched exactly while a
+            # forward is in flight, so a wedged device call trips the watchdog
+            hb.begin("serving")
+            get_flight_recorder().record("serving", "put", seqs=len(batch_uids),
+                                         tokens=int(sum(t.size for t in batch_tokens)))
         try:
             return self._put(batch_uids, batch_tokens, do_checks, sample, block, sampling)
         finally:
-            hb.end("serving")
+            if hb.enabled:
+                hb.end("serving")
+            if gl is not None:
+                gl.book(gp_cat, time.perf_counter() - t_gp)
 
+    @_serving_compile_scope
     def _put(self, batch_uids, batch_tokens, do_checks, sample, block, sampling=None):
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
@@ -342,12 +388,13 @@ class InferenceEngineV2:
             # prefill (multi-token chunks) latency IS TTFT when block=True
             # (admission -> first token on host, the FastGen definition);
             # block=False measures only async dispatch, so no latency sample
-            kind = "prefill" if had_prefill else "decode_step"
-            hist = ("serving/ttft_ms" if kind == "prefill" else "serving/decode_step_ms") if block else None
+            hist = ("serving/ttft_ms" if had_prefill else "serving/decode_step_ms") if block else None
             # uids ride the span so a request-scoped trace can attribute
             # every engine forward to the requests composing it (capped:
-            # span args are JSONL payload, not a table)
-            observe_latency(t0, f"serving/{kind}", hist_name=hist,
+            # span args are JSONL payload, not a table); span name as a
+            # two-literal conditional so check_goodput_taxonomy can map both
+            observe_latency(t0, "serving/prefill" if had_prefill else "serving/decode_step",
+                            hist_name=hist,
                             span_args={"seqs": len(batch_uids),
                                        "tokens": int(sum(t.size for t in batch_tokens)),
                                        "uids": [int(u) for u in batch_uids[:16]],
@@ -383,18 +430,27 @@ class InferenceEngineV2:
         """
         batch_uids = list(batch_uids)
         hb = self._health
-        if not hb.enabled:
+        gl = self.goodput_ledger
+        if gl is None and not hb.enabled:
             return self._decode(batch_uids, first_tokens, n_steps, block, eos_token_ids,
                                 sampling)
-        hb.begin("serving")
-        get_flight_recorder().record("serving", "decode", seqs=len(batch_uids),
-                                     steps=int(n_steps))
+        if gl is not None:
+            self._gp_last_uids = batch_uids
+            t_gp = time.perf_counter()
+        if hb.enabled:
+            hb.begin("serving")
+            get_flight_recorder().record("serving", "decode", seqs=len(batch_uids),
+                                         steps=int(n_steps))
         try:
             return self._decode(batch_uids, first_tokens, n_steps, block, eos_token_ids,
                                 sampling)
         finally:
-            hb.end("serving")
+            if hb.enabled:
+                hb.end("serving")
+            if gl is not None:
+                gl.book("decode_active", time.perf_counter() - t_gp)
 
+    @_serving_compile_scope
     def _decode(self, batch_uids, first_tokens, n_steps, block, eos_token_ids=None,
                 sampling=None):
         observing = get_tracer().enabled or get_metrics().enabled
@@ -633,18 +689,27 @@ class InferenceEngineV2:
         refcount machinery."""
         batch_uids = list(batch_uids)
         hb = self._health
-        if not hb.enabled:
+        gl = self.goodput_ledger
+        if gl is None and not hb.enabled:
             return self._speculate(batch_uids, first_tokens, draft_tokens, k, eos_token_ids,
                                    sampling)
-        hb.begin("serving")
-        get_flight_recorder().record("serving", "speculate", seqs=len(batch_uids),
-                                     k=int(k) if k is not None else -1)
+        if gl is not None:
+            self._gp_last_uids = batch_uids
+            t_gp = time.perf_counter()
+        if hb.enabled:
+            hb.begin("serving")
+            get_flight_recorder().record("serving", "speculate", seqs=len(batch_uids),
+                                         k=int(k) if k is not None else -1)
         try:
             return self._speculate(batch_uids, first_tokens, draft_tokens, k, eos_token_ids,
                                    sampling)
         finally:
-            hb.end("serving")
+            if hb.enabled:
+                hb.end("serving")
+            if gl is not None:
+                gl.book("spec_verify", time.perf_counter() - t_gp)
 
+    @_serving_compile_scope
     def _speculate(self, batch_uids, first_tokens, draft_tokens, k, eos_token_ids=None,
                    sampling=None):
         from .sampling import all_greedy, pack_sampling
@@ -852,10 +917,42 @@ class InferenceEngineV2:
                                        "uids": [int(u) for u in uids[:16]]})
         return results
 
+    def _note_compile(self, bucket):
+        """Recompile-sentinel feed: a compiled-cache miss IS the moment XLA
+        compiles a new (bucket) program — report it with this engine's own
+        warmup-boundary verdict and the in-flight uids (joined to request
+        ids when the replica registered a resolver)."""
+        gp = get_goodput()
+        if not gp.enabled:
+            return
+        uids = list(self._gp_last_uids or [])[:8]
+        rids = None
+        res = self.gp_rid_resolver
+        if res is not None:
+            try:
+                rids = [res(u) for u in uids]
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                rids = None
+        gp.sentinel.note_compile("serving", bucket=bucket, warmed=self._gp_warmed,
+                                 uids=uids, rids=rids)
+
+    def declare_gp_warmed(self):
+        """Declare this engine's recompile-sentinel warmup boundary without
+        running :meth:`warmup` — for callers (bench, tests) that warmed the
+        compiled-program cache with real traffic instead of zero
+        descriptors. Every later compiled-cache miss is flagged."""
+        self._gp_warmed = True
+        gp = get_goodput()
+        if gp.enabled:
+            gp.sentinel.declare_warmed("serving")
+        return self
+
     def _get_compiled_verify(self, t_bucket: int, s_bucket: int, k: int,
                              tree: bool = False, sampled: bool = False):
         key = ("verify", t_bucket, s_bucket, k, bool(tree), bool(sampled))
         if key not in self._compiled:
+            self._note_compile(f"verify/t{t_bucket}/s{s_bucket}/k{k}"
+                               f"{'/tree' if tree else ''}{'/sampled' if sampled else ''}")
             step_fn = self._ragged_step
             mb = self._max_blocks_per_seq
 
@@ -900,6 +997,8 @@ class InferenceEngineV2:
     def _get_compiled_decode(self, s_bucket: int, n_steps: int, sampled: bool = False):
         key = ("decode", s_bucket, n_steps, bool(sampled))
         if key not in self._compiled:
+            self._note_compile(f"decode/s{s_bucket}/n{n_steps}"
+                               f"{'/sampled' if sampled else ''}")
             from .ragged.ragged_wrapper import unpack_descriptors
 
             max_blocks = self._max_blocks_per_seq
@@ -952,21 +1051,31 @@ class InferenceEngineV2:
                      f"sampled={sampled}", ranks=[0])
         return self._compiled[key]
 
-    def warmup(self, seq_buckets: Iterable[int], decode_steps) -> List[dict]:
-        """Pre-compile the lazy multi-step decode buckets at startup so the
-        first real request does not pay the XLA compile inside its TTFT.
+    @_serving_compile_scope
+    def warmup(self, seq_buckets: Iterable[int], decode_steps,
+               token_buckets: Iterable[int] = (), put_samples=("greedy", ),
+               declare_warmed: bool = True) -> List[dict]:
+        """Pre-compile the lazy shape buckets at startup so the first real
+        request does not pay the XLA compile inside its TTFT.
 
         ``seq_buckets``: sequence counts, each rounded UP to the wrapper's
         static bucket (the same rounding ``decode`` applies); ``decode_steps``:
-        one scan horizon or an iterable of them. Each distinct
-        (bucket, horizon) program is traced, compiled, and executed once on an
-        all-zero descriptor against the real (donated-through) KV pools, so
-        the jit executable cache holds exactly the signature real traffic
-        hits. The zero descriptor scribbles into pool block 0, which is
-        harmless before any sequence exists but NOT after — warmup therefore
-        refuses to run once sequences are tracked. Each compile is recorded
-        as a ``jax_compile`` event on the trace bus (``args.source``
-        = "warmup"). Returns ``[{"seqs", "steps", "seconds", "cached"}, ...]``.
+        one scan horizon or an iterable of them. ``token_buckets`` (optional):
+        prefill token counts — each (token-bucket x seq-bucket x sample mode
+        in ``put_samples``) ``put`` program is ALSO pre-compiled, closing the
+        warmup gap the recompile sentinel otherwise names on the first real
+        prefill. Each distinct program is traced, compiled, and executed once
+        on an all-zero descriptor against the real (donated-through) KV
+        pools, so the jit executable cache holds exactly the signature real
+        traffic hits. The zero descriptor scribbles into pool block 0, which
+        is harmless before any sequence exists but NOT after — warmup
+        therefore refuses to run once sequences are tracked. Each compile is
+        recorded as a ``jax_compile`` event on the trace bus (``args.source``
+        = "warmup"). Completion declares this engine's recompile-sentinel
+        warmup boundary: with the goodput plane armed, every LATER compile of
+        a new bucket is flagged as an unexpected steady-state recompile.
+        Returns ``[{"seqs", "steps", "seconds", "cached"}, ...]`` (prefill
+        entries carry ``"tokens"``/``"sample"`` instead of ``"steps"``).
         """
         if self.state_manager.n_tracked_sequences:
             raise RuntimeError("warmup() must run before serving traffic: its zero descriptor "
@@ -984,8 +1093,8 @@ class InferenceEngineV2:
         kv = self.state_manager.kv_cache
         max_blocks = self._max_blocks_per_seq
         results = []
-        for want in seq_buckets:
-            s_bucket = next_bucket(int(want), self.batch.seq_buckets)
+        s_buckets = [next_bucket(int(w), self.batch.seq_buckets) for w in seq_buckets]
+        for s_bucket in s_buckets:
             for n_steps in decode_steps:
                 n_steps = int(n_steps)
                 key = ("decode", s_bucket, n_steps, False)
@@ -1006,6 +1115,44 @@ class InferenceEngineV2:
                 log_dist(f"warmup compiled decode bucket seqs={s_bucket} steps={n_steps} "
                          f"in {dt:.2f}s", ranks=[0])
                 results.append({"seqs": s_bucket, "steps": n_steps, "seconds": dt, "cached": False})
+        for sample in put_samples:
+            if sample not in (None, "greedy"):
+                # the 'sample' variant takes extra per-request sampling
+                # operands this zero-descriptor path does not build
+                raise ValueError(f"warmup(put_samples=...) supports None/'greedy', got {sample!r}")
+        for want_t in token_buckets or ():
+            t_bucket = next_bucket(int(want_t), self.batch.token_buckets)
+            for s_bucket in s_buckets:
+                if s_bucket > t_bucket:
+                    continue  # a prefill batch never has more rows than tokens
+                for sample in put_samples:
+                    key = (t_bucket, s_bucket, sample)
+                    if key in self._compiled:
+                        results.append({"seqs": s_bucket, "tokens": t_bucket,
+                                        "sample": sample, "seconds": 0.0, "cached": True})
+                        continue
+                    fn = self._get_compiled(t_bucket, s_bucket, sample)
+                    # put-path packed layout: [T ids][T idx][T pos][T valid]
+                    # [S*max_blocks][S last]
+                    packed = jnp.zeros(4 * t_bucket + s_bucket * (max_blocks + 1), jnp.int32)
+                    t0 = time.perf_counter()
+                    out, pools = fn(self.params, packed, kv.pools())
+                    jax.block_until_ready(out)
+                    kv.update(*pools)
+                    dt = time.perf_counter() - t0
+                    tracer.complete("jax_compile", t0, dt, tid="compile",
+                                    args={"source": "warmup", "tokens": t_bucket,
+                                          "seqs": s_bucket, "sample": sample})
+                    log_dist(f"warmup compiled prefill bucket tokens={t_bucket} "
+                             f"seqs={s_bucket} sample={sample} in {dt:.2f}s", ranks=[0])
+                    results.append({"seqs": s_bucket, "tokens": t_bucket,
+                                    "sample": sample, "seconds": dt, "cached": False})
+        # warmup boundary declared at COMPLETION: later bucket compiles on
+        # this engine are steady-state recompiles the sentinel flags. A
+        # caller warming in several calls (the replica's per-entry loop)
+        # passes declare_warmed=False and declares once after the last.
+        if declare_warmed:
+            self.declare_gp_warmed()
         return results
 
     # ------------------------------------------------------------------
@@ -1146,6 +1293,7 @@ class InferenceEngineV2:
     def _get_compiled(self, t_bucket: int, s_bucket: int, sample: Optional[str] = None):
         key = (t_bucket, s_bucket, sample)
         if key not in self._compiled:
+            self._note_compile(f"put/t{t_bucket}/s{s_bucket}/{sample or 'logits'}")
             if sample not in (None, "greedy", "sample"):
                 raise ValueError(f"unsupported sample mode {sample!r}: None | 'greedy' | 'sample'")
             step_fn = self._ragged_step

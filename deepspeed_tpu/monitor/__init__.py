@@ -14,3 +14,6 @@ from .metrics import (  # noqa: F401
 from .flight import get_flight_recorder, FlightRecorder  # noqa: F401
 from .health import get_health, configure_health, HealthPlane  # noqa: F401
 from .memory import get_memory, hbm_report, tree_device_bytes, MemoryAttribution  # noqa: F401
+from .goodput import (  # noqa: F401
+    get_goodput, configure_goodput, conservation_ok, GoodputLedger, GoodputPlane,
+    RecompileSentinel, TRAIN_CATEGORIES, SERVING_CATEGORIES)

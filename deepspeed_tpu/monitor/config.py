@@ -11,11 +11,11 @@ from ..runtime.config_utils import DeepSpeedConfigModel
 def get_monitor_config(param_dict):
     monitor_dict = {key: param_dict.get(key, {})
                     for key in ("tensorboard", "wandb", "csv_monitor", "comet", "trace",
-                                "health")}
+                                "health", "goodput")}
     # presence-enables: an EMPTY {"trace": {}} / {"health": {}} block in the
     # config means "on with defaults" (the validator can only see set
     # fields, not presence)
-    for key in ("trace", "health"):
+    for key in ("trace", "health", "goodput"):
         if key in param_dict and not monitor_dict[key]:
             monitor_dict[key] = {"enabled": True}
     return DeepSpeedMonitorConfig(**monitor_dict)
@@ -112,6 +112,32 @@ class HealthConfig(DeepSpeedConfigModel):
         return self
 
 
+class GoodputConfig(DeepSpeedConfigModel):
+    """``monitor.goodput`` block — the wall-clock attribution ledger +
+    recompile sentinel (``monitor/goodput.py``). Enabled by presence (same
+    contract as ``trace``/``health``); off by default — the step loop and
+    the serving driver then pay one ``is not None`` check each, with no
+    ledger objects, no threads, no per-step allocations."""
+    enabled: bool = False
+    # training warmup boundary: jax compiles during the first N steps are
+    # expected; every compile after is flagged by the sentinel
+    train_warmup_steps: int = Field(2, ge=0)
+    # a step/driver-loop gap at least this long books as stall[ed] (the
+    # same wedges the PR 5 watchdog dumps; shorter gaps stay in the
+    # compute residual / unattributed)
+    stall_gap_s: float = Field(0.05, gt=0)
+    # compile-storm detection: K unexpected compiles inside the window
+    # raise a `compile_storm` trace instant + counter (once per burst)
+    storm_k: int = Field(5, ge=2)
+    storm_window_s: float = Field(10.0, gt=0)
+
+    @model_validator(mode="after")
+    def enable_when_configured(self):
+        if self.model_fields_set and "enabled" not in self.model_fields_set:
+            self.enabled = True
+        return self
+
+
 class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
     tensorboard: TensorBoardConfig = {}
     wandb: WandbConfig = {}
@@ -119,6 +145,7 @@ class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
     comet: CometConfig = {}
     trace: TraceConfig = {}
     health: HealthConfig = {}
+    goodput: GoodputConfig = {}
 
     @property
     def enabled(self):

@@ -304,11 +304,57 @@ def configure_tracer(config=None, **kwargs) -> Tracer:
 
 _COMPILE_LISTENER = {"installed": False}
 
+# compile-source routing: XLA compiles happen synchronously on the thread
+# that triggered them, so a THREAD-LOCAL source label attributes each
+# compile event to the engine that compiled — a serving replica thread's
+# bucket compile must not count under train/ (the pre-PR-14 drift). The
+# default (no scope pushed) stays "train", the historical behavior.
+_COMPILE_SOURCES = ("train", "serving")
+_compile_tls = threading.local()
+
+# subscribers: fn(source, event_name, duration_s) per compile event — the
+# goodput plane books training compile seconds through this. Zero overhead
+# while empty (one truthiness check per event).
+_compile_subscribers = []
+
+
+def push_compile_source(source):
+    """Set this thread's compile-source label; returns the previous value
+    for :func:`pop_compile_source` (nestable)."""
+    if source not in _COMPILE_SOURCES:
+        source = "train"
+    prev = getattr(_compile_tls, "source", None)
+    _compile_tls.source = source
+    return prev
+
+
+def pop_compile_source(prev):
+    _compile_tls.source = prev
+
+
+def current_compile_source():
+    return getattr(_compile_tls, "source", None) or "train"
+
+
+def add_compile_listener(fn):
+    """Subscribe ``fn(source, event_name, duration_s)`` to compile events."""
+    if fn not in _compile_subscribers:
+        _compile_subscribers.append(fn)
+    _install_compile_listener()
+
+
+def remove_compile_listener(fn):
+    try:
+        _compile_subscribers.remove(fn)
+    except ValueError:
+        pass
+
 
 def _install_compile_listener():
     """Capture XLA compile/lower durations as ``jax_compile`` trace events and
-    ``train/compile_*`` metrics. Installed once, fires only while tracing/metrics
-    are enabled (one attribute check per event otherwise)."""
+    ``<source>/compile_*`` metrics. Installed once, fires only while tracing/
+    metrics are enabled or a subscriber is registered (one attribute check
+    per event otherwise)."""
     if _COMPILE_LISTENER["installed"]:
         return
     try:
@@ -317,19 +363,32 @@ def _install_compile_listener():
         def _on_event_duration(event, duration, **kwargs):
             if "compile" not in event and "lower" not in event:
                 return
+            source = current_compile_source()
             tr = _tracer
             if tr.enabled:
                 now = time.perf_counter()
                 tr.complete("jax_compile", now - duration, duration, tid="compile",
-                            args={"source": event})
+                            args={"source": event, "engine": source})
             from .metrics import get_metrics
 
             reg = get_metrics()
             if reg.enabled:
-                # train/ namespace per tools/check_metric_names.py (the old
-                # compile/* names predated the approved prefix set)
-                reg.counter("train/compile_events").inc()
-                reg.counter("train/compile_seconds").inc(duration)
+                # <source>/ namespace per tools/check_metric_names.py (the
+                # old compile/* names predated the approved prefix set; the
+                # old always-train/ attribution predated serving engines
+                # compiling from replica threads). Names assembled outside
+                # the registration call: this module is gate-allowlisted
+                # for dynamic names it validates itself (_COMPILE_SOURCES).
+                ev_name = source + "/compile_events"
+                sec_name = source + "/compile_seconds"
+                reg.counter(ev_name).inc()
+                reg.counter(sec_name).inc(duration)
+            if _compile_subscribers:
+                for fn in list(_compile_subscribers):
+                    try:
+                        fn(source, event, duration)
+                    except Exception:  # noqa: BLE001 — telemetry never raises
+                        pass
 
         jmon.register_event_duration_secs_listener(_on_event_duration)
         _COMPILE_LISTENER["installed"] = True

@@ -42,6 +42,7 @@ from ..monitor.monitor import MonitorMaster
 from ..monitor.trace import configure_tracer, get_tracer
 from ..monitor.metrics import get_metrics, compute_mfu
 from ..monitor.health import get_health
+from ..monitor.goodput import configure_goodput, get_goodput
 from ..parallel import groups
 from ..parallel.mesh import (BATCH_AXES, DATA_AXIS, DATA_REPL_AXIS, SEQ_AXIS, MeshConfig, build_mesh,
                              shard_map_compat)
@@ -448,6 +449,18 @@ class DeepSpeedEngine:
             # deadline_train_step_s — a slow first compile past the deadline
             # costs one latched dump, not a kill
             self._health.beat("engine")
+        # goodput ledger (monitor/goodput.py): wall-clock attribution +
+        # recompile sentinel. The plane is process-global (the training
+        # ledger spans resilient restarts); this engine attaches when the
+        # config block arms it OR the plane was armed externally (chaos
+        # drill, bench). Absent: one `is not None` check per step.
+        self._goodput = None
+        self._gp_warm_declared = False
+        if config.monitor_config.goodput.enabled:
+            configure_goodput(config=config.monitor_config.goodput)
+        _gp = get_goodput()
+        if _gp.enabled:
+            self._goodput = _gp.training
         if config.flops_profiler_config.enabled:
             from ..profiling.flops_profiler import FlopsProfiler
 
@@ -1292,7 +1305,13 @@ class DeepSpeedEngine:
         """
         gas = self.config.gradient_accumulation_steps
         health_on = self._health.enabled
-        wait_obs = self._tracer.enabled or self._metrics.enabled or health_on
+        gl = self._goodput
+        if gl is not None:
+            # books the gap since the last boundary as idle (or recovery,
+            # when the resilience runner flagged a restart in flight)
+            gl.step_entry()
+        wait_obs = self._tracer.enabled or self._metrics.enabled or health_on \
+            or gl is not None
         t_in = time.perf_counter() if wait_obs else 0.0
         prefetched = isinstance(batch, DeviceBatch)
         if batch is None:
@@ -1346,6 +1365,14 @@ class DeepSpeedEngine:
         else:
             if "train_step" not in self._compiled:
                 self._last_batch_struct = jax.tree_util.tree_map(lambda x: np.ndim(x), placed)
+                if gl is not None:
+                    # a fused-step (re)build after the warmup boundary is
+                    # EXACTLY the silent steady-state recompile the
+                    # sentinel exists to flag (shape drift, remesh, a
+                    # curriculum bucket never seen in warmup)
+                    get_goodput().sentinel.note_compile(
+                        "train", bucket="train_step", warmed=self._gp_warm_declared,
+                        step=self.global_steps)
                 self._compiled["train_step"] = self._build_train_step(gas)
             with self.mesh:
                 self.state, metrics = self._compiled["train_step"](self.state, placed, step_rng)
@@ -1368,11 +1395,24 @@ class DeepSpeedEngine:
         # chaos injection point: a storm's kill/stall/straggle/preempt land
         # HERE, at the step boundary — the one place the engine's state is
         # consistent enough to restart from (no-op-when-unhooked fire())
+        t_fire = time.perf_counter() if gl is not None else 0.0
         chaos.fire("engine/step", {"engine": self, "step": self.global_steps})
+        if gl is not None:
+            gap = time.perf_counter() - t_fire
+            if gap >= get_goodput().stall_gap_s:
+                # a fire hook slept/wedged the step thread: the same gap
+                # the watchdog trips on, booked as stall (a sub-threshold
+                # gap stays in the compute residual)
+                gl.book("stall", gap)
         if self._resilience_active:
             self._poll_resilience()
         if health_on:
             self._health.step_boundary(self.global_steps)
+        if gl is not None:
+            gl.step_boundary(dt_in)
+            if not self._gp_warm_declared and self.global_steps >= get_goodput().train_warmup_steps:
+                self._gp_warm_declared = True
+                get_goodput().sentinel.declare_warmed("train")
         return metrics["loss"]
 
     def aot_lower_train_step(self, seq_len: int):
@@ -1921,6 +1961,11 @@ class DeepSpeedEngine:
         if self._metrics.enabled:
             self._metrics.histogram("train/ckpt_blocked_ms").observe(
                 (time.perf_counter() - t0) * 1e3)
+        if self._goodput is not None:
+            # the step-loop seconds this save blocked (host snapshot under
+            # async, the whole write under sync) — same window the
+            # histogram above measures
+            self._goodput.book("ckpt_blocked", time.perf_counter() - t0)
         if ok and self.config.checkpoint_config.remesh_snapshot:
             # elastic warm remesh: publish a host universal-layout snapshot
             # alongside the save, so a topology-change restart re-shards

@@ -114,6 +114,15 @@ def run_resilient(train_fn: Callable, ds_config: dict, save_dir: Optional[str] =
         t0 = time.perf_counter()
         try:
             return train_fn(batch_config, resume)
+        except BaseException:
+            # goodput: recovery badput starts at the failure/preemption
+            # boundary and ends at the restarted engine's first step entry
+            # (the ledger books the interval there); a disarmed plane makes
+            # this one enabled check
+            from ...monitor.goodput import get_goodput
+
+            get_goodput().note_training_failure()
+            raise
         finally:
             # recovery-time accounting for the chaos drill / bench: how long
             # each restarted attempt ran (the drill derives time-to-recover

@@ -107,6 +107,11 @@ class GatewayConfig:
     # (seq_bucket, decode_steps) pairs pre-compiled per replica at start()
     # via engine.warmup; empty = no warmup
     warmup: Tuple = ()
+    # prefill token buckets ALSO pre-compiled (against the warmup seq
+    # buckets) so the recompile sentinel's warmup boundary covers the put
+    # path — without these, the first real request per (token, seq) bucket
+    # compiles post-boundary and is flagged as a steady-state recompile
+    warmup_token_buckets: Tuple = ()
     # request-scoped tracing + per-request summary log; off by default
     tracing: RequestTraceConfig = field(default_factory=RequestTraceConfig)
 

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..monitor.flight import get_flight_recorder
+from ..monitor.goodput import get_goodput
 from ..monitor.health import get_health
 from ..monitor.metrics import get_metrics
 from ..inference.v2 import DynamicSplitFuseScheduler
@@ -182,6 +183,10 @@ class EngineReplica:
         self.started = False
         self.warmed = False
         self.steps = 0
+        # goodput ledger (attached post-warmup in start(); None = one check
+        # per loop iteration, the PR 5 zero-overhead contract)
+        self._goodput = None
+        self._gp_death_t = None
 
     # -- public surface the gateway/router/tests drive ---------------------
     @property
@@ -269,10 +274,44 @@ class EngineReplica:
     def start(self):
         if self.started:
             return self
+        seq_warmed = []
         if self.config.warmup:
             for bucket, steps in self.config.warmup:
-                self.engine.warmup([int(bucket)], int(steps))
+                # boundary declared once after the WHOLE sequence — a
+                # per-call declaration would flag entries 2..N's own
+                # warmup compiles as steady-state recompiles
+                self.engine.warmup([int(bucket)], int(steps),
+                                   declare_warmed=False)
+                seq_warmed.append(int(bucket))
+        if self.config.warmup_token_buckets:
+            # prefill put buckets — also honored WITHOUT decode warmup
+            # entries (falls back to the smallest engine seq bucket). The
+            # sentinel boundary below makes any bucket missed here a
+            # flagged steady-state recompile.
+            self.engine.warmup(seq_warmed or [1], [],
+                               token_buckets=self.config.warmup_token_buckets,
+                               declare_warmed=False)
+        if self.config.warmup or self.config.warmup_token_buckets:
+            self.engine.declare_gp_warmed()
         self.warmed = True
+        gp = get_goodput()
+        if gp.enabled and self._goodput is None:
+            # ledger wall-clock origin is HERE, after warmup: the serving
+            # taxonomy has no compile bucket — warmed-engine serving time is
+            # what the ledger attributes (warmup compiles ride the trace bus
+            # + sentinel's expected count instead)
+            self._goodput = gp.serving_ledger(self.name)
+            self.engine.goodput_ledger = self._goodput
+        elif self._goodput is not None:
+            # stop() -> start() on the same replica: the frozen interval was
+            # a deliberate drain, not a failure — book it as draining and
+            # un-freeze (no-op if the clock is already running)
+            self._goodput.resume("draining")
+        if self._goodput is not None:
+            # (re-)register the uid -> request-id join; stop() clears it so
+            # a dead replica never pins itself on the process-global plane
+            self.engine.gp_rid_resolver = self._rid_of
+            gp.sentinel.set_uid_resolver(self.name, self._rid_of)
         self._stop.clear()
         self._thread = threading.Thread(target=self._run,
                                         name=f"dstpu-serving-{self.name}", daemon=True)
@@ -287,6 +326,18 @@ class EngineReplica:
             self._thread.join(timeout=timeout)
         self.started = False
         self._fail_active("replica_stopped")
+        if self._goodput is not None:
+            self._goodput.stop()  # freeze wall clock: reports stay stable
+            # drop the sentinel's strong reference to this replica (the
+            # plane is process-global; a stopped replica must be
+            # collectable). restart()/start() re-register.
+            get_goodput().sentinel.set_uid_resolver(self.name, None)
+
+    def _rid_of(self, uid):
+        """uid -> request id for the sentinel's compile-tail attribution
+        (None once the request left this replica)."""
+        req = self._streams.get(int(uid))
+        return req.rid if req is not None else None
 
     def restart(self):
         """Bring a dead replica back into rotation (chaos drill / operator
@@ -298,6 +349,18 @@ class EngineReplica:
         if self._thread is not None and self._thread.is_alive():
             return self
         self._fail_active("replica_stopped")  # belt-and-braces: crash paths
+        gl = self._goodput
+        if gl is not None:
+            # down-time books as `recovering`: crash (death stamp) -> now,
+            # CLAMPED to any stop() freeze — resume() books the frozen
+            # interval itself, so booking past the freeze would double-count
+            if self._gp_death_t is not None:
+                end = gl.stopped_at if gl.stopped_at is not None \
+                    else time.perf_counter()
+                gl.book("recovering", end - self._gp_death_t)
+                self._gp_death_t = None
+            gl.resume("recovering")
+            get_goodput().sentinel.set_uid_resolver(self.name, self._rid_of)
         self._stop.clear()
         self._wake.clear()
         self._thread = threading.Thread(target=self._run,
@@ -311,11 +374,21 @@ class EngineReplica:
     def _run(self):
         hb = get_health()
         src = self.heartbeat_source
+        gl = self._goodput
+        stall_gap = get_goodput().stall_gap_s
         try:
             while not self._stop.is_set():
                 # chaos injection point: a storm's replica kill lands here,
                 # between scheduler steps (no-op-when-unhooked fire())
+                t_fire = time.perf_counter() if gl is not None else 0.0
                 chaos.fire("serving/driver", {"replica": self.name})
+                if gl is not None:
+                    gap = time.perf_counter() - t_fire
+                    if gap >= stall_gap:
+                        # a fire hook wedged the driver — the same gap the
+                        # serving watchdog trips on. Booked as `stalled`,
+                        # NOT idle: the replica had (or was denied) work.
+                        gl.book("stalled", gap)
                 busy = False
                 self._process_cancellations()
                 if not self.paused:
@@ -330,8 +403,12 @@ class EngineReplica:
                 if not busy:
                     if hb.enabled:
                         hb.disarm(src)
+                    t_wait = time.perf_counter() if gl is not None else 0.0
                     self._wake.wait(self.IDLE_WAIT_S)
                     self._wake.clear()
+                    if gl is not None:
+                        gl.book("draining" if self.paused else "idle",
+                                time.perf_counter() - t_wait)
         except BaseException:  # noqa: BLE001 — driver death is a replica
             # failure, distinct from shed in the metrics: the counter is what
             # lets an operator tell "queue full" from "replica died" on a
@@ -341,6 +418,9 @@ class EngineReplica:
             get_metrics().counter("gateway/replica_failures_total").inc()
             get_flight_recorder().record("serving", "replica_driver_death",
                                          replica=self.name)
+            if gl is not None:
+                # recovery clock starts at the death site; restart() books it
+                self._gp_death_t = time.perf_counter()
             self._fail_active("replica_stopped")
             raise
         finally:

@@ -30,6 +30,16 @@ def _model():
                                            attention_impl="reference"))
 
 
+@pytest.fixture(autouse=True)
+def _disarm_goodput():
+    """The drills arm the process-global goodput plane (their verdicts need
+    it); leave it disarmed so other test files never pay the booking path."""
+    yield
+    from deepspeed_tpu.monitor.goodput import get_goodput
+
+    get_goodput().shutdown()
+
+
 def _config(**ckpt):
     return {
         "train_batch_size": 8,
@@ -509,6 +519,11 @@ def test_training_drill_smoke(tmp_path):
     assert out["events"].get("stall", 0) >= 1
     assert out["restarts"] >= 1
     assert out["warm_resumes"] >= 1  # at least one restart skipped disk
+    # PR 14 goodput verdicts: the stormed run's ledger conserves wall clock
+    # (warm restarts included) and recovery badput is a measured number
+    assert out["goodput_conserved"], out["goodput"]
+    assert out["recovery_badput_measured"] and out["recovery_badput_s"] > 0
+    assert out["goodput"]["categories"]["stall"] > 0  # injected stalls booked
 
 
 @pytest.mark.slow
@@ -537,6 +552,16 @@ def test_serving_drill_smoke():
     assert out["replica_failure_counted"], out
     assert out["readyz_flipped"], out
     assert out["recovered"], out
+    # PR 14 (ROADMAP 5(b) leftover): the stall/straggle storm with serving
+    # heartbeat deadlines armed — the watchdog trips on the super-deadline
+    # stall and the ledger books the wedged interval as stalled, not idle
+    st = out["stall_storm"]
+    assert st["events"].get("stall", 0) >= 1, st
+    assert st["watchdog_tripped"] and st["stall_dumps"] >= 1, st
+    assert st["stalled_not_idle"] and st["stalled_s_booked"] > 0, st
+    # every replica ledger conserves; the kill's down-time was measured
+    assert out["goodput_conserved"], out["goodput"]
+    assert out["recovery_badput_measured"], out
 
 
 # ----------------------------------------------------------------------

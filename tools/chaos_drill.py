@@ -141,6 +141,17 @@ def training_drill(seed=0, steps=8, workdir=None, storm=None, deadline_s=0.5,
     # -- stormed run --------------------------------------------------------
     remesh.clear_snapshots()
     configure_metrics(enabled=True)
+    # goodput ledger: armed over the stormed run so recovery badput is a
+    # MEASURED verdict (restart wall booked as `recovery`, stall sleeps as
+    # `stall`, the whole run conserving wall clock), not a log line
+    from deepspeed_tpu.monitor.goodput import (configure_goodput, conservation_ok,
+                                               get_goodput)
+
+    gp_recovery_before = 0.0
+    gp_train = get_goodput().training if get_goodput().enabled else None
+    if gp_train is not None:  # plane shared with a caller (bench): delta it
+        gp_recovery_before = gp_train.report()["categories"]["recovery"]
+    configure_goodput(enabled=True)
     health = configure_health(enabled=True, deadlines={"engine": deadline_s},
                               watchdog_poll_s=0.03, dump_dir=dump_dir,
                               dump_on_destroy=False)
@@ -234,6 +245,21 @@ def training_drill(seed=0, steps=8, workdir=None, storm=None, deadline_s=0.5,
             dumps_named += 1
     stall_dumps_match = (len(dumps) == n_stalls == dumps_named)
 
+    # goodput verdicts: the ledger spans the stormed run's restarts — the
+    # kills/preempts above must show up as measured recovery seconds and
+    # the category sum must still match wall clock
+    gp_rep = None
+    gp_conserved = None
+    gp_recovery_s = None
+    led = get_goodput().training
+    if led is not None:
+        gp_rep = led.report()
+        # the bound makes silent hook-loss a failure: a stormed training
+        # run's wall is step-loop time, almost all of it attributable
+        gp_conserved = conservation_ok(gp_rep, max_unattributed_frac=0.25)
+        gp_recovery_s = round(
+            gp_rep["categories"]["recovery"] - gp_recovery_before, 3)
+
     counts = storm.counts()
     rec = state["recovery_ms"]
     return {
@@ -251,6 +277,13 @@ def training_drill(seed=0, steps=8, workdir=None, storm=None, deadline_s=0.5,
         "warm_resumes": state["warm_resumes"],
         "resumes": state["resumes"],
         "recovery_ms_p50": (round(float(np.percentile(rec, 50)), 1) if rec else None),
+        "goodput": gp_rep,
+        "goodput_conserved": gp_conserved,
+        # recovery badput as a verdict: restarts happened => the ledger
+        # measured recovery seconds for them
+        "recovery_badput_s": gp_recovery_s,
+        "recovery_badput_measured": (state["restarts"] == 0
+                                     or (gp_recovery_s or 0.0) > 0),
         "workdir": workdir,
     }
 
@@ -259,19 +292,37 @@ def training_drill(seed=0, steps=8, workdir=None, storm=None, deadline_s=0.5,
 # serving arm
 # ---------------------------------------------------------------------------
 def serving_drill(seed=0, n_requests=24, n_replicas=2, kill_after_fires=20,
-                  concurrency=4, rate_rps=None, timeout_s=60.0):
+                  concurrency=4, rate_rps=None, timeout_s=60.0,
+                  stall_deadline_s=0.25, dump_dir=None):
     """Run the serving chaos drill; returns the verdicts dict. A chaos kill
     takes one replica driver down under closed-loop blocking HTTP load; the
-    drill restarts it once it is observed dead, then runs a drain/undrain
-    cycle against ``/readyz``."""
+    drill restarts it once it is observed dead, runs a drain/undrain cycle
+    against ``/readyz``, then a stall/straggle storm on the driver loop with
+    the serving heartbeat deadline armed — the watchdog must trip on the
+    super-deadline stall (and only on it) and the goodput ledger must book
+    the wedged interval as ``stalled``, not ``idle``. Recovery badput is a
+    measured verdict: the restarted replica's ledger books its down-time as
+    ``recovering`` and every replica ledger must conserve wall clock."""
+    import tempfile
     import urllib.request
     import urllib.error
 
+    from deepspeed_tpu.monitor.goodput import (configure_goodput, conservation_ok,
+                                               get_goodput)
+    from deepspeed_tpu.monitor.health import configure_health, get_health
     from deepspeed_tpu.monitor.metrics import configure_metrics, get_metrics
     from deepspeed_tpu.runtime.resilience.chaos import ChaosSchedule, ChaosSpec
     from tools.serving_load import build_gateway, make_workload, run_http_load
 
     configure_metrics(enabled=True)
+    # goodput BEFORE the gateway: replicas attach their serving ledgers at
+    # start(). The serving heartbeat DEADLINE is armed later, only under
+    # the stall storm — armed during warmup it would trip on every
+    # multi-second first compile inside a forward (CPU), marking healthy
+    # replicas dead before the drill begins
+    configure_goodput(enabled=True)
+    dump_dir = dump_dir or tempfile.mkdtemp(prefix="chaos_serving_dumps_")
+    health = None
     reg = get_metrics()
     fail_c = reg.counter("gateway/replica_failures_total")
     base_failures = fail_c.value
@@ -388,9 +439,87 @@ def serving_drill(seed=0, n_requests=24, n_replicas=2, kill_after_fires=20,
                                             timeout_s=timeout_s)
         result["recovered_completions"] = tail_agg["completed"]
         result["recovered"] = tail_agg["completed"] == len(tail_recs)
+
+        # --- stall/straggle storm (ROADMAP 5(b) leftover): the driver loop
+        # wedges under load with the serving deadline armed. The super-
+        # deadline stall must trip the watchdog (one forensic dump naming
+        # the serving source) and the ledger must book the wedged interval
+        # as `stalled` — a sub-deadline straggle only skews latency ---
+        stall_s = max(0.6, 3 * stall_deadline_s)
+        straggle_s = 0.3 * stall_deadline_s
+        gp = get_goodput()
+
+        def booked(cat):
+            return {r.name: (r._goodput.report()["categories"][cat]
+                             if r._goodput is not None else 0.0)
+                    for r in gw.replicas}
+
+        stalled_before = booked("stalled")
+        # serving deadline armed ONLY under this storm (every bucket is warm
+        # by now, so the only super-deadline wedge left is the injected one)
+        health = configure_health(enabled=True,
+                                  deadlines={"serving": stall_deadline_s},
+                                  watchdog_poll_s=0.03, dump_dir=dump_dir,
+                                  dump_on_destroy=False)
+        stalls_before = health.stall_count
+        stall_storm = ChaosSchedule(seed + 10, [
+            ChaosSpec("stall", "serving/driver", rate=1.0, duration_s=stall_s,
+                      start_after=2, max_events=1),
+            ChaosSpec("straggle", "serving/driver", rate=0.5, duration_s=straggle_s,
+                      start_after=2, max_events=3),
+        ])
+        wl_stall = make_workload(max(8, n_requests // 2), prompt_lo=8, prompt_hi=16,
+                                 new_lo=3, new_hi=6, rate_rps=None, seed=seed + 3,
+                                 uid_base=20_000)
+        with stall_storm:
+            stall_agg, _ = run_http_load(gw.config.host, gw.port, wl_stall,
+                                         concurrency=concurrency, stream=False,
+                                         timeout_s=timeout_s)
+        time.sleep(2 * 0.03)  # let an in-flight watchdog pass observe
+        d_stalled = sum(booked("stalled").values()) - sum(stalled_before.values())
+        n_stall_dumps = sum(1 for f in os.listdir(dump_dir)
+                            if f.startswith("health_stall_") and "serving" in f)
+        n_stalls = stall_storm.counts().get("stall", 0)
+        result["stall_storm"] = {
+            "events": stall_storm.counts(),
+            "completed_under_storm": stall_agg["completed"],
+            # the watchdog saw the wedge: one trip per injected stall, each
+            # with a forensic dump naming the serving source
+            "watchdog_tripped": health.stall_count - stalls_before >= n_stalls > 0,
+            "stall_dumps": n_stall_dumps,
+            # the ledger's verdict: the wedged seconds are STALLED (within
+            # the fire-gap bracket, so >= the injected sleep), never idle
+            "stalled_s_booked": round(d_stalled, 3),
+            "stalled_not_idle": d_stalled >= 0.8 * stall_s,
+        }
+
+        # --- goodput verdicts: recovery badput is measured, and every
+        # replica ledger conserves wall clock ---
+        reps = {r.name: r._goodput.report() for r in gw.replicas
+                if r._goodput is not None}
+        # the unattributed bound makes silent hook-loss a failure: a
+        # replica's wall is driver-loop time (active/idle/stalled), almost
+        # all of it attributable
+        result["goodput"] = {
+            name: {"wall_s": rep["wall_s"], "categories": rep["categories"],
+                   "unattributed_s": rep["unattributed_s"],
+                   "conserved": conservation_ok(rep, max_unattributed_frac=0.25)}
+            for name, rep in reps.items()}
+        result["goodput_conserved"] = bool(reps) and all(
+            conservation_ok(rep, max_unattributed_frac=0.25)
+            for rep in reps.values())
+        # the killed replica's down-time was booked as recovering — a
+        # measured number, not a log line
+        result["recovery_badput_s"] = round(sum(
+            rep["categories"]["recovering"] for rep in reps.values()), 3)
+        result["recovery_badput_measured"] = (not result["kill_observed"]
+                                              or result["recovery_badput_s"] > 0)
+        result["unexpected_compiles"] = gp.sentinel.unexpected("serving")
     finally:
         storm.uninstall()
         gw.stop()
+        if health is not None:
+            health.shutdown()
     return result
 
 

@@ -1,0 +1,194 @@
+"""Static check: every tracer span/instant name the engine/serving/
+resilience code emits maps to exactly one goodput-ledger category, or sits
+on an explicit allowlist.
+
+Companion to ``check_timed_ops.py`` / ``check_metric_names.py`` (same
+lesson: structural invariants rot silently unless CI asserts them). The
+goodput ledger (``monitor/goodput.py``) promises that its categories sum to
+wall clock — a promise a future PR can silently break by adding a
+time-consuming span the ledger never books (the seconds would drift into
+``unattributed`` with no reviewer ever deciding that). The rule:
+
+  * every literal span/instant name passed to ``.span(...)``,
+    ``.complete(...)``, ``.instant(...)`` or ``observe_latency(t0, name)``
+    in the scanned trees must be a key of ``goodput.SPAN_TO_CATEGORY``
+    (mapped to exactly one ledger category) or a member of
+    ``goodput.SPAN_ALLOWLIST`` (with its reason documented there);
+  * a conditional of two literals (``"a" if c else "b"``) checks both
+    branches; any other dynamic name is a violation — name the span where
+    this gate can see it;
+  * the mapping's values must themselves be valid ledger categories, and a
+    name must not sit in BOTH tables ("exactly one").
+
+The contract tables are read from ``monitor/goodput.py`` by AST (no package
+import, so the gate runs anywhere). A tier-1 test
+(``tests/test_goodput.py``) runs this on every CI pass.
+"""
+
+import ast
+import os
+import sys
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+DEFAULT_PKG_DIR = os.path.join(_REPO, "deepspeed_tpu")
+
+# the "engine/serving/resilience code" trees whose spans the ledger must
+# classify (monitor/ itself is the plumbing that emits on behalf of callers)
+SCAN_PATHS = (
+    os.path.join("runtime", "engine.py"),
+    os.path.join("runtime", "resilience"),
+    "elasticity",
+    "inference",
+    "serving",
+)
+
+GOODPUT_MODULE = os.path.join("monitor", "goodput.py")
+
+EMIT_ATTRS = ("span", "complete", "instant")
+
+# forwarding emitters: helpers that take the span name as their first
+# argument and pass it (verbatim) to a tracer call. Their CALL SITES are
+# checked for literal names; inside their own def, the forwarded parameter
+# is exempt from the dynamic-name rule (the literals were already checked
+# where they entered).
+FORWARD_EMITTERS = ("_emit_phase",)
+
+
+def load_contract(pkg_dir=DEFAULT_PKG_DIR):
+    """(mapping, allowlist, categories) literal-evaluated from
+    ``monitor/goodput.py``'s module-level assignments — no import."""
+    path = os.path.join(pkg_dir, GOODPUT_MODULE)
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    found = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name in ("SPAN_TO_CATEGORY", "SPAN_ALLOWLIST",
+                        "TRAIN_CATEGORIES", "SERVING_CATEGORIES"):
+                found[name] = ast.literal_eval(node.value)
+    mapping = dict(found.get("SPAN_TO_CATEGORY", {}))
+    allowlist = set(found.get("SPAN_ALLOWLIST", ()))
+    categories = set(found.get("TRAIN_CATEGORIES", ())) \
+        | set(found.get("SERVING_CATEGORIES", ()))
+    return mapping, allowlist, categories
+
+
+def _span_names(arg):
+    """Literal span names an emission argument can evaluate to, or None
+    when the expression is dynamic (a violation)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.IfExp):
+        body = _span_names(arg.body)
+        orelse = _span_names(arg.orelse)
+        if body is not None and orelse is not None:
+            return body + orelse
+    return None
+
+
+def find_violations(pkg_dir=DEFAULT_PKG_DIR):
+    """[(relpath, lineno, name_or_snippet, why)] for every emission whose
+    span name the ledger contract does not classify."""
+    mapping, allowlist, categories = load_contract(pkg_dir)
+    violations = []
+
+    # contract self-checks first: a broken contract must fail loudly, not
+    # silently admit everything
+    for span, cat in mapping.items():
+        if cat not in categories:
+            violations.append((GOODPUT_MODULE, 0, span,
+                               f"SPAN_TO_CATEGORY maps to unknown category {cat!r}"))
+        if span in allowlist:
+            violations.append((GOODPUT_MODULE, 0, span,
+                               "span appears in BOTH SPAN_TO_CATEGORY and "
+                               "SPAN_ALLOWLIST (must be exactly one)"))
+
+    def check_name(arg, rel, node, emission):
+        names = _span_names(arg)
+        snippet = ast.dump(arg)[:60] if names is None else None
+        if names is None:
+            violations.append((rel, node.lineno, snippet,
+                               f"dynamic span name in {emission} — use a literal "
+                               "(or a two-literal conditional) the gate can read"))
+            return
+        for name in names:
+            if name not in mapping and name not in allowlist:
+                violations.append((rel, node.lineno, name,
+                                   f"span {name!r} not in goodput SPAN_TO_CATEGORY "
+                                   "or SPAN_ALLOWLIST — classify it or allowlist "
+                                   "it with a reason"))
+
+    for scan in SCAN_PATHS:
+        root_path = os.path.join(pkg_dir, scan)
+        files = []
+        if os.path.isfile(root_path):
+            files = [root_path]
+        else:
+            for root, _dirs, fnames in os.walk(root_path):
+                files.extend(os.path.join(root, f) for f in sorted(fnames)
+                             if f.endswith(".py"))
+        for path in files:
+            rel = os.path.relpath(path, pkg_dir)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            # forwarded-parameter exemption: inside a FORWARD_EMITTERS def,
+            # the tracer call that passes the def's own name-parameter
+            # through is exempt — its literal names are checked at the
+            # helper's call sites below
+            exempt_calls = set()
+            for fdef in ast.walk(tree):
+                if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and fdef.name in FORWARD_EMITTERS:
+                    params = [a.arg for a in fdef.args.args if a.arg != "self"]
+                    fwd_param = params[0] if params else None
+                    for inner in ast.walk(fdef):
+                        if isinstance(inner, ast.Call) and inner.args \
+                                and isinstance(inner.args[0], ast.Name) \
+                                and inner.args[0].id == fwd_param:
+                            exempt_calls.add(id(inner))
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr in FORWARD_EMITTERS \
+                        and node.args:
+                    check_name(node.args[0], rel, node, f".{fn.attr}()")
+                    continue
+                if id(node) in exempt_calls:
+                    continue
+                if isinstance(fn, ast.Attribute) and fn.attr in EMIT_ATTRS \
+                        and node.args:
+                    # skip non-tracer .complete()/.span() lookalikes by
+                    # requiring a string-ish first argument shape
+                    if _span_names(node.args[0]) is not None or isinstance(
+                            node.args[0], (ast.JoinedStr, ast.Name, ast.BinOp)):
+                        check_name(node.args[0], rel, node, f".{fn.attr}()")
+                elif isinstance(fn, ast.Name) and fn.id == "observe_latency" \
+                        and len(node.args) >= 2:
+                    check_name(node.args[1], rel, node, "observe_latency()")
+    return violations
+
+
+def check(pkg_dir=DEFAULT_PKG_DIR):
+    """Return the violation list (empty = every span is classified)."""
+    return find_violations(pkg_dir)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    pkg_dir = argv[0] if argv else DEFAULT_PKG_DIR
+    bad = check(pkg_dir)
+    if bad:
+        print(f"check_goodput_taxonomy: unclassified tracer spans in {pkg_dir}:")
+        for rel, lineno, name, why in bad:
+            print(f"  {rel}:{lineno}: {why}\n      {name}")
+        return 1
+    print("check_goodput_taxonomy: every engine/serving/resilience span maps to "
+          "one ledger category or a documented allowlist entry")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,234 @@
+"""Machine reader for the driver's ``BENCH_r*.json`` round wrappers.
+
+The r01→rNN benchmark trajectory has been sitting on disk as opaque wrapper
+files (``{"n": <round>, "cmd": ..., "rc": ..., "tail": ..., "parsed": {...}}``)
+with no machine reader — the r03 regression (rc=1, no parsed payload) and the
+r04/r05 backend flip (CPU fallback silently incomparable to the on-chip
+r01/r02 numbers) were only visible to a human reading prose. This tool:
+
+  * loads every round wrapper under a directory (``BENCH_r01.json`` ...),
+    tolerating failed rounds (``rc != 0`` / ``parsed: null`` become explicit
+    gap entries, never crashes);
+  * flattens each round's parsed bench JSON into dotted scalar metrics
+    (``serving.value``, ``serving.ttft_p50_ms``, ``value``, ...) and
+    aggregates the per-metric series across rounds;
+  * emits a regression verdict per metric over the LAST comparable pair of
+    rounds — reusing ``bench.comparability_refusal`` (the cross-backend /
+    cross-chip refusal core of ``compare_to_baseline``), so a backend flip
+    yields ``verdict: "refused"`` with the reason instead of a bogus ratio;
+  * knows metric direction by suffix (``*_ms``/``*_s``/``*_bytes`` lower is
+    better; ``*tok_s``/``*_rate``/``value``/``mfu``/``speedup`` higher is
+    better; anything else is reported informationally as
+    ``unknown_direction``).
+
+Runnable in CI (``python tools/perf_sentinel.py [dir] [--out v.json]
+[--threshold 0.9] [--strict]``; ``--strict`` exits 1 on regressions) and
+from ``bench.py --history``.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# metric-direction tables: suffix (or exact-name) match on the LAST dotted
+# component. The SPECIFIC throughput suffixes are checked first: a name like
+# ``decode_tok_s`` also ends in the generic ``_s`` latency suffix and must
+# not be read as lower-is-better.
+LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_bytes", "_seconds", "_blocked_ratio")
+HIGHER_BETTER_SUFFIXES = ("tok_s", "_rate", "_mfu", "speedup", "_tokens_per_sec")
+HIGHER_BETTER_NAMES = ("value", "mfu", "accept_rate", "hit_rate", "ratio")
+
+# wall-clock ACCOUNTING fields, not performance metrics: a longer bench run
+# is not a regression. The whole goodput block is attribution (its *_s
+# leaves would otherwise hit the generic latency rule), as are the
+# disclosure leaves wherever they appear.
+NEUTRAL_PREFIXES = ("goodput.",)
+NEUTRAL_NAMES = ("wall_s", "unattributed_s", "overbooked_s", "recovery_badput_s")
+
+
+def metric_direction(metric):
+    """'lower' | 'higher' | None (unknown/neutral) for a dotted name."""
+    leaf = metric.rsplit(".", 1)[-1]
+    if metric.startswith(NEUTRAL_PREFIXES) or leaf in NEUTRAL_NAMES:
+        return None
+    if leaf.endswith(HIGHER_BETTER_SUFFIXES) or leaf in HIGHER_BETTER_NAMES:
+        return "higher"
+    if leaf.endswith(LOWER_BETTER_SUFFIXES):
+        return "lower"
+    return None
+
+
+def read_rounds(bench_dir):
+    """[(round_n, wrapper_dict)] sorted by round, one entry per
+    ``BENCH_r*.json`` — failed rounds keep their wrapper (``parsed`` None)."""
+    rounds = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                wrap = json.load(f)
+        except (OSError, ValueError) as e:
+            wrap = {"rc": None, "parsed": None,
+                    "read_error": f"{type(e).__name__}: {e}"}
+        if not isinstance(wrap, dict):
+            wrap = {"rc": None, "parsed": None, "read_error": "not a JSON object"}
+        n = wrap.get("n", int(m.group(1)))
+        rounds.append((int(n), wrap))
+    rounds.sort(key=lambda t: t[0])
+    return rounds
+
+
+def flatten_metrics(parsed, prefix=""):
+    """Nested bench JSON -> {dotted_name: float} over numeric scalar leaves
+    (bools/strings/lists skipped; lists are workload detail, not series)."""
+    out = {}
+    if not isinstance(parsed, dict):
+        return out
+    for key, val in parsed.items():
+        name = f"{prefix}{key}"
+        if isinstance(val, bool):
+            continue
+        if isinstance(val, (int, float)):
+            out[name] = float(val)
+        elif isinstance(val, dict):
+            out.update(flatten_metrics(val, prefix=name + "."))
+    return out
+
+
+def metric_series(rounds):
+    """{metric: [(round_n, value)]} over the successfully parsed rounds."""
+    series = {}
+    for n, wrap in rounds:
+        parsed = wrap.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        for metric, val in flatten_metrics(parsed).items():
+            series.setdefault(metric, []).append((n, val))
+    return series
+
+
+def _verdict(metric, prev, cur, ratio, threshold):
+    direction = metric_direction(metric)
+    if direction is None:
+        return "unknown_direction"
+    # threshold is the tolerated fractional change in the BAD direction
+    # (0.9 => flag a >10% move for the worse); the GOOD direction mirrors it
+    if direction == "higher":
+        if ratio < threshold:
+            return "regressed"
+        if ratio > 1.0 / threshold:
+            return "improved"
+    else:
+        if ratio > 1.0 / threshold:
+            return "regressed"
+        if ratio < threshold:
+            return "improved"
+    return "ok"
+
+
+def trajectory_verdicts(bench_dir, threshold=0.9):
+    """The full machine-readable trajectory report:
+
+    ``rounds``: per-round status (rc, backend, headline value, gaps named);
+    ``series``: per-metric [(round, value)] across parsed rounds;
+    ``verdicts``: one row per metric over the last ADJACENT parsed pair —
+    {metric, prev_round, cur_round, prev, cur, ratio, verdict} with
+    cross-backend/cross-chip pairs refused (reason in ``refused``), the
+    same refusal logic ``bench.compare_to_baseline`` applies to headlines.
+    """
+    from bench import comparability_refusal, backend_of
+
+    rounds = read_rounds(bench_dir)
+    round_rows = []
+    for n, wrap in rounds:
+        parsed = wrap.get("parsed")
+        row = {"round": n, "rc": wrap.get("rc"),
+               "parsed": isinstance(parsed, dict)}
+        if isinstance(parsed, dict):
+            row["backend"] = backend_of(parsed)
+            row["chip"] = parsed.get("chip")
+            row["metric"] = parsed.get("metric")
+            row["value"] = parsed.get("value")
+        elif "read_error" in wrap:
+            row["error"] = wrap["read_error"]
+        round_rows.append(row)
+
+    parsed_rounds = [(n, w["parsed"]) for n, w in rounds
+                     if isinstance(w.get("parsed"), dict)]
+    series = metric_series(rounds)
+    verdicts = []
+    if len(parsed_rounds) >= 2:
+        (pn, prev_parsed), (cn, cur_parsed) = parsed_rounds[-2], parsed_rounds[-1]
+        refusal = comparability_refusal(prev_parsed, cur_parsed)
+        prev_m = flatten_metrics(prev_parsed)
+        cur_m = flatten_metrics(cur_parsed)
+        for metric in sorted(set(prev_m) & set(cur_m)):
+            prev, cur = prev_m[metric], cur_m[metric]
+            row = {"metric": metric, "prev_round": pn, "cur_round": cn,
+                   "prev": prev, "cur": cur}
+            if refusal is not None:
+                row.update({"ratio": None, "verdict": "refused", "refused": refusal})
+            elif prev == 0:
+                row.update({"ratio": None, "verdict": "unknown_direction"})
+            else:
+                ratio = cur / prev
+                row.update({"ratio": round(ratio, 4),
+                            "verdict": _verdict(metric, prev, cur, ratio, threshold)})
+            verdicts.append(row)
+    regressions = [v for v in verdicts if v["verdict"] == "regressed"]
+    return {
+        "bench_dir": os.path.abspath(bench_dir),
+        "threshold": threshold,
+        "rounds": round_rows,
+        "series": {m: s for m, s in sorted(series.items())},
+        "verdicts": verdicts,
+        "regressions": len(regressions),
+        "refused": sum(1 for v in verdicts if v["verdict"] == "refused"),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Regression sentinel over the BENCH_r*.json round trajectory")
+    p.add_argument("bench_dir", nargs="?",
+                   default=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                        os.pardir))
+    p.add_argument("--out", default=None, help="write the full verdict JSON here")
+    p.add_argument("--threshold", type=float, default=0.9,
+                   help="tolerated worse-direction ratio (0.9 = flag >10%% regressions)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 when any metric regressed")
+    args = p.parse_args(argv)
+
+    report = trajectory_verdicts(args.bench_dir, threshold=args.threshold)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    n_rounds = len(report["rounds"])
+    parsed = sum(1 for r in report["rounds"] if r["parsed"])
+    print(f"# perf_sentinel: {n_rounds} rounds ({parsed} parsed), "
+          f"{len(report['verdicts'])} metrics compared, "
+          f"{report['regressions']} regressed, {report['refused']} refused")
+    for v in report["verdicts"]:
+        if v["verdict"] in ("regressed", "improved", "refused"):
+            detail = (f"ratio={v['ratio']}" if v.get("ratio") is not None
+                      else v.get("refused", ""))
+            print(f"#   {v['verdict']:9s} {v['metric']}: "
+                  f"{v['prev']} -> {v['cur']} ({detail})")
+    print(json.dumps({"regressions": report["regressions"],
+                      "refused": report["refused"],
+                      "rounds": n_rounds}))
+    return 1 if (args.strict and report["regressions"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
