@@ -1116,6 +1116,33 @@ def run_bench():
             print(f"# WARNING: chaos bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
 
+    # --tenants: tenant-scoped metering & fairness (ISSUE 15) — the
+    # multi-tenant closed-loop HTTP workload (Zipf tenant shares + one
+    # adversarial hot tenant) with the metering plane armed: fairness
+    # index (higher-better for the sentinel), per-tenant hit rates and
+    # spend, hot-tenant compute share, starvation count. Per-tenant rows
+    # are ACCOUNTING fields (perf_sentinel treats the block as neutral
+    # except fairness_index). Outside the headline timed window;
+    # DS_TPU_BENCH_TENANTS=0 skips, failure never costs the headline.
+    tenants_line = None
+    if os.environ.get("DS_TPU_BENCH_TENANTS", "1") != "0":
+        try:
+            from tools.serving_load import multi_tenant_bench
+
+            mt = multi_tenant_bench(on_tpu)
+            tenants_line = {k: mt[k] for k in
+                            ("fairness_index", "starvations", "tenants_seen",
+                             "hot_tenant_compute_share", "rest_ttft_p99_ms",
+                             "achieved_rps", "shed_rate", "per_tenant")}
+            print(f"# tenants: fairness={mt['fairness_index']} "
+                  f"hot_compute_share={mt['hot_tenant_compute_share']} "
+                  f"starvations={mt['starvations']} "
+                  f"rest_ttft_p99={mt['rest_ttft_p99_ms']}ms "
+                  f"(n={mt['tenants_seen']} tenants)", flush=True)
+        except Exception as e:
+            print(f"# WARNING: tenants bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+
     # --kernels: raw-speed microbench A/Bs (q-tiled paged attention, explicit
     # ZeRO-3 overlap, tuned-vs-default flash tiles). Outside the headline
     # timed window; DS_TPU_BENCH_KERNELS=0 skips, failure never costs the
@@ -1203,6 +1230,8 @@ def run_bench():
         line["cache"] = cache_line
     if memory_line is not None:
         line["memory"] = memory_line
+    if tenants_line is not None:
+        line["tenants"] = tenants_line
     if not on_tpu:
         line["tpu_unavailable_reason"] = tpu_error or "no TPU device visible"
     if gate_note:

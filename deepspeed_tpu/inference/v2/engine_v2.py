@@ -135,6 +135,12 @@ class InferenceEngineV2:
         self._gp_warmed = False
         self._gp_last_uids = None
         self.gp_rid_resolver = None
+        # tenant metering (serving/metering.py): the owning replica attaches
+        # the gateway's TenantMeter via `set_tenant_meter`, which wires one
+        # per-engine EngineMeterView into the block-lifecycle hooks. None by
+        # default — no stamp arrays exist and every hook site below the
+        # state manager stays one attribute check.
+        self._tenant_meter = None
         # live-health plane: serving heartbeats (`serving` watchdog source,
         # armed per forward) + a /healthz section. One boolean per call when
         # the plane is off.
@@ -1242,6 +1248,33 @@ class InferenceEngineV2:
         of inflating the primary ``params``/``kv_block_pool`` rows)."""
         self._memory_role = role
 
+    # -- tenant metering (serving/metering.py) ---------------------------
+    def set_tenant_meter(self, meter) -> None:
+        """Attach a gateway ``TenantMeter``: builds this engine's
+        :class:`~deepspeed_tpu.serving.metering.EngineMeterView` (block ids
+        are engine-local) and wires it into the block-lifecycle hooks —
+        allocator allocate/free (the CacheTelemetry surface), owner
+        stamping in the state manager, and the prefix cache's tenant-level
+        publish/hit/evict forwards. The ONE public entry the request plane
+        is allowed to use (``tools/check_gateway_api.py`` keeps serving/
+        out of engine internals). Idempotent per meter; ``None`` detaches."""
+        if meter is None:
+            view = self.state_manager.tenant_meter
+            if self._tenant_meter is not None and view is not None:
+                # settle the view's in-flight residency charges and stop it
+                # contributing to reports (a detached view can never see
+                # on_free again — kept live it would accrue phantom
+                # block-seconds forever)
+                self._tenant_meter.drop_view(view)
+            self._tenant_meter = None
+            self.state_manager.set_tenant_meter(None)
+            return
+        if self._tenant_meter is meter:
+            return  # replica restart: keep the live view (owner stamps survive)
+        self._tenant_meter = meter
+        view = meter.engine_view(self.state_manager.kv_cache.total_blocks)
+        self.state_manager.set_tenant_meter(view)
+
     def probe_prefix(self, prompt_tokens):
         """PURE prefix lookup (no references taken, no LRU touch, no stats):
         ``(n_cached_tokens, n_shared_full_blocks, n_tree_only, match)`` the
@@ -1259,24 +1292,30 @@ class InferenceEngineV2:
                         if self.state_manager.kv_cache.refcount(b) == 1)
         return m.n_cached_tokens, len(m.shared_blocks), tree_only, m
 
-    def acquire_prefix(self, uid: int, prompt_tokens, match=None) -> Tuple[int, int]:
+    def acquire_prefix(self, uid: int, prompt_tokens, match=None,
+                       tenant=None) -> Tuple[int, int]:
         """Create the sequence for ``uid`` pre-populated from the prefix
         cache (the scheduler's admission-side entry: it knows the FULL
         prompt, so the match is not limited to the first SplitFuse chunk).
         ``match`` — the object from :meth:`probe_prefix` — skips the
         re-match (valid as long as nothing mutated the tree in between).
+        ``tenant`` — the requesting owner identity, stamped on the sequence
+        (and its blocks / published tree nodes) when the metering plane is
+        attached; None = untenanted.
         Returns ``(n_cached_tokens, n_shared_full_blocks)`` — the scheduler
         feeds ``prompt[n_cached:]`` and charges only the uncached tokens.
         Roll back an abandoned acquisition with ``flush(uid)``."""
         seq, skip = self._create_with_prefix(
-            uid, np.asarray(prompt_tokens, np.int32).reshape(-1), match=match)
+            uid, np.asarray(prompt_tokens, np.int32).reshape(-1), match=match,
+            tenant=tenant)
         return skip, seq.shared_blocks
 
-    def _create_with_prefix(self, uid: int, prompt_tokens, match=None):
+    def _create_with_prefix(self, uid: int, prompt_tokens, match=None, tenant=None):
         """Sequence creation + the monitor's view of the lookup: hit-rate
         gauge, cached-token counters, and a ``prefix_hit`` trace span."""
         seq, skip = self.state_manager.create_sequence_with_prefix(uid, prompt_tokens,
-                                                                   match=match)
+                                                                   match=match,
+                                                                   tenant=tenant)
         pc = self.state_manager.prefix_cache
         if pc is not None:
             m = get_metrics()

@@ -39,6 +39,9 @@ class BlockedAllocator:
         # default) keeps every lifecycle event at a single attribute check —
         # the zero-overhead-off contract
         self.telemetry = None
+        # tenant-metering hook (``serving/metering.py`` EngineMeterView):
+        # the SAME lifecycle surface, second consumer, same None contract
+        self.meter = None
 
     @property
     def free_blocks(self) -> int:
@@ -75,6 +78,8 @@ class BlockedAllocator:
         self._refcount[out] = 1
         if self.telemetry is not None:
             self.telemetry.on_allocate(out)
+        if self.meter is not None:
+            self.meter.on_allocate(out)
         return out
 
     def incref(self, blocks: Union[int, Iterable[int]]) -> None:
@@ -88,7 +93,7 @@ class BlockedAllocator:
         """Drop one reference per block; a block returns to the free list only
         at refcount zero. Releasing an already-free block (double free) or a
         never-allocated id raises instead of corrupting the free list."""
-        freed = [] if self.telemetry is not None else None
+        freed = [] if (self.telemetry is not None or self.meter is not None) else None
         for b in self._as_ids(blocks):
             if self._refcount[b] == 0:
                 raise ValueError(f"double free of block {b}: block is already on the free list")
@@ -100,7 +105,10 @@ class BlockedAllocator:
                 if freed is not None:
                     freed.append(b)
         if freed:
-            self.telemetry.on_free(freed)
+            if self.telemetry is not None:
+                self.telemetry.on_free(freed)
+            if self.meter is not None:
+                self.meter.on_free(freed)
 
     # the historical name: one holder dropping its reference. Kept as an
     # exact alias so pre-refcount callers get the loud double-free guard

@@ -226,6 +226,13 @@ class CacheTelemetry:
         self.reuse_interval_s = Histogram("cache/reuse_interval_s", buckets=AGE_BUCKETS_S)
         self.evicted_block_age_s = Histogram("cache/evicted_block_age_s",
                                              buckets=AGE_BUCKETS_S)
+        # occupancy-time integral ∫ occupied_blocks dt (block-seconds),
+        # advanced at every allocate/free event: the pool-side ground truth
+        # the tenant meter's per-owner KV-block-second charges must sum to
+        # (the PR 15 conservation acceptance check)
+        self._occ_blocks = 0
+        self._occ_last_t = self._clock()
+        self._occ_integral_s = 0.0
         sample_rate = getattr(config, "mrc_sample_rate", 0.25) if config else 0.25
         max_tracked = getattr(config, "mrc_max_tracked", 4096) if config else 4096
         mults = getattr(config, "mrc_capacity_mults", None) if config else None
@@ -235,9 +242,21 @@ class CacheTelemetry:
         # set by the owning DSStateManager; None keeps fragmentation at 0
         self.occupancy_provider = None
 
+    def _advance_occupancy(self, now, delta_blocks) -> None:
+        self._occ_integral_s += self._occ_blocks * max(0.0, now - self._occ_last_t)
+        self._occ_last_t = now
+        self._occ_blocks = max(0, self._occ_blocks + delta_blocks)
+
+    def occupancy_integral_s(self) -> float:
+        """Block-seconds of pool occupancy since construction (the partial
+        interval of currently-resident blocks included)."""
+        now = self._clock()
+        return self._occ_integral_s + self._occ_blocks * max(0.0, now - self._occ_last_t)
+
     # -- allocator hooks ---------------------------------------------------
     def on_allocate(self, blocks) -> None:
         now = self._clock()
+        self._advance_occupancy(now, len(blocks))
         self._alloc_t[np.asarray(blocks, np.int64)] = now
         self.counters["allocated"] += len(blocks)
 
@@ -245,6 +264,7 @@ class CacheTelemetry:
         """Physical frees (refcount reached zero): block age = allocate ->
         free, the residency distribution of the whole pool."""
         now = self._clock()
+        self._advance_occupancy(now, -len(blocks))
         reg = get_metrics()
         mirror = reg.histogram("cache/block_age_s", buckets=AGE_BUCKETS_S) \
             if reg.enabled else None
@@ -372,6 +392,7 @@ class CacheTelemetry:
             "counters": dict(self.counters),
             "classes": self.refcount_classes(),
             "occupancy": round(self.occupancy(), 4),
+            "occupancy_integral_s": round(self.occupancy_integral_s(), 6),
             "fragmentation": round(self.fragmentation(), 4),
             "block_age_s": self.block_age_s.summary(),
             "reuse_interval_s": self.reuse_interval_s.summary(),

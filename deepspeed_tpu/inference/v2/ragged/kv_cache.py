@@ -105,6 +105,11 @@ class BlockedKVCache:
         the facade's only sanctioned route to them."""
         self._allocator.telemetry = telemetry
 
+    def set_meter(self, view) -> None:
+        """Arm (or with None, disarm) the tenant-metering view on the same
+        allocator lifecycle surface cache telemetry rides."""
+        self._allocator.meter = view
+
     def copy_block(self, src: int, dst: int) -> None:
         """Device-side copy of one block's KV slots ``src`` → ``dst`` (the
         copy-on-write primitive: a sequence that must write into a SHARED

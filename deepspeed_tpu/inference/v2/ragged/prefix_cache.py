@@ -35,16 +35,21 @@ from .cache_telemetry import chunk_key
 
 class _Node:
     """One radix-tree edge = one full KV block: ``chunk`` (block_size token
-    ids) → ``block`` (physical block id). Children keyed by their chunk."""
+    ids) → ``block`` (physical block id). Children keyed by their chunk.
+    ``owner`` is the publishing sequence's tenant (serving metering): one
+    string reference, stamped at insert — it makes hits and eviction
+    pressure attributable per tenant, and is the exact prerequisite for
+    ROADMAP item 4's tenant-prefixed radix keys."""
 
-    __slots__ = ("chunk", "block", "parent", "children", "last_access")
+    __slots__ = ("chunk", "block", "parent", "children", "last_access", "owner")
 
-    def __init__(self, chunk, block, parent):
+    def __init__(self, chunk, block, parent, owner=None):
         self.chunk = chunk
         self.block = int(block)
         self.parent = parent
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.last_access = 0
+        self.owner = owner
 
 
 @dataclass
@@ -84,6 +89,10 @@ class PrefixKVCache:
         # block-lifecycle + MRC observability (``cache_telemetry.py``); None
         # keeps every hook below at a single attribute check
         self._telemetry = telemetry
+        # tenant metering view (serving/metering.py EngineMeterView), wired
+        # by DSStateManager.set_tenant_meter: hit attribution via node
+        # owners, publish credit, eviction pressure. Same None contract.
+        self._meter = None
         self._root = _Node(chunk=(), block=-1, parent=None)
         self._n_nodes = 0
         self._clock = 0  # monotonic LRU clock
@@ -130,6 +139,11 @@ class PrefixKVCache:
         with self._tree_lock:
             return sum(1 for n in self._iter_nodes()
                        if self.kv_cache.refcount(n.block) == 1)
+
+    def set_meter(self, view) -> None:
+        """Arm (or with None, disarm) the tenant-metering forwards."""
+        with self._tree_lock:
+            self._meter = view
 
     # -- admission side ----------------------------------------------------
     def match(self, tokens) -> PrefixMatch:
@@ -186,7 +200,8 @@ class PrefixKVCache:
             return PrefixMatch()
         return m
 
-    def acquire(self, tokens, match: Optional[PrefixMatch] = None) -> Tuple[List[int], int, int]:
+    def acquire(self, tokens, match: Optional[PrefixMatch] = None,
+                tenant: Optional[str] = None) -> Tuple[List[int], int, int]:
         """Match ``tokens`` and take ownership of the hit on behalf of a new
         sequence: incref every shared full block, then (for a partial tail)
         allocate + device-copy the COW block. ``match`` reuses the result of
@@ -219,9 +234,12 @@ class PrefixKVCache:
                 return [], 0, 0
             # touch the matched path (LRU) and pin the shared run
             node = self._root
+            hit_owners = [] if self._meter is not None else None
             for i, b in enumerate(m.shared_blocks):
                 node = node.children[tuple(int(t) for t in np.asarray(tokens[i * bs:(i + 1) * bs]))]
                 self._touch(node)
+                if hit_owners is not None:
+                    hit_owners.append((node.owner, bs))
             if m.shared_blocks:
                 self.kv_cache.incref(m.shared_blocks)
                 if self._telemetry is not None:
@@ -235,12 +253,26 @@ class PrefixKVCache:
                     dst = None  # pool truly dry: fall back to the full-block hit
                 if dst is not None:
                     self.kv_cache.copy_block(m.cow_src, dst)
+                    if self._meter is not None:
+                        # the duplicate belongs to the REQUESTER (it will
+                        # write its own tail into it); the saved tokens are
+                        # still credited to the COW source's publisher
+                        self._meter.stamp([dst], tenant)
+                        cow_owner = next((c.owner for c in node.children.values()
+                                          if c.block == m.cow_src), None)
+                        hit_owners.append((cow_owner, m.cow_tokens))
                     blocks.append(dst)
                     n_cached += m.cow_tokens
                     self.stats["cow_copies"] += 1
                     self.stats["cow_bytes"] += self.kv_cache.block_bytes()
                     get_metrics().counter("cache/cow_bytes").inc(
                         self.kv_cache.block_bytes())
+            if self._meter is not None and tenant is not None and hit_owners:
+                # per-tenant hit ATTRIBUTION: consumer's saved tokens split
+                # self vs cross-tenant, publishers credited served_tokens
+                self._meter.on_prefix_hit(tenant,
+                                          [o for o, _ in hit_owners],
+                                          [t for _, t in hit_owners])
             if n_cached == 0:
                 return [], 0, 0
             self.stats["hits"] += 1
@@ -287,7 +319,8 @@ class PrefixKVCache:
                     key = chunk_key(key, chunk)
                 child = node.children.get(chunk)
                 if child is None:
-                    child = _Node(chunk=chunk, block=seq.kv_blocks[b], parent=node)
+                    child = _Node(chunk=chunk, block=seq.kv_blocks[b], parent=node,
+                                  owner=getattr(seq, "tenant", None))
                     self.kv_cache.incref(child.block)
                     node.children[chunk] = child
                     self._n_nodes += 1
@@ -306,6 +339,8 @@ class PrefixKVCache:
                 # reusable chains deeper in the modeled LRU stack without
                 # inflating the predicted hit rate
                 tel.record_inserts(new_keys)
+            if self._meter is not None and inserted:
+                self._meter.on_publish(getattr(seq, "tenant", None), inserted)
             seq.published_blocks = full
             return inserted
 
@@ -378,5 +413,8 @@ class PrefixKVCache:
         get_metrics().counter("cache/evicted_tokens").inc(self.block_size)
         if self._telemetry is not None:
             self._telemetry.on_evict(node.block)  # victim age BEFORE the free
+        if self._meter is not None:
+            # eviction pressure attributed to the evicted block's publisher
+            self._meter.on_evict(node.owner)
         self.kv_cache.release(node.block)
         self._n_nodes -= 1

@@ -60,6 +60,20 @@ class DSStateManager:
                            "the prefix cache itself is disabled — enable "
                            "ragged.prefix_cache to arm cache telemetry")
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        # tenant metering view (serving/metering.py EngineMeterView): set by
+        # the engine's set_tenant_meter; None keeps every stamp site below
+        # at one attribute check (the zero-overhead-off contract)
+        self.tenant_meter = None
+
+    def set_tenant_meter(self, view) -> None:
+        """Wire (or with None, unwire) a per-engine tenant-meter view into
+        the block lifecycle: the allocator's allocate/free hooks (alongside
+        cache telemetry), owner stamping here, and the prefix cache's
+        tenant-level publish/hit/evict forwards."""
+        self.tenant_meter = view
+        self.kv_cache.set_meter(view)
+        if self.prefix_cache is not None:
+            self.prefix_cache.set_meter(view)
 
     # -- queries -----------------------------------------------------------
     @property
@@ -118,8 +132,8 @@ class DSStateManager:
             return seq
         return self.create_sequence_with_prefix(uid, None)[0]
 
-    def create_sequence_with_prefix(self, uid: int, prompt_tokens,
-                                    match=None) -> Tuple[DSSequenceDescriptor, int]:
+    def create_sequence_with_prefix(self, uid: int, prompt_tokens, match=None,
+                                    tenant=None) -> Tuple[DSSequenceDescriptor, int]:
         """Create a FRESH sequence, pre-populated from the prefix cache when
         ``prompt_tokens`` (the tokens about to be fed) hit the radix tree:
         the block table starts with the shared run (plus a COW tail copy)
@@ -132,10 +146,12 @@ class DSStateManager:
         if len(self._seqs) >= self.max_tracked_sequences:
             raise RuntimeError(f"already tracking {self.max_tracked_sequences} sequences")
         seq = DSSequenceDescriptor(uid=uid, block_size=self.block_size)
+        seq.tenant = tenant
         n_cached = 0
         if self.prefix_cache is not None and prompt_tokens is not None:
             prompt_tokens = np.asarray(prompt_tokens).reshape(-1)
-            blocks, n_cached, shared = self.prefix_cache.acquire(prompt_tokens, match=match)
+            blocks, n_cached, shared = self.prefix_cache.acquire(prompt_tokens, match=match,
+                                                                 tenant=tenant)
             if n_cached:
                 seq.kv_blocks = [int(b) for b in blocks]
                 seq.seen_tokens = n_cached
@@ -153,7 +169,12 @@ class DSStateManager:
         if need > 0:
             if self.prefix_cache is not None and need > self.kv_cache.free_blocks:
                 self.prefix_cache.evict(need - self.kv_cache.free_blocks)
-            seq.extend_blocks(self.kv_cache.reserve(need))
+            fresh = self.kv_cache.reserve(need)
+            if self.tenant_meter is not None:
+                # block-second attribution: the sequence's owner holds the
+                # residency of every block it materializes KV into
+                self.tenant_meter.stamp(fresh, seq.tenant)
+            seq.extend_blocks(fresh)
 
     def note_tokens(self, seq: DSSequenceDescriptor, tokens) -> None:
         """Record the token ids being materialized this forward (put chunk,
@@ -214,6 +235,8 @@ class DSStateManager:
             if self.prefix_cache is not None and self.kv_cache.free_blocks < 1:
                 self.prefix_cache.evict(1)
             cow_dst = int(self.kv_cache.reserve(1)[0])
+            if self.tenant_meter is not None:
+                self.tenant_meter.stamp([cow_dst], seq.tenant)
             self.kv_cache.copy_block(cow_src, cow_dst)
         tail = seq.kv_blocks[keep:]
         del seq.kv_blocks[keep:]

@@ -32,6 +32,10 @@ class DSSequenceDescriptor:
     shared_blocks: int = 0
     prefix_cached_tokens: int = 0
     published_blocks: int = 0  # publish() walk cursor: full blocks already walked
+    # owner identity (serving/metering.py): stamped at creation when the
+    # request plane knows a tenant; rides into published radix-tree nodes
+    # so hits and eviction pressure are attributable. None = untenanted.
+    tenant: str = None
 
     @property
     def cur_allocated_blocks(self) -> int:

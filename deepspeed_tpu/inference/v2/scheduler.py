@@ -29,14 +29,16 @@ from .scheduling_utils import SchedulingResult
 
 class _Request:
     __slots__ = ("uid", "prompt", "max_new_tokens", "eos_token_id", "fed", "generated", "done",
-                 "charged_blocks", "shared_blocks", "sampling")
+                 "charged_blocks", "shared_blocks", "sampling", "tenant")
 
-    def __init__(self, uid, prompt, max_new_tokens, eos_token_id, sampling=None):
+    def __init__(self, uid, prompt, max_new_tokens, eos_token_id, sampling=None,
+                 tenant=None):
         self.uid = uid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
         self.sampling = sampling  # SamplingParams | None (= greedy)
+        self.tenant = tenant      # owner identity (serving metering); None = untenanted
         self.fed = 0          # prompt tokens already given to the engine
         self.generated: List[int] = []
         self.done = False
@@ -115,19 +117,25 @@ class DynamicSplitFuseScheduler:
         # delta instead of re-concatenating the whole stream (O(new tokens),
         # not O(context), in the hottest serving loop)
         self._spec_ctx: Dict[int, np.ndarray] = {}
-        # optional per-step observer, `fn(uids, chunk_sizes, t0, dur)` after
-        # each composed `put` forward — the serving replica attaches one to
-        # attribute step wall time to the requests whose chunks composed it
-        # (per-chunk prefill spans). None (the default) adds zero work.
+        # optional per-step observer, `fn(uids, chunk_sizes, t0, dur, kind)`
+        # after EVERY engine forward this scheduler composes — `kind` is
+        # "put" (mixed decode+prefill chunks), "decode" (the multi-step
+        # burst, chunk_sizes = the horizon per row) or "spec_verify" (the
+        # speculative verify forward, chunk_sizes = the verify-chunk rows).
+        # The serving replica attaches one to attribute forward wall time
+        # to the requests whose chunks composed it (per-chunk prefill spans
+        # + per-tenant compute-second apportionment). None (the default)
+        # adds zero work on every path.
         self.step_observer = None
 
     def submit(self, uid: int, prompt, max_new_tokens: int = 32, eos_token_id=None,
-               sampling=None):
+               sampling=None, tenant=None):
         if uid in self._active or any(r.uid == uid for r in self._pending):
             raise ValueError(f"uid {uid} already queued")
         if sampling is not None:
             sampling.validate()  # raises ValueError on out-of-range knobs
-        req = _Request(uid, prompt, max_new_tokens, eos_token_id, sampling=sampling)
+        req = _Request(uid, prompt, max_new_tokens, eos_token_id, sampling=sampling,
+                       tenant=tenant)
         if req.prompt.size == 0:
             raise ValueError(f"uid {uid}: empty prompt")
         if req.max_new_tokens <= 0:
@@ -275,7 +283,8 @@ class DynamicSplitFuseScheduler:
         if self.engine.can_schedule(batch_uids + [req.uid],
                                     batch_lengths + [first]) is not SchedulingResult.Success:
             return False
-        n_cached, shared = self.engine.acquire_prefix(req.uid, req.prompt, match=match)
+        n_cached, shared = self.engine.acquire_prefix(req.uid, req.prompt, match=match,
+                                                      tenant=req.tenant)
         req.fed = n_cached
         req.charged_blocks = self._blocks_for(req.total_tokens) - shared
         req.shared_blocks = shared
@@ -314,8 +323,15 @@ class DynamicSplitFuseScheduler:
         # sampling rides down only when some row actually samples — an
         # all-greedy burst keeps the original argmax scan program
         samp = [r.sampling for r in decoding] if any(r.sampled for r in decoding) else None
-        toks = np.asarray(self.engine.decode(uids, first, horizon, eos_token_ids=eos,
-                                             sampling=samp))  # [S, horizon]
+        if self.step_observer is None:
+            toks = np.asarray(self.engine.decode(uids, first, horizon, eos_token_ids=eos,
+                                                 sampling=samp))  # [S, horizon]
+        else:
+            t0 = time.perf_counter()
+            toks = np.asarray(self.engine.decode(uids, first, horizon, eos_token_ids=eos,
+                                                 sampling=samp))  # [S, horizon]
+            self.step_observer(uids, [horizon] * len(uids), t0,
+                               time.perf_counter() - t0, "decode")
         for req, row in zip(decoding, toks):
             for tok in row.tolist():
                 self._append_token(req, int(tok))
@@ -423,11 +439,18 @@ class DynamicSplitFuseScheduler:
         # per-request eos rides down (decode()'s contract): an eos inside
         # the accepted run truncates the commit there, so the tree never
         # receives post-eos paths even when acceptance carries past it
-        outs = eng.speculate_decode(
-            uids, firsts,
-            [branches[r.uid] if len(branches[r.uid]) > 1 else branches[r.uid][0]
-             for r in spec_reqs],
-            k, eos_token_ids=[r.eos_token_id for r in spec_reqs], sampling=samp)
+        spec_drafts = [branches[r.uid] if len(branches[r.uid]) > 1 else branches[r.uid][0]
+                       for r in spec_reqs]
+        spec_eos = [r.eos_token_id for r in spec_reqs]
+        if self.step_observer is None:
+            outs = eng.speculate_decode(uids, firsts, spec_drafts, k,
+                                        eos_token_ids=spec_eos, sampling=samp)
+        else:
+            t0 = time.perf_counter()
+            outs = eng.speculate_decode(uids, firsts, spec_drafts, k,
+                                        eos_token_ids=spec_eos, sampling=samp)
+            self.step_observer(uids, [n_new] * len(uids), t0,
+                               time.perf_counter() - t0, "spec_verify")
         self.spec_stats["rounds"] += 1
         backoff_n = getattr(self._spec, "backoff_after", 0)
         committed = 0
@@ -530,7 +553,7 @@ class DynamicSplitFuseScheduler:
             t0 = time.perf_counter()
             toks = self.engine.put(uids, chunks, sample="greedy", sampling=samp)
             self.step_observer(uids, [c.size for c in chunks], t0,
-                               time.perf_counter() - t0)
+                               time.perf_counter() - t0, "put")
         n = sum(c.size for c in chunks)
         for uid, tok in zip(uids, np.asarray(toks).reshape(-1)):
             req = self._active[uid]

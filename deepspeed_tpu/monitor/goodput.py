@@ -135,6 +135,9 @@ SPAN_ALLOWLIST = (
     "serving/request_done",
     "serving/request_shed",
     "serving/request_failed",
+    # tenant metering (serving/metering.py): a starvation detection is a
+    # zero-duration instant — it consumes no wall clock
+    "serving/tenant_starvation",
 )
 
 

@@ -17,7 +17,12 @@ Layering (request -> token):
     PR 5 heartbeat state;
   * :mod:`replica`   — one driver thread per engine running the
     SplitFuse put/decode loop and fanning generated tokens out to
-    bounded per-request stream queues.
+    bounded per-request stream queues;
+  * :mod:`metering`  — tenant-scoped resource metering & fairness
+    observability (``serving.gateway.metering`` block): sanitized
+    ``X-Tenant-Id`` identity charged per-tenant token/KV-block-second/
+    compute-second integrals, DRF fairness index, starvation instants,
+    bounded top-K Prometheus export, ``GET /v1/usage``.
 
 Everything defaults OFF: importing this package starts no threads, and a
 constructed-but-never-started gateway allocates no queues' worth of
@@ -29,11 +34,14 @@ The request plane talks to the engine ONLY through its public API
 by the ``tools/check_gateway_api.py`` AST gate, run from tier-1.
 """
 
-from .config import GatewayConfig, RequestTraceConfig, SLOClassConfig
+from .config import (GatewayConfig, MeteringConfig, RequestTraceConfig,
+                     SLOClassConfig)
 from .admission import AdmissionController
 from .router import ReplicaRouter
 from .replica import EngineReplica, GatewayRequest, TokenStream
 from .reqtrace import (RequestContext, RequestLog, RequestTracing,
                        extract_request_id, new_request_id, parse_traceparent,
                        sanitize_request_id)
+from .metering import (DEFAULT_TENANT, EngineMeterView, TenantMeter,
+                       sanitize_tenant_id)
 from .gateway import ServingGateway, parse_sse, sse_frame

@@ -32,9 +32,13 @@ from ..monitor.metrics import get_metrics
 class AdmissionController:
     """Bounded per-(replica, class) queues + uncached-token accounting."""
 
-    def __init__(self, config, reqtrace=None):
+    def __init__(self, config, reqtrace=None, meter=None):
         self.config = config
         self.reqtrace = reqtrace
+        # tenant metering plane (serving/metering.py): None (the default)
+        # keeps every hook below at one attribute check — the shed path
+        # then stays byte-identical to the pre-metering controller
+        self.meter = meter
         self._lock = threading.Lock()
         self._queues: Dict[Tuple[str, str], deque] = {}
         self._queued_uncached: Dict[Tuple[str, str], int] = {}
@@ -99,6 +103,12 @@ class AdmissionController:
                 self.stats["shed"] += 1
                 cs["shed"] += 1
                 reg.counter(f"gateway/shed_{req.slo_class}_total").inc()
+                if self.meter is not None:
+                    # shed split BY TENANT (bounded by the meter's top-K
+                    # aggregator): one tenant's burst filling a class queue
+                    # is attributable, instead of reading as systemic
+                    # overload on the aggregate per-class counter above
+                    self.meter.on_shed(req.tenant, req.slo_class, reason)
                 return False, reason
             req.cached_tokens = int(n_cached)
             req.uncached_tokens = uncached
@@ -119,6 +129,10 @@ class AdmissionController:
         reg.counter(f"gateway/requests_{req.slo_class}_total").inc()
         reg.counter("gateway/admitted_uncached_tokens_total").inc(uncached)
         reg.counter("gateway/admitted_cached_tokens_total").inc(int(n_cached))
+        if self.meter is not None:
+            # the admission charge IS the token meter: uncached prompt
+            # tokens billed, prefix-cache tokens credited as savings
+            self.meter.on_admitted(req.tenant, uncached, int(n_cached))
         reg.gauge(f"gateway/queue_depth_{req.slo_class}").set(self.depth(slo_class=req.slo_class))
         return True, None
 

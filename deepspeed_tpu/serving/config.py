@@ -79,6 +79,41 @@ class RequestTraceConfig:
 
 
 @dataclass
+class MeteringConfig:
+    """``serving.gateway.metering`` block — tenant-scoped resource metering
+    & fairness observability (``serving/metering.py``). Presence-enables
+    (the ``tracing``/``health`` contract): an absent block costs the
+    request path zero allocations and zero threads — no meter object, no
+    engine views, no per-block stamp arrays (test-enforced in
+    ``tests/test_tenant_metering.py``)."""
+
+    enabled: bool = False
+    # tenants exported individually on /metrics and /v1/usage; everything
+    # past the cut aggregates into ONE `other` row — the scrape never
+    # carries more than top_k + 1 distinct tenant label values
+    top_k: int = 8
+    # distinct in-memory ledgers; past this bound new tenant ids fold into
+    # the `other` ledger (a hostile client inventing ids cannot grow memory)
+    max_tracked_tenants: int = 256
+    # atomically-rotated usage JSONL (the reqtrace RequestLog pattern):
+    # one record per terminal request + periodic full-ledger snapshots;
+    # "" = in-memory only, no file
+    usage_log_path: str = ""
+    usage_log_max_bytes: int = 16 << 20
+    usage_log_max_files: int = 2
+    # a full per-tenant ledger snapshot line every N terminal requests
+    # (0 = per-request records only)
+    ledger_snapshot_every: int = 64
+    # starvation detector: a tenant's windowed p99 queue wait must exceed
+    # BOTH `starvation_factor` x the global p99 AND the absolute floor
+    # before the latched starvation instant fires
+    starvation_factor: float = 4.0
+    starvation_min_wait_s: float = 0.05
+    # per-tenant sliding queue-wait window the p99s are computed over
+    starvation_window: int = 64
+
+
+@dataclass
 class GatewayConfig:
     enabled: bool = False
     host: str = "127.0.0.1"
@@ -114,12 +149,16 @@ class GatewayConfig:
     warmup_token_buckets: Tuple = ()
     # request-scoped tracing + per-request summary log; off by default
     tracing: RequestTraceConfig = field(default_factory=RequestTraceConfig)
+    # tenant-scoped resource metering + fairness observability; off by
+    # default with the same zero-overhead-absent contract
+    metering: MeteringConfig = field(default_factory=MeteringConfig)
 
     @classmethod
     def from_dict(cls, d) -> "GatewayConfig":
         d = dict(d or {})
         classes = d.pop("slo_classes", None)
         tracing = d.pop("tracing", None)
+        metering = d.pop("metering", None)
         known = {f.name for f in fields(cls)}
         unknown = set(d) - known
         if unknown:
@@ -140,6 +179,25 @@ class GatewayConfig:
             if not 0.0 <= cfg.tracing.sample_rate <= 1.0:
                 raise ValueError("serving.gateway.tracing: sample_rate must be in [0, 1], "
                                  f"got {cfg.tracing.sample_rate}")
+        if metering is not None:
+            if isinstance(metering, MeteringConfig):
+                cfg.metering = metering
+            else:
+                body = dict(metering)
+                mt_known = {f.name for f in fields(MeteringConfig)}
+                bad = set(body) - mt_known
+                if bad:
+                    raise ValueError(f"serving.gateway.metering: unknown keys {sorted(bad)}")
+                if "enabled" not in body:  # presence-enables
+                    body["enabled"] = True
+                cfg.metering = MeteringConfig(**body)
+            if cfg.metering.top_k < 1:
+                raise ValueError("serving.gateway.metering: top_k must be >= 1, "
+                                 f"got {cfg.metering.top_k}")
+            if cfg.metering.max_tracked_tenants < cfg.metering.top_k:
+                raise ValueError("serving.gateway.metering: max_tracked_tenants "
+                                 f"({cfg.metering.max_tracked_tenants}) must cover "
+                                 f"top_k ({cfg.metering.top_k})")
         if classes is not None:
             slo_known = {f.name for f in fields(SLOClassConfig)}
             parsed = {}

@@ -19,6 +19,9 @@ pattern — one daemon accept thread, one handler thread per connection):
     (started, not draining, replicas warmed + live, every bounded class
     queue below its shed depth), 503 otherwise — so a drained replica
     leaves rotation without being killed.
+  * ``GET /v1/usage`` — the per-tenant metering ledger (top-K tenants by
+    spend + aggregated ``other``, fairness index, starvation count); 404
+    when the ``serving.gateway.metering`` block is absent.
 
 SSE frame format (``sse_frame``/``parse_sse`` are the canonical pair; the
 load generator and the tests share them):
@@ -40,6 +43,7 @@ from ..monitor.health import get_health
 from ..monitor.metrics import get_metrics
 from .admission import AdmissionController
 from .config import GatewayConfig
+from .metering import TenantMeter, sanitize_tenant_id
 from .replica import EngineReplica, GatewayRequest
 from .reqtrace import (RequestTracing, extract_request_id, new_request_id,
                        sanitize_request_id)
@@ -77,9 +81,17 @@ class ServingGateway:
         self.reqtrace = (RequestTracing(self.config.tracing,
                                         slo_classes=self.config.slo_classes)
                          if self.config.tracing.enabled else None)
-        self.admission = AdmissionController(self.config, reqtrace=self.reqtrace)
+        # tenant metering plane: exists ONLY when the metering block asked
+        # for it — with it absent no meter, no per-engine views, no stamp
+        # arrays, and every hook stays one `is not None` check (the same
+        # zero-overhead contract as the tracing plane above)
+        self.meter = (TenantMeter(self.config.metering,
+                                  slo_classes=self.config.slo_classes)
+                      if self.config.metering.enabled else None)
+        self.admission = AdmissionController(self.config, reqtrace=self.reqtrace,
+                                             meter=self.meter)
         self.replicas = [EngineReplica(str(i), eng, self.admission, self.config,
-                                       reqtrace=self.reqtrace)
+                                       reqtrace=self.reqtrace, meter=self.meter)
                          for i, eng in enumerate(engines)]
         self.router = ReplicaRouter(self.replicas, policy=self.config.router)
         self._uid_lock = threading.Lock()
@@ -90,6 +102,8 @@ class ServingGateway:
         self._registered_state = None
         self._registered_gauges = None
         self._registered_dump = None
+        self._registered_tenant_gauges = None
+        self._registered_tenant_dump = None
         self.started = False
         self.draining = False
 
@@ -107,6 +121,11 @@ class ServingGateway:
                              "serving.gateway.enabled (or GatewayConfig(enabled=True)) "
                              "before start()")
         get_metrics().enable()  # gateway metrics ride the registry
+        if self.meter is not None:
+            # (re-)attach the engine-side views: stop() detaches them, so a
+            # stop() -> start() cycle on one gateway keeps metering live
+            for r in self.replicas:
+                r.engine.set_tenant_meter(self.meter)
         for r in self.replicas:
             r.start()
         self._start_http()
@@ -126,6 +145,14 @@ class ServingGateway:
         self._registered_dump = self.inflight_request_summaries
         health.set_gauge_provider("gateway", self._registered_gauges)
         health.set_dump_provider("inflight_requests", self._registered_dump)
+        if self.meter is not None:
+            # tenant-labelled rows on /metrics (top-K + `other`, the only
+            # sanctioned source of a `tenant` label) and tenant rows in
+            # forensic stall dumps — ownership-checked like the rest
+            self._registered_tenant_gauges = self.meter.gauge_rows
+            self._registered_tenant_dump = self.meter.dump_rows
+            health.set_gauge_provider("tenant_meter", self._registered_tenant_gauges)
+            health.set_dump_provider("tenants", self._registered_tenant_dump)
         return self
 
     def stop(self, timeout: float = 10.0):
@@ -147,8 +174,19 @@ class ServingGateway:
             health.clear_state_provider("gateway", self._registered_state)
             health.clear_gauge_provider("gateway", self._registered_gauges)
             health.clear_dump_provider("inflight_requests", self._registered_dump)
+            if self.meter is not None:
+                health.clear_gauge_provider("tenant_meter",
+                                            self._registered_tenant_gauges)
+                health.clear_dump_provider("tenants", self._registered_tenant_dump)
         if self.reqtrace is not None:
             self.reqtrace.close()
+        if self.meter is not None:
+            # detach the engine-side views: a reused engine must not keep
+            # feeding a dead gateway's meter (and a later unmetered gateway
+            # must find the hooks disarmed)
+            for r in self.replicas:
+                r.engine.set_tenant_meter(None)
+            self.meter.close()
         self.started = False
 
     def drain(self, on: bool = True):
@@ -185,7 +223,7 @@ class ServingGateway:
     def submit(self, prompt, max_new_tokens: int = 16, slo_class: Optional[str] = None,
                eos_token_id=None, rid: Optional[str] = None,
                traceparent: Optional[str] = None, temperature=None, top_p=None,
-               seed=None):
+               seed=None, tenant: Optional[str] = None):
         """Validate -> route -> admit. Returns ``(200, GatewayRequest)`` or
         ``(status, error_dict)`` with status 400/429/503. ``rid`` is the
         (already-sanitized) client request id — generated when absent, so
@@ -194,11 +232,17 @@ class ServingGateway:
         ``temperature``/``top_p``/``seed``: per-request sampling
         (``SamplingParams``) — absent/temperature-0 keeps the greedy fast
         path; out-of-range values are a 400 at the door, never a replica
-        error."""
+        error.
+
+        ``tenant``: the request's owner identity (``X-Tenant-Id`` at the
+        HTTP door) — sanitized with the request-id charset discipline and
+        defaulted, so every request is charged to SOME tenant; the meter
+        itself only exists when ``serving.gateway.metering`` is present."""
         rt = self.reqtrace
         rid = sanitize_request_id(rid) or new_request_id()
+        tenant = sanitize_tenant_id(tenant)
         cls = slo_class or self.config.default_slo_class
-        ctx = rt.open(rid, traceparent=traceparent, slo_class=cls) \
+        ctx = rt.open(rid, traceparent=traceparent, slo_class=cls, tenant=tenant) \
             if rt is not None else None
 
         def refuse(status, payload, replica=None):
@@ -233,7 +277,7 @@ class ServingGateway:
                 self._next_uid += 1
             req = GatewayRequest(uid, prompt, max_new_tokens, cls,
                                  eos_token_id=eos_token_id, rid=rid, ctx=ctx,
-                                 sampling=sampling)
+                                 sampling=sampling, tenant=tenant)
             if ctx is not None:
                 # stamped here (not at admission) so too_large/shed records
                 # — exactly the always-retained tail — carry the real size
@@ -312,6 +356,8 @@ class ServingGateway:
                "router": self.router.state()}
         if self.reqtrace is not None:
             out["tracing"] = self.reqtrace.state()
+        if self.meter is not None:
+            out["metering"] = self.meter.state()
         return out
 
     def inflight_request_summaries(self) -> dict:
@@ -379,9 +425,19 @@ class ServingGateway:
                         self._json(200 if ready else 503,
                                    {"ready": ready, "draining": outer.draining},
                                    rid=rid)
+                    elif path == "/v1/usage":
+                        # the per-tenant ledger: top-K + aggregated `other`,
+                        # fairness index, starvation count — 404 when the
+                        # metering block is absent (there IS no ledger)
+                        if outer.meter is None:
+                            self._json(404, {"error": "metering_disabled"},
+                                       rid=rid)
+                        else:
+                            self._json(200, outer.meter.usage_report(), rid=rid)
                     else:
                         self._json(404, {"error": "not_found",
-                                         "paths": ["/v1/generate", "/healthz", "/readyz"]},
+                                         "paths": ["/v1/generate", "/v1/usage",
+                                                   "/healthz", "/readyz"]},
                                    rid=rid)
                 except (BrokenPipeError, ConnectionResetError):
                     pass
@@ -412,7 +468,8 @@ class ServingGateway:
                         rid=rid, traceparent=traceparent,
                         temperature=body.get("temperature"),
                         top_p=body.get("top_p"),
-                        seed=body.get("seed"))
+                        seed=body.get("seed"),
+                        tenant=self.headers.get("X-Tenant-Id"))
                     if status != 200:
                         self._json(status, result, rid=rid)
                         return
@@ -448,6 +505,7 @@ class ServingGateway:
                 try:
                     self.wfile.write(sse_frame({"meta": True, "uid": req.uid,
                                                 "request_id": req.rid,
+                                                "tenant": req.tenant,
                                                 "slo_class": req.slo_class,
                                                 "replica": req.replica_name,
                                                 "cached_tokens": req.cached_tokens}))

@@ -1,0 +1,692 @@
+"""Tenant-scoped resource metering & fairness observability.
+
+Every observability plane so far explains WHAT the fleet did (goodput
+categories, cache lifecycles, request stages) — none of them knows WHO
+consumed the resource. ROADMAP item 4 ("millions of users" = many tenants
+on one fleet, with quotas, weighted-fair queueing and tenant-scoped
+caches) cannot land without that attribution: a hot tenant starving the
+rest is invisible until users complain. This module is the attribution
+plane, in two halves:
+
+  * :class:`TenantMeter` — one per gateway. Accumulates per-tenant
+    resource-time integrals fed by narrow hooks on the existing
+    measurement points:
+
+      - **tokens**: uncached prefill tokens charged vs prefix-cache
+        tokens saved (admission's probe numbers), generated tokens, and
+        per-tenant hit ATTRIBUTION — hits split into self-hits vs
+        cross-tenant hits via tenant-stamped published radix-tree blocks,
+        with the publishing tenant credited ``served_tokens`` (the
+        cross-subsidy ledger item 4's tenant-prefixed radix keys need);
+      - **KV-block-seconds**: per-block allocate→physical-free intervals
+        charged to the block's stamped owner (the same allocator
+        lifecycle surface ``CacheTelemetry`` rides), so the sum over
+        tenants equals the pool's occupancy integral by construction —
+        test-enforced against cache telemetry's independent integral;
+      - **compute-seconds**: the scheduler's step-observer apportionment
+        (PR 7) extended to decode/verify bursts — each engine forward's
+        wall clock split across its batch by token share, bucketed
+        prefill/decode/spec_verify so the tenant sum reconciles with the
+        PR 14 goodput ledger's serving active categories (test-enforced
+        within 5%);
+      - **queue-seconds** per SLO class, stamped at replica dequeue;
+      - **shed/429 accounting per tenant** (the admission satellite): a
+        shed caused by one tenant's burst is now distinguishable from
+        systemic overload.
+
+    On top of the ledgers: per-tenant share-of-capacity gauges, a
+    dominant-resource-fairness index (Jain's index over each tenant's
+    dominant resource share — 1.0 = perfectly fair), and STARVATION
+    instants: when a tenant's windowed p99 queue wait detaches from the
+    global p99 (factor + floor, latched per tenant), a
+    ``serving/tenant_starvation`` trace instant + counter fires naming
+    the tenant.
+
+  * :class:`EngineMeterView` — the per-engine adapter (one per replica;
+    block ids are engine-local). Owns the per-block owner/alloc-time
+    stamp arrays and forwards tenant-level prefix-cache events up to the
+    gateway's meter. Engines reach it only through
+    ``InferenceEngineV2.set_tenant_meter`` — the request plane itself
+    never touches engine internals (the ``check_gateway_api`` contract).
+
+Cardinality is BOUNDED everywhere: at most ``max_tracked_tenants``
+ledgers exist (overflow folds into the ``other`` ledger), and the export
+side (``gauge_rows`` → labelled Prometheus rows ``serving/tenant_*``)
+emits the top-K tenants by spend plus one aggregated ``other`` row —
+``/metrics`` never carries more than K+1 distinct ``tenant`` label values
+regardless of how many tenants exist (``tools/check_tenant_labels.py``
+gates any tenant-labelled registration outside this module, and the bound
+is test-enforced). The per-tenant ledger is served by ``GET /v1/usage``
+and mirrored into an atomically-rotated usage JSONL (the reqtrace
+``RequestLog`` pattern) plus tenant rows in forensic stall dumps.
+
+Zero overhead when the ``serving.gateway.metering`` block is absent: no
+meter object, no engine views, no stamp arrays, no threads, no
+per-request allocations — every hook site is one ``is not None`` check
+(test-enforced, the PR 5 contract).
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..monitor.flight import get_flight_recorder
+from ..monitor.metrics import get_metrics
+from ..monitor.trace import get_tracer
+from .reqtrace import RequestLog, sanitize_request_id
+
+# the identity every request carries when the client sent none: metering
+# still charges SOMEONE, and an all-anonymous fleet degrades to exactly
+# the pre-metering aggregate view
+DEFAULT_TENANT = "default"
+
+# export-row name for everything past the top-K cut and for ledgers folded
+# at the max_tracked_tenants bound
+OTHER_TENANT = "other"
+
+# owner bucket for blocks allocated outside any tenanted request (engine
+# warmup, direct scheduler use): disclosed, never silently dropped — the
+# KV conservation check needs every occupied block-second attributed
+UNTENANTED = "untenanted"
+
+_COMPUTE_KINDS = ("prefill", "decode", "spec_verify")
+
+
+# names the meter itself emits: a client must not be able to collide with
+# the aggregate bucket (duplicate Prometheus series) or the disclosed
+# residual (silent overwrite in kv_block_seconds)
+_RESERVED_TENANTS = (OTHER_TENANT, UNTENANTED)
+
+
+def sanitize_tenant_id(raw) -> str:
+    """Fold a client-supplied ``X-Tenant-Id`` into the request-id charset
+    and length bound (header-safe, label-safe, log-safe — the exact
+    ``sanitize_request_id`` discipline). Absent/empty/hostile-only input
+    yields :data:`DEFAULT_TENANT`, never None: every request is charged to
+    SOME tenant. The meter's own sentinel names (``other``,
+    ``untenanted``) are escaped with an ``x-`` prefix so a client can
+    never impersonate the aggregate bucket or the disclosed residual."""
+    rid = sanitize_request_id(raw) or DEFAULT_TENANT
+    if rid in _RESERVED_TENANTS:
+        return "x-" + rid
+    return rid
+
+
+class _TenantLedger:
+    """Accumulators for ONE tenant. Plain slots, mutated under the owning
+    meter's lock; ``snapshot`` is the JSON-able read side."""
+
+    __slots__ = ("name", "requests", "completed", "cancelled", "shed",
+                 "uncached_tokens", "cached_tokens", "generated_tokens",
+                 "computed_tokens", "hit_tokens_self", "hit_tokens_cross",
+                 "served_tokens", "published_blocks", "evicted_blocks",
+                 "kv_block_s", "compute_s", "queue_s", "starvations",
+                 "waits", "starved", "shed_reasons")
+
+    def __init__(self, name, wait_window=64):
+        self.name = name
+        self.requests = 0
+        self.completed = 0
+        self.cancelled = 0
+        self.shed = 0
+        self.uncached_tokens = 0
+        self.cached_tokens = 0
+        self.generated_tokens = 0
+        self.computed_tokens = 0
+        self.hit_tokens_self = 0      # hits on blocks this tenant published
+        self.hit_tokens_cross = 0     # hits on another tenant's blocks
+        self.served_tokens = 0        # producer credit: others hit OUR blocks
+        self.published_blocks = 0
+        self.evicted_blocks = 0       # eviction pressure: OUR blocks evicted
+        self.kv_block_s = 0.0
+        self.compute_s = {k: 0.0 for k in _COMPUTE_KINDS}
+        self.queue_s: Dict[str, float] = {}
+        self.starvations = 0
+        self.waits = deque(maxlen=max(8, int(wait_window)))
+        self.starved = False          # starvation latch (one instant per episode)
+        self.shed_reasons: Dict[str, int] = {}
+
+    @property
+    def compute_total_s(self) -> float:
+        return sum(self.compute_s.values())
+
+    @property
+    def queue_total_s(self) -> float:
+        return sum(self.queue_s.values())
+
+    def spend(self) -> float:
+        """The top-K ranking key: resource-time actually consumed."""
+        return self.compute_total_s + self.kv_block_s
+
+    def merge_into(self, other: "_TenantLedger") -> None:
+        """Fold this ledger into ``other`` (the export-side aggregation of
+        everything past the top-K cut)."""
+        other.requests += self.requests
+        other.completed += self.completed
+        other.cancelled += self.cancelled
+        other.shed += self.shed
+        other.uncached_tokens += self.uncached_tokens
+        other.cached_tokens += self.cached_tokens
+        other.generated_tokens += self.generated_tokens
+        other.computed_tokens += self.computed_tokens
+        other.hit_tokens_self += self.hit_tokens_self
+        other.hit_tokens_cross += self.hit_tokens_cross
+        other.served_tokens += self.served_tokens
+        other.published_blocks += self.published_blocks
+        other.evicted_blocks += self.evicted_blocks
+        other.kv_block_s += self.kv_block_s
+        for k, v in self.compute_s.items():
+            other.compute_s[k] += v
+        for c, v in self.queue_s.items():
+            other.queue_s[c] = other.queue_s.get(c, 0.0) + v
+        for r, v in self.shed_reasons.items():
+            other.shed_reasons[r] = other.shed_reasons.get(r, 0) + v
+        other.starvations += self.starvations
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": self.requests, "completed": self.completed,
+            "cancelled": self.cancelled, "shed": self.shed,
+            "shed_reasons": dict(self.shed_reasons),
+            "uncached_tokens": self.uncached_tokens,
+            "cached_tokens": self.cached_tokens,
+            "generated_tokens": self.generated_tokens,
+            "computed_tokens": self.computed_tokens,
+            "hit_tokens_self": self.hit_tokens_self,
+            "hit_tokens_cross": self.hit_tokens_cross,
+            "served_tokens": self.served_tokens,
+            "published_blocks": self.published_blocks,
+            "evicted_blocks": self.evicted_blocks,
+            "kv_block_s": round(self.kv_block_s, 6),
+            "compute_s": {k: round(v, 6) for k, v in self.compute_s.items()},
+            "compute_total_s": round(self.compute_total_s, 6),
+            "queue_s": {c: round(v, 6) for c, v in self.queue_s.items()},
+            "starvations": self.starvations,
+        }
+
+
+class EngineMeterView:
+    """Per-engine block-lifecycle adapter for one :class:`TenantMeter`.
+
+    Block ids are engine-local, so owner/alloc-time stamp arrays live here
+    (pre-allocated to the pool size — the CacheTelemetry discipline: no
+    per-block dict entries). ``on_allocate``/``on_free`` ride the SAME
+    allocator lifecycle hooks CacheTelemetry does; ``stamp`` associates an
+    owner when the tenanted layer (state manager / prefix cache) knows
+    one. Physical free charges the block's whole resident interval to its
+    owner, so summed tenant KV-block-seconds equal the pool's occupancy
+    integral by construction (unfreed blocks contribute their partial
+    interval at report time via :meth:`inflight_kv_s`).
+    """
+
+    def __init__(self, meter: "TenantMeter", num_blocks: int,
+                 clock=time.perf_counter):
+        self.meter = meter
+        self.num_blocks = int(num_blocks)
+        self._clock = clock
+        self._alloc_t = np.zeros(self.num_blocks, np.float64)
+        self._allocated = np.zeros(self.num_blocks, bool)
+        self._owner: List[Optional[str]] = [None] * self.num_blocks
+
+    # -- allocator lifecycle hooks (the CacheTelemetry surface) ---------
+    def on_allocate(self, blocks) -> None:
+        now = self._clock()
+        for b in blocks:
+            b = int(b)
+            self._alloc_t[b] = now
+            self._allocated[b] = True
+            self._owner[b] = None
+
+    def on_free(self, blocks) -> None:
+        now = self._clock()
+        for b in blocks:
+            b = int(b)
+            if not self._allocated[b]:
+                continue
+            self.meter.charge_kv(self._owner[b], now - self._alloc_t[b])
+            self._allocated[b] = False
+            self._owner[b] = None
+
+    def stamp(self, blocks, tenant: Optional[str]) -> None:
+        """Associate an owner with live blocks (state-manager growth, COW
+        copies). A re-stamp overwrites — the last tenanted holder to
+        materialize content owns the residency."""
+        if tenant is None:
+            return
+        for b in blocks:
+            self._owner[int(b)] = tenant
+
+    def owner_of(self, block: int) -> Optional[str]:
+        return self._owner[int(block)]
+
+    def inflight_kv_s(self) -> Dict[str, float]:
+        """Partial block-second charges for blocks still resident, per
+        owner (``UNTENANTED`` for unstamped) — the report-time complement
+        of the free-time charges."""
+        now = self._clock()
+        out: Dict[str, float] = {}
+        for b in np.nonzero(self._allocated)[0]:
+            t = self._owner[int(b)] or UNTENANTED
+            out[t] = out.get(t, 0.0) + float(now - self._alloc_t[int(b)])
+        return out
+
+    def retire(self) -> Dict[str, float]:
+        """Detach-time settlement: return the in-flight residency charges
+        and clear every allocated bit — a retired view contributes nothing
+        further (it can never see ``on_free`` again)."""
+        settled = self.inflight_kv_s()
+        self._allocated[:] = False
+        return settled
+
+    # -- prefix-cache forwards (tenant-level, engine-agnostic) ----------
+    def on_prefix_hit(self, tenant, owners, tokens_by_owner) -> None:
+        self.meter.on_prefix_hit(tenant, owners, tokens_by_owner)
+
+    def on_publish(self, tenant, n_blocks) -> None:
+        self.meter.on_publish(tenant, n_blocks)
+
+    def on_evict(self, owner) -> None:
+        self.meter.on_evict(owner)
+
+
+class TenantMeter:
+    """The gateway's tenant attribution plane (see module docstring).
+
+    Thread-safety: hooks arrive from HTTP handler threads (admission),
+    every replica driver (compute/queue/terminal), and engine internals
+    (block lifecycle via the views) — all accumulation serializes on one
+    lock; reads (:meth:`usage_report`, :meth:`gauge_rows`) snapshot under
+    the same lock. No hook ever calls back into the serving plane while
+    holding it."""
+
+    def __init__(self, config, slo_classes=None, clock=time.perf_counter):
+        self.config = config
+        self.slo_classes = dict(slo_classes or {})
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _TenantLedger] = {}
+        self._other = _TenantLedger(OTHER_TENANT, config.starvation_window)
+        self._untenanted_kv_s = 0.0
+        self._views: List[EngineMeterView] = []
+        self._global_waits = deque(maxlen=max(16, int(config.starvation_window) * 4))
+        self._t0 = time.time()
+        self._terminals = 0
+        self.stats = {"tenants_seen": 0, "folded_other": 0, "starvations": 0,
+                      "usage_records": 0}
+        self.usage_log = (RequestLog(config.usage_log_path,
+                                     config.usage_log_max_bytes,
+                                     config.usage_log_max_files)
+                          if config.usage_log_path else None)
+
+    # -- engine attachment ----------------------------------------------
+    def engine_view(self, num_blocks: int) -> EngineMeterView:
+        """A per-engine block-lifecycle adapter (replicas each get one;
+        block ids are engine-local). Kept for report-time in-flight
+        charges; the engine returns it via :meth:`drop_view` on detach."""
+        view = EngineMeterView(self, num_blocks, clock=self._clock)
+        with self._lock:
+            self._views.append(view)
+        return view
+
+    def drop_view(self, view) -> None:
+        """Retire a detached engine view (gateway ``stop()``): the view's
+        in-flight residency charges are SETTLED into the ledgers first —
+        blocks still resident at detach paid for their interval so far —
+        then the view stops contributing. Without this, a stopped
+        gateway's view would keep its allocated bits forever (it can no
+        longer see ``on_free``) and accrue phantom KV-block-seconds that
+        grow with wall clock."""
+        settled = view.retire()
+        for t, s in settled.items():
+            self.charge_kv(None if t == UNTENANTED else t, s)
+        with self._lock:
+            if view in self._views:
+                self._views.remove(view)
+
+    # -- ledger plumbing -------------------------------------------------
+    def _ledger(self, tenant: Optional[str]) -> _TenantLedger:
+        """Get-or-create under the caller's lock. Past
+        ``max_tracked_tenants`` distinct tenants, new ones fold into the
+        ``other`` ledger — the meter's memory is bounded no matter how
+        many tenant ids a hostile client invents."""
+        led = self._tenants.get(tenant)
+        if led is not None:
+            return led
+        if tenant is None:
+            return self._other
+        if len(self._tenants) >= self.config.max_tracked_tenants:
+            self.stats["folded_other"] += 1
+            return self._other
+        led = self._tenants[tenant] = _TenantLedger(
+            tenant, self.config.starvation_window)
+        self.stats["tenants_seen"] += 1
+        return led
+
+    # -- admission hooks -------------------------------------------------
+    def on_admitted(self, tenant, uncached_tokens, cached_tokens) -> None:
+        with self._lock:
+            led = self._ledger(tenant)
+            led.requests += 1
+            led.uncached_tokens += int(uncached_tokens)
+            led.cached_tokens += int(cached_tokens)
+
+    def on_shed(self, tenant, slo_class, reason) -> None:
+        """The admission satellite: shed/429 accounting split by tenant —
+        ``Retry-After`` pressure caused by one tenant's burst is now
+        attributable instead of reading as systemic overload."""
+        with self._lock:
+            led = self._ledger(tenant)
+            led.shed += 1
+            led.shed_reasons[str(reason)] = led.shed_reasons.get(str(reason), 0) + 1
+
+    # -- replica hooks ----------------------------------------------------
+    def on_queue_wait(self, tenant, slo_class, wait_s, rid=None) -> None:
+        """Queue-seconds per SLO class + the starvation detector: when this
+        tenant's windowed p99 queue wait detaches from the GLOBAL MEDIAN
+        wait (``starvation_factor`` above it AND past the absolute floor),
+        one latched ``serving/tenant_starvation`` instant fires — re-armed
+        when the tenant's p99 re-attaches. The comparison baseline is the
+        global p50, not the global p99: a starving tenant IS the global
+        tail, so its own waits would contaminate a p99 baseline and mask
+        exactly the detachment being detected."""
+        wait_s = max(0.0, float(wait_s))
+        starved_now = None
+        with self._lock:
+            led = self._ledger(tenant)
+            led.queue_s[slo_class] = led.queue_s.get(slo_class, 0.0) + wait_s
+            led.waits.append(wait_s)
+            self._global_waits.append(wait_s)
+            if len(led.waits) >= 8 and len(self._global_waits) >= 16:
+                t_p99 = float(np.percentile(np.asarray(led.waits), 99))
+                g_p50 = float(np.percentile(np.asarray(self._global_waits), 50))
+                detached = (t_p99 >= self.config.starvation_min_wait_s
+                            and t_p99 > self.config.starvation_factor * g_p50)
+                if detached and not led.starved:
+                    led.starved = True
+                    led.starvations += 1
+                    self.stats["starvations"] += 1
+                    starved_now = (led.name, t_p99, g_p50)
+                elif not detached:
+                    led.starved = False
+        if starved_now is not None:
+            name, t_p99, g_p50 = starved_now
+            get_metrics().counter("serving/tenant_starvation_total").inc()
+            get_tracer().instant("serving/tenant_starvation", tid="serving",
+                                 request_id=rid, tenant=name,
+                                 tenant_p99_wait_ms=round(t_p99 * 1e3, 3),
+                                 global_p50_wait_ms=round(g_p50 * 1e3, 3))
+            get_flight_recorder().record("serving", "tenant_starvation",
+                                         tenant=name, request_id=rid,
+                                         tenant_p99_wait_ms=round(t_p99 * 1e3, 3))
+
+    def on_compute(self, tenant, kind, seconds, tokens=0) -> None:
+        """One request's share of one engine forward's wall clock (the
+        scheduler step-observer apportionment), bucketed
+        prefill/decode/spec_verify."""
+        if seconds <= 0.0 and not tokens:
+            return
+        with self._lock:
+            led = self._ledger(tenant)
+            led.compute_s[kind] += max(0.0, float(seconds))
+            led.computed_tokens += int(tokens)
+
+    def on_terminal(self, tenant, rid, slo_class, finish_reason,
+                    generated_tokens, cancelled=False) -> None:
+        """Terminal accounting + the usage JSONL: one per-request record,
+        and every ``ledger_snapshot_every`` terminals a full per-tenant
+        ledger snapshot line (both via the atomically-rotated
+        ``RequestLog``)."""
+        with self._lock:
+            led = self._ledger(tenant)
+            led.generated_tokens += int(generated_tokens)
+            if cancelled:
+                led.cancelled += 1
+            else:
+                led.completed += 1
+            self._terminals += 1
+            write_ledger = (self.usage_log is not None
+                            and self.config.ledger_snapshot_every > 0
+                            and self._terminals % self.config.ledger_snapshot_every == 0)
+        if self.usage_log is None:
+            return
+        try:
+            self.usage_log.write({
+                "kind": "request", "t_unix": time.time(), "tenant": tenant,
+                "request_id": rid, "slo_class": slo_class,
+                "finish_reason": finish_reason,
+                "generated_tokens": int(generated_tokens)})
+            if write_ledger:
+                self.usage_log.write({"kind": "ledger", **self.usage_report()})
+            self.stats["usage_records"] += 1
+        except Exception as e:  # noqa: BLE001 — metering runs on the replica
+            # driver thread: a full disk costs the record, never the loop
+            self.stats["log_errors"] = self.stats.get("log_errors", 0) + 1
+            self._log().error(f"usage log write failed: {e!r}")
+
+    # -- KV / prefix-cache hooks (via EngineMeterView) --------------------
+    def charge_kv(self, tenant, seconds) -> None:
+        seconds = max(0.0, float(seconds))
+        with self._lock:
+            if tenant is None:
+                self._untenanted_kv_s += seconds
+            else:
+                self._ledger(tenant).kv_block_s += seconds
+
+    def on_prefix_hit(self, tenant, owners, tokens_by_owner) -> None:
+        """Hit attribution via tenant-stamped published blocks: the
+        consumer splits saved tokens into self vs cross-tenant, and each
+        publishing tenant is credited ``served_tokens`` — the
+        cross-subsidy ledger."""
+        with self._lock:
+            led = self._ledger(tenant)
+            for owner, tokens in zip(owners, tokens_by_owner):
+                tokens = int(tokens)
+                if owner == tenant:
+                    led.hit_tokens_self += tokens
+                else:
+                    led.hit_tokens_cross += tokens
+                if owner is not None:
+                    self._ledger(owner).served_tokens += tokens
+
+    def on_publish(self, tenant, n_blocks) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            self._ledger(tenant).published_blocks += int(n_blocks)
+
+    def on_evict(self, owner) -> None:
+        """Eviction pressure attributed to the evicted block's publisher —
+        the direct precursor of item 4's per-tenant cache namespaces."""
+        if owner is None:
+            return
+        with self._lock:
+            self._ledger(owner).evicted_blocks += 1
+
+    # -- read side ---------------------------------------------------------
+    def _kv_with_inflight_locked(self):
+        """(per-tenant kv_block_s incl. in-flight partials, untenanted
+        total) — charged intervals plus each live view's resident blocks."""
+        per = {name: led.kv_block_s for name, led in self._tenants.items()}
+        if self._other.kv_block_s:
+            per[OTHER_TENANT] = per.get(OTHER_TENANT, 0.0) + self._other.kv_block_s
+        unt = self._untenanted_kv_s
+        for view in self._views:
+            for t, s in view.inflight_kv_s().items():
+                if t == UNTENANTED:
+                    unt += s
+                else:
+                    per[t] = per.get(t, 0.0) + s
+        return per, unt
+
+    def kv_block_seconds(self) -> Dict[str, float]:
+        """Per-tenant KV-block-seconds including in-flight partials, with
+        the ``untenanted`` residual disclosed — the conservation test sums
+        this against cache telemetry's occupancy integral."""
+        with self._lock:
+            per, unt = self._kv_with_inflight_locked()
+        per[UNTENANTED] = unt
+        return per
+
+    def _fairness_locked(self, per_kv) -> Optional[float]:
+        """Jain's index over each tenant's DOMINANT resource share
+        (compute-seconds, KV-block-seconds, uncached tokens — the DRF
+        dominant share): 1.0 = perfectly fair, 1/N = one tenant holds
+        everything. None before any consumption. Caller holds the lock
+        and passes the per-tenant KV it already computed, so one report
+        reads one consistent snapshot (and pays one view scan, not two)."""
+        rows = [(led.compute_total_s, per_kv.get(name, 0.0),
+                 float(led.uncached_tokens))
+                for name, led in self._tenants.items()]
+        if not rows:
+            return None
+        totals = [sum(r[i] for r in rows) for i in range(3)]
+        dom = []
+        for r in rows:
+            shares = [r[i] / totals[i] for i in range(3) if totals[i] > 0]
+            if shares:
+                dom.append(max(shares))
+        if not dom or sum(dom) <= 0:
+            return None
+        return float(sum(dom) ** 2 / (len(dom) * sum(x * x for x in dom)))
+
+    def fairness_index(self) -> Optional[float]:
+        with self._lock:
+            per_kv, _unt = self._kv_with_inflight_locked()
+            return self._fairness_locked(per_kv)
+
+    def _top_k_locked(self):
+        """(top-K ledgers by spend, aggregated-rest ledger-or-None)."""
+        ranked = sorted(self._tenants.values(),
+                        key=lambda led: (led.spend(), led.uncached_tokens,
+                                         led.name),
+                        reverse=True)
+        top = ranked[:max(1, self.config.top_k)]
+        rest = ranked[len(top):]
+        other = None
+        if rest or self._other.requests or self._other.shed \
+                or self._other.spend() > 0:
+            other = _TenantLedger(OTHER_TENANT, 8)
+            self._other.merge_into(other)
+            for led in rest:
+                led.merge_into(other)
+        return top, other
+
+    def usage_report(self) -> dict:
+        """The ``GET /v1/usage`` payload: the top-K per-tenant ledgers +
+        the aggregated ``other`` bucket, fairness, and the disclosed
+        untenanted KV residual. In-flight KV partials are included so the
+        report is current, not free-lagged."""
+        with self._lock:
+            per_kv, unt = self._kv_with_inflight_locked()
+            top, other = self._top_k_locked()
+            tot_kv = sum(per_kv.values())
+            top_kv = 0.0
+            snaps = {}
+            for led in top:
+                s = led.snapshot()
+                kv_s = per_kv.get(led.name, 0.0)
+                top_kv += kv_s
+                s["kv_block_s"] = round(kv_s, 6)
+                snaps[led.name] = s
+            other_snap = other.snapshot() if other is not None else None
+            if other_snap is not None:
+                # everything per_kv holds beyond the top-K (folded ledgers
+                # AND the rest tenants' charges + in-flight partials) — the
+                # merged ledger alone misses the rest's live partials
+                other_snap["kv_block_s"] = round(max(0.0, tot_kv - top_kv), 6)
+            fi = self._fairness_locked(per_kv)
+            n_seen = self.stats["tenants_seen"]
+        return {
+            "since_unix": self._t0,
+            "wall_s": round(time.time() - self._t0, 3),
+            "tenants_seen": n_seen,
+            "top_k": self.config.top_k,
+            "fairness_index": fi,
+            "tenants": snaps,
+            "other": other_snap,
+            "untenanted_kv_block_s": round(unt, 6),
+            "starvations": self.stats["starvations"],
+        }
+
+    def gauge_rows(self):
+        """Labelled Prometheus rows for the health exporter — the ONLY
+        sanctioned source of ``tenant``-labelled metric rows
+        (``tools/check_tenant_labels.py`` gates every other site). Top-K
+        tenants + one aggregated ``other`` row per family: the scrape
+        carries at most K+1 distinct tenant label values."""
+        with self._lock:
+            per_kv, _unt = self._kv_with_inflight_locked()
+            top, other = self._top_k_locked()
+            rows = []
+            ledgers = [(led.name, led) for led in top]
+            tot_kv = sum(per_kv.values()) or 0.0
+            top_kv = {led.name: per_kv.get(led.name, 0.0) for led in top}
+            if other is not None:
+                # the aggregate row's KV is everything beyond the top-K
+                # (folded + rest tenants incl. their in-flight partials),
+                # so the exported family still sums to the pool total
+                top_kv[OTHER_TENANT] = max(0.0, tot_kv - sum(top_kv.values()))
+                ledgers.append((OTHER_TENANT, other))
+            tot_compute = sum(led.compute_total_s for _, led in ledgers) or 0.0
+            for name, led in ledgers:
+                labels = {"tenant": name}
+                kv_s = top_kv[name]
+                rows.append(("serving/tenant_uncached_tokens_total", labels,
+                             float(led.uncached_tokens)))
+                rows.append(("serving/tenant_cached_tokens_total", labels,
+                             float(led.cached_tokens)))
+                rows.append(("serving/tenant_generated_tokens_total", labels,
+                             float(led.generated_tokens)))
+                rows.append(("serving/tenant_compute_seconds_total", labels,
+                             led.compute_total_s))
+                rows.append(("serving/tenant_kv_block_seconds_total", labels, kv_s))
+                rows.append(("serving/tenant_queue_seconds_total", labels,
+                             led.queue_total_s))
+                rows.append(("serving/tenant_shed_total", labels, float(led.shed)))
+                rows.append(("serving/tenant_served_tokens_total", labels,
+                             float(led.served_tokens)))
+                rows.append(("serving/tenant_evicted_blocks_total", labels,
+                             float(led.evicted_blocks)))
+                rows.append(("serving/tenant_starvations_total", labels,
+                             float(led.starvations)))
+                if tot_compute > 0:
+                    rows.append(("serving/tenant_share",
+                                 {"tenant": name, "resource": "compute"},
+                                 led.compute_total_s / tot_compute))
+                if tot_kv > 0:
+                    rows.append(("serving/tenant_share",
+                                 {"tenant": name, "resource": "kv_blocks"},
+                                 kv_s / tot_kv))
+            n_seen = self.stats["tenants_seen"]
+            fi = self._fairness_locked(per_kv)
+        if fi is not None:
+            rows.append(("serving/tenant_fairness_index", {}, fi))
+        rows.append(("serving/tenants_tracked", {}, float(n_seen)))
+        return rows
+
+    def dump_rows(self) -> dict:
+        """Forensic stall-dump section: the usage report, so a wedged
+        replica's dump names which tenants held the fleet's resources."""
+        return self.usage_report()
+
+    def state(self) -> dict:
+        with self._lock:
+            n = len(self._tenants)
+        return {**self.stats, "tracked": n,
+                "fairness_index": self.fairness_index(),
+                "usage_log_path": self.config.usage_log_path or None,
+                "usage_log_written": self.usage_log.written if self.usage_log else 0}
+
+    def close(self) -> None:
+        if self.usage_log is not None:
+            self.usage_log.close()
+
+    @staticmethod
+    def _log():
+        from ..utils.logging import logger  # lazy: keep module import-light
+
+        return logger

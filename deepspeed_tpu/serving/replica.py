@@ -121,10 +121,11 @@ class GatewayRequest:
 
     __slots__ = ("uid", "prompt", "max_new_tokens", "slo_class", "eos_token_id",
                  "stream", "replica_name", "t_admitted", "cached_tokens",
-                 "uncached_tokens", "ttft_ms", "tpot_ms", "rid", "ctx", "sampling")
+                 "uncached_tokens", "ttft_ms", "tpot_ms", "rid", "ctx", "sampling",
+                 "tenant")
 
     def __init__(self, uid, prompt, max_new_tokens, slo_class, eos_token_id=None,
-                 rid=None, ctx=None, sampling=None):
+                 rid=None, ctx=None, sampling=None, tenant=None):
         self.uid = int(uid)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -142,6 +143,10 @@ class GatewayRequest:
         # header + SSE meta); ctx only when request tracing is configured
         self.rid = rid
         self.ctx = ctx
+        # sanitized tenant identity (X-Tenant-Id, DEFAULT_TENANT when
+        # absent): always carried so the request log and SSE meta can name
+        # the owner; the METER only exists when the config block asks
+        self.tenant = tenant
 
 
 class EngineReplica:
@@ -153,17 +158,29 @@ class EngineReplica:
     # fleet of replicas is not spinning on the admission lock
     IDLE_WAIT_S = 0.05
 
-    def __init__(self, name, engine, admission, config, reqtrace=None):
+    def __init__(self, name, engine, admission, config, reqtrace=None, meter=None):
         self.name = str(name)
         self.engine = engine
         self.config = config
         self._admission = admission
         self._reqtrace = reqtrace
+        # tenant metering plane (serving/metering.py): compute-seconds via
+        # the step observer, queue-seconds at dequeue, terminal accounting
+        # at close-out. None keeps every site at one attribute check and
+        # attaches NOTHING to the engine (the zero-overhead-off contract).
+        self._meter = meter
+        if meter is not None:
+            # per-block owner stamps + prefix-hit attribution ride the
+            # engine's own lifecycle hooks — wired through the ONE public
+            # entry (the check_gateway_api contract keeps the request
+            # plane out of engine internals)
+            engine.set_tenant_meter(meter)
         self._scheduler = DynamicSplitFuseScheduler(
             engine, token_budget=config.token_budget or None)
-        if reqtrace is not None:
-            # per-chunk prefill attribution rides the scheduler's step
-            # observer (None by default — the un-traced path is untouched)
+        if reqtrace is not None or meter is not None:
+            # per-chunk prefill attribution + per-tenant compute-second
+            # apportionment ride the scheduler's step observer (None by
+            # default — the un-traced, un-metered path is untouched)
             self._scheduler.step_observer = self._on_sched_step
         self._max_inflight = (config.max_inflight_per_replica
                               or engine.max_concurrent_sequences)
@@ -227,7 +244,7 @@ class EngineReplica:
         out = []
         for uid, req in list(self._streams.items()):
             row = {"request_id": req.rid, "uid": uid, "replica": self.name,
-                   "slo_class": req.slo_class,
+                   "tenant": req.tenant, "slo_class": req.slo_class,
                    "prompt_tokens": int(req.prompt.size),
                    "max_new_tokens": req.max_new_tokens,
                    "produced": req.stream.produced,
@@ -239,17 +256,34 @@ class EngineReplica:
             out.append(row)
         return out
 
-    def _on_sched_step(self, uids, chunk_sizes, t0, dur):
-        """Scheduler step observer: apportion one composed forward's wall
-        time across its prefill chunks (a request still pre-first-token is
-        by definition prefilling)."""
+    def _on_sched_step(self, uids, chunk_sizes, t0, dur, kind="put"):
+        """Scheduler step observer: apportion one engine forward's wall
+        time across the requests whose chunks composed it, by token share.
+        Two consumers ride the same apportionment:
+
+          * request tracing — per-chunk prefill spans for ``put`` steps
+            (a request still pre-first-token is by definition prefilling);
+          * tenant metering — compute-seconds charged to each request's
+            tenant, bucketed prefill/decode/spec_verify so the per-tenant
+            sum reconciles with the goodput ledger's serving active
+            categories (the conservation acceptance bar).
+        """
         total = sum(chunk_sizes) or 1
+        meter = self._meter
         for uid, n in zip(uids, chunk_sizes):
             req = self._streams.get(uid)
-            if req is None or req.ctx is None:
+            if req is None:
                 continue
-            if req.stream.first_token_t is None:
-                self._reqtrace.on_prefill_chunk(req, n, t0, dur * (n / total))
+            share = dur * (n / total)
+            if kind == "put" and req.ctx is not None \
+                    and req.stream.first_token_t is None:
+                self._reqtrace.on_prefill_chunk(req, n, t0, share)
+            if meter is not None:
+                if kind == "put":
+                    bucket = "prefill" if n > 1 else "decode"
+                else:
+                    bucket = kind  # "decode" | "spec_verify"
+                meter.on_compute(req.tenant, bucket, share, tokens=n)
 
     def cancel(self, uid: int):
         """Request abort of ``uid`` (client timed out / disconnected). The
@@ -466,6 +500,10 @@ class EngineReplica:
             self._inflight -= 1
             req.stream.finish(reason="error", error="cancelled")
             get_metrics().counter(f"gateway/cancelled_{req.slo_class}_total").inc()
+            if self._meter is not None:
+                self._meter.on_terminal(req.tenant, req.rid, req.slo_class,
+                                        "cancelled", req.stream.produced,
+                                        cancelled=True)
             if self._reqtrace is not None:
                 # the stream latched its REAL terminal first (timeout /
                 # disconnect / explicit cancel) — finalize reads it
@@ -481,7 +519,8 @@ class EngineReplica:
                 self._scheduler.submit(req.uid, req.prompt,
                                        max_new_tokens=req.max_new_tokens,
                                        eos_token_id=req.eos_token_id,
-                                       sampling=req.sampling)
+                                       sampling=req.sampling,
+                                       tenant=req.tenant)
             except Exception as e:  # validation said yes, scheduler said no
                 req.stream.finish(reason="error", error=f"{type(e).__name__}: {e}")
                 if self._reqtrace is not None:
@@ -489,6 +528,13 @@ class EngineReplica:
                 continue
             if self._reqtrace is not None and req.ctx is not None:
                 self._reqtrace.on_dequeue(req)
+            if self._meter is not None and req.t_admitted is not None:
+                # queue-seconds per SLO class, stamped at the replica pull
+                # (the same admitted->dequeued interval the tracing stage
+                # breakdown measures) — also feeds the starvation detector
+                self._meter.on_queue_wait(
+                    req.tenant, req.slo_class,
+                    time.perf_counter() - req.t_admitted, rid=req.rid)
             self._streams[req.uid] = req
             self._inflight += 1
             pulled = True
@@ -559,6 +605,9 @@ class EngineReplica:
             if cls.tpot_target_ms > 0 and (req.tpot_ms or 0) > cls.tpot_target_ms:
                 get_metrics().counter(f"gateway/slo_tpot_miss_{req.slo_class}_total").inc()
         get_metrics().counter(f"gateway/completed_{req.slo_class}_total").inc()
+        if self._meter is not None:
+            self._meter.on_terminal(req.tenant, req.rid, req.slo_class,
+                                    reason, n)
         if self._reqtrace is not None:
             # finalize BEFORE the stream latches done: the HTTP handler
             # wakes on finish and may read the request log immediately —

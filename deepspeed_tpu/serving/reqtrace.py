@@ -96,17 +96,19 @@ class RequestContext:
     ``time.perf_counter`` clock so stage durations and end-to-end latency
     subtract exactly (no cross-clock skew in the breakdown)."""
 
-    __slots__ = ("rid", "traceparent", "slo_class", "sampled", "closed",
+    __slots__ = ("rid", "traceparent", "slo_class", "tenant", "sampled", "closed",
                  "t_recv", "t_admitted", "t_dequeued", "t_first_token",
                  "t_last_token", "t_done",
                  "route_choice", "route_policy", "route_scores",
                  "prefix_hit_tokens", "prompt_tokens",
                  "prefill_chunks", "prefill_compute_ms")
 
-    def __init__(self, rid, traceparent=None, slo_class=None, sampled=True):
+    def __init__(self, rid, traceparent=None, slo_class=None, sampled=True,
+                 tenant=None):
         self.rid = rid
         self.traceparent = traceparent
         self.slo_class = slo_class
+        self.tenant = tenant
         self.sampled = sampled
         self.closed = False
         self.t_recv = time.perf_counter()
@@ -226,9 +228,10 @@ class RequestTracing:
         return (zlib.crc32(rid.encode("utf-8")) % 10_000) < rate * 10_000
 
     # -- lifecycle ------------------------------------------------------
-    def open(self, rid, traceparent=None, slo_class=None) -> RequestContext:
+    def open(self, rid, traceparent=None, slo_class=None,
+             tenant=None) -> RequestContext:
         ctx = RequestContext(rid, traceparent=traceparent, slo_class=slo_class,
-                             sampled=self.head_sample(rid))
+                             sampled=self.head_sample(rid), tenant=tenant)
         self.stats["opened"] += 1
         return ctx
 
@@ -368,7 +371,7 @@ class RequestTracing:
                       "tpot_ms": round(req.tpot_ms, 3) if req.tpot_ms else None})
         record = {
             "request_id": ctx.rid, "uid": req.uid,
-            "traceparent": ctx.traceparent,
+            "traceparent": ctx.traceparent, "tenant": ctx.tenant,
             "slo_class": ctx.slo_class, "replica": req.replica_name,
             "finish_reason": finish_reason, "error": error,
             "slo_verdict": verdict, "t_unix": time.time(),
@@ -418,7 +421,7 @@ class RequestTracing:
                                      reason=str(reason))
         record = {
             "request_id": ctx.rid, "traceparent": ctx.traceparent,
-            "slo_class": ctx.slo_class, "replica": replica,
+            "tenant": ctx.tenant, "slo_class": ctx.slo_class, "replica": replica,
             "finish_reason": finish, "error": str(reason),
             "slo_verdict": "n/a", "t_unix": time.time(), "status": int(status),
             "n_tokens": 0, "prompt_tokens": ctx.prompt_tokens,

@@ -1,0 +1,130 @@
+"""Static check: tenant-label cardinality discipline.
+
+Companion to ``check_metric_names.py`` (same lesson: structural invariants
+rot silently unless CI asserts them). Unbounded tenant-cardinality
+Prometheus rows are a fleet-killer: one hostile client inventing tenant
+ids per request grows the scrape (and every downstream TSDB) without
+bound. The ONLY sanctioned source of a ``tenant`` metric label is the
+bounded top-K aggregator in ``serving/metering.py`` (``TenantMeter
+.gauge_rows``: top-K tenants by spend + ONE aggregated ``other`` row, so
+``/metrics`` never carries more than K+1 distinct tenant label values).
+
+Two rules, AST-checked with no package imports so the gate runs anywhere:
+
+  1. **No tenant-labelled gauge rows outside metering.py.** A labelled
+     exporter row is the 3-tuple ``(name, {labels}, value)`` (the
+     ``HealthPlane.set_gauge_provider`` shape): any such tuple literal
+     whose label dict carries a ``"tenant"`` key, anywhere under
+     ``deepspeed_tpu/`` except ``serving/metering.py``, is a violation —
+     route the row through the meter's aggregator instead.
+  2. **No tenant-named registry metrics outside metering.py.** Any
+     ``counter``/``gauge``/``histogram`` registration whose literal (or
+     f-string head) name contains ``tenant`` outside ``serving/metering.py``
+     is a violation — per-tenant series belong behind the top-K bound,
+     and an f-string interpolating a tenant id into a metric NAME is the
+     same unbounded-cardinality bug wearing a different hat.
+
+A tier-1 test (``tests/test_tenant_metering.py``) runs this on every CI
+pass, with the usual drift-catch (a synthetic violating tree must fail).
+"""
+
+import ast
+import os
+import sys
+
+DEFAULT_PKG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                               "deepspeed_tpu")
+
+# the one module allowed to emit tenant-labelled rows / tenant-named metrics
+ALLOWED_MODULE = os.path.join("serving", "metering.py")
+
+REGISTRATION_CALLS = ("counter", "gauge", "histogram")
+
+
+def _dict_has_tenant_key(node) -> bool:
+    if not isinstance(node, ast.Dict):
+        return False
+    return any(isinstance(k, ast.Constant) and k.value == "tenant"
+               for k in node.keys)
+
+
+def _is_tenant_labelled_row(node) -> bool:
+    """A ``(name, {...'tenant'...}, value)`` gauge-row tuple literal."""
+    if not isinstance(node, ast.Tuple) or len(node.elts) != 3:
+        return False
+    name = node.elts[0]
+    name_ok = (isinstance(name, ast.Constant) and isinstance(name.value, str)) \
+        or isinstance(name, ast.JoinedStr)
+    return name_ok and _dict_has_tenant_key(node.elts[1])
+
+
+def _registration_name(node):
+    """The literal/f-string-head metric name of a registration call, or
+    None when the call is not a registration (or the name is dynamic)."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr in REGISTRATION_CALLS and node.args):
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr) and arg.values \
+            and isinstance(arg.values[0], ast.Constant) \
+            and isinstance(arg.values[0].value, str):
+        return arg.values[0].value
+    return None
+
+
+def find_violations(pkg_dir=DEFAULT_PKG_DIR):
+    """[(relpath, lineno, snippet, why)] for every tenant-label escape."""
+    violations = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, pkg_dir)
+            if rel == ALLOWED_MODULE:
+                continue
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+            lines = src.splitlines()
+
+            def flag(node, why):
+                snippet = lines[node.lineno - 1].strip() if node.lineno <= len(lines) else ""
+                violations.append((rel, node.lineno, snippet, why))
+
+            for node in ast.walk(tree):
+                if _is_tenant_labelled_row(node):
+                    flag(node, "tenant-labelled gauge row outside serving/metering.py "
+                               "— route it through TenantMeter's bounded top-K "
+                               "aggregator")
+                name = _registration_name(node)
+                if name is not None and "tenant" in name:
+                    flag(node, f"metric registration {name!r} carries 'tenant' "
+                               "outside serving/metering.py — per-tenant series "
+                               "belong behind the top-K bound")
+    return violations
+
+
+def check(pkg_dir=DEFAULT_PKG_DIR):
+    """Return the violation list (empty = every tenant label is bounded)."""
+    return find_violations(pkg_dir)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    pkg_dir = argv[0] if argv else DEFAULT_PKG_DIR
+    bad = check(pkg_dir)
+    if bad:
+        print(f"check_tenant_labels: unbounded tenant-label escapes in {pkg_dir}:")
+        for rel, lineno, snippet, why in bad:
+            print(f"  {rel}:{lineno}: {why}\n      {snippet}")
+        return 1
+    print("check_tenant_labels: every tenant-labelled metric routes through "
+          "the bounded top-K aggregator in serving/metering.py")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,14 +48,24 @@ HIGHER_BETTER_NAMES = ("value", "mfu", "accept_rate", "hit_rate", "ratio")
 # wall-clock ACCOUNTING fields, not performance metrics: a longer bench run
 # is not a regression. The whole goodput block is attribution (its *_s
 # leaves would otherwise hit the generic latency rule), as are the
-# disclosure leaves wherever they appear.
-NEUTRAL_PREFIXES = ("goodput.",)
+# disclosure leaves wherever they appear. The tenants block mirrors the
+# goodput neutrality rule: per-tenant counters/seconds are ATTRIBUTION of
+# whatever the round consumed (a different tenant mix is not a
+# regression) — only its fairness index carries a direction.
+NEUTRAL_PREFIXES = ("goodput.", "tenants.")
 NEUTRAL_NAMES = ("wall_s", "unattributed_s", "overbooked_s", "recovery_badput_s")
+
+# direction overrides that win over the neutral prefixes: the fairness
+# index inside the tenants block IS a performance verdict (higher = the
+# fleet shares capacity more evenly under the same adversarial load)
+HIGHER_BETTER_LEAVES = ("fairness_index",)
 
 
 def metric_direction(metric):
     """'lower' | 'higher' | None (unknown/neutral) for a dotted name."""
     leaf = metric.rsplit(".", 1)[-1]
+    if leaf in HIGHER_BETTER_LEAVES:
+        return "higher"
     if metric.startswith(NEUTRAL_PREFIXES) or leaf in NEUTRAL_NAMES:
         return None
     if leaf.endswith(HIGHER_BETTER_SUFFIXES) or leaf in HIGHER_BETTER_NAMES:

@@ -34,6 +34,14 @@ prefix-aware-router vs random-placement A/B on the Zipf shared-prefix
 workload (same engines, caches cleared between arms — strictly higher
 aggregate hit rate is the acceptance bar). CLI: ``python
 tools/serving_load.py gateway`` emits both as one JSON line.
+
+PR 15 added the **multi-tenant** face: :func:`make_multi_tenant_workload`
+(N Zipf-share tenants + one adversarial hot tenant, per-tenant prefix
+pools, rows carry ``tenant`` → sent as ``X-Tenant-Id``) and
+:func:`multi_tenant_bench` — closed-loop HTTP with the metering plane
+armed, reporting the fairness index, per-tenant client-side TTFT/TPOT and
+hit rates, and the hot tenant's compute share (``bench.py``'s
+``tenants{...}`` block; CLI ``multi_tenant``).
 """
 
 import json
@@ -98,6 +106,48 @@ def make_shared_prefix_workload(n_requests, n_prefixes, prefix_len, suffix_lo, s
             "arrival": float(arrivals[i]),
             "prompt": np.concatenate([prefix, suffix]),
             "max_new_tokens": int(rng.integers(new_lo, new_hi + 1)),
+        })
+    return work
+
+
+def make_multi_tenant_workload(n_requests, n_tenants=4, zipf_a=1.3,
+                               hot_tenant="hot", hot_share=0.4,
+                               n_prefixes_per_tenant=2, prefix_len=24,
+                               suffix_lo=4, suffix_hi=10, new_lo=3, new_hi=8,
+                               hot_new_mult=2, rate_rps=None, seed=0, uid_base=0):
+    """Multi-tenant workload (the ISSUE 15 shape): ``n_tenants`` tenants
+    with Zipf-skewed traffic shares plus ONE adversarial hot tenant taking
+    ``hot_share`` of all requests with ``hot_new_mult``x longer generations
+    — the starve-the-rest scenario the fairness observability exists to
+    make visible. Each tenant owns its own small prefix pool (its few-shot
+    templates), so per-tenant hit rates and cross-tenant hit attribution
+    are both meaningful. Rows carry ``tenant`` (sent as ``X-Tenant-Id`` by
+    the HTTP load generator); arrival semantics as :func:`make_workload`."""
+    rng = np.random.default_rng(seed)
+    if rate_rps is None:
+        arrivals = np.zeros(n_requests)
+    else:
+        arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, size=n_requests))
+    names = [f"t{i}" for i in range(n_tenants)]
+    pools = {t: [rng.integers(0, 100, size=prefix_len).astype(np.int32)
+                 for _ in range(n_prefixes_per_tenant)]
+             for t in names + [hot_tenant]}
+    ranks = (rng.zipf(zipf_a, size=n_requests) - 1) % n_tenants
+    hot_mask = rng.random(n_requests) < hot_share
+    work = []
+    for i in range(n_requests):
+        tenant = hot_tenant if hot_mask[i] else names[int(ranks[i])]
+        prefix = pools[tenant][int(rng.integers(len(pools[tenant])))]
+        suffix = rng.integers(0, 100, size=int(rng.integers(suffix_lo, suffix_hi + 1))).astype(np.int32)
+        new = int(rng.integers(new_lo, new_hi + 1))
+        if tenant == hot_tenant:
+            new *= hot_new_mult
+        work.append({
+            "uid": uid_base + i,
+            "arrival": float(arrivals[i]),
+            "tenant": tenant,
+            "prompt": np.concatenate([prefix, suffix]),
+            "max_new_tokens": new,
         })
     return work
 
@@ -592,15 +642,19 @@ def _http_generate(host, port, r, stream, timeout_s, slo_class):
         body["slo_class"] = slo_class
     rec = {"uid": r["uid"], "status": None, "tokens": [], "ttft_ms": None,
            "tpot_ms": None, "latency_ms": None, "error": None,
-           "request_id": None, "retry_after": None}
+           "request_id": None, "retry_after": None, "tenant": r.get("tenant")}
     t_send = time.time()
     conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
     try:
         # a client-supplied id keyed on the workload uid: request-log lines
-        # and trace spans join back to the workload row by inspection
-        conn.request("POST", "/v1/generate", json.dumps(body),
-                     {"Content-Type": "application/json",
-                      "X-Request-Id": f"load-{r['uid']}"})
+        # and trace spans join back to the workload row by inspection; a
+        # workload row carrying a tenant sends it as X-Tenant-Id (the
+        # metering identity)
+        headers = {"Content-Type": "application/json",
+                   "X-Request-Id": f"load-{r['uid']}"}
+        if r.get("tenant"):
+            headers["X-Tenant-Id"] = str(r["tenant"])
+        conn.request("POST", "/v1/generate", json.dumps(body), headers)
         resp = conn.getresponse()
         rec["status"] = resp.status
         rec["request_id"] = resp.getheader("X-Request-Id")
@@ -825,6 +879,76 @@ def router_prefix_ab(on_tpu, n_requests=None, seed=0, n_replicas=2, gateway=None
             gw.router.policy = gw.config.router
 
 
+def multi_tenant_bench(on_tpu, n_requests=None, seed=0, n_replicas=2,
+                       n_tenants=4, hot_share=0.4):
+    """Multi-tenant closed-loop HTTP load with tenant metering armed (the
+    ``bench.py`` ``tenants{...}`` block): N Zipf-share tenants plus one
+    adversarial hot tenant, per-tenant CLIENT-side TTFT/TPOT, the meter's
+    fairness index, per-tenant prefix hit rates (cached / prompt tokens),
+    shed attribution and KV/compute spend — the dashboard that makes a hot
+    tenant starving the rest visible BEFORE item 4's quota enforcement
+    exists to act on it."""
+    from deepspeed_tpu.serving import MeteringConfig
+
+    n = n_requests or (48 if on_tpu else 18)
+    gw = build_gateway(n_replicas=n_replicas, prefix_cache=True,
+                       metering=MeteringConfig(enabled=True,
+                                               top_k=n_tenants + 1))
+    try:
+        warm = make_multi_tenant_workload(max(6, n // 3), n_tenants=n_tenants,
+                                          hot_share=hot_share, seed=seed + 7,
+                                          uid_base=90_000)
+        run_http_load(gw.config.host, gw.port, warm, stream=False)  # compile buckets
+        wl = make_multi_tenant_workload(n, n_tenants=n_tenants, hot_share=hot_share,
+                                        seed=seed, uid_base=0)
+        agg, recs = run_http_load(gw.config.host, gw.port, wl, stream=False)
+        usage = gw.meter.usage_report()
+        per_tenant = {}
+        ledgers = dict(usage["tenants"])
+        by_tenant_recs = {}
+        for r in recs:
+            by_tenant_recs.setdefault(r.get("tenant"), []).append(r)
+        for tenant, led in sorted(ledgers.items()):
+            rs = [r for r in by_tenant_recs.get(tenant, ())
+                  if r["status"] == 200 and r["error"] is None]
+            prompt_tokens = led["uncached_tokens"] + led["cached_tokens"]
+            per_tenant[tenant] = {
+                "requests": led["requests"], "completed": led["completed"],
+                "shed": led["shed"],
+                "hit_rate": (round(led["cached_tokens"] / prompt_tokens, 3)
+                             if prompt_tokens else 0.0),
+                "hit_tokens_cross": led["hit_tokens_cross"],
+                "served_tokens": led["served_tokens"],
+                "compute_s": led["compute_total_s"],
+                "kv_block_s": led["kv_block_s"],
+                "queue_s": round(sum(led["queue_s"].values()), 6),
+                "ttft": _percentiles([r["ttft_ms"] for r in rs if r["ttft_ms"]]),
+                "tpot": _percentiles([r["tpot_ms"] for r in rs if r["tpot_ms"]]),
+            }
+        hot = per_tenant.get("hot", {})
+        rest_ttfts = [r["ttft_ms"] for t, rows in by_tenant_recs.items()
+                      if t != "hot" for r in rows
+                      if r["status"] == 200 and r["error"] is None and r["ttft_ms"]]
+        return {
+            "config": "multi_tenant",
+            "n_requests": n, "n_tenants": n_tenants, "hot_share": hot_share,
+            "n_replicas": n_replicas,
+            "achieved_rps": agg["achieved_rps"], "shed_rate": agg["shed_rate"],
+            "fairness_index": usage["fairness_index"],
+            "starvations": usage["starvations"],
+            "tenants_seen": usage["tenants_seen"],
+            "hot_tenant_compute_share": (
+                round(hot.get("compute_s", 0.0) /
+                      max(1e-9, sum(t["compute_s"] for t in per_tenant.values())), 3)
+                if per_tenant else None),
+            "rest_ttft_p99_ms": (round(float(np.percentile(rest_ttfts, 99)), 1)
+                                 if rest_ttfts else None),
+            "per_tenant": per_tenant,
+        }
+    finally:
+        gw.stop()
+
+
 # ---------------------------------------------------------------------------
 # request-scoped tracing: log consumption, p99 attribution, overhead A/B
 # ---------------------------------------------------------------------------
@@ -986,6 +1110,8 @@ def main():
         out = gateway_bench(on_tpu)
     elif "cache_pressure" in sys.argv[1:]:
         out = cache_pressure_bench(on_tpu)
+    elif "multi_tenant" in sys.argv[1:]:
+        out = multi_tenant_bench(on_tpu)
     else:
         out = serving_load_bench(on_tpu)
     out["on_tpu"] = on_tpu
