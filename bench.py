@@ -618,6 +618,14 @@ def run_bench():
 
     configure_goodput(enabled=True)
 
+    # roofline plane (monitor/roofline.py): cost-vs-wall verdict for every
+    # post-warmup compiled bucket; the final JSON's `roofline` block is what
+    # perf_sentinel trends MFU/MBU over. DS_TPU_BENCH_ROOFLINE=0 skips.
+    if os.environ.get("DS_TPU_BENCH_ROOFLINE", "1") != "0":
+        from deepspeed_tpu.monitor.roofline import configure_roofline
+
+        configure_roofline(enabled=True)
+
     try:
         on_tpu = any(d.platform == "tpu" for d in jax.devices())
     except Exception as e:  # backend init died mid-child: disclose, run CPU
@@ -1263,6 +1271,31 @@ def run_bench():
               flush=True)
     except Exception as e:  # the headline line never forfeits to telemetry
         print(f"# WARNING: goodput block failed ({type(e).__name__}: {e})", flush=True)
+    # roofline block: the cost-vs-measured verdict for every post-warmup
+    # compiled bucket (train step, serving put/decode/verify buckets, tuned
+    # Pallas entrypoints) + the top gap-to-roof offenders — the buckets the
+    # online re-tuner should attack (ROADMAP 5c). On CPU the peaks are null
+    # and every verdict reads `unknown` (disclosed, never guessed).
+    if os.environ.get("DS_TPU_BENCH_ROOFLINE", "1") != "0":
+        try:
+            from deepspeed_tpu.monitor.roofline import get_roofline
+
+            rrep = get_roofline().report()
+            gaps = sorted(((r["gap_to_roof"], b) for b, r in rrep["buckets"].items()
+                           if r["gap_to_roof"] is not None), reverse=True)[:5]
+            line["roofline"] = {
+                "peak_flops": rrep["peak_flops"], "peak_hbm_bw": rrep["peak_hbm_bw"],
+                "buckets": rrep["buckets"],
+                "top_gap": [{"bucket": b, "gap_to_roof": g,
+                             "verdict": rrep["buckets"][b]["verdict"]} for g, b in gaps],
+            }
+            counts = {}
+            for r in rrep["buckets"].values():
+                counts[r["verdict"]] = counts.get(r["verdict"], 0) + 1
+            print("# roofline: " + " ".join(f"{v}={n}" for v, n in sorted(counts.items()))
+                  + (f" worst={gaps[0][1]}@{gaps[0][0]}x" if gaps else ""), flush=True)
+        except Exception as e:
+            print(f"# WARNING: roofline block failed ({type(e).__name__}: {e})", flush=True)
     if trace_path:
         from deepspeed_tpu.comm.comm import comms_logger
         from deepspeed_tpu.monitor.trace import get_tracer

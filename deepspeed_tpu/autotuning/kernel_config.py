@@ -268,6 +268,20 @@ class KernelAutotuner:
             return None
         self.registry.record(kernel, bucket, {**best, "_ms": round(best_t * 1e3, 4)})
         self.results.setdefault(kernel, {})[bucket] = {**best, "_ms": round(best_t * 1e3, 4)}
+        rf_label = f"pallas/{kernel}/{bucket}"
+        try:
+            from ..monitor.roofline import get_roofline
+
+            rf = get_roofline()
+            if rf.enabled:
+                # the winner's roofline row: measured median wall + lazy cost
+                # of the winning thunk (closed-over operands lower as
+                # constants — fine for flop/byte totals)
+                rf.note_wall(rf_label, best_t)
+                rf.register_thunk(rf_label, build(best))
+        except Exception as e:  # noqa: BLE001 — telemetry never costs a sweep
+            logger.warning(f"roofline join for {rf_label} failed: "
+                           f"{type(e).__name__}: {str(e)[:120]}")
         return best
 
     # -- per-kernel sweeps --------------------------------------------

@@ -22,6 +22,7 @@ from ...monitor.goodput import get_goodput
 from ...monitor.health import get_health
 from ...monitor.memory import get_memory, tree_device_bytes
 from ...monitor.metrics import get_metrics
+from ...monitor.roofline import get_roofline
 from ...monitor.trace import (get_tracer, observe_latency, pop_compile_source,
                               push_compile_source)
 from ...utils.logging import log_dist
@@ -327,6 +328,8 @@ class InferenceEngineV2:
     def _put(self, batch_uids, batch_tokens, do_checks, sample, block, sampling=None):
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
+        rf = get_roofline()
+        t_rf = time.perf_counter() if rf.enabled else 0.0
         batch_tokens = [np.asarray(t, np.int32).reshape(-1) for t in batch_tokens]
         if any(t.size == 0 for t in batch_tokens):
             # an empty chunk would alias the PREVIOUS row's last_idx in the
@@ -374,12 +377,14 @@ class InferenceEngineV2:
             # sampled rows draw on device (greedy rows argmax via temp 0);
             # sample='greedy' callers without sampling keep the original
             # compiled program byte-for-byte
+            mode = "sample"
             fn = self._get_compiled(rb.token_ids.shape[0], rb.block_tables.shape[0],
                                     "sample")
             samp_f, seeds = pack_sampling(sampling, batch_uids, rb.block_tables.shape[0])
             out, pools = fn(self.params, jnp.asarray(rb.packed()), jnp.asarray(samp_f),
                             jnp.asarray(seeds), kv.pools())
         else:
+            mode = sample
             fn = self._get_compiled(rb.token_ids.shape[0], rb.block_tables.shape[0], sample)
             # ONE descriptor upload per forward (reference single pinned-buffer
             # upload; each separate array would be its own RPC on a tunnel)
@@ -390,6 +395,12 @@ class InferenceEngineV2:
             self.state_manager.publish_sequence(seq)  # completed full blocks → tree
         out = out[:rb.n_seqs]  # slice ON DEVICE: the host fetch moves
         out = out if not block else np.asarray(out)  # n_seqs rows, not the padded bucket
+        if rf.enabled and block:
+            # wall join through the blocking host fetch — the same window the
+            # outer put() books as prefill/decode-active in the goodput ledger,
+            # so the roofline and goodput accountings reconcile
+            rf.note_wall(f"put/t{rb.token_ids.shape[0]}/s{rb.block_tables.shape[0]}"
+                         f"/{mode or 'logits'}", time.perf_counter() - t_rf)
         if observing:
             # prefill (multi-token chunks) latency IS TTFT when block=True
             # (admission -> first token on host, the FastGen definition);
@@ -461,6 +472,8 @@ class InferenceEngineV2:
                 sampling=None):
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
+        rf = get_roofline()
+        t_rf = time.perf_counter() if rf.enabled else 0.0
         uids = list(batch_uids)
         S = len(uids)
         if len(set(uids)) != len(uids):
@@ -506,7 +519,9 @@ class InferenceEngineV2:
 
         kv = self.state_manager.kv_cache
         s_bucket = rb.token_ids.shape[0]
-        if sampling is not None and not all_greedy(sampling):
+        rf_sampled = sampling is not None and not all_greedy(sampling)
+        rf_bucket = f"decode/s{s_bucket}/n{n_steps}{'/sampled' if rf_sampled else ''}"
+        if rf_sampled:
             fn = self._get_compiled_decode(s_bucket, n_steps, sampled=True)
             samp_f, seeds = pack_sampling(sampling, uids, s_bucket)
             toks, pools = fn(self.params, jnp.asarray(rb.packed()), jnp.asarray(samp_f),
@@ -549,6 +564,8 @@ class InferenceEngineV2:
             for seq in seqs:
                 seq.post_forward()
                 self.state_manager.publish_sequence(seq)
+        if rf.enabled and block:
+            rf.note_wall(rf_bucket, time.perf_counter() - t_rf)
         if observing:
             # as with put(): without the host fetch the wall time is dispatch
             # only — emit the span (blocked flag disclosed), skip the samples
@@ -722,6 +739,8 @@ class InferenceEngineV2:
 
         observing = get_tracer().enabled or get_metrics().enabled
         t0 = time.perf_counter() if observing else 0.0
+        rf = get_roofline()
+        t_rf = time.perf_counter() if rf.enabled else 0.0
         uids = list(batch_uids)
         S = len(uids)
         firsts = [np.asarray(t, np.int32).reshape(-1) for t in first_tokens]
@@ -904,6 +923,12 @@ class InferenceEngineV2:
             accepts.append(a)
         self._spec_totals["drafted"] += drafted
         self._spec_totals["accepted"] += accepted
+        if rf.enabled:
+            # speculate always fetches to host (the committed rows), so the
+            # verify wall join needs no block gate
+            rf.note_wall(f"verify/t{t_bucket}/s{s_bucket}/k{n_new - 1}"
+                         f"{'/tree' if tree else ''}{'/sampled' if sampled else ''}",
+                         time.perf_counter() - t_rf)
         if observing:
             m = get_metrics()
             if m.enabled:
@@ -957,8 +982,9 @@ class InferenceEngineV2:
                              tree: bool = False, sampled: bool = False):
         key = ("verify", t_bucket, s_bucket, k, bool(tree), bool(sampled))
         if key not in self._compiled:
-            self._note_compile(f"verify/t{t_bucket}/s{s_bucket}/k{k}"
-                               f"{'/tree' if tree else ''}{'/sampled' if sampled else ''}")
+            bucket = (f"verify/t{t_bucket}/s{s_bucket}/k{k}"
+                      f"{'/tree' if tree else ''}{'/sampled' if sampled else ''}")
+            self._note_compile(bucket)
             step_fn = self._ragged_step
             mb = self._max_blocks_per_seq
 
@@ -996,6 +1022,11 @@ class InferenceEngineV2:
                     return toks.reshape(s_bucket, k + 1), pools
 
                 self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
+            rf = get_roofline()
+            if rf.enabled:
+                # roofline cost capture: the wrapper snapshots this program's
+                # abstract signature on its first real call (lazy cost_analysis)
+                self._compiled[key] = rf.capture_executable(bucket, self._compiled[key])
             log_dist(f"compiled speculative verify bucket tokens={t_bucket} "
                      f"seqs={s_bucket} k={k} tree={tree} sampled={sampled}", ranks=[0])
         return self._compiled[key]
@@ -1003,8 +1034,8 @@ class InferenceEngineV2:
     def _get_compiled_decode(self, s_bucket: int, n_steps: int, sampled: bool = False):
         key = ("decode", s_bucket, n_steps, bool(sampled))
         if key not in self._compiled:
-            self._note_compile(f"decode/s{s_bucket}/n{n_steps}"
-                               f"{'/sampled' if sampled else ''}")
+            bucket = f"decode/s{s_bucket}/n{n_steps}{'/sampled' if sampled else ''}"
+            self._note_compile(bucket)
             from .ragged.ragged_wrapper import unpack_descriptors
 
             max_blocks = self._max_blocks_per_seq
@@ -1053,6 +1084,9 @@ class InferenceEngineV2:
                     return out.T, pools  # [S, n_steps]
 
                 self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
+            rf = get_roofline()
+            if rf.enabled:
+                self._compiled[key] = rf.capture_executable(bucket, self._compiled[key])
             log_dist(f"compiled multi-step decode bucket seqs={s_bucket} steps={n_steps} "
                      f"sampled={sampled}", ranks=[0])
         return self._compiled[key]
@@ -1332,7 +1366,8 @@ class InferenceEngineV2:
     def _get_compiled(self, t_bucket: int, s_bucket: int, sample: Optional[str] = None):
         key = (t_bucket, s_bucket, sample)
         if key not in self._compiled:
-            self._note_compile(f"put/t{t_bucket}/s{s_bucket}/{sample or 'logits'}")
+            bucket = f"put/t{t_bucket}/s{s_bucket}/{sample or 'logits'}"
+            self._note_compile(bucket)
             if sample not in (None, "greedy", "sample"):
                 raise ValueError(f"unsupported sample mode {sample!r}: None | 'greedy' | 'sample'")
             step_fn = self._ragged_step
@@ -1360,6 +1395,9 @@ class InferenceEngineV2:
                     return out, pools
 
                 self._compiled[key] = jax.jit(fwd, donate_argnums=(2, ))
+            rf = get_roofline()
+            if rf.enabled:
+                self._compiled[key] = rf.capture_executable(bucket, self._compiled[key])
             log_dist(f"compiled ragged forward bucket tokens={t_bucket} seqs={s_bucket} "
                      f"sample={sample}", ranks=[0])
         return self._compiled[key]

@@ -255,7 +255,7 @@ def configure_metrics(enabled=None) -> MetricsRegistry:
 
 
 # ---------------------------------------------------------------------------
-# MFU: chip peak-FLOPs table + derivation helpers
+# MFU/MBU: chip peak-FLOPs + peak-HBM-bandwidth tables + derivation helpers
 # ---------------------------------------------------------------------------
 
 # dense bf16 peak FLOP/s per chip (published TPU specs)
@@ -263,20 +263,33 @@ CHIP_PEAK_FLOPS = {
     "v4": 275e12,
     "v5e": 197e12,
     "v5p": 459e12,
+    "v6e": 918e12,
 }
 
-# jax ``device_kind`` strings -> table keys (v5e reports as "TPU v5 lite")
+# peak HBM bandwidth, bytes/s per chip (published TPU specs) — the MBU
+# denominator and the bandwidth roof of the roofline verdicts; keyed
+# identically to CHIP_PEAK_FLOPS so the two tables can never disagree about
+# which chip they price
+CHIP_PEAK_HBM_BW = {
+    "v4": 1228e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v6e": 1640e9,
+}
+
+# jax ``device_kind`` strings -> table keys (v5e reports as "TPU v5 lite",
+# v6e as "TPU v6 lite" / "TPU v6e" / Trillium)
 _DEVICE_KIND_ALIASES = (
     ("v5 lite", "v5e"), ("v5litepod", "v5e"), ("v5e", "v5e"),
     ("v5p", "v5p"),
+    ("v6 lite", "v6e"), ("v6e", "v6e"), ("trillium", "v6e"),
     ("v4", "v4"),
 )
 
 
-def peak_flops_per_chip(device_kind=None):
-    """bf16 peak FLOP/s for ``device_kind`` (defaults to the local device).
-    Returns None when the chip is unknown (CPU fallback) — callers report
-    MFU as null rather than a misleading number."""
+def _chip_key(device_kind=None):
+    """Resolve ``device_kind`` (default: the local device) to a peak-table
+    key, or None when the chip is unknown (CPU fallback)."""
     if device_kind is None:
         try:
             import jax
@@ -287,8 +300,24 @@ def peak_flops_per_chip(device_kind=None):
     kind = str(device_kind).lower()
     for marker, key in _DEVICE_KIND_ALIASES:
         if marker in kind:
-            return CHIP_PEAK_FLOPS[key]
+            return key
     return None
+
+
+def peak_flops_per_chip(device_kind=None):
+    """bf16 peak FLOP/s for ``device_kind`` (defaults to the local device).
+    Returns None when the chip is unknown (CPU fallback) — callers report
+    MFU as null rather than a misleading number."""
+    key = _chip_key(device_kind)
+    return CHIP_PEAK_FLOPS[key] if key is not None else None
+
+
+def peak_hbm_bw_per_chip(device_kind=None):
+    """Peak HBM bandwidth (bytes/s) for ``device_kind`` (defaults to the
+    local device). Returns None when the chip is unknown — the same
+    null-not-a-number contract as :func:`peak_flops_per_chip`."""
+    key = _chip_key(device_kind)
+    return CHIP_PEAK_HBM_BW[key] if key is not None else None
 
 
 def compute_mfu(model_flops_per_step, step_time_s, n_chips=1, peak_flops=None):
@@ -300,3 +329,15 @@ def compute_mfu(model_flops_per_step, step_time_s, n_chips=1, peak_flops=None):
     if not peak_flops or step_time_s <= 0 or n_chips <= 0:
         return None
     return model_flops_per_step / step_time_s / (peak_flops * n_chips)
+
+
+def compute_mbu(bytes_per_step, step_time_s, n_chips=1, peak_bw=None):
+    """Model bandwidth utilization: achieved HBM bytes/s over the slice's
+    peak — the :func:`compute_mfu` companion (same contract: ``peak_bw``
+    overrides the table, None when the chip is unknown, so a CPU fallback
+    can never report a misleading utilization)."""
+    if peak_bw is None:
+        peak_bw = peak_hbm_bw_per_chip()
+    if not peak_bw or step_time_s <= 0 or n_chips <= 0:
+        return None
+    return bytes_per_step / step_time_s / (peak_bw * n_chips)

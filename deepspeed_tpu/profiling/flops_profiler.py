@@ -25,13 +25,14 @@ def training_flops_per_token(n_params, num_layers=None, hidden_size=None, seq_le
 
 
 def analyze_fn(fn, *example_args, **example_kwargs):
-    """Compile ``fn`` and return {'flops': float, 'bytes accessed': float, ...}."""
+    """Compile ``fn`` and return {'flops': float, 'bytes accessed': float, ...}.
+    Extraction is shared with the roofline plane (``monitor/roofline.py``) so
+    the point-wise profiler and the per-bucket verdicts can never read
+    different keys out of the same executable."""
+    from ..monitor.roofline import cost_analysis_dict
+
     lowered = jax.jit(fn).lower(*example_args, **example_kwargs)
-    compiled = lowered.compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):  # older jax returns [dict]
-        cost = cost[0] if cost else {}
-    return dict(cost or {})
+    return cost_analysis_dict(lowered.compile())
 
 
 def build_module_profile(model, batch_size: int, seq_len: int) -> dict:

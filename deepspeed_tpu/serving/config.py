@@ -114,6 +114,25 @@ class MeteringConfig:
 
 
 @dataclass
+class ProfilingConfig:
+    """``serving.gateway.profiling`` block — the on-demand ``POST
+    /v1/profile`` XPlane capture endpoint (``monitor/roofline.py``'s
+    :class:`CaptureManager` bracketing ``jax.profiler`` around live
+    traffic). Presence-enables (the ``tracing``/``metering`` contract): an
+    absent block keeps the route returning 404 and allocates nothing."""
+
+    enabled: bool = False
+    # artifact root; each capture lands as an atomically-renamed
+    # subdirectory (a visible dir is always a whole, loadable artifact)
+    artifact_dir: str = "/tmp/dstpu_xplane"
+    # capture length when the request body names none
+    default_duration_s: float = 2.0
+    # hard bound: requested durations clamp here (a typo'd duration must
+    # not hold the process-global profiler for an hour)
+    max_duration_s: float = 60.0
+
+
+@dataclass
 class GatewayConfig:
     enabled: bool = False
     host: str = "127.0.0.1"
@@ -152,6 +171,9 @@ class GatewayConfig:
     # tenant-scoped resource metering + fairness observability; off by
     # default with the same zero-overhead-absent contract
     metering: MeteringConfig = field(default_factory=MeteringConfig)
+    # on-demand XPlane capture endpoint (POST /v1/profile); off by default —
+    # the route 404s and no capture manager is created
+    profiling: ProfilingConfig = field(default_factory=ProfilingConfig)
 
     @classmethod
     def from_dict(cls, d) -> "GatewayConfig":
@@ -159,6 +181,7 @@ class GatewayConfig:
         classes = d.pop("slo_classes", None)
         tracing = d.pop("tracing", None)
         metering = d.pop("metering", None)
+        profiling = d.pop("profiling", None)
         known = {f.name for f in fields(cls)}
         unknown = set(d) - known
         if unknown:
@@ -198,6 +221,22 @@ class GatewayConfig:
                 raise ValueError("serving.gateway.metering: max_tracked_tenants "
                                  f"({cfg.metering.max_tracked_tenants}) must cover "
                                  f"top_k ({cfg.metering.top_k})")
+        if profiling is not None:
+            if isinstance(profiling, ProfilingConfig):
+                cfg.profiling = profiling
+            else:
+                body = dict(profiling)
+                pf_known = {f.name for f in fields(ProfilingConfig)}
+                bad = set(body) - pf_known
+                if bad:
+                    raise ValueError(f"serving.gateway.profiling: unknown keys {sorted(bad)}")
+                if "enabled" not in body:  # presence-enables
+                    body["enabled"] = True
+                cfg.profiling = ProfilingConfig(**body)
+            if cfg.profiling.max_duration_s <= 0 or cfg.profiling.default_duration_s <= 0:
+                raise ValueError("serving.gateway.profiling: durations must be > 0, got "
+                                 f"default={cfg.profiling.default_duration_s} "
+                                 f"max={cfg.profiling.max_duration_s}")
         if classes is not None:
             slo_known = {f.name for f in fields(SLOClassConfig)}
             parsed = {}

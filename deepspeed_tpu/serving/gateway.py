@@ -22,6 +22,13 @@ pattern — one daemon accept thread, one handler thread per connection):
   * ``GET /v1/usage`` — the per-tenant metering ledger (top-K tenants by
     spend + aggregated ``other``, fairness index, starvation count); 404
     when the ``serving.gateway.metering`` block is absent.
+  * ``POST /v1/profile`` — on-demand deep profiling of LIVE traffic: body
+    ``{"duration_s": 2.0}`` (optional) brackets ``jax.profiler``
+    start/stop around whatever the replicas are serving and returns the
+    atomically-renamed XPlane artifact directory. Bounded duration
+    (clamped to ``profiling.max_duration_s``), 409 while another capture
+    is in flight (the profiler is process-global), 404 when the
+    ``serving.gateway.profiling`` block is absent.
 
 SSE frame format (``sse_frame``/``parse_sse`` are the canonical pair; the
 load generator and the tests share them):
@@ -41,6 +48,7 @@ from typing import Optional
 
 from ..monitor.health import get_health
 from ..monitor.metrics import get_metrics
+from ..monitor.roofline import CaptureBusyError, get_capture_manager
 from .admission import AdmissionController
 from .config import GatewayConfig
 from .metering import TenantMeter, sanitize_tenant_id
@@ -348,6 +356,40 @@ class ServingGateway:
                 return True
         return False
 
+    # -- on-demand profiling ---------------------------------------------------
+    def capture_profile(self, duration_s=None):
+        """One bounded XPlane capture of live traffic (``POST /v1/profile``).
+        Returns ``(status, body)`` exactly like :meth:`submit`: 404 when the
+        ``profiling`` block is absent, 400 on a bad duration, 409 while
+        another capture holds the process-global profiler, 200 with the
+        final (atomically-renamed) artifact directory. The handler thread
+        blocks here for the capture window — live traffic on the replica
+        threads is exactly what lands in the trace."""
+        cfg = self.config.profiling
+        if not cfg.enabled:
+            return 404, {"error": "profiling_disabled"}
+        if duration_s is None:
+            duration_s = cfg.default_duration_s
+        try:
+            duration_s = float(duration_s)
+        except (TypeError, ValueError):
+            return 400, {"error": "bad_duration",
+                         "detail": f"duration_s must be a number, got {duration_s!r}"}
+        if duration_s <= 0:
+            return 400, {"error": "bad_duration",
+                         "detail": f"duration_s must be > 0, got {duration_s}"}
+        duration_s = min(duration_s, cfg.max_duration_s)
+        try:
+            artifact = get_capture_manager().capture(
+                duration_s, cfg.artifact_dir, label="gateway",
+                max_s=cfg.max_duration_s)
+        except CaptureBusyError:
+            return 409, {"error": "capture_in_flight"}
+        except Exception as e:  # noqa: BLE001 — profiling must never 500-loop
+            return 500, {"error": "capture_failed",
+                         "detail": f"{type(e).__name__}: {e}"}
+        return 200, {"artifact_dir": artifact, "duration_s": duration_s}
+
     # -- introspection --------------------------------------------------------
     def state(self) -> dict:
         out = {"ready": self.ready, "draining": self.draining,
@@ -437,7 +479,8 @@ class ServingGateway:
                     else:
                         self._json(404, {"error": "not_found",
                                          "paths": ["/v1/generate", "/v1/usage",
-                                                   "/healthz", "/readyz"]},
+                                                   "/v1/profile", "/healthz",
+                                                   "/readyz"]},
                                    rid=rid)
                 except (BrokenPipeError, ConnectionResetError):
                     pass
@@ -448,7 +491,7 @@ class ServingGateway:
                 rid, traceparent = extract_request_id(self.headers)
                 path = self.path.split("?", 1)[0]
                 try:
-                    if path != "/v1/generate":
+                    if path not in ("/v1/generate", "/v1/profile"):
                         self._json(404, {"error": "not_found"}, rid=rid)
                         return
                     try:
@@ -459,6 +502,18 @@ class ServingGateway:
                     except (ValueError, json.JSONDecodeError) as e:
                         self._json(400, {"error": "bad_json", "detail": str(e),
                                          "request_id": rid}, rid=rid)
+                        return
+                    if path == "/v1/profile":
+                        # on-demand XPlane capture of live traffic; the
+                        # request-id echo rides _respond like every response
+                        # (normalized here so body and X-Request-Id agree
+                        # even when the client sent none)
+                        rid = sanitize_request_id(rid) or new_request_id()
+                        status, result = outer.capture_profile(
+                            body.get("duration_s"))
+                        if status == 200:
+                            result = {**result, "request_id": rid}
+                        self._json(status, result, rid=rid)
                         return
                     status, result = outer.submit(
                         body.get("prompt"),

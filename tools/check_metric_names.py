@@ -41,7 +41,7 @@ DEFAULT_PKG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pa
                                "deepspeed_tpu")
 
 APPROVED_PREFIXES = ("train", "serving", "gateway", "health", "comm",
-                     "checkpoint", "cache", "memory", "goodput")
+                     "checkpoint", "cache", "memory", "goodput", "profile")
 
 REGISTRATION_CALLS = ("counter", "gauge", "histogram")
 

@@ -17,6 +17,13 @@ prints one JSON line per measurement so the gap is attributable, not vibes:
 Run on a TPU host: ``python tools/decode_profile.py`` (add ``--kv int8`` for
 the quantized cache). CPU fallback runs tiny shapes so the harness itself
 stays tested in CI.
+
+The roofline itself comes from the shared plane (``monitor/roofline.py``):
+the peak-bandwidth denominator is the ``CHIP_PEAK_HBM_BW`` table (one table
+for the whole repo — this tool and the plane can never disagree about the
+roof), and each measurement's bytes numerator is XLA's own
+``cost_analysis()`` out of the executable-cost registry, with the old
+analytic KV-bytes estimate printed alongside as disclosure.
 """
 
 import argparse
@@ -61,12 +68,22 @@ def main():
                                 num_heads=16, num_kv_heads=16, intermediate_size=5632,
                                 max_seq_len=2048, dtype=jnp.bfloat16, attention_impl="flash")
         n_seqs, ctx, bs, reps = args.seqs, args.ctx, 128, 20
-        hbm_bw = 819e9
     else:
         cfg = TransformerConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=8,
                                 num_kv_heads=8, intermediate_size=256, max_seq_len=512,
                                 dtype=jnp.float32, attention_impl="reference")
         n_seqs, ctx, bs, reps = 4, 128, 64, 2
+
+    # shared peak tables + cost registry (monitor/roofline.py): the SAME
+    # roofline the serving plane verdicts against. Unknown chip (CPU CI):
+    # an explicit assumed bandwidth roof, disclosed — never a silent guess.
+    from deepspeed_tpu.monitor.roofline import configure_roofline
+
+    rf = configure_roofline(enabled=True)
+    hbm_bw = rf.peaks()[1]
+    assumed_roof = hbm_bw is None
+    if assumed_roof:
+        rf.configure(peak_hbm_bw=50e9)
         hbm_bw = 50e9
 
     nkv, d, L = cfg.num_kv_heads, cfg.head_dim, cfg.num_layers
@@ -93,20 +110,31 @@ def main():
     pos = jnp.full((n_seqs,), ctx - 1, jnp.int32)
 
     step = jax.jit(lambda q, kp, vp: paged_attention(q, kp, vp, tables, seq_idx, pos, bs, **scales))
+    kernel_bucket = f"pallas/paged_attention/s{n_seqs}_ctx{ctx}_{args.kv}"
+    rf.register_fn(kernel_bucket, step, q, k_pool, v_pool)
     _sync(step(q, k_pool, v_pool))  # compile
     t0 = time.time()
     for _ in range(reps):
         out = step(q, k_pool, v_pool)
     _sync(out)
     dt_kernel = (time.time() - t0) / reps
-    # factor 2: BOTH the K and V pools stream every step (and both scale
-    # pools in int8 mode) — matches bench.py's bench_serving accounting
-    # (ADVICE r4: the single-pool count halved the ideal time and thus
-    # under-reported the kernel's fraction-of-roofline ~2x)
+    rf.note_wall(kernel_bucket, dt_kernel)
+    # analytic KV-stream estimate kept as DISCLOSURE beside the registry's
+    # cost_analysis bytes. Factor 2: BOTH the K and V pools stream every
+    # step (and both scale pools in int8 mode) — matches bench.py's
+    # bench_serving accounting (ADVICE r4: the single-pool count halved the
+    # ideal time and under-reported the fraction-of-roofline ~2x)
     kv_bytes = 2 * n_seqs * ctx * nkv * (d * kv_itemsize + (4 if kv_int8 else 0))
-    kernel_roofline = kv_bytes / hbm_bw  # one layer's KV stream
+    krow = rf.report()["buckets"][kernel_bucket]
+    # roofline numerator: XLA's own bytes for the compiled kernel (the same
+    # number the serving plane verdicts on); analytic KV stream only when
+    # the backend can't price it
+    roof_bytes = krow["bytes"] if krow["bytes"] is not None else kv_bytes
+    kernel_roofline = roof_bytes / hbm_bw
     print(json.dumps({"metric": "decode_kernel_step_s", "value": round(dt_kernel, 6),
                       "kv_bytes_per_layer": kv_bytes, "kv": args.kv,
+                      "cost_bytes": krow["bytes"], "mbu": krow["mbu"],
+                      "verdict": krow["verdict"], "assumed_roof": assumed_roof,
                       "vs_roofline": round(kernel_roofline / max(dt_kernel, 1e-12), 4)}))
 
     # ---- 2/3. engine decode: horizon sweep ----
@@ -134,15 +162,26 @@ def main():
         _sync(out)
         dt = time.time() - t0
         per_step = dt / horizon
+        # the engine's compile site registered this decode bucket with the
+        # plane (rf is armed), so the registry's cost-model bytes price the
+        # whole-horizon scan; null on a backend without cost analysis
+        hrow = next((r for bkt, r in rf.report()["buckets"].items()
+                     if bkt.startswith("decode/") and bkt.endswith(f"/n{horizon}")), None)
+        cost_bytes = hrow["bytes"] if hrow else None
+        xla_roofline = (cost_bytes / horizon / hbm_bw) if cost_bytes is not None else None
         print(json.dumps({
             "metric": "decode_horizon_step_s", "horizon": horizon, "kv": args.kv,
             "per_step_s": round(per_step, 6),
             "tokens_per_s": round(n_seqs * horizon / dt, 1),
             "vs_roofline": round(step_roofline / max(per_step, 1e-12), 4),
+            "vs_roofline_xla": (round(xla_roofline / max(per_step, 1e-12), 4)
+                                if xla_roofline is not None else None),
+            "verdict": hrow["verdict"] if hrow else None,
         }))
     # host dispatch estimate: time of a horizon-H call minus H * best per-step
     print(json.dumps({"metric": "decode_step_roofline_s", "value": round(step_roofline, 6),
-                      "param_bytes": param_bytes, "kv_bytes": step_kv_bytes, "kv": args.kv}))
+                      "param_bytes": param_bytes, "kv_bytes": step_kv_bytes,
+                      "kv": args.kv, "assumed_roof": assumed_roof}))
 
 
 if __name__ == "__main__":

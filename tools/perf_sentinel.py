@@ -42,8 +42,9 @@ _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 # ``decode_tok_s`` also ends in the generic ``_s`` latency suffix and must
 # not be read as lower-is-better.
 LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_bytes", "_seconds", "_blocked_ratio")
-HIGHER_BETTER_SUFFIXES = ("tok_s", "_rate", "_mfu", "speedup", "_tokens_per_sec")
-HIGHER_BETTER_NAMES = ("value", "mfu", "accept_rate", "hit_rate", "ratio")
+HIGHER_BETTER_SUFFIXES = ("tok_s", "_rate", "_mfu", "_mbu", "speedup",
+                          "_tokens_per_sec")
+HIGHER_BETTER_NAMES = ("value", "mfu", "mbu", "accept_rate", "hit_rate", "ratio")
 
 # wall-clock ACCOUNTING fields, not performance metrics: a longer bench run
 # is not a regression. The whole goodput block is attribution (its *_s
@@ -52,13 +53,15 @@ HIGHER_BETTER_NAMES = ("value", "mfu", "accept_rate", "hit_rate", "ratio")
 # goodput neutrality rule: per-tenant counters/seconds are ATTRIBUTION of
 # whatever the round consumed (a different tenant mix is not a
 # regression) — only its fairness index carries a direction.
-NEUTRAL_PREFIXES = ("goodput.", "tenants.")
+NEUTRAL_PREFIXES = ("goodput.", "tenants.", "roofline.")
 NEUTRAL_NAMES = ("wall_s", "unattributed_s", "overbooked_s", "recovery_badput_s")
 
 # direction overrides that win over the neutral prefixes: the fairness
 # index inside the tenants block IS a performance verdict (higher = the
-# fleet shares capacity more evenly under the same adversarial load)
-HIGHER_BETTER_LEAVES = ("fairness_index",)
+# fleet shares capacity more evenly under the same adversarial load), and
+# the roofline block's utilizations are too (higher = closer to the roof) —
+# its flop/byte/wall accounting stays neutral
+HIGHER_BETTER_LEAVES = ("fairness_index", "mfu", "mbu")
 
 
 def metric_direction(metric):
