@@ -1082,6 +1082,38 @@ def run_bench():
             print(f"# WARNING: cache bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
 
+    # --cache.host_tier: tiered KV-cache A/B (ISSUE 17) — the Zipf corpus
+    # resized to ~10x the HBM pool, run HBM-only vs with the pinned host
+    # tier armed. The leaves perf_sentinel trends: hierarchy_hit_rate vs
+    # hbm_hit_rate (higher-better), promote_p50/p99_ms and the TTFT split
+    # (lower-better). Outside the headline window; DS_TPU_BENCH_HOST_TIER=0
+    # skips, failure never costs the headline.
+    if cache_line is not None and os.environ.get("DS_TPU_BENCH_HOST_TIER", "1") != "0":
+        try:
+            from tools.serving_load import host_tier_ab
+
+            ht = host_tier_ab(on_tpu)
+            on, off = ht["host_tier"], ht["hbm_only"]
+            cache_line["host_tier"] = {
+                "hierarchy_hit_rate": on["hierarchy_hit_rate"],
+                "hbm_hit_rate": off["hbm_hit_rate"],
+                "hit_rate_gain": ht["hit_rate_gain"],
+                "token_parity": ht["token_parity"],
+                "promote_p50_ms": on.get("promote_p50_ms"),
+                "promote_p99_ms": on.get("promote_p99_ms"),
+                "ttft_promoted_hit_p50_ms": (on["ttft_promoted_hit_ms"] or {}).get("p50_ms"),
+                "ttft_miss_p50_ms": (on["ttft_miss_ms"] or {}).get("p50_ms"),
+                "demotions": on["demotions"],
+                "promotions": on["promotions"],
+            }
+            print(f"# host_tier: hierarchy_hit={on['hierarchy_hit_rate']} "
+                  f"hbm_hit={off['hbm_hit_rate']} gain={ht['hit_rate_gain']} "
+                  f"parity={ht['token_parity']} promote_p99={on.get('promote_p99_ms')}ms",
+                  flush=True)
+        except Exception as e:
+            print(f"# WARNING: host_tier bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+
     # --chaos: resilience drills (ISSUE 12) — the seeded training storm
     # (kill/stall/straggle/preempt/collective-delay with warm-remesh
     # restarts) and the serving replica-kill drill, reporting the drill

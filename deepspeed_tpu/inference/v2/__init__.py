@@ -1,8 +1,9 @@
 """FastGen-equivalent inference v2 (reference ``deepspeed/inference/v2``):
 ragged continuous batching over a paged KV cache."""
 
-from .config_v2 import (CacheTelemetryConfig, DSStateManagerConfig, ModulesConfig,
-                        PrefixCacheConfig, RaggedInferenceEngineConfig, SpeculativeConfig)
+from .config_v2 import (CacheTelemetryConfig, DSStateManagerConfig, HostTierConfig,
+                        ModulesConfig, PrefixCacheConfig, RaggedInferenceEngineConfig,
+                        SpeculativeConfig)
 from .engine_v2 import InferenceEngineV2
 from .engine_factory import build_engine, build_model_engine
 from .scheduling_utils import SchedulingError, SchedulingResult

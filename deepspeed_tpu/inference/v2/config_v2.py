@@ -44,6 +44,42 @@ class CacheTelemetryConfig:
 
 
 @dataclass
+class HostTierConfig:
+    """``ragged.prefix_cache.host_tier`` block: the capacity tier under the
+    radix tree (``ragged/tiered_store.py``) — evicted tree-only blocks are
+    DEMOTED to a pinned host block pool (async D2H through a bounded
+    migration queue) instead of dropped, and a later hit on a demoted chain
+    PROMOTES the blocks back to HBM ahead of prefill. Presence-enabled:
+    when this block is absent (``PrefixCacheConfig.host_tier is None``) no
+    host pool, no worker thread and no per-block residency state exist
+    anywhere (the PR 5 zero-overhead contract, test-enforced in
+    ``tests/test_tiered_store.py``). Size the pool from the MRC curve
+    (``serving/mrc_hit_rate``): flat by 2x the HBM pool ⇒ leave the tier
+    off; still climbing at 8x ⇒ give the host pool the capacity the curve
+    says the workload wants."""
+    enabled: bool = True
+    # host pool capacity in blocks; 0 derives it from host_pool_bytes
+    host_blocks: int = 0
+    # alternative sizing: host bytes -> blocks via the HBM pool's block_bytes
+    host_pool_bytes: int = 0
+    # proactive-demotion watermarks on the HBM FREE fraction: when free
+    # drops below `low_watermark`, cold tree-only leaves are demoted in the
+    # background until free reaches `high_watermark` — demand eviction then
+    # rarely has to demote inline on the admission path
+    low_watermark: float = 0.10
+    high_watermark: float = 0.25
+    # bounded migration queue depth (the ResilientSaver discipline: a slow
+    # tier back-pressures into plain drops, never into unbounded memory)
+    queue_depth: int = 8
+    # optional disk tier: directory for spilled host blocks (None = off).
+    # Block files are checksummed and tracked in a manifest; corrupt or
+    # missing files read as misses, never as wrong KV.
+    disk_path: object = None
+    # disk tier capacity in blocks (ignored when disk_path is None)
+    disk_blocks: int = 256
+
+
+@dataclass
 class PrefixCacheConfig:
     """``ragged.prefix_cache`` block: block-granular KV reuse across requests
     (PagedAttention sharing + RadixAttention LRU tree). Off by default —
@@ -60,6 +96,9 @@ class PrefixCacheConfig:
     # rides the prefix cache because the radix tree is what gives block
     # reuse a lifecycle worth accounting
     telemetry: CacheTelemetryConfig = field(default_factory=CacheTelemetryConfig)
+    # host-memory (+ optional disk) capacity tier under the radix tree:
+    # presence-enabled — None means no tier objects exist anywhere
+    host_tier: object = None  # Optional[HostTierConfig]
 
 
 @dataclass

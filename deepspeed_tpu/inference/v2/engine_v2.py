@@ -1266,6 +1266,17 @@ class InferenceEngineV2:
         ``ragged.prefix_cache.telemetry`` block is enabled)."""
         return self.state_manager.cache_telemetry
 
+    @property
+    def tiered_store(self):
+        """The host/disk KV capacity tier (None unless the
+        ``ragged.prefix_cache.host_tier`` block is present and enabled)."""
+        return self.state_manager.tiered_store
+
+    def shutdown(self) -> None:
+        """Stop background workers this engine owns (currently the KV
+        tier's migration thread). Idempotent; a no-op without a tier."""
+        self.state_manager.shutdown()
+
     # -- HBM attribution (monitor/memory.py) ----------------------------
     def _memory_sections(self):
         # per-host shard bytes (the pools shard over the model axis under
@@ -1346,11 +1357,17 @@ class InferenceEngineV2:
 
     def _create_with_prefix(self, uid: int, prompt_tokens, match=None, tenant=None):
         """Sequence creation + the monitor's view of the lookup: hit-rate
-        gauge, cached-token counters, and a ``prefix_hit`` trace span."""
+        gauge, cached-token counters, and a ``prefix_hit`` trace span. When
+        the hit landed on a demoted chain, the synchronous H2D promotion
+        wait the request just ate is booked as ``input_wait``-class goodput
+        and emitted as a ``serving/promote_wait`` span — a tier that slows
+        admission must show up in the ledger, never silently."""
+        pc = self.state_manager.prefix_cache
+        pw0 = pc.stats["promote_wait_s"] if pc is not None else 0.0
+        t0 = time.perf_counter()
         seq, skip = self.state_manager.create_sequence_with_prefix(uid, prompt_tokens,
                                                                    match=match,
                                                                    tenant=tenant)
-        pc = self.state_manager.prefix_cache
         if pc is not None:
             m = get_metrics()
             m.counter("serving/prefix_lookups").inc()
@@ -1360,6 +1377,15 @@ class InferenceEngineV2:
                 m.counter("serving/prefix_cached_tokens").inc(skip)
                 get_tracer().instant("prefix_hit", tid="serving", uid=int(uid),
                                      tokens=int(skip), blocks=len(seq.kv_blocks))
+            promote_wait = pc.stats["promote_wait_s"] - pw0
+            if promote_wait > 0.0:
+                gl = self.goodput_ledger
+                if gl is not None:
+                    gl.book("input_wait", promote_wait)
+                tr = get_tracer()
+                if tr.enabled:
+                    tr.complete("serving/promote_wait", t0, promote_wait,
+                                tid="serving", args={"uid": int(uid)})
         return seq, skip
 
     # ------------------------------------------------------------------

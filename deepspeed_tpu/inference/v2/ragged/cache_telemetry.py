@@ -216,9 +216,11 @@ class CacheTelemetry:
         self._alloc_t = np.zeros(nb, np.float64)
         self._access_t = np.zeros(nb, np.float64)
         self._tree_held = np.zeros(nb, bool)
-        # lifetime event counters (ints, monotonic)
+        # lifetime event counters (ints, monotonic). The demote/promote
+        # trio stays zero (and costs nothing) without a host tier.
         self.counters = {"allocated": 0, "freed": 0, "published": 0,
-                         "hit_blocks": 0, "evicted": 0}
+                         "hit_blocks": 0, "evicted": 0,
+                         "demote_queued": 0, "demoted": 0, "promoted": 0}
         # local histograms: self-contained and deterministic whether or not
         # the global metrics registry is armed (the registry gets mirrored
         # observations when it is — cumulative Prometheus buckets for free)
@@ -226,6 +228,21 @@ class CacheTelemetry:
         self.reuse_interval_s = Histogram("cache/reuse_interval_s", buckets=AGE_BUCKETS_S)
         self.evicted_block_age_s = Histogram("cache/evicted_block_age_s",
                                              buckets=AGE_BUCKETS_S)
+        # tier migration latency distributions: promote is the admission-
+        # side wait a request actually eats (headline p50/p99 in the
+        # serving_load host_tier A/B); demote is worker-side queue+copy time
+        self.promote_latency_s = Histogram("cache/promote_latency_s",
+                                           buckets=AGE_BUCKETS_S)
+        self.demote_latency_s = Histogram("cache/demote_latency_s",
+                                          buckets=AGE_BUCKETS_S)
+        # host-tier occupancy-time integral ∫ host_used_blocks dt — the
+        # host-pool ground truth the tenant meter's host_kv_s charges must
+        # sum to (same conservation contract as the HBM integral below).
+        # Advanced with ABSOLUTE used-counts the tier reports on every
+        # transition (all under the tree lock, so no extra locking here).
+        self._host_occ_blocks = 0
+        self._host_occ_last_t = self._clock()
+        self._host_occ_integral_s = 0.0
         # occupancy-time integral ∫ occupied_blocks dt (block-seconds),
         # advanced at every allocate/free event: the pool-side ground truth
         # the tenant meter's per-owner KV-block-second charges must sum to
@@ -322,6 +339,53 @@ class CacheTelemetry:
         gone but this was not LRU pressure — no victim-age samples."""
         self._tree_held[np.asarray(list(blocks), np.int64)] = False
 
+    # -- tier hooks (tiered_store.py; all under the tree lock) -------------
+    def on_demote_queued(self, block: int) -> None:
+        """Eviction handed a block to the migration queue instead of
+        dropping it (the HBM block is released NOW; the D2H completes on
+        the worker)."""
+        self.counters["demote_queued"] += 1
+        self._tree_held[int(block)] = False
+
+    def on_demote(self, host_used_blocks: int, wait_s: float = 0.0) -> None:
+        """The migration worker finalized one demotion into the host pool:
+        ``wait_s`` is enqueue→resident (queue wait + D2H + host write)."""
+        self.counters["demoted"] += 1
+        self.demote_latency_s.observe(max(0.0, wait_s))
+        self.note_host_used(host_used_blocks)
+        reg = get_metrics()
+        if reg.enabled:
+            reg.histogram("cache/demote_latency_s",
+                          buckets=AGE_BUCKETS_S).observe(max(0.0, wait_s))
+
+    def on_promote(self, block: int, wait_s: float = 0.0,
+                   from_disk: bool = False) -> None:
+        """A demoted chain hit was restored to HBM on the admission path:
+        ``wait_s`` is the synchronous H2D (+ disk read) the request ate."""
+        self.counters["promoted"] += 1
+        self._tree_held[int(block)] = True
+        self._access_t[int(block)] = self._clock()
+        self.promote_latency_s.observe(max(0.0, wait_s))
+        reg = get_metrics()
+        if reg.enabled:
+            reg.histogram("cache/promote_latency_s",
+                          buckets=AGE_BUCKETS_S).observe(max(0.0, wait_s))
+
+    def note_host_used(self, used_blocks: int) -> None:
+        """Advance the host occupancy-time integral to an ABSOLUTE used
+        count (the tier reports after every host-pool transition)."""
+        now = self._clock()
+        self._host_occ_integral_s += self._host_occ_blocks * max(0.0, now - self._host_occ_last_t)
+        self._host_occ_last_t = now
+        self._host_occ_blocks = max(0, int(used_blocks))
+
+    def host_occupancy_integral_s(self) -> float:
+        """Host-block-seconds of tier occupancy since construction (current
+        residents' partial interval included) — what the per-tenant
+        ``host_kv_s`` charges must reconcile against."""
+        now = self._clock()
+        return self._host_occ_integral_s + self._host_occ_blocks * max(0.0, now - self._host_occ_last_t)
+
     # -- MRC feed (called under the tree lock) -----------------------------
     def record_lookup(self, keys, observed_hits: int) -> None:
         self.mrc.record(keys, observed_hits)
@@ -383,6 +447,10 @@ class CacheTelemetry:
                         self.reuse_interval_s.percentile(50)))
         rows.append(row("cache/evicted_block_age_p50_s", {},
                         self.evicted_block_age_s.percentile(50)))
+        if self.counters["demote_queued"] or self._host_occ_blocks:
+            rows.append(row("cache/host_blocks_used", {}, self._host_occ_blocks))
+            rows.append(row("cache/promote_latency_p50_s", {},
+                            self.promote_latency_s.percentile(50)))
         return rows
 
     def snapshot(self) -> dict:
@@ -403,6 +471,15 @@ class CacheTelemetry:
                                       if self.mrc.observed_hit_rate is not None else None),
             "mrc_refs": self.mrc.refs_total,
             "mrc_tracked_keys": self.mrc.tracked_keys,
+            "tiers": {
+                "demote_queued": self.counters["demote_queued"],
+                "demoted": self.counters["demoted"],
+                "promoted": self.counters["promoted"],
+                "host_blocks_used": self._host_occ_blocks,
+                "host_occupancy_integral_s": round(self.host_occupancy_integral_s(), 6),
+                "promote_latency_s": self.promote_latency_s.summary(),
+                "demote_latency_s": self.demote_latency_s.summary(),
+            },
         }
 
     def reset(self) -> None:
@@ -416,3 +493,11 @@ class CacheTelemetry:
         self.reuse_interval_s = Histogram("cache/reuse_interval_s", buckets=AGE_BUCKETS_S)
         self.evicted_block_age_s = Histogram("cache/evicted_block_age_s",
                                              buckets=AGE_BUCKETS_S)
+        self.promote_latency_s = Histogram("cache/promote_latency_s",
+                                           buckets=AGE_BUCKETS_S)
+        self.demote_latency_s = Histogram("cache/demote_latency_s",
+                                          buckets=AGE_BUCKETS_S)
+        # the host occupancy INTEGRAL is an accumulator; the current used
+        # count is live state and survives (same rule as the stamp arrays)
+        self._host_occ_integral_s = 0.0
+        self._host_occ_last_t = self._clock()

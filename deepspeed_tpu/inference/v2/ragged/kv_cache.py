@@ -130,6 +130,43 @@ class BlockedKVCache:
                 sc = sc.at[:, :, d:d + bs].set(sc[:, :, s:s + bs])
                 setattr(self, name, sc.reshape(nkv, -1))
 
+    # -- tier migration surface (ragged/tiered_store.py) -------------------
+    def read_block(self, block: int):
+        """Value-snapshot of one block's KV for D2H demotion:
+        ``(k, v, k_scale, v_scale)`` device arrays (scales None on the
+        non-quantized layout), each a NEW functional slice of the pools.
+        The snapshot is safe to materialize from another thread AFTER the
+        physical block is freed and even after the pool buffers themselves
+        are donated to a later forward — jax slicing captures the pool
+        VALUE at call time, so the migration worker's ``np.asarray`` reads
+        the snapshot, never the live (possibly reused) slots."""
+        bs = self.block_size
+        s = int(block) * bs
+        k = self.k_pool[:, s:s + bs]
+        v = self.v_pool[:, s:s + bs]
+        ks = vs = None
+        if self.quantized:
+            nkv, span = self.num_kv_heads, self.num_blocks * bs
+            ks = self.k_scale.reshape(nkv, self.num_layers, span)[:, :, s:s + bs]
+            vs = self.v_scale.reshape(nkv, self.num_layers, span)[:, :, s:s + bs]
+        return k, v, ks, vs
+
+    def write_block(self, block: int, k, v, k_scale=None, v_scale=None) -> None:
+        """H2D promotion: install host-resident KV into one block's slots
+        (the inverse of :meth:`read_block`, same shapes). MUST run on the
+        driver thread between forwards — it replaces the pool arrays, and
+        racing a forward's donation would read an invalidated buffer."""
+        bs = self.block_size
+        d = int(block) * bs
+        self.k_pool = self.k_pool.at[:, d:d + bs].set(jnp.asarray(k, self.k_pool.dtype))
+        self.v_pool = self.v_pool.at[:, d:d + bs].set(jnp.asarray(v, self.v_pool.dtype))
+        if self.quantized and k_scale is not None:
+            nkv, span = self.num_kv_heads, self.num_blocks * bs
+            for name, blk in (("k_scale", k_scale), ("v_scale", v_scale)):
+                sc = getattr(self, name).reshape(nkv, self.num_layers, span)
+                sc = sc.at[:, :, d:d + bs].set(jnp.asarray(blk, jnp.float32))
+                setattr(self, name, sc.reshape(nkv, -1))
+
     def compact_slots(self, src_slots, dst_slots) -> None:
         """Device-side KV move of individual token slots ``src → dst``
         across every layer — the token-tree verification commit: an

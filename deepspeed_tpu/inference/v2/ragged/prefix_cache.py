@@ -24,13 +24,16 @@ scheduler / engine (asserted by ``tests/test_prefix_cache.py`` and the
 
 import heapq
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ....monitor.flight import get_flight_recorder
 from ....monitor.metrics import get_metrics
 from .cache_telemetry import chunk_key
+from .tiered_store import RES_DISK, RES_HBM, RES_HOST, RES_IN_FLIGHT
 
 
 class _Node:
@@ -39,9 +42,19 @@ class _Node:
     ``owner`` is the publishing sequence's tenant (serving metering): one
     string reference, stamped at insert — it makes hits and eviction
     pressure attributable per tenant, and is the exact prerequisite for
-    ROADMAP item 4's tenant-prefixed radix keys."""
+    ROADMAP item 4's tenant-prefixed radix keys.
 
-    __slots__ = ("chunk", "block", "parent", "children", "last_access", "owner")
+    ``res``/``host_block``/``disk_id`` are the tiered-store residency
+    fields (``tiered_store.py``): which tier holds this chunk's KV and its
+    slot there. Without a host tier they stay at the class-constant-like
+    defaults forever (shared small ints / interned str — no per-block
+    allocations, preserving the zero-overhead-absent contract). The
+    invariant the tier maintains: along any root→leaf path residency is
+    monotone ``hbm* (in_flight|host)* disk*`` — a demoted node never sits
+    above an HBM one, so the match walk's HBM run is always a tree prefix."""
+
+    __slots__ = ("chunk", "block", "parent", "children", "last_access", "owner",
+                 "res", "host_block", "disk_id")
 
     def __init__(self, chunk, block, parent, owner=None):
         self.chunk = chunk
@@ -50,6 +63,9 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.last_access = 0
         self.owner = owner
+        self.res = RES_HBM
+        self.host_block = -1
+        self.disk_id = -1
 
 
 @dataclass
@@ -57,13 +73,20 @@ class PrefixMatch:
     """Result of a (pure) longest-prefix walk."""
 
     n_cached_tokens: int = 0      # tokens of prompt covered (full + COW tail)
-    shared_blocks: List[int] = field(default_factory=list)  # full-block hits
+    shared_blocks: List[int] = field(default_factory=list)  # HBM full-block hits
     cow_src: Optional[int] = None  # block to duplicate for a partial tail
     cow_tokens: int = 0            # tokens of the COW block that are reusable
+    # demoted chain matched past the HBM run (host/disk residency): COUNT
+    # only — the blocks have no HBM id yet; ``acquire`` promotes them.
+    # Admission treats these as uncached supply-wise (promotion charges the
+    # budget like uncached tokens), so they are deliberately NOT part of
+    # ``shared_blocks``.
+    host_blocks: int = 0
 
     @property
     def hit_blocks(self) -> int:
-        return len(self.shared_blocks) + (1 if self.cow_src is not None else 0)
+        return (len(self.shared_blocks) + self.host_blocks
+                + (1 if self.cow_src is not None else 0))
 
 
 class PrefixKVCache:
@@ -93,6 +116,10 @@ class PrefixKVCache:
         # by DSStateManager.set_tenant_meter: hit attribution via node
         # owners, publish credit, eviction pressure. Same None contract.
         self._meter = None
+        # host/disk capacity tier (tiered_store.TieredBlockStore), wired by
+        # attach_tier when ragged.prefix_cache.host_tier is present. Same
+        # None contract: absent ⇒ every tier branch is one attribute check.
+        self._tier = None
         self._root = _Node(chunk=(), block=-1, parent=None)
         self._n_nodes = 0
         self._clock = 0  # monotonic LRU clock
@@ -109,7 +136,11 @@ class PrefixKVCache:
         # registry as cache/evicted_tokens + cache/cow_bytes counters
         self.stats = {"lookups": 0, "hits": 0, "cached_tokens": 0, "cow_copies": 0,
                       "insertions": 0, "evictions": 0, "evicted_tokens": 0,
-                      "cow_bytes": 0}
+                      "cow_bytes": 0,
+                      # tier lifecycle (all zero and inert without a tier)
+                      "demotions_queued": 0, "promotions": 0,
+                      "promoted_tokens": 0, "promote_wait_s": 0.0,
+                      "evict_starved": 0, "readoptions": 0}
 
     # -- queries -----------------------------------------------------------
     @property
@@ -121,29 +152,51 @@ class PrefixKVCache:
         return self.stats["hits"] / self.stats["lookups"] if self.stats["lookups"] else 0.0
 
     def cached_block_ids(self) -> List[int]:
-        """Block ids currently held by the tree (one tree reference each)."""
+        """HBM block ids currently held by the tree (one tree reference
+        each). Demoted nodes have no HBM block and are excluded."""
         with self._tree_lock:
-            return [n.block for n in self._iter_nodes()]
+            return [n.block for n in self._iter_nodes() if n.res == RES_HBM]
 
     @property
     def evictable_blocks(self) -> int:
-        """Blocks eviction could return to the free list RIGHT NOW: tree-held
-        blocks whose only reference is the tree's. Exact, not an upper bound:
-        a sequence holding a node always holds its whole ancestor path
-        (``acquire`` pins the matched run, ``publish`` descends only through
-        blocks the publisher holds), so a sole-owner node's entire subtree
-        is sole-owner too and repeated leaf eviction reaches all of it.
-        O(tree) per call — fine at the current pool scale; an incrementally
-        maintained counter needs refcount-transition hooks in the allocator
-        and is the first thing to add if admission ever shows up hot."""
+        """HBM blocks eviction could return to the free list RIGHT NOW:
+        tree-held blocks whose only reference is the tree's (demoted nodes
+        hold no HBM block — ``available_blocks`` stays HBM-only by
+        construction). Exact, not an upper bound: a sequence holding a node
+        always holds its whole ancestor path (``acquire`` pins the matched
+        run, ``publish`` descends only through blocks the publisher holds),
+        so a sole-owner node's entire subtree is sole-owner too and repeated
+        leaf eviction reaches all of it. O(tree) per call — fine at the
+        current pool scale; an incrementally maintained counter needs
+        refcount-transition hooks in the allocator and is the first thing
+        to add if admission ever shows up hot."""
         with self._tree_lock:
             return sum(1 for n in self._iter_nodes()
-                       if self.kv_cache.refcount(n.block) == 1)
+                       if n.res == RES_HBM and self.kv_cache.refcount(n.block) == 1)
+
+    @property
+    def host_resident_blocks(self) -> int:
+        """Nodes whose KV currently lives in the host (or disk) tier."""
+        with self._tree_lock:
+            return sum(1 for n in self._iter_nodes()
+                       if n.res in (RES_HOST, RES_DISK))
 
     def set_meter(self, view) -> None:
         """Arm (or with None, disarm) the tenant-metering forwards."""
         with self._tree_lock:
             self._meter = view
+            if self._tier is not None:
+                self._tier.set_meter(view)
+
+    def attach_tier(self, tier) -> None:
+        """Wire the host/disk capacity tier (``tiered_store.py``) under the
+        tree: eviction demotes instead of dropping, the match walk extends
+        into demoted chains, ``acquire`` promotes them back."""
+        with self._tree_lock:
+            self._tier = tier
+            tier.attach(self)
+            if self._meter is not None:
+                tier.set_meter(self._meter)
 
     # -- admission side ----------------------------------------------------
     def match(self, tokens) -> PrefixMatch:
@@ -169,7 +222,17 @@ class PrefixKVCache:
             child = node.children.get(tuple(int(t) for t in tokens[j * bs:(j + 1) * bs]))
             if child is None:
                 break
-            m.shared_blocks.append(child.block)
+            if child.res == RES_HBM:
+                if m.host_blocks:
+                    break  # unreachable by the residency-ordering invariant
+                m.shared_blocks.append(child.block)
+            elif child.res in (RES_HOST, RES_DISK):
+                # demoted chain: usable after promotion — counted, not id'd
+                m.host_blocks += 1
+            else:
+                # in_flight: the migration worker owns it; neither tier's
+                # copy is authoritative yet, so the walk stops here
+                break
             node = child
             j += 1
         # partial tail: the longest common prefix between the remaining
@@ -181,9 +244,13 @@ class PrefixKVCache:
         # reuse is unreachable here (an exact-chunk child would have matched
         # above unless the cap already stopped the walk)
         cap = min(usable - j * bs, bs)
-        if cap >= 1 and node.children:
+        # COW needs a device-side source block, so only HBM children apply —
+        # and only when the run didn't end inside a demoted chain
+        if cap >= 1 and node.children and m.host_blocks == 0:
             best, best_t = None, 0
             for child in node.children.values():
+                if child.res != RES_HBM:
+                    continue
                 key = np.asarray(child.chunk[:cap], dtype=np.int64)
                 neq = np.nonzero(rest[:key.size] != key)[0]
                 t = int(neq[0]) if neq.size else int(key.size)
@@ -219,33 +286,52 @@ class PrefixKVCache:
         bs = self.block_size
         with self._tree_lock:
             self.stats["lookups"] += 1
-            m = match if match is not None else self._match_locked(tokens)
+            if self._tier is not None:
+                # residency can change between the admission probe and here
+                # (the migration worker finalizes demotions on its own
+                # thread), so with a tier armed the match is always redone
+                # under the lock — O(prompt), noise against the promotion
+                # D2H/H2D it guards
+                m = self._match_locked(tokens)
+            else:
+                m = match if match is not None else self._match_locked(tokens)
             if self._telemetry is not None:
                 # MRC demand feed: EVERY usable full-block chunk of the
                 # prompt is one reference (path-chained keys), hit or miss —
                 # cold misses belong in the miss-ratio denominator. Fed
                 # before the early return so refused hits still count.
+                # Demoted-chain hits count as demand too: the MRC models the
+                # HIERARCHY (a host hit at 4x capacity is the evidence the
+                # curve exists to surface).
                 key, keys = 0, []
                 for i in range((tokens.size - 1) // bs):
                     key = chunk_key(key, tokens[i * bs:(i + 1) * bs])
                     keys.append(key)
-                self._telemetry.record_lookup(keys, len(m.shared_blocks))
+                self._telemetry.record_lookup(keys, len(m.shared_blocks) + m.host_blocks)
             if m.n_cached_tokens == 0:
                 return [], 0, 0
-            # touch the matched path (LRU) and pin the shared run
+            # touch the matched path (LRU), pin the HBM run, collect the
+            # demoted chain for promotion
             node = self._root
             hit_owners = [] if self._meter is not None else None
-            for i, b in enumerate(m.shared_blocks):
+            n_shared = len(m.shared_blocks)
+            chain = []
+            for i in range(n_shared + m.host_blocks):
                 node = node.children[tuple(int(t) for t in np.asarray(tokens[i * bs:(i + 1) * bs]))]
                 self._touch(node)
-                if hit_owners is not None:
-                    hit_owners.append((node.owner, bs))
+                if i < n_shared:
+                    if hit_owners is not None:
+                        hit_owners.append((node.owner, bs))
+                else:
+                    chain.append(node)
             if m.shared_blocks:
                 self.kv_cache.incref(m.shared_blocks)
                 if self._telemetry is not None:
                     self._telemetry.on_hit(m.shared_blocks)
             blocks = list(m.shared_blocks)
-            n_cached = len(m.shared_blocks) * bs
+            n_cached = n_shared * bs
+            if chain:
+                n_cached += self._promote_chain(chain, blocks, hit_owners, tenant)
             if m.cow_src is not None:
                 try:
                     dst = int(self._reserve_with_eviction(1)[0])
@@ -278,6 +364,57 @@ class PrefixKVCache:
             self.stats["hits"] += 1
             self.stats["cached_tokens"] += n_cached
             return blocks, n_cached, len(m.shared_blocks)
+
+    def _promote_chain(self, chain, blocks, hit_owners, tenant) -> int:
+        """H2D-restore a matched demoted run IN ORDER (root-ward first) on
+        the driver thread, ahead of prefill — the admission-side half of the
+        tier, and the only synchronous migration anywhere (decode steps
+        never reach here). Each promoted node regains an HBM block holding
+        the tree's reference plus the requesting sequence's — the incref
+        immediately after install pins it against the NEXT iteration's
+        ``_reserve_with_eviction``. Returns the tokens restored; a dry pool
+        or a lost backing copy SHORTENS the hit instead of failing it."""
+        bs = self.block_size
+        tier = self._tier
+        promoted = 0
+        for hn in chain:
+            t0 = time.monotonic()
+            payload = tier.promote_payload(hn)
+            if payload is None:
+                # backing copy gone (disk corruption / torn spill): the
+                # node and its demoted descendants are unusable without it
+                # — a shorter hit, never wrong KV
+                self._drop_node_subtree(hn)
+                break
+            try:
+                dst = int(self._reserve_with_eviction(1)[0])
+            except ValueError:
+                break  # HBM dry even after eviction: shorten the hit
+            from_disk = hn.res == RES_DISK
+            self.kv_cache.write_block(dst, *payload)
+            tier.release_resident(hn)
+            hn.res = RES_HBM
+            hn.block = dst
+            # tree reference came with the reserve; this is the sequence's
+            self.kv_cache.incref([dst])
+            tier.note_promoted(from_disk)
+            if self._meter is not None:
+                # residency restarts under the original publisher, exactly
+                # like a publish stamp — the owner survives the round trip
+                self._meter.stamp([dst], hn.owner)
+            blocks.append(dst)
+            promoted += 1
+            dt = time.monotonic() - t0
+            self.stats["promote_wait_s"] += dt
+            if hit_owners is not None:
+                hit_owners.append((hn.owner, bs))
+            if self._telemetry is not None:
+                self._telemetry.on_promote(dst, wait_s=dt, from_disk=from_disk)
+        if promoted:
+            self.stats["promotions"] += promoted
+            self.stats["promoted_tokens"] += promoted * bs
+            get_metrics().counter("cache/promotions").inc(promoted)
+        return promoted * bs
 
     # -- exit side ---------------------------------------------------------
     def publish(self, seq) -> int:
@@ -330,6 +467,22 @@ class PrefixKVCache:
                     if tel is not None:
                         tel.on_publish(child.block)
                         new_keys.append(key)
+                elif child.res != RES_HBM:
+                    # re-adopt: the publisher holds a live HBM copy of a
+                    # chunk the tree only has demoted (or mid-demotion) —
+                    # take the publisher's block as the node's HBM copy for
+                    # free (no H2D) and drop the tier copy; an in-flight
+                    # demotion finalizes as cancelled when the worker sees
+                    # the residency flipped back
+                    if self._tier is not None:
+                        self._tier.release_resident(child)
+                    child.res = RES_HBM
+                    child.block = int(seq.kv_blocks[b])
+                    self.kv_cache.incref(child.block)
+                    self.stats["readoptions"] += 1
+                    self._touch(child)
+                    if tel is not None:
+                        tel.on_publish(child.block)
                 elif child.block != seq.kv_blocks[b]:
                     break  # a different writer owns this path from here down
                 node = child
@@ -346,39 +499,122 @@ class PrefixKVCache:
 
     # -- pressure valve ----------------------------------------------------
     def evict(self, n_blocks: int) -> int:
-        """Release up to ``n_blocks`` tree-only blocks, LRU leaves first.
-        One pass builds a min-heap of evictable leaves; a removed leaf that
-        exposes its parent (now a leaf, tree-only) pushes the parent — no
-        per-block rescan of the whole tree.
-        Returns how many blocks actually went back to the free list."""
+        """Free up to ``n_blocks`` HBM blocks from tree-only holders, LRU
+        HBM-leaves first (nodes with no HBM children — demoted descendants
+        don't anchor their parent). One pass builds a min-heap of evictable
+        leaves; a removed leaf that exposes its parent pushes the parent —
+        no per-block rescan of the whole tree.
+
+        With a tier attached each victim is DEMOTED (functional device
+        snapshot captured here on the driver thread, HBM block released
+        immediately, the D2H copy finishes on the migration worker); a full
+        migration queue falls back to the plain drop — eviction never waits
+        on the worker. Returns how many HBM blocks actually went back to
+        the free list; a shortfall is counted and breadcrumbed so operators
+        can tell eviction-starved (all holders active) from pool-dry
+        (nothing tree-held at all)."""
         with self._tree_lock:
-            heap = [(n.last_access, id(n), n) for n in self._iter_leaves()
+            requested = int(n_blocks)
+            heap = [(n.last_access, id(n), n) for n in self._iter_hbm_leaves()
                     if self.kv_cache.refcount(n.block) == 1]
             heapq.heapify(heap)
             freed = 0
             while heap and freed < n_blocks:
                 _, _, node = heapq.heappop(heap)
                 parent = node.parent
-                self._remove(node)
+                if not self._demote_node(node):
+                    if node.children:
+                        # demoted/in-flight children can't outlive their
+                        # parent's KV: the drop takes the whole subtree
+                        self._drop_node_subtree(node)
+                    else:
+                        self._remove(node)
+                        self.stats["evictions"] += 1
                 freed += 1
-                self.stats["evictions"] += 1
-                if (parent is not self._root and not parent.children
-                        and self.kv_cache.refcount(parent.block) == 1):
+                if (parent is not self._root and parent.res == RES_HBM
+                        and self.kv_cache.refcount(parent.block) == 1
+                        and not any(c.res == RES_HBM
+                                    for c in parent.children.values())):
                     heapq.heappush(heap, (parent.last_access, id(parent), parent))
+            if freed < requested:
+                self.stats["evict_starved"] += 1
+                get_metrics().counter("cache/evict_starved_total").inc()
+                reason = "pool_dry"
+                for n in self._iter_nodes():
+                    if n.res == RES_HBM:
+                        reason = "eviction_starved"
+                        break
+                get_flight_recorder().record("cache", "evict_starved",
+                                             requested=requested, freed=freed,
+                                             reason=reason)
             return freed
 
+    def _demote_node(self, node) -> bool:
+        """Hand one HBM victim to the tier's migration queue: capture the
+        functional device snapshot (driver thread — the donation-safety
+        rule), mark the node ``in_flight``, release the HBM block NOW so
+        the caller's reserve succeeds without waiting for the D2H. False
+        (tier absent / queue at depth) means the caller drops instead."""
+        if self._tier is None:
+            return False
+        snapshot = self.kv_cache.read_block(node.block)
+        if not self._tier.try_demote(node, snapshot):
+            return False
+        block = node.block
+        node.res = RES_IN_FLIGHT
+        node.block = -1
+        self.stats["demotions_queued"] += 1
+        if self._telemetry is not None:
+            self._telemetry.on_demote_queued(block)
+        self.kv_cache.release(block)
+        return True
+
+    def demote_cold(self, n_blocks: int) -> int:
+        """Proactive watermark demotion (``host_tier.low_watermark``): move
+        up to ``n_blocks`` cold tree-only HBM-leaves to the tier WITHOUT
+        dropping anything — a full queue stops the pass (unlike demand
+        ``evict``, nothing here has to free memory). Keeps demand eviction
+        off the inline-demote path in the steady state."""
+        if self._tier is None or n_blocks <= 0:
+            return 0
+        with self._tree_lock:
+            heap = [(n.last_access, id(n), n) for n in self._iter_hbm_leaves()
+                    if self.kv_cache.refcount(n.block) == 1]
+            heapq.heapify(heap)
+            moved = 0
+            while heap and moved < n_blocks:
+                _, _, node = heapq.heappop(heap)
+                parent = node.parent
+                if not self._demote_node(node):
+                    break
+                moved += 1
+                if (parent is not self._root and parent.res == RES_HBM
+                        and self.kv_cache.refcount(parent.block) == 1
+                        and not any(c.res == RES_HBM
+                                    for c in parent.children.values())):
+                    heapq.heappush(heap, (parent.last_access, id(parent), parent))
+            return moved
+
     def clear(self) -> int:
-        """Release EVERY tree reference (eviction flush): blocks whose only
-        holder was the tree return to the free list; blocks still held by
-        live sequences merely lose the tree's reference."""
+        """Release EVERY tree reference (eviction flush): HBM blocks whose
+        only holder was the tree return to the free list; blocks still held
+        by live sequences merely lose the tree's reference; host/disk
+        copies are dropped and in-flight demotions finalize as cancelled
+        (the worker sees the node detached)."""
         with self._tree_lock:
             nodes = list(self._iter_nodes())
-            if self._telemetry is not None and nodes:
+            hbm = [n.block for n in nodes if n.res == RES_HBM]
+            if self._telemetry is not None and hbm:
                 # a flush is not LRU pressure: drop the tree-held flags
                 # without recording eviction-victim ages
-                self._telemetry.on_tree_clear([n.block for n in nodes])
+                self._telemetry.on_tree_clear(hbm)
             for node in nodes:
-                self.kv_cache.release(node.block)
+                if node.res == RES_HBM:
+                    self.kv_cache.release(node.block)
+                elif self._tier is not None:
+                    self._tier.release_resident(node)
+                node.parent = None  # detaches any in-flight migration
+                node.children = {}
             self._root.children = {}
             self._n_nodes = 0
             return len(nodes)
@@ -404,6 +640,16 @@ class PrefixKVCache:
     def _iter_leaves(self):
         return (n for n in self._iter_nodes() if not n.children)
 
+    def _iter_hbm_leaves(self):
+        """Eviction/demotion victims: HBM-resident nodes with no HBM
+        children. Demoted (host/disk/in-flight) descendants don't anchor
+        their parent — demoting the parent keeps the root-ward residency
+        ordering (it joins them in the lower tier). Without a tier every
+        node is HBM and this degenerates to plain leaves."""
+        return (n for n in self._iter_nodes()
+                if n.res == RES_HBM
+                and not any(c.res == RES_HBM for c in n.children.values()))
+
     def _remove(self, node) -> None:
         assert not node.children, "only leaves are evictable"
         del node.parent.children[node.chunk]
@@ -418,3 +664,37 @@ class PrefixKVCache:
             self._meter.on_evict(node.owner)
         self.kv_cache.release(node.block)
         self._n_nodes -= 1
+
+    def _drop_node_subtree(self, node) -> int:
+        """Remove ``node`` and every descendant (demotion failure, disk
+        corruption, host-tier overflow drop, queue-full eviction of a node
+        with demoted children): by the residency ordering the descendants
+        are host/disk/in-flight — unusable without this node's KV, so the
+        whole subtree goes. Tier copies are freed, in-flight jobs are left
+        to cancel themselves (the worker sees the node detached). Called
+        under the tree lock, from the driver thread OR the migration
+        worker's failure path. Returns the node count dropped."""
+        if node.parent is None:
+            return 0  # already detached (racing drop)
+        del node.parent.children[node.chunk]
+        dropped = 0
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            n.children = {}
+            n.parent = None
+            if n.res == RES_HBM and n.block >= 0:
+                if self._telemetry is not None:
+                    self._telemetry.on_evict(n.block)
+                if self._meter is not None:
+                    self._meter.on_evict(n.owner)
+                self.kv_cache.release(n.block)
+            elif self._tier is not None:
+                self._tier.release_resident(n)
+            n.block = -1
+            self._n_nodes -= 1
+            dropped += 1
+            self.stats["evictions"] += 1
+            self.stats["evicted_tokens"] += self.block_size
+        return dropped

@@ -33,6 +33,10 @@ class DSStateManager:
         self.kv_cache = BlockedKVCache(num_layers, num_kv_heads, head_dim, num_blocks, block_size, dtype=dtype,
                                        sharding=kv_sharding)
         self.prefix_cache: Optional[PrefixKVCache] = None
+        # host/disk capacity tier under the radix tree (tiered_store.py);
+        # None whenever ragged.prefix_cache.host_tier is absent/disabled —
+        # the zero-overhead-absent contract
+        self.tiered_store = None
         # memory & cache observability plane (``ragged.prefix_cache.telemetry``
         # block): when absent/off, NO telemetry object exists anywhere and
         # every hook in the allocator/tree stays one `is not None` check —
@@ -49,6 +53,16 @@ class DSStateManager:
                                               min_hit_blocks=prefix_cache_config.min_hit_blocks,
                                               eviction=prefix_cache_config.eviction,
                                               telemetry=self.cache_telemetry)
+            ht_cfg = getattr(prefix_cache_config, "host_tier", None)
+            if ht_cfg is not None and getattr(ht_cfg, "enabled", False):
+                # host/disk capacity tier (ragged.prefix_cache.host_tier):
+                # presence-enabled — this branch is the ONLY place tier
+                # objects (and the migration worker thread) come to exist
+                from .tiered_store import TieredBlockStore
+
+                self.tiered_store = TieredBlockStore(self.kv_cache, ht_cfg,
+                                                     telemetry=self.cache_telemetry)
+                self.prefix_cache.attach_tier(self.tiered_store)
         elif tel_cfg is not None and getattr(tel_cfg, "enabled", False):
             # the telemetry plane rides the prefix cache (blocks only have a
             # reuse lifecycle once the radix tree shares them) — an enabled
@@ -118,6 +132,8 @@ class DSStateManager:
                 out["prefix_cache"] = dict(self.prefix_cache.stats,
                                            cached_blocks=self.prefix_cache.n_cached_blocks,
                                            hit_rate=self.prefix_cache.hit_rate)
+            if self.tiered_store is not None:
+                out["host_tier"] = self.tiered_store.snapshot()
             return out
         return self._seqs.get(uid)
 
@@ -175,6 +191,14 @@ class DSStateManager:
                 # residency of every block it materializes KV into
                 self.tenant_meter.stamp(fresh, seq.tenant)
             seq.extend_blocks(fresh)
+        if self.tiered_store is not None:
+            # proactive watermark demotion: below low_watermark free HBM,
+            # push cold tree-only leaves toward the host tier so demand
+            # eviction rarely demotes inline on the admission path. O(1)
+            # when above the watermark.
+            target = self.tiered_store.demotion_target()
+            if target > 0:
+                self.prefix_cache.demote_cold(target)
 
     def note_tokens(self, seq: DSSequenceDescriptor, tokens) -> None:
         """Record the token ids being materialized this forward (put chunk,
@@ -285,6 +309,12 @@ class DSStateManager:
             if m and len(seq.token_history) >= n_tokens >= m:
                 seq.token_history[n_tokens - m:n_tokens] = [int(t) for t in committed_tokens]
         return released
+
+    def shutdown(self) -> None:
+        """Stop the tier's migration worker (engine destroy / test teardown);
+        a no-op without a tier."""
+        if self.tiered_store is not None:
+            self.tiered_store.shutdown()
 
     def flush_sequence(self, uid: int) -> None:
         """Release a finished sequence's block references (reference

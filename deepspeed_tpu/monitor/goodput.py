@@ -73,8 +73,14 @@ from .trace import get_tracer
 
 TRAIN_CATEGORIES = ("compute", "input_wait", "comm_exposed", "ckpt_blocked",
                     "compile", "stall", "recovery", "idle")
+# input_wait on the serving side: admission-path waits a request eats
+# before its prefill can start — today the synchronous H2D promotion of a
+# demoted prefix chain (the tiered KV cache restoring a host/disk-resident
+# hit). Same semantic as the training category: time the accelerator sat
+# ready while the input pipeline (here: the memory hierarchy) caught up.
 SERVING_CATEGORIES = ("prefill_active", "decode_active", "spec_verify",
-                      "idle", "stalled", "draining", "recovering")
+                      "input_wait", "idle", "stalled", "draining",
+                      "recovering")
 
 # training categories booked directly by their sources (compile listener,
 # comm hook, ckpt save path, chaos-gap detection) INSIDE a step window; the
@@ -99,6 +105,8 @@ SPAN_TO_CATEGORY = {
     "serving/decode_step": "decode_active",
     "serving/decode": "decode_active",
     "serving/spec_verify": "spec_verify",
+    # tiered KV cache: synchronous promotion wait on the admission path
+    "serving/promote_wait": "input_wait",
 }
 
 SPAN_ALLOWLIST = (

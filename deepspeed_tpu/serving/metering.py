@@ -124,8 +124,8 @@ class _TenantLedger:
                  "uncached_tokens", "cached_tokens", "generated_tokens",
                  "computed_tokens", "hit_tokens_self", "hit_tokens_cross",
                  "served_tokens", "published_blocks", "evicted_blocks",
-                 "kv_block_s", "compute_s", "queue_s", "starvations",
-                 "waits", "starved", "shed_reasons")
+                 "kv_block_s", "host_kv_s", "compute_s", "queue_s",
+                 "starvations", "waits", "starved", "shed_reasons")
 
     def __init__(self, name, wait_window=64):
         self.name = name
@@ -143,6 +143,7 @@ class _TenantLedger:
         self.published_blocks = 0
         self.evicted_blocks = 0       # eviction pressure: OUR blocks evicted
         self.kv_block_s = 0.0
+        self.host_kv_s = 0.0          # tiered host-pool residency (own resource)
         self.compute_s = {k: 0.0 for k in _COMPUTE_KINDS}
         self.queue_s: Dict[str, float] = {}
         self.starvations = 0
@@ -179,6 +180,7 @@ class _TenantLedger:
         other.published_blocks += self.published_blocks
         other.evicted_blocks += self.evicted_blocks
         other.kv_block_s += self.kv_block_s
+        other.host_kv_s += self.host_kv_s
         for k, v in self.compute_s.items():
             other.compute_s[k] += v
         for c, v in self.queue_s.items():
@@ -202,6 +204,7 @@ class _TenantLedger:
             "published_blocks": self.published_blocks,
             "evicted_blocks": self.evicted_blocks,
             "kv_block_s": round(self.kv_block_s, 6),
+            "host_kv_s": round(self.host_kv_s, 6),
             "compute_s": {k: round(v, 6) for k, v in self.compute_s.items()},
             "compute_total_s": round(self.compute_total_s, 6),
             "queue_s": {c: round(v, 6) for c, v in self.queue_s.items()},
@@ -292,6 +295,12 @@ class EngineMeterView:
     def on_evict(self, owner) -> None:
         self.meter.on_evict(owner)
 
+    def charge_host_kv(self, owner, seconds) -> None:
+        """Tiered host-pool residency charge (the tier calls this when a
+        demoted block leaves the host pool) — HBM stamps survive demotion,
+        so the same owner pays for the host tier as its own resource."""
+        self.meter.charge_host_kv(owner, seconds)
+
 
 class TenantMeter:
     """The gateway's tenant attribution plane (see module docstring).
@@ -311,6 +320,7 @@ class TenantMeter:
         self._tenants: Dict[str, _TenantLedger] = {}
         self._other = _TenantLedger(OTHER_TENANT, config.starvation_window)
         self._untenanted_kv_s = 0.0
+        self._untenanted_host_kv_s = 0.0
         self._views: List[EngineMeterView] = []
         self._global_waits = deque(maxlen=max(16, int(config.starvation_window) * 4))
         self._t0 = time.time()
@@ -476,6 +486,18 @@ class TenantMeter:
             else:
                 self._ledger(tenant).kv_block_s += seconds
 
+    def charge_host_kv(self, tenant, seconds) -> None:
+        """Host-tier block-seconds: a demoted block's residency in the
+        pinned host pool, charged to the owner its HBM stamp carried at
+        demotion time — the tier's own resource, never folded into
+        ``kv_block_s`` (HBM and host capacity are separate budgets)."""
+        seconds = max(0.0, float(seconds))
+        with self._lock:
+            if tenant is None:
+                self._untenanted_host_kv_s += seconds
+            else:
+                self._ledger(tenant).host_kv_s += seconds
+
     def on_prefix_hit(self, tenant, owners, tokens_by_owner) -> None:
         """Hit attribution via tenant-stamped published blocks: the
         consumer splits saved tokens into self vs cross-tenant, and each
@@ -529,6 +551,20 @@ class TenantMeter:
         with self._lock:
             per, unt = self._kv_with_inflight_locked()
         per[UNTENANTED] = unt
+        return per
+
+    def host_kv_block_seconds(self) -> Dict[str, float]:
+        """Per-tenant host-tier block-seconds (charged at host release;
+        blocks still host-resident are not yet included — the conservation
+        test drains the tier before comparing against the telemetry host
+        occupancy integral)."""
+        with self._lock:
+            per = {name: led.host_kv_s for name, led in self._tenants.items()
+                   if led.host_kv_s}
+            if self._other.host_kv_s:
+                per[OTHER_TENANT] = (per.get(OTHER_TENANT, 0.0)
+                                     + self._other.host_kv_s)
+            per[UNTENANTED] = self._untenanted_host_kv_s
         return per
 
     def _fairness_locked(self, per_kv) -> Optional[float]:
@@ -644,6 +680,9 @@ class TenantMeter:
                 rows.append(("serving/tenant_compute_seconds_total", labels,
                              led.compute_total_s))
                 rows.append(("serving/tenant_kv_block_seconds_total", labels, kv_s))
+                if led.host_kv_s:
+                    rows.append(("serving/tenant_host_kv_block_seconds_total",
+                                 labels, led.host_kv_s))
                 rows.append(("serving/tenant_queue_seconds_total", labels,
                              led.queue_total_s))
                 rows.append(("serving/tenant_shed_total", labels, float(led.shed)))

@@ -23,16 +23,20 @@ DEFAULT_V2_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.par
                               "deepspeed_tpu", "inference", "v2")
 
 # the only modules allowed to touch the raw free path: the allocator itself,
-# the device pool fronting it, and the prefix cache (which owns the
-# refcount-aware release/evict logic)
+# the device pool fronting it, the prefix cache (which owns the
+# refcount-aware release/evict logic), and the tier store (which owns the
+# host pool's free list — the same corruption class, one tier down)
 ALLOWED_FILES = (
     os.path.join("ragged", "blocked_allocator.py"),
     os.path.join("ragged", "kv_cache.py"),
     os.path.join("ragged", "prefix_cache.py"),
+    os.path.join("ragged", "tiered_store.py"),
 )
 
-# call names that bypass the refcount-aware release path
-RAW_RELEASE_CALLS = ("free",)
+# call names that bypass the refcount-aware release path: raw HBM frees plus
+# the host pool's own mutators — a host_free/host_reserve/host_write outside
+# the tier store would detach a block's residency state from the radix tree
+RAW_RELEASE_CALLS = ("free", "host_free", "host_reserve", "host_write")
 
 
 def find_violations(v2_dir=DEFAULT_V2_DIR):

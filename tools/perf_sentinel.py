@@ -44,7 +44,10 @@ _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_bytes", "_seconds", "_blocked_ratio")
 HIGHER_BETTER_SUFFIXES = ("tok_s", "_rate", "_mfu", "_mbu", "speedup",
                           "_tokens_per_sec")
-HIGHER_BETTER_NAMES = ("value", "mfu", "mbu", "accept_rate", "hit_rate", "ratio")
+HIGHER_BETTER_NAMES = ("value", "mfu", "mbu", "accept_rate", "hit_rate", "ratio",
+                       # tiered-cache bench leaves: reuse the cache hierarchy
+                       # can serve at all (HBM + host + disk) vs HBM alone
+                       "hierarchy_hit_rate", "hbm_hit_rate")
 
 # wall-clock ACCOUNTING fields, not performance metrics: a longer bench run
 # is not a regression. The whole goodput block is attribution (its *_s
@@ -54,7 +57,10 @@ HIGHER_BETTER_NAMES = ("value", "mfu", "mbu", "accept_rate", "hit_rate", "ratio"
 # whatever the round consumed (a different tenant mix is not a
 # regression) — only its fairness index carries a direction.
 NEUTRAL_PREFIXES = ("goodput.", "tenants.", "roofline.")
-NEUTRAL_NAMES = ("wall_s", "unattributed_s", "overbooked_s", "recovery_badput_s")
+NEUTRAL_NAMES = ("wall_s", "unattributed_s", "overbooked_s", "recovery_badput_s",
+                 # tier migration volume is workload attribution, not a verdict:
+                 # more demotions under the same load is the tier doing its job
+                 "demotions", "promotions", "host_evictions", "disk_spills")
 
 # direction overrides that win over the neutral prefixes: the fairness
 # index inside the tenants block IS a performance verdict (higher = the

@@ -498,6 +498,149 @@ def cache_pressure_bench(on_tpu, n_requests=None, seed=0, corpus_mult=4.0):
     return result
 
 
+def host_tier_ab(on_tpu, n_requests=None, seed=0, corpus_mult=10.0):
+    """Tiered KV-cache A/B (ISSUE 17): the cache_pressure Zipf corpus sized
+    at ``corpus_mult``x (~10x) the HBM block pool, run once HBM-only and once
+    with the pinned host tier armed, one request at a time. The tier arm's
+    eviction victims demote to host instead of dropping, so a re-referenced
+    Zipf-head prefix that HBM alone would have lost comes back as a
+    promoted hit. Reports the hierarchy hit rate vs the HBM-only hit rate
+    (acceptance: strictly above, with greedy token parity), promotion
+    latency p50/p99, and TTFT split by how the prefix was served
+    (promoted hit vs outright miss) — the user-visible cost of an H2D
+    restore vs recomputing the prefill."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.inference.v2 import (CacheTelemetryConfig, DSStateManagerConfig,
+                                            DynamicSplitFuseScheduler, HostTierConfig,
+                                            InferenceEngineV2, PrefixCacheConfig,
+                                            RaggedInferenceEngineConfig)
+
+    if on_tpu:
+        n = n_requests or 128
+        cfg = TransformerConfig(vocab_size=32000, hidden_size=1024, num_layers=6,
+                                num_heads=8, num_kv_heads=8, intermediate_size=2816,
+                                max_seq_len=2048, norm="rmsnorm", positions="rotary",
+                                mlp="swiglu", dtype=jnp.bfloat16, attention_impl="flash")
+        sm = DSStateManagerConfig(max_tracked_sequences=16, max_ragged_batch_size=512,
+                                  max_ragged_sequence_count=16, max_context=768)
+        # host = 3x pool: hierarchy capacity lands exactly on the MRC's 4.0x
+        # multiplier, so the curve's prediction is directly comparable
+        block, pool, host_blocks = 128, 96, 288
+        shape = dict(prefix_len=512, suffix_lo=16, suffix_hi=64, new_lo=8, new_hi=32)
+        budget = 512
+    else:
+        n = n_requests or 64
+        cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                                num_kv_heads=2, intermediate_size=128, max_seq_len=256,
+                                dtype=jnp.float32, attention_impl="reference")
+        sm = DSStateManagerConfig(max_tracked_sequences=8, max_ragged_batch_size=64,
+                                  max_ragged_sequence_count=8, max_context=64)
+        block, pool, host_blocks = 8, 48, 144  # hierarchy = 4.0x the HBM pool
+        shape = dict(prefix_len=40, suffix_lo=4, suffix_hi=10, new_lo=3, new_hi=6)
+        budget = 64
+    pool_tokens = pool * block
+    n_prefixes = max(2, int(round(corpus_mult * pool_tokens / shape["prefix_len"])))
+    wl = make_shared_prefix_workload(n, n_prefixes=n_prefixes, rate_rps=None,
+                                     seed=seed, uid_base=0, zipf_a=1.2, **shape)
+    result = {"config": "host_tier_ab", "n_requests": n, "corpus_mult": corpus_mult,
+              "n_prefixes": n_prefixes, "pool_blocks": pool, "block_size": block,
+              "host_blocks": host_blocks}
+    tokens_by_arm = {}
+    for arm, tier_on in (("hbm_only", False), ("host_tier", True)):
+        pc_cfg = PrefixCacheConfig(
+            enabled=True,
+            telemetry=CacheTelemetryConfig(enabled=True,
+                                           mrc_sample_rate=0.25 if on_tpu else 1.0),
+            host_tier=(HostTierConfig(host_blocks=host_blocks) if tier_on else None))
+        icfg = RaggedInferenceEngineConfig(
+            kv_block_size=block, num_kv_blocks=pool,
+            kv_dtype="int8" if on_tpu else jnp.float32, state_manager=sm,
+            use_pallas_kernels="auto" if on_tpu else "never", prefix_cache=pc_cfg)
+        engine = InferenceEngineV2(TransformerLM(cfg), icfg)
+        sched = DynamicSplitFuseScheduler(engine, token_budget=budget)
+        pc = engine.prefix_cache
+        # warmup compiles shape buckets on an all-unique stream, then the
+        # measured pass starts from a cold cache (cache_pressure discipline)
+        warm = make_shared_prefix_workload(max(4, n // 8), n_prefixes=n_prefixes,
+                                           rate_rps=None, seed=seed + 7,
+                                           uid_base=90_000, unique=True, **shape)
+        for r in warm:
+            sched.submit(r["uid"], r["prompt"], max_new_tokens=r["max_new_tokens"])
+            sched.run()
+        pc.clear()
+        pc.stats.update({k: 0 for k in pc.stats})
+        if engine.cache_telemetry is not None:
+            engine.cache_telemetry.reset()
+
+        ttft_by_class = {"promoted_hit": [], "hbm_hit": [], "miss": []}
+        t0 = time.time()
+        for r in wl:  # strictly sequential: publish-before-next-lookup
+            h0, p0 = pc.stats["hits"], pc.stats["promotions"]
+            sched.submit(r["uid"], r["prompt"], max_new_tokens=r["max_new_tokens"])
+            t_req = time.perf_counter()
+            # step until the first generated token lands: TTFT under the
+            # same split-fuse budget the throughput arm uses
+            while sched.has_work and not sched.new_tokens(r["uid"], 0):
+                sched.step()
+            ttft_ms = (time.perf_counter() - t_req) * 1e3
+            sched.run()
+            cls = ("promoted_hit" if pc.stats["promotions"] > p0
+                   else "hbm_hit" if pc.stats["hits"] > h0 else "miss")
+            ttft_by_class[cls].append(ttft_ms)
+        span = time.time() - t0
+
+        line = {"rps": round(n / span, 2),
+                "hit_rate": round(pc.hit_rate, 4),
+                "cached_tokens": pc.stats["cached_tokens"],
+                "evictions": pc.stats["evictions"],
+                "requests_by_class": {c: len(v) for c, v in ttft_by_class.items()},
+                "ttft_miss_ms": _percentiles(ttft_by_class["miss"]),
+                "ttft_hbm_hit_ms": _percentiles(ttft_by_class["hbm_hit"])}
+        if tier_on:
+            # the headline: what fraction of lookups ANY tier could serve
+            line["hierarchy_hit_rate"] = round(pc.hit_rate, 4)
+            line["demotions"] = pc.stats["demotions_queued"]
+            line["promotions"] = pc.stats["promotions"]
+            line["promoted_tokens"] = pc.stats["promoted_tokens"]
+            line["ttft_promoted_hit_ms"] = _percentiles(ttft_by_class["promoted_hit"])
+            tel = engine.cache_telemetry
+            if tel is not None:
+                tiers = tel.snapshot().get("tiers", {})
+                plat = tiers.get("promote_latency_s") or {}
+                line["promote_p50_ms"] = (round(plat["p50"] * 1e3, 3)
+                                          if plat.get("p50") is not None else None)
+                line["promote_p99_ms"] = (round(plat["p99"] * 1e3, 3)
+                                          if plat.get("p99") is not None else None)
+                line["host_occupancy_integral_s"] = tiers.get(
+                    "host_occupancy_integral_s")
+                # the MRC's live accuracy check, one tier up (ISSUE 17
+                # acceptance): the curve's prediction at the HIERARCHY's
+                # capacity multiplier vs the measured hierarchy (HBM+host)
+                # block hit rate over the same reference stream
+                mult = (pool + host_blocks) / pool
+                pred = tel.mrc.predict().get(mult)
+                meas = tel.mrc.observed_hit_rate
+                line["mrc_hierarchy_mult"] = mult
+                line["mrc_predicted_hierarchy"] = (round(pred, 4)
+                                                   if pred is not None else None)
+                line["measured_hierarchy_block_hit_rate"] = (
+                    round(meas, 4) if meas is not None else None)
+                line["mrc_hierarchy_abs_err"] = (
+                    round(abs(meas - pred), 4)
+                    if meas is not None and pred is not None else None)
+            line["tier"] = engine.tiered_store.snapshot()
+        else:
+            line["hbm_hit_rate"] = round(pc.hit_rate, 4)
+        tokens_by_arm[arm] = {u: t for u, t in sorted(sched.results.items())}
+        result[arm] = line
+        engine.shutdown()
+    result["token_parity"] = tokens_by_arm["hbm_only"] == tokens_by_arm["host_tier"]
+    result["hit_rate_gain"] = round(result["host_tier"]["hit_rate"]
+                                    - result["hbm_only"]["hit_rate"], 4)
+    return result
+
+
 def speculative_ab(on_tpu, n_requests=None, seed=0, k=4, mode="ngram", min_match=None,
                    tree_width=1):
     """Speculative-decoding A/B on the Zipf shared-prefix workload: the same
@@ -1110,6 +1253,8 @@ def main():
         out = gateway_bench(on_tpu)
     elif "cache_pressure" in sys.argv[1:]:
         out = cache_pressure_bench(on_tpu)
+    elif "host_tier" in sys.argv[1:]:
+        out = host_tier_ab(on_tpu)
     elif "multi_tenant" in sys.argv[1:]:
         out = multi_tenant_bench(on_tpu)
     else:
