@@ -1114,6 +1114,42 @@ def run_bench():
             print(f"# WARNING: host_tier bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
 
+    # --disagg: disaggregated prefill/decode A/B (ISSUE 18) — a decode-heavy
+    # foreground stream measured under a pure-prefill background storm,
+    # co-located mixed fleet vs ("prefill","decode") pools with the
+    # host-tier KV handoff. The leaves perf_sentinel trends: foreground
+    # TPOT/TTFT percentiles (lower-better), handoff_p50_ms and
+    # handoff_fallback_rate (explicitly lower-better in its direction
+    # table). Outside the headline window; DS_TPU_BENCH_DISAGG=0 skips,
+    # failure never costs the headline.
+    disagg_line = None
+    if os.environ.get("DS_TPU_BENCH_DISAGG", "1") != "0":
+        try:
+            from tools.serving_load import disagg_ab
+
+            da = disagg_ab(on_tpu)
+            co, dg = da["colocated"], da["disagg"]
+            disagg_line = {
+                "fg_tpot_p99_colocated_ms": co["fg_tpot"].get("p99_ms"),
+                "fg_tpot_p99_disagg_ms": dg["fg_tpot"].get("p99_ms"),
+                "fg_ttft_p99_colocated_ms": co["fg_ttft"].get("p99_ms"),
+                "fg_ttft_p99_disagg_ms": dg["fg_ttft"].get("p99_ms"),
+                "tpot_p99_improved": da["tpot_p99_improved"],
+                "token_parity": da["token_parity"],
+                "migrated": dg["migrated"],
+                "fallbacks": dg["fallbacks"],
+                "blocks_moved": dg["blocks_moved"],
+                "handoff_p50_ms": dg["handoff_p50_ms"],
+                "handoff_fallback_rate": dg["handoff_fallback_rate"],
+            }
+            print(f"# disagg: fg_tpot_p99 {co['fg_tpot'].get('p99_ms')}ms -> "
+                  f"{dg['fg_tpot'].get('p99_ms')}ms parity={da['token_parity']} "
+                  f"migrated={dg['migrated']} fallbacks={dg['fallbacks']} "
+                  f"handoff_p50={dg['handoff_p50_ms']}ms", flush=True)
+        except Exception as e:
+            print(f"# WARNING: disagg bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+
     # --chaos: resilience drills (ISSUE 12) — the seeded training storm
     # (kill/stall/straggle/preempt/collective-delay with warm-remesh
     # restarts) and the serving replica-kill drill, reporting the drill
@@ -1268,6 +1304,8 @@ def run_bench():
         line["chaos"] = chaos_line
     if cache_line is not None:
         line["cache"] = cache_line
+    if disagg_line is not None:
+        line["disagg"] = disagg_line
     if memory_line is not None:
         line["memory"] = memory_line
     if tenants_line is not None:

@@ -1355,6 +1355,45 @@ class InferenceEngineV2:
             tenant=tenant)
         return skip, seq.shared_blocks
 
+    def export_sequence_kv(self, uid: int, tokens):
+        """Functional D2H export of one live sequence's FULL KV blocks for a
+        cross-replica handoff (``serving/handoff.py``): returns
+        ``(token_chunks, payloads)`` — per-block token-id tuples and their
+        ``read_block`` value snapshots materialized to numpy. Driver-thread
+        only (``read_block`` is a device op); the snapshots are plain host
+        arrays afterwards, so the broker can checksum/ship them from any
+        thread. ``tokens`` is the prompt + generated-so-far stream; export
+        is clamped to the KV the engine has actually materialized
+        (``seen_tokens``) — the KV for the newest generated token does not
+        exist yet, and partial blocks never travel (the tree only holds
+        full blocks, same rule as ``publish``)."""
+        sm = self.state_manager
+        seq = sm.get_sequence(uid)
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.config.kv_block_size
+        n = min(int(seq.seen_tokens), tokens.size)
+        n_full = min(n // bs, len(seq.kv_blocks))
+        chunks, payloads = [], []
+        for i in range(n_full):
+            k, v, ks, vs = sm.kv_cache.read_block(seq.kv_blocks[i])
+            payloads.append((np.asarray(k), np.asarray(v),
+                             None if ks is None else np.asarray(ks),
+                             None if vs is None else np.asarray(vs)))
+            chunks.append(tuple(int(t) for t in tokens[i * bs:(i + 1) * bs]))
+        return chunks, payloads
+
+    def install_prefix_kv(self, token_chunks, payloads, tenant=None) -> int:
+        """Receiving half of the handoff: adopt exported block payloads into
+        this engine's prefix cache as HOST-tier residents
+        (:meth:`PrefixKVCache.install_host_chain`). Host-memory ops only —
+        callable off this replica's driver thread. Returns blocks installed
+        (0 when the prefix cache or host tier is absent: the resume then
+        simply re-prefills, correct but uncached)."""
+        pc = self.state_manager.prefix_cache
+        if pc is None:
+            return 0
+        return pc.install_host_chain(token_chunks, payloads, tenant=tenant)
+
     def _create_with_prefix(self, uid: int, prompt_tokens, match=None, tenant=None):
         """Sequence creation + the monitor's view of the lookup: hit-rate
         gauge, cached-token counters, and a ``prefix_hit`` trace span. When

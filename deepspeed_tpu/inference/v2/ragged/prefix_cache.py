@@ -140,7 +140,8 @@ class PrefixKVCache:
                       # tier lifecycle (all zero and inert without a tier)
                       "demotions_queued": 0, "promotions": 0,
                       "promoted_tokens": 0, "promote_wait_s": 0.0,
-                      "evict_starved": 0, "readoptions": 0}
+                      "evict_starved": 0, "readoptions": 0,
+                      "host_installed": 0}
 
     # -- queries -----------------------------------------------------------
     @property
@@ -373,12 +374,20 @@ class PrefixKVCache:
         the tree's reference plus the requesting sequence's — the incref
         immediately after install pins it against the NEXT iteration's
         ``_reserve_with_eviction``. Returns the tokens restored; a dry pool
-        or a lost backing copy SHORTENS the hit instead of failing it."""
+        or a lost backing copy SHORTENS the hit instead of failing it.
+
+        Lookahead: before materializing chain[i], chain[i+1] is handed to
+        the migration worker (``tier.prefetch``) so its host memcpy / disk
+        read + crc overlaps this block's H2D instead of serializing behind
+        it — the PR 17 residual. A busy worker just leaves that step
+        synchronous."""
         bs = self.block_size
         tier = self._tier
         promoted = 0
-        for hn in chain:
+        for i, hn in enumerate(chain):
             t0 = time.monotonic()
+            if i + 1 < len(chain):
+                tier.prefetch(chain[i + 1])
             payload = tier.promote_payload(hn)
             if payload is None:
                 # backing copy gone (disk corruption / torn spill): the
@@ -496,6 +505,51 @@ class PrefixKVCache:
                 self._meter.on_publish(getattr(seq, "tenant", None), inserted)
             seq.published_blocks = full
             return inserted
+
+    def install_host_chain(self, token_chunks, payloads,
+                           tenant: Optional[str] = None) -> int:
+        """Adopt an externally-exported chain of full KV blocks as HOST
+        residents — the receiving half of a cross-replica handoff
+        (``serving/handoff.py``). Walks/extends the radix tree from the
+        root: a chunk the tree already holds (any residency) is skipped —
+        first writer wins, exactly like :meth:`publish` — and each new
+        chunk lands in the host tier (``TieredBlockStore.host_install``) as
+        a first-class demoted node, so the resuming request's ``acquire``
+        promotes it H2D through the standard ``_promote_chain`` lookahead
+        path, and every OTHER replica's future requests can hit it too
+        (fleet-shared prefix state). Host-memory ops only: callable off
+        this replica's driver thread (the broker runs on the source's).
+        Installation stops at a disk-resident ancestor (a host child below
+        a disk parent would break the residency ordering) or when the host
+        pool cannot make room. Returns the number of blocks installed."""
+        if self._tier is None:
+            return 0
+        installed = 0
+        with self._tree_lock:
+            node = self._root
+            for chunk, payload in zip(token_chunks, payloads):
+                key = tuple(int(t) for t in chunk)
+                child = node.children.get(key)
+                if child is not None:
+                    if child.res == RES_DISK:
+                        break
+                    self._touch(child)
+                    node = child
+                    continue
+                hb = self._tier.host_install(payload)
+                if hb < 0:
+                    break
+                child = _Node(chunk=key, block=-1, parent=node, owner=tenant)
+                self._tier.register_host_node(child, hb)
+                node.children[key] = child
+                self._n_nodes += 1
+                self._touch(child)
+                installed += 1
+                node = child
+            if installed:
+                self.stats["host_installed"] += installed
+                get_metrics().counter("cache/host_installed").inc(installed)
+        return installed
 
     # -- pressure valve ----------------------------------------------------
     def evict(self, n_blocks: int) -> int:

@@ -193,7 +193,16 @@ class TieredBlockStore:
                          "demote_cancelled": 0, "promotions_host": 0,
                          "promotions_disk": 0, "host_evictions": 0,
                          "disk_spills": 0, "disk_corrupt": 0,
-                         "disk_drops": 0}
+                         "disk_drops": 0, "prefetch_enqueued": 0,
+                         "prefetch_hits": 0, "prefetch_unused": 0,
+                         "host_installs": 0}
+        # chain-lookahead staging: node -> materialized payload the worker
+        # parked ahead of the driver's promotion walk (``prefetch``), plus
+        # the in-flight markers that dedupe enqueues. Guarded by ``_mu``;
+        # parking re-checks residency under the TREE lock so a dropped
+        # node's payload can never wedge a slot.
+        self._prefetched = {}
+        self._prefetch_inflight = set()
         self._q = deque()
         self._cv = threading.Condition()
         self._stop = False
@@ -221,7 +230,29 @@ class TieredBlockStore:
         with self._cv:
             if self._stop or len(self._q) >= self.queue_depth:
                 return False
-            self._q.append((node, snapshot, self._clock()))
+            self._q.append(("demote", node, snapshot, self._clock()))
+            self._cv.notify()
+        return True
+
+    def prefetch(self, node) -> bool:
+        """Queue a background materialization of a demoted node's payload —
+        the chain-lookahead: while the driver H2Ds chain[i], the worker
+        stages chain[i+1]'s host/disk bytes so the next ``promote_payload``
+        is a dict pop instead of a copy (+ disk read + crc). Enqueue-only,
+        called under the tree lock; depth-bounded like demotion, so a busy
+        worker just leaves that promotion synchronous — never blocks, never
+        wrong."""
+        if node.res not in (RES_HOST, RES_DISK):
+            return False
+        with self._cv:
+            if self._stop or len(self._q) >= self.queue_depth:
+                return False
+            with self._mu:
+                if node in self._prefetched or node in self._prefetch_inflight:
+                    return True
+                self._prefetch_inflight.add(node)
+                self.counters["prefetch_enqueued"] += 1
+            self._q.append(("prefetch", node))
             self._cv.notify()
         return True
 
@@ -235,7 +266,14 @@ class TieredBlockStore:
         """Host/disk payload of a demoted node for H2D restore:
         ``(k, v, k_scale, v_scale)`` or None when the backing copy is gone
         or fails its checksum — the caller drops the node (a miss, never
-        wrong KV). Called under the tree lock on the driver thread."""
+        wrong KV). Called under the tree lock on the driver thread. A
+        payload the lookahead worker already parked is consumed directly."""
+        with self._mu:
+            parked = self._prefetched.pop(node, None)
+            if parked is not None:
+                self.counters["prefetch_hits"] += 1
+        if parked is not None:
+            return parked
         if node.res == RES_HOST:
             # copy, don't alias: on CPU backends jnp.asarray may wrap the
             # host buffer zero-copy, and host_free can recycle the slot
@@ -258,6 +296,10 @@ class TieredBlockStore:
     def release_resident(self, node) -> None:
         """Drop a node's host/disk copy (after promotion installed it in
         HBM, or when the node is being discarded). Tree lock held."""
+        with self._mu:
+            if self._prefetched.pop(node, None) is not None:
+                self.counters["prefetch_unused"] += 1
+            self._prefetch_inflight.discard(node)
         if node.host_block >= 0:
             self._release_host_block(node.host_block)
             self._host_nodes.pop(node, None)
@@ -265,6 +307,40 @@ class TieredBlockStore:
         if node.disk_id >= 0:
             self._disk_drop(node.disk_id)
             node.disk_id = -1
+
+    # -- handoff adoption (disaggregated serving) ----------------------------
+    def host_install(self, payload) -> int:
+        """Reserve a host block and fill it with an externally-produced KV
+        payload (``read_block`` shapes) — the landing zone of a
+        cross-replica handoff (``serving/handoff.py``). Makes room by
+        evicting cold host residents exactly like the demotion worker;
+        returns -1 only when the pool holds no evictable leaf. Host-memory
+        and file ops only, so it is safe OFF this replica's driver thread
+        (the handoff broker runs on the SOURCE replica's driver)."""
+        hb = self.pool.host_reserve()
+        while hb < 0:
+            try:
+                self._evict_host_one()
+            except RuntimeError:
+                return -1
+            hb = self.pool.host_reserve()
+        k, v, ks, vs = payload
+        self.pool.host_write(hb, k, v, ks, vs)
+        with self._mu:
+            self.counters["host_installs"] += 1
+        return hb
+
+    def register_host_node(self, node, host_block: int) -> None:
+        """Finalize adoption: bind an installed host block to its new tree
+        node as a first-class host resident (LRU-tracked, owner-stamped so
+        PR 15's ``host_kv_s`` conservation holds across the handoff). Tree
+        lock held by the caller (``PrefixKVCache.install_host_chain``)."""
+        node.res = RES_HOST
+        node.host_block = int(host_block)
+        self._host_nodes[node] = int(host_block)
+        self._host_stamp[int(host_block)] = (node.owner, self._clock())
+        if self._telemetry is not None:
+            self._telemetry.note_host_used(self.pool.used_blocks)
 
     # -- watermark surface ---------------------------------------------------
     def demotion_target(self) -> int:
@@ -283,6 +359,7 @@ class TieredBlockStore:
         with self._mu:
             c = dict(self.counters)
             disk_used = len(self._disk_manifest)
+            c["prefetched_parked"] = len(self._prefetched)
         c.update(host_blocks=self.pool.num_blocks,
                  host_used=self.pool.used_blocks,
                  host_bytes=self.pool.memory_bytes(),
@@ -300,8 +377,12 @@ class TieredBlockStore:
             self._q.clear()
             self._cv.notify_all()
         self._worker.join(timeout)
-        for node, _snapshot, _t0 in pending:
-            self._fail_node(node, cancelled=True)
+        for item in pending:
+            if item[0] == "demote":
+                self._fail_node(item[1], cancelled=True)
+            else:
+                with self._mu:
+                    self._prefetch_inflight.discard(item[1])
 
     # -- migration worker -----------------------------------------------------
     def _run(self) -> None:
@@ -311,7 +392,11 @@ class TieredBlockStore:
                     self._cv.wait()
                 if self._stop:
                     return
-                node, snapshot, t0 = self._q.popleft()
+                item = self._q.popleft()
+            if item[0] == "prefetch":
+                self._run_prefetch(item[1])
+                continue
+            _, node, snapshot, t0 = item
             try:
                 # chaos point: a hook here simulates the worker dying
                 # mid-copy — the except arm below is the blast-radius
@@ -329,6 +414,38 @@ class TieredBlockStore:
                 with self._mu:
                     self.counters["demote_failures"] += 1
                 self._fail_node(node)
+
+    def _run_prefetch(self, node) -> None:
+        """Worker half of :meth:`prefetch`: materialize one demoted node's
+        payload (host memcpy or disk read, never a device op) and park it.
+        Residency is checked under the tree lock both before the read and
+        at park time — a node promoted or dropped since enqueue just clears
+        its in-flight marker, and a stale payload can never occupy a slot
+        (every drop path pops ``_prefetched`` under the same lock)."""
+        cache = self._cache
+        payload = disk_id = None
+        try:
+            with cache._tree_lock:
+                if node.res == RES_HOST and node.host_block >= 0:
+                    payload = tuple(None if a is None else np.array(a)
+                                    for a in self.pool.host_read(node.host_block))
+                elif node.res == RES_DISK and node.disk_id >= 0:
+                    disk_id = node.disk_id
+            if disk_id is not None:
+                with self._mu:
+                    payload = self._disk_pending.get(disk_id)
+                if payload is None:
+                    payload = self._disk_read(disk_id)
+            with cache._tree_lock:
+                with self._mu:
+                    self._prefetch_inflight.discard(node)
+                    if (payload is not None
+                            and node.res in (RES_HOST, RES_DISK)
+                            and len(self._prefetched) < self.queue_depth):
+                        self._prefetched[node] = payload
+        except Exception:
+            with self._mu:
+                self._prefetch_inflight.discard(node)
 
     def _finalize_demote(self, node, host_block: int, t0: float) -> None:
         cache = self._cache

@@ -79,7 +79,7 @@ TRAIN_CATEGORIES = ("compute", "input_wait", "comm_exposed", "ckpt_blocked",
 # hit). Same semantic as the training category: time the accelerator sat
 # ready while the input pipeline (here: the memory hierarchy) caught up.
 SERVING_CATEGORIES = ("prefill_active", "decode_active", "spec_verify",
-                      "input_wait", "idle", "stalled", "draining",
+                      "handoff", "input_wait", "idle", "stalled", "draining",
                       "recovering")
 
 # training categories booked directly by their sources (compile listener,
@@ -107,6 +107,11 @@ SPAN_TO_CATEGORY = {
     "serving/spec_verify": "spec_verify",
     # tiered KV cache: synchronous promotion wait on the admission path
     "serving/promote_wait": "input_wait",
+    # disaggregated serving: the prefill replica's driver exporting +
+    # brokering one request's KV to a decode replica — real driver seconds
+    # that are neither prefill nor decode compute, so they get their own
+    # category instead of contaminating pool purity
+    "serving/handoff": "handoff",
 }
 
 SPAN_ALLOWLIST = (

@@ -19,6 +19,10 @@ Registered production points (the names ``fire`` is called with):
 ``comm/collective``    eager device-collective bracket (``ctx``: op)
 ``comm/host_collective``  blocking host-plane gather/broadcast (``ctx``: op)
 ``serving/driver``     each serving replica driver loop (``ctx``: replica)
+``serving/handoff``    the disaggregated KV handoff, between export and
+                       checksum verify (``ctx``: rid, src, dst, payloads —
+                       a hook may raise OR swap a corrupted payload into
+                       the list; the verify gate must catch either)
 ``prefetch/item``      the prefetch worker, once per assembled batch
 =====================  ======================================================
 

@@ -22,7 +22,13 @@ Layering (request -> token):
     observability (``serving.gateway.metering`` block): sanitized
     ``X-Tenant-Id`` identity charged per-tenant token/KV-block-second/
     compute-second integrals, DRF fairness index, starvation instants,
-    bounded top-K Prometheus export, ``GET /v1/usage``.
+    bounded top-K Prometheus export, ``GET /v1/usage``;
+  * :mod:`disagg` / :mod:`handoff` — disaggregated prefill/decode pools
+    (``serving.gateway.disagg`` block): role-typed replicas, new requests
+    placed on the prefill pool, and completed prefills migrated to the
+    decode pool by a gateway-brokered cross-replica KV handoff through
+    the host tier (checksummed manifests, at-most-once, fallback-in-place
+    — never a lost request), ``GET /v1/pools``.
 
 Everything defaults OFF: importing this package starts no threads, and a
 constructed-but-never-started gateway allocates no queues' worth of
@@ -34,11 +40,13 @@ The request plane talks to the engine ONLY through its public API
 by the ``tools/check_gateway_api.py`` AST gate, run from tier-1.
 """
 
-from .config import (GatewayConfig, MeteringConfig, RequestTraceConfig,
-                     SLOClassConfig)
+from .config import (DisaggConfig, GatewayConfig, MeteringConfig,
+                     RequestTraceConfig, SLOClassConfig)
 from .admission import AdmissionController
 from .router import ReplicaRouter
 from .replica import EngineReplica, GatewayRequest, TokenStream
+from .disagg import DisaggCoordinator
+from .handoff import HandoffError, HandoffLedger
 from .reqtrace import (RequestContext, RequestLog, RequestTracing,
                        extract_request_id, new_request_id, parse_traceparent,
                        sanitize_request_id)

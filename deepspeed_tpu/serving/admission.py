@@ -43,11 +43,21 @@ class AdmissionController:
         self._queues: Dict[Tuple[str, str], deque] = {}
         self._queued_uncached: Dict[Tuple[str, str], int] = {}
         self._order = config.class_order()
+        # disaggregated pool roles by replica name (serving/disagg.py):
+        # empty when disagg is off — gauge rows then carry no pool label,
+        # byte-identical to the pre-disagg scrape
+        self._roles: Dict[str, str] = {}
         self.stats = {"admitted": 0, "shed": 0,
                       "uncached_tokens_admitted": 0, "cached_tokens_admitted": 0}
         # per-SLO-class admitted/shed counts behind the scrapeable shed-rate
         # gauge (gauge_rows) — the aggregate stats above can't give per-class
         self.class_stats: Dict[str, Dict[str, int]] = {}
+
+    def set_roles(self, roles: Dict[str, str]) -> None:
+        """Arm the disaggregation role map (gateway wiring): queue-depth
+        gauge rows gain a ``pool`` label so a dashboard can see which POOL
+        a backlog is building in, not just which replica."""
+        self._roles = dict(roles)
 
     # -- depth introspection -------------------------------------------------
     def depth(self, replica: Optional[str] = None, slo_class: Optional[str] = None) -> int:
@@ -212,6 +222,8 @@ class AdmissionController:
         with self._lock:
             for (r, c), q in self._queues.items():
                 labels = {"replica": r, "slo_class": c}
+                if self._roles:
+                    labels["pool"] = self._roles.get(r, "mixed")
                 rows.append(("gateway/queue_depth", labels, float(len(q))))
                 rows.append(("gateway/queued_uncached_tokens", labels,
                              float(self._queued_uncached.get((r, c), 0))))

@@ -133,6 +133,26 @@ class ProfilingConfig:
 
 
 @dataclass
+class DisaggConfig:
+    """``serving.gateway.disagg`` block — disaggregated prefill/decode
+    serving (``serving/disagg.py`` + ``serving/handoff.py``). Presence-
+    enables (the ``tracing``/``metering``/``profiling`` contract): an
+    absent block means every replica stays ``mixed``, the router ignores
+    roles, and no coordinator/ledger objects exist."""
+
+    enabled: bool = False
+    # per-replica role by LIST INDEX ('prefill' | 'decode' | 'mixed');
+    # shorter than the replica list pads the tail with 'mixed'. New
+    # requests place onto prefill/mixed replicas; completed prefills hand
+    # off to decode/mixed replicas through the host tier.
+    roles: Tuple = ()
+    # generated tokens a prefill replica waits for before handing off —
+    # the first token proves prefill really completed (and is the TTFT the
+    # client already saw); raising it delays migration
+    handoff_after_tokens: int = 1
+
+
+@dataclass
 class GatewayConfig:
     enabled: bool = False
     host: str = "127.0.0.1"
@@ -174,6 +194,9 @@ class GatewayConfig:
     # on-demand XPlane capture endpoint (POST /v1/profile); off by default —
     # the route 404s and no capture manager is created
     profiling: ProfilingConfig = field(default_factory=ProfilingConfig)
+    # disaggregated prefill/decode replica pools + KV handoff; off by
+    # default with the same zero-overhead-absent contract
+    disagg: DisaggConfig = field(default_factory=DisaggConfig)
 
     @classmethod
     def from_dict(cls, d) -> "GatewayConfig":
@@ -182,6 +205,7 @@ class GatewayConfig:
         tracing = d.pop("tracing", None)
         metering = d.pop("metering", None)
         profiling = d.pop("profiling", None)
+        disagg = d.pop("disagg", None)
         known = {f.name for f in fields(cls)}
         unknown = set(d) - known
         if unknown:
@@ -237,6 +261,27 @@ class GatewayConfig:
                 raise ValueError("serving.gateway.profiling: durations must be > 0, got "
                                  f"default={cfg.profiling.default_duration_s} "
                                  f"max={cfg.profiling.max_duration_s}")
+        if disagg is not None:
+            if isinstance(disagg, DisaggConfig):
+                cfg.disagg = disagg
+            else:
+                body = dict(disagg)
+                dg_known = {f.name for f in fields(DisaggConfig)}
+                bad = set(body) - dg_known
+                if bad:
+                    raise ValueError(f"serving.gateway.disagg: unknown keys {sorted(bad)}")
+                if "enabled" not in body:  # presence-enables
+                    body["enabled"] = True
+                cfg.disagg = DisaggConfig(**body)
+            cfg.disagg.roles = tuple(str(r) for r in cfg.disagg.roles)
+            bad_roles = [r for r in cfg.disagg.roles
+                         if r not in ("prefill", "decode", "mixed")]
+            if bad_roles:
+                raise ValueError(f"serving.gateway.disagg: unknown roles {bad_roles}: "
+                                 "'prefill' | 'decode' | 'mixed'")
+            if cfg.disagg.handoff_after_tokens < 1:
+                raise ValueError("serving.gateway.disagg: handoff_after_tokens must "
+                                 f"be >= 1, got {cfg.disagg.handoff_after_tokens}")
         if classes is not None:
             slo_known = {f.name for f in fields(SLOClassConfig)}
             parsed = {}

@@ -1,0 +1,148 @@
+"""Disaggregated prefill/decode serving: role-typed replica pools with
+cross-replica KV handoff through the host tier.
+
+Prefill is compute-bound and bursty; decode is bandwidth-bound and steady —
+co-locating them forces SplitFuse to arbitrate, and one long prefill still
+inflates decode TPOT tails (PR 7's p99 stage attribution). This module
+splits the fleet: replicas carry a ``role`` (``prefill`` / ``decode`` /
+``mixed``, ``serving/config.py``'s ``disagg`` block), the router places new
+requests on the prefill pool, and once a request's prefill completes (its
+first token proves it) the :class:`DisaggCoordinator` migrates the
+request's KV to a decode replica and resumes it there — the DeepSpeed-
+FastGen/MII successor architecture (SURVEY.md § inference v2).
+
+The migration rides PR 17's tiered store as transport: the source driver
+D2H-exports the sequence's full blocks (``engine.export_sequence_kv``),
+the :class:`~deepspeed_tpu.serving.handoff.HandoffLedger` checksums and
+brokers ownership (at-most-once, fallback-in-place — never a lost
+request), the destination adopts them as host-tier radix nodes
+(``engine.install_prefix_kv``), and the resume's admission promotes H2D
+through the standard lookahead promotion pipeline. Because the adopted
+chain is ordinary fleet-visible radix state, every decode replica also
+gains the migrated prefix for FUTURE requests — cross-replica prefix
+sharing falls out of the same mechanism.
+
+Threading: ``try_handoff`` runs on the SOURCE replica's driver thread
+(from ``_fanout``), which is what makes ``export_sequence_kv`` (a device
+op) and ``detach_request`` (scheduler surgery) legal without extra locks.
+Everything that touches the DESTINATION is host-memory-only
+(``install_prefix_kv`` under the dest tree lock; ``enqueue_resume`` is a
+list append) — the decode replica's driver is never blocked by a
+migration. Chaos point ``serving/handoff`` sits between export and
+verify: a hook can raise (transport loss) or swap a corrupted payload into
+the manifest's list (the checksum gate must catch it); either way the
+request falls back to decoding in place on the source.
+"""
+
+import time
+
+import numpy as np
+
+from ..monitor.trace import observe_latency
+from ..runtime.resilience import chaos
+from .handoff import HandoffError, HandoffLedger
+
+__all__ = ["DisaggCoordinator", "ROLES"]
+
+ROLES = ("prefill", "decode", "mixed")
+
+
+class DisaggCoordinator:
+    """Gateway-owned broker for the prefill→decode migrations of one fleet.
+
+    One instance per gateway when ``serving.gateway.disagg`` is present;
+    replicas get it via ``set_disagg`` and call :meth:`try_handoff` from
+    their drivers. Stateless beyond the ledger — destination choice is
+    least-loaded at migration time, no sticky assignment.
+    """
+
+    def __init__(self, replicas, config, ledger=None):
+        self.replicas = list(replicas)
+        self.config = config
+        self.ledger = ledger if ledger is not None else HandoffLedger()
+        self.stats = {"attempted": 0, "migrated": 0, "fallbacks": 0}
+
+    # -- pool topology -----------------------------------------------------
+    def roles(self):
+        return {r.name: r.role for r in self.replicas}
+
+    def pools(self):
+        out = {role: [] for role in ROLES}
+        for r in self.replicas:
+            out.setdefault(r.role, []).append(r.name)
+        return {role: names for role, names in out.items() if names}
+
+    @property
+    def handoff_after_tokens(self) -> int:
+        return max(1, int(getattr(self.config, "handoff_after_tokens", 1)))
+
+    def wants_handoff(self, replica) -> bool:
+        """Only dedicated prefill replicas push work away; mixed replicas
+        keep their requests (they ARE the co-located baseline)."""
+        return replica.role == "prefill"
+
+    def pick_decode_replica(self, src):
+        cands = [r for r in self.replicas
+                 if r is not src and r.alive and r.role in ("decode", "mixed")]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.load, r.name))
+
+    # -- the migration -----------------------------------------------------
+    def try_handoff(self, src, req, generated) -> bool:
+        """Migrate one request whose prefill just completed on ``src``.
+        Runs on ``src``'s driver thread. True = the request now lives on a
+        decode replica (the caller must NOT touch it again); False = the
+        handoff fell back and the request keeps decoding in place on
+        ``src`` — every failure path lands here, never a lost request."""
+        rid = req.rid or f"uid-{req.uid}"
+        t0 = time.perf_counter()
+        self.stats["attempted"] += 1
+        dst = self.pick_decode_replica(src)
+        if not self.ledger.begin(rid, src.name, dst.name if dst else None):
+            # at-most-once refusal: this rid already has a ledger entry
+            # (an earlier attempt got somewhere) — decode wherever it is
+            return False
+        try:
+            if dst is None:
+                raise HandoffError("no_live_decode_replica")
+            tokens = np.concatenate([
+                np.asarray(req.prompt, np.int32).reshape(-1),
+                np.asarray(generated, np.int32).reshape(-1)])
+            chunks, payloads = src.engine.export_sequence_kv(req.uid, tokens)
+            self.ledger.record_manifest(rid, chunks, payloads)
+            # chaos drill: a hook here can raise (transport loss) or swap a
+            # corrupted payload into the list (the verify gate must catch it)
+            chaos.fire("serving/handoff", {"rid": rid, "src": src.name,
+                                           "dst": dst.name,
+                                           "payloads": payloads})
+            if not self.ledger.verify(rid, payloads):
+                raise HandoffError("checksum_mismatch")
+            installed = dst.engine.install_prefix_kv(chunks, payloads,
+                                                     tenant=req.tenant)
+            self.ledger.mark_installed(rid, installed)
+            remaining = int(req.max_new_tokens) - int(len(generated))
+            # ---- point of no return: detach is driver-thread-local (we
+            # ARE src's driver) and the enqueue is an infallible append —
+            # past here the request lives on dst, exactly once
+            src.detach_request(req.uid)
+            dst.enqueue_resume(req, tokens, remaining)
+            self.ledger.mark_resumed(rid)
+            self.stats["migrated"] += 1
+            dt = observe_latency(t0, "serving/handoff",
+                                 hist_name="handoff/latency_ms",
+                                 span_args={"rid": rid, "src": src.name,
+                                            "dst": dst.name,
+                                            "blocks": len(payloads)})
+            src.book_handoff(dt)
+            return True
+        except Exception as e:  # noqa: BLE001 — every failure = fallback
+            # ledger.fail owns the handoff/fallback_total counter
+            self.ledger.fail(rid, f"{type(e).__name__}: {e}")
+            self.stats["fallbacks"] += 1
+            src.book_handoff(time.perf_counter() - t0)
+            return False
+
+    def state(self) -> dict:
+        return {"pools": self.pools(), "roles": self.roles(),
+                **self.stats, "handoff": self.ledger.state()}

@@ -22,6 +22,10 @@ pattern — one daemon accept thread, one handler thread per connection):
   * ``GET /v1/usage`` — the per-tenant metering ledger (top-K tenants by
     spend + aggregated ``other``, fairness index, starvation count); 404
     when the ``serving.gateway.metering`` block is absent.
+  * ``GET /v1/pools`` — disaggregated-serving topology + handoff ledger
+    (pool membership, per-pool roles, migration stats, recent handoff
+    entries with their state-machine position); 404 when the
+    ``serving.gateway.disagg`` block is absent.
   * ``POST /v1/profile`` — on-demand deep profiling of LIVE traffic: body
     ``{"duration_s": 2.0}`` (optional) brackets ``jax.profiler``
     start/stop around whatever the replicas are serving and returns the
@@ -51,6 +55,7 @@ from ..monitor.metrics import get_metrics
 from ..monitor.roofline import CaptureBusyError, get_capture_manager
 from .admission import AdmissionController
 from .config import GatewayConfig
+from .disagg import DisaggCoordinator
 from .metering import TenantMeter, sanitize_tenant_id
 from .replica import EngineReplica, GatewayRequest
 from .reqtrace import (RequestTracing, extract_request_id, new_request_id,
@@ -98,9 +103,22 @@ class ServingGateway:
                       if self.config.metering.enabled else None)
         self.admission = AdmissionController(self.config, reqtrace=self.reqtrace,
                                              meter=self.meter)
+        # disaggregated pools: roles come from the config block by replica
+        # index, padded with "mixed" — an absent block means every replica
+        # is mixed, no coordinator, no ledger (zero-overhead-off)
+        dcfg = self.config.disagg
+        roles = [str(dcfg.roles[i]) if dcfg.enabled and i < len(dcfg.roles)
+                 else "mixed" for i in range(len(engines))]
         self.replicas = [EngineReplica(str(i), eng, self.admission, self.config,
-                                       reqtrace=self.reqtrace, meter=self.meter)
+                                       reqtrace=self.reqtrace, meter=self.meter,
+                                       role=roles[i])
                          for i, eng in enumerate(engines)]
+        self.disagg = None
+        if dcfg.enabled:
+            self.disagg = DisaggCoordinator(self.replicas, dcfg)
+            for r in self.replicas:
+                r.set_disagg(self.disagg)
+            self.admission.set_roles({r.name: r.role for r in self.replicas})
         self.router = ReplicaRouter(self.replicas, policy=self.config.router)
         self._uid_lock = threading.Lock()
         self._next_uid = 1
@@ -112,6 +130,7 @@ class ServingGateway:
         self._registered_dump = None
         self._registered_tenant_gauges = None
         self._registered_tenant_dump = None
+        self._registered_handoff_gauges = None
         self.started = False
         self.draining = False
 
@@ -161,6 +180,11 @@ class ServingGateway:
             self._registered_tenant_dump = self.meter.dump_rows
             health.set_gauge_provider("tenant_meter", self._registered_tenant_gauges)
             health.set_dump_provider("tenants", self._registered_tenant_dump)
+        if self.disagg is not None:
+            # handoff ledger rows on /metrics (started/fallback-rate/volume
+            # + p50 once any migration completed) — ownership-checked too
+            self._registered_handoff_gauges = self.disagg.ledger.gauge_rows
+            health.set_gauge_provider("handoff", self._registered_handoff_gauges)
         return self
 
     def stop(self, timeout: float = 10.0):
@@ -186,6 +210,9 @@ class ServingGateway:
                 health.clear_gauge_provider("tenant_meter",
                                             self._registered_tenant_gauges)
                 health.clear_dump_provider("tenants", self._registered_tenant_dump)
+            if self.disagg is not None:
+                health.clear_gauge_provider("handoff",
+                                            self._registered_handoff_gauges)
         if self.reqtrace is not None:
             self.reqtrace.close()
         if self.meter is not None:
@@ -400,6 +427,8 @@ class ServingGateway:
             out["tracing"] = self.reqtrace.state()
         if self.meter is not None:
             out["metering"] = self.meter.state()
+        if self.disagg is not None:
+            out["disagg"] = self.disagg.state()
         return out
 
     def inflight_request_summaries(self) -> dict:
@@ -476,11 +505,20 @@ class ServingGateway:
                                        rid=rid)
                         else:
                             self._json(200, outer.meter.usage_report(), rid=rid)
+                    elif path == "/v1/pools":
+                        # disaggregation topology + the handoff ledger —
+                        # 404 when the disagg block is absent (there ARE
+                        # no pools, only the mixed fleet)
+                        if outer.disagg is None:
+                            self._json(404, {"error": "disagg_disabled"},
+                                       rid=rid)
+                        else:
+                            self._json(200, outer.disagg.state(), rid=rid)
                     else:
                         self._json(404, {"error": "not_found",
                                          "paths": ["/v1/generate", "/v1/usage",
-                                                   "/v1/profile", "/healthz",
-                                                   "/readyz"]},
+                                                   "/v1/pools", "/v1/profile",
+                                                   "/healthz", "/readyz"]},
                                    rid=rid)
                 except (BrokenPipeError, ConnectionResetError):
                     pass

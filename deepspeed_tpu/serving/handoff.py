@@ -1,0 +1,199 @@
+"""Cross-replica KV-block handoff ledger: the transport half of
+disaggregated prefill/decode serving (``serving/disagg.py``).
+
+A handoff moves one request's prefix KV from the prefill replica that
+computed it to the decode replica that will finish it, THROUGH the host
+tier: the source driver exports functional D2H snapshots of the sequence's
+full blocks (``InferenceEngineV2.export_sequence_kv`` — the exact
+``read_block`` path tiered demotion rides), this ledger checksums every
+block into a manifest, and the destination adopts the payloads as
+host-resident radix nodes (``install_prefix_kv``) that the resume's
+admission promotes H2D through the standard lookahead promotion pipeline.
+
+The ledger is the never-lose-a-request contract:
+
+  * **at-most-once**: one entry per request id, ever — a second ``begin``
+    for the same rid is refused, so a retried or raced handoff can never
+    resume one request on two decode replicas;
+  * **checksummed**: every block payload is crc32'd at export
+    (``record_manifest``) and re-verified before install (``verify``); a
+    mismatch — chaos-injected corruption included — fails the handoff
+    BEFORE the destination sees a byte of wrong KV;
+  * **fallback is terminal and safe**: any failure before the resume
+    enqueue leaves the request decoding in place on its prefill replica;
+    the ledger records the fallback + reason and the request is never lost
+    (zero-unreported, chaos-drilled in ``tests/test_disagg.py``).
+
+State machine (one direction, no retries — retrying would need a second
+ledger entry, which at-most-once refuses by design)::
+
+    started ──> exported ──> installed ──> resumed
+       │            │             │
+       └────────────┴─────────────┴──────> fallback(reason)
+"""
+
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ..monitor.metrics import get_metrics
+
+__all__ = ["HandoffError", "HandoffLedger"]
+
+
+class HandoffError(RuntimeError):
+    """A handoff step failed — the coordinator falls back to decoding in
+    place on the source replica (the request is never lost)."""
+
+
+def _payload_crc(payload) -> int:
+    crc = 0
+    for arr in payload:
+        if arr is not None:
+            crc = zlib.crc32(np.ascontiguousarray(arr).view(np.uint8), crc)
+    return crc & 0xFFFFFFFF
+
+
+class HandoffLedger:
+    """Gateway-brokered bookkeeping for every prefill→decode migration.
+
+    Entries are kept for the gateway's lifetime (one small dict per
+    migrated request) — that retention IS the at-most-once mechanism, and
+    the ``/v1/pools`` endpoint serves the recent ones for operators.
+    """
+
+    STATES = ("started", "exported", "installed", "resumed", "fallback")
+
+    def __init__(self, clock=time.perf_counter, keep_entries: int = 256):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._keep = max(1, int(keep_entries))
+        self._lat_s = []  # completed handoff latencies, bounded like entries
+        self.stats = {"started": 0, "resumed": 0, "fallbacks": 0,
+                      "refused": 0, "blocks_moved": 0, "bytes_moved": 0,
+                      "checksum_failures": 0}
+
+    # -- state machine -----------------------------------------------------
+    def begin(self, rid: str, src: str, dst) -> bool:
+        """Open a handoff. False = REFUSED: this rid has a ledger entry
+        already (at-most-once — the request must keep decoding wherever it
+        currently lives, no second migration attempt)."""
+        with self._lock:
+            if rid in self._entries:
+                self.stats["refused"] += 1
+                return False
+            self._entries[rid] = {"state": "started", "src": str(src),
+                                  "dst": None if dst is None else str(dst),
+                                  "t0": self._clock(), "blocks": 0,
+                                  "bytes": 0, "crcs": [], "reason": None}
+            self.stats["started"] += 1
+        return True
+
+    def record_manifest(self, rid: str, token_chunks, payloads) -> None:
+        """Checksum the exported blocks into the entry's manifest."""
+        crcs = [_payload_crc(p) for p in payloads]
+        nbytes = sum(a.nbytes for p in payloads for a in p if a is not None)
+        with self._lock:
+            ent = self._entries[rid]
+            ent.update(state="exported", blocks=len(payloads), bytes=nbytes,
+                       crcs=crcs, n_chunks=len(token_chunks))
+
+    def verify(self, rid: str, payloads) -> bool:
+        """Re-checksum ``payloads`` against the manifest — the integrity
+        gate between export and install. Any mismatch (corruption in the
+        broker's hands) or count drift fails the whole handoff."""
+        with self._lock:
+            want = list(self._entries[rid]["crcs"])
+        ok = (len(payloads) == len(want)
+              and all(_payload_crc(p) == c for p, c in zip(payloads, want)))
+        if not ok:
+            with self._lock:
+                self.stats["checksum_failures"] += 1
+            get_metrics().counter("handoff/checksum_failures_total").inc()
+        return ok
+
+    def mark_installed(self, rid: str, n_blocks: int) -> None:
+        with self._lock:
+            self._entries[rid].update(state="installed",
+                                      installed_blocks=int(n_blocks))
+
+    def mark_resumed(self, rid: str) -> None:
+        """The point past no-return succeeded: the request now lives on the
+        decode replica. Books the migration's latency + moved volume."""
+        with self._lock:
+            ent = self._entries[rid]
+            dt = self._clock() - ent["t0"]
+            ent.update(state="resumed", latency_s=round(dt, 6))
+            self.stats["resumed"] += 1
+            self.stats["blocks_moved"] += ent["blocks"]
+            self.stats["bytes_moved"] += ent["bytes"]
+            self._lat_s.append(dt)
+            if len(self._lat_s) > self._keep:
+                del self._lat_s[:-self._keep]
+            blocks = ent["blocks"]
+        m = get_metrics()
+        m.counter("handoff/completed_total").inc()
+        m.counter("handoff/blocks_moved_total").inc(blocks)
+
+    def fail(self, rid: str, reason: str) -> None:
+        """Terminal fallback: the request decodes in place on its source
+        replica. Idempotent-safe for a rid that never opened (refused
+        begin) — that path records nothing."""
+        with self._lock:
+            ent = self._entries.get(rid)
+            if ent is None or ent["state"] in ("resumed", "fallback"):
+                return
+            ent.update(state="fallback", reason=str(reason)[:200])
+            self.stats["fallbacks"] += 1
+        get_metrics().counter("handoff/fallback_total").inc()
+
+    # -- queries -----------------------------------------------------------
+    def entry(self, rid: str):
+        with self._lock:
+            ent = self._entries.get(rid)
+            return dict(ent) if ent is not None else None
+
+    @property
+    def p50_ms(self):
+        with self._lock:
+            if not self._lat_s:
+                return None
+            return round(float(np.percentile(np.asarray(self._lat_s), 50)) * 1e3, 3)
+
+    @property
+    def fallback_rate(self) -> float:
+        with self._lock:
+            return self.stats["fallbacks"] / max(1, self.stats["started"])
+
+    def state(self) -> dict:
+        with self._lock:
+            recent = dict(sorted(self._entries.items())[-self._keep:])
+            recent = {rid: {k: v for k, v in e.items() if k != "crcs"}
+                      for rid, e in recent.items()}
+            stats = dict(self.stats)
+            lat = list(self._lat_s)
+        out = {**stats, "inflight": sum(1 for e in recent.values()
+                                        if e["state"] not in ("resumed",
+                                                              "fallback")),
+               "handoff_p50_ms": (round(float(np.percentile(
+                   np.asarray(lat), 50)) * 1e3, 3) if lat else None),
+               "handoff_p99_ms": (round(float(np.percentile(
+                   np.asarray(lat), 99)) * 1e3, 3) if lat else None),
+               "handoff_fallback_rate": round(
+                   stats["fallbacks"] / max(1, stats["started"]), 4),
+               "recent": recent}
+        return out
+
+    def gauge_rows(self):
+        """Labelled rows for the health exporter's ``/metrics`` scrape."""
+        rows = [("handoff/started_total", {}, float(self.stats["started"])),
+                ("handoff/fallback_rate", {}, float(self.fallback_rate)),
+                ("handoff/bytes_moved_total", {},
+                 float(self.stats["bytes_moved"]))]
+        p50 = self.p50_ms
+        if p50 is not None:
+            rows.append(("handoff/p50_ms", {}, float(p50)))
+        return rows

@@ -325,6 +325,10 @@ class TenantMeter:
         self._global_waits = deque(maxlen=max(16, int(config.starvation_window) * 4))
         self._t0 = time.time()
         self._terminals = 0
+        # per-pool compute split (serving/disagg.py): {role: {kind: s}} —
+        # fleet-level, not per-tenant, because pool purity is a topology
+        # property (did the prefill pool do decode work?), not a billing one
+        self._pool_compute: Dict[str, Dict[str, float]] = {}
         self.stats = {"tenants_seen": 0, "folded_other": 0, "starvations": 0,
                       "usage_records": 0}
         self.usage_log = (RequestLog(config.usage_log_path,
@@ -433,16 +437,21 @@ class TenantMeter:
                                          tenant=name, request_id=rid,
                                          tenant_p99_wait_ms=round(t_p99 * 1e3, 3))
 
-    def on_compute(self, tenant, kind, seconds, tokens=0) -> None:
+    def on_compute(self, tenant, kind, seconds, tokens=0, pool=None) -> None:
         """One request's share of one engine forward's wall clock (the
         scheduler step-observer apportionment), bucketed
-        prefill/decode/spec_verify."""
+        prefill/decode/spec_verify. ``pool`` is the serving replica's
+        disaggregation role — the fleet-level per-pool split it feeds is
+        what the pool-purity acceptance test measures."""
         if seconds <= 0.0 and not tokens:
             return
         with self._lock:
             led = self._ledger(tenant)
             led.compute_s[kind] += max(0.0, float(seconds))
             led.computed_tokens += int(tokens)
+            if pool is not None:
+                by_kind = self._pool_compute.setdefault(str(pool), {})
+                by_kind[kind] = by_kind.get(kind, 0.0) + max(0.0, float(seconds))
 
     def on_terminal(self, tenant, rid, slo_class, finish_reason,
                     generated_tokens, cancelled=False) -> None:
@@ -636,6 +645,10 @@ class TenantMeter:
                 other_snap["kv_block_s"] = round(max(0.0, tot_kv - top_kv), 6)
             fi = self._fairness_locked(per_kv)
             n_seen = self.stats["tenants_seen"]
+            # disaggregated-pool compute split (empty dict when the fleet
+            # is all-mixed or disagg is off): {role: {kind: seconds}}
+            pools = {role: {k: round(v, 6) for k, v in by_kind.items()}
+                     for role, by_kind in self._pool_compute.items()}
         return {
             "since_unix": self._t0,
             "wall_s": round(time.time() - self._t0, 3),
@@ -644,6 +657,7 @@ class TenantMeter:
             "fairness_index": fi,
             "tenants": snaps,
             "other": other_snap,
+            "pools": pools,
             "untenanted_kv_block_s": round(unt, 6),
             "starvations": self.stats["starvations"],
         }

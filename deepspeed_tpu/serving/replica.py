@@ -122,7 +122,7 @@ class GatewayRequest:
     __slots__ = ("uid", "prompt", "max_new_tokens", "slo_class", "eos_token_id",
                  "stream", "replica_name", "t_admitted", "cached_tokens",
                  "uncached_tokens", "ttft_ms", "tpot_ms", "rid", "ctx", "sampling",
-                 "tenant")
+                 "tenant", "resume_base", "handoff_state")
 
     def __init__(self, uid, prompt, max_new_tokens, slo_class, eos_token_id=None,
                  rid=None, ctx=None, sampling=None, tenant=None):
@@ -147,6 +147,13 @@ class GatewayRequest:
         # absent): always carried so the request log and SSE meta can name
         # the owner; the METER only exists when the config block asks
         self.tenant = tenant
+        # disaggregated-serving migration state (serving/disagg.py):
+        # resume_base = tokens the stream already held when this request
+        # resumed on a decode replica (its scheduler counts from 0 again);
+        # handoff_state latches the one migration attempt — None (never
+        # tried) | 'migrated' | 'fallback' (failed, decoding in place)
+        self.resume_base = 0
+        self.handoff_state = None
 
 
 class EngineReplica:
@@ -158,10 +165,18 @@ class EngineReplica:
     # fleet of replicas is not spinning on the admission lock
     IDLE_WAIT_S = 0.05
 
-    def __init__(self, name, engine, admission, config, reqtrace=None, meter=None):
+    def __init__(self, name, engine, admission, config, reqtrace=None, meter=None,
+                 role="mixed"):
         self.name = str(name)
         self.engine = engine
         self.config = config
+        # disaggregated pool role (serving/disagg.py): "prefill" replicas
+        # push completed prefills to the decode pool through the KV handoff;
+        # "mixed" (the default) is the co-located baseline and never migrates
+        self.role = str(role)
+        self._disagg = None  # DisaggCoordinator, wired by the gateway
+        self._resume_lock = threading.Lock()
+        self._resumes = []  # (req, tokens, remaining) adopted migrations
         self._admission = admission
         self._reqtrace = reqtrace
         # tenant metering plane (serving/metering.py): compute-seconds via
@@ -283,7 +298,51 @@ class EngineReplica:
                     bucket = "prefill" if n > 1 else "decode"
                 else:
                     bucket = kind  # "decode" | "spec_verify"
-                meter.on_compute(req.tenant, bucket, share, tokens=n)
+                # pool=<role> feeds the per-pool compute split the purity
+                # acceptance bar measures (zero decode-seconds on a prefill
+                # pool is what proves disaggregation actually disaggregated)
+                meter.on_compute(req.tenant, bucket, share, tokens=n,
+                                 pool=self.role)
+
+    def set_disagg(self, coordinator):
+        """Arm the disaggregation coordinator (gateway wiring, pre-start):
+        prefill-role replicas begin offering completed prefills to it."""
+        self._disagg = coordinator
+
+    def detach_request(self, uid: int):
+        """Surgically remove ``uid`` from this replica WITHOUT terminal
+        accounting — the request is migrating, not finishing (the decode
+        replica close-out runs exactly once, over the full token count).
+        Driver-thread only. The scheduler cancel flushes the engine
+        sequence, which publishes its full blocks into this replica's OWN
+        radix tree first — the migrated prefix stays locally reusable, so
+        prefix sharing flows both directions of the handoff."""
+        req = self._streams.pop(int(uid), None)
+        if req is None:
+            return
+        if self._scheduler.cancel(int(uid)):
+            self._scheduler.discard_result(int(uid))
+        self._inflight -= 1
+
+    def enqueue_resume(self, req, tokens, remaining):
+        """Adopt a migrated request (called from the SOURCE replica's driver
+        via the coordinator): an infallible list append — the scheduler
+        submit happens on THIS replica's own driver at its next loop
+        iteration (the single-threaded-scheduler contract). ``tokens`` is
+        prompt + everything generated so far; ``remaining`` is the new-token
+        budget left."""
+        with self._resume_lock:
+            self._resumes.append((req,
+                                  np.asarray(tokens, np.int32).reshape(-1),
+                                  max(1, int(remaining))))
+        self.wake()
+
+    def book_handoff(self, seconds: float):
+        """Goodput booking for handoff broker wall time: driver seconds
+        spent migrating (or failing to migrate) a request are neither
+        prefill nor decode — they get their own serving category."""
+        if self._goodput is not None:
+            self._goodput.book("handoff", max(0.0, float(seconds)))
 
     def cancel(self, uid: int):
         """Request abort of ``uid`` (client timed out / disconnected). The
@@ -426,6 +485,7 @@ class EngineReplica:
                 busy = False
                 self._process_cancellations()
                 if not self.paused:
+                    busy = self._pull_resumes() or busy
                     busy = self._pull_admitted() or busy
                     if self._scheduler.has_work:
                         if hb.enabled:
@@ -486,6 +546,14 @@ class EngineReplica:
                 self._reqtrace.finalize(req)
         self._streams.clear()
         self._inflight = 0
+        # adopted migrations still queued for submit die with the driver
+        # too — the never-lose-a-request contract covers the resume queue
+        with self._resume_lock:
+            resumes, self._resumes = self._resumes, []
+        for req, _tokens, _remaining in resumes:
+            req.stream.finish(reason="error", error=error)
+            if self._reqtrace is not None:
+                self._reqtrace.finalize(req)
 
     def _process_cancellations(self):
         with self._cancel_lock:
@@ -540,6 +608,39 @@ class EngineReplica:
             pulled = True
         return pulled
 
+    def _pull_resumes(self) -> bool:
+        """Driver-side half of a handoff adoption: submit each migrated
+        request's full stream (prompt + produced) with its remaining token
+        budget. The host chain the handoff installed makes the submit's
+        prefix acquisition a hierarchy hit — only the un-exported tail
+        re-prefills before decode continues. Bypasses ``_max_inflight``
+        (the request already holds a fleet-wide slot, counted on its source
+        at admission) and never raises: a failed submit finishes the stream
+        with the error, so migrated requests are never silently lost."""
+        with self._resume_lock:
+            if not self._resumes:
+                return False
+            items, self._resumes = self._resumes, []
+        for req, tokens, remaining in items:
+            try:
+                self._scheduler.submit(req.uid, tokens,
+                                       max_new_tokens=remaining,
+                                       eos_token_id=req.eos_token_id,
+                                       sampling=req.sampling,
+                                       tenant=req.tenant)
+            except Exception as e:  # noqa: BLE001 — report, never lose
+                req.stream.finish(reason="error",
+                                  error=f"{type(e).__name__}: {e}")
+                if self._reqtrace is not None:
+                    self._reqtrace.finalize(req)
+                continue
+            req.resume_base = req.stream.produced
+            req.replica_name = self.name
+            self._streams[req.uid] = req
+            self._inflight += 1
+            get_metrics().counter("gateway/resumed_requests_total").inc()
+        return True
+
     def _step(self) -> bool:
         try:
             n = self._scheduler.step()
@@ -568,7 +669,10 @@ class EngineReplica:
         reg = get_metrics()
         for uid, req in list(self._streams.items()):
             st = req.stream
-            new = self._scheduler.new_tokens(uid, st.produced)
+            # resume_base: tokens the stream already held when a migrated
+            # request resumed HERE — this scheduler's generation restarts at
+            # zero, so the stream cursor is offset by what the source made
+            new = self._scheduler.new_tokens(uid, st.produced - req.resume_base)
             if new:
                 pushed = st.push(new)
                 if pushed:
@@ -578,6 +682,22 @@ class EngineReplica:
                         reg.histogram(f"gateway/ttft_ms_{req.slo_class}").observe(req.ttft_ms)
                         if self._reqtrace is not None and req.ctx is not None:
                             self._reqtrace.on_first_token(req, req.ttft_ms)
+            if (self._disagg is not None and uid not in finished
+                    and req.handoff_state is None and req.resume_base == 0
+                    and req.sampling is None  # greedy-parity contract only
+                    and self._disagg.wants_handoff(self)
+                    and st.produced >= self._disagg.handoff_after_tokens
+                    and st.produced < req.max_new_tokens):
+                # prefill is proven done (first tokens exist) and decode
+                # remains — migrate to the decode pool. try_handoff runs the
+                # whole pipeline on THIS driver thread; True means detach
+                # already removed the request from our maps.
+                if self._disagg.try_handoff(self, req, st.all_tokens()):
+                    req.handoff_state = "migrated"
+                    continue
+                # terminal fallback: decode in place, never re-attempted
+                # (the ledger refused-or-failed entry pins at-most-once)
+                req.handoff_state = "fallback"
             if uid in finished:  # once: the stream entry is removed with it
                 self._inflight -= 1
                 del self._streams[uid]
@@ -622,7 +742,8 @@ class EngineReplica:
     # -- introspection -------------------------------------------------------
     def state(self) -> dict:
         out = {"name": self.name, "alive": self.alive, "paused": self.paused,
-               "warmed": self.warmed, "inflight": self._inflight,
+               "warmed": self.warmed, "role": self.role,
+               "inflight": self._inflight,
                "queued": self._admission.depth(replica=self.name),
                "steps": self.steps,
                "available_blocks": self.engine.available_blocks}

@@ -18,6 +18,15 @@ Liveness comes from the PR 5 health plane: a replica whose
 thread died) is excluded from placement until a fresh beat re-arms it —
 so a wedged replica sheds to its siblings instead of black-holing
 requests.
+
+Disaggregated pools (``serving/disagg.py``): when any replica carries a
+non-``mixed`` role, NEW requests place only onto prefill-capable replicas
+(``prefill``/``mixed``) — decode replicas receive work through the KV
+handoff, not the front door. The prefix oracle still scores the WHOLE
+live fleet: the host tier is fleet-shared state (a handed-off chain is
+promotable from any replica's host pool after adoption), so a hit
+anywhere counts as ``fleet_prefix_hits`` even when placement is
+restricted to the prefill pool.
 """
 
 from typing import List, Optional
@@ -35,10 +44,24 @@ class ReplicaRouter:
         self.policy = policy
         self._rng = np.random.default_rng(seed)
         self.stats = {"routed": 0, "prefix_hits": 0, "fallback_least_loaded": 0,
-                      "no_live_replica": 0}
+                      "no_live_replica": 0, "fleet_prefix_hits": 0,
+                      "pool_restricted": 0}
 
     def live(self) -> List:
         return [r for r in self.replicas if r.alive]
+
+    def _placement_pool(self, live: List) -> List:
+        """Role-restricted placement candidates: with disaggregated pools,
+        new requests go to prefill-capable replicas only. Every live
+        replica mixed (or no role attr at all) = the full live set; an
+        all-decode live fleet also falls back to the full set — degraded
+        placement beats a 503."""
+        if all(getattr(r, "role", "mixed") == "mixed" for r in live):
+            return live
+        pool = [r for r in live if getattr(r, "role", "mixed") in ("prefill", "mixed")]
+        if pool and len(pool) < len(live):
+            self.stats["pool_restricted"] += 1
+        return pool or live
 
     def select(self, prompt_tokens, ctx=None) -> Optional[object]:
         """Pick the replica for a prompt; None when no replica is live.
@@ -50,31 +73,38 @@ class ReplicaRouter:
             self.stats["no_live_replica"] += 1
             return None
         self.stats["routed"] += 1
+        cands = self._placement_pool(live)
         if self.policy == "random":
-            chosen = live[int(self._rng.integers(len(live)))]
+            chosen = cands[int(self._rng.integers(len(cands)))]
             if ctx is not None:
                 ctx.route_policy, ctx.route_scores = self.policy, {}
             return chosen
         if self.policy == "prefix":
-            scores = [r.prefix_overlap(prompt_tokens) for r in live]
+            # score the WHOLE live fleet (the fleet-wide radix oracle over
+            # shared host-tier state), place within the candidate pool
+            scores = {r.name: r.prefix_overlap(prompt_tokens) for r in live}
             if ctx is not None:
                 ctx.route_policy = self.policy
-                ctx.route_scores = {r.name: int(s) for r, s in zip(live, scores)}
-            best = max(scores)
+                ctx.route_scores = {n: int(s) for n, s in scores.items()}
+            if max(scores.values()) > 0:
+                self.stats["fleet_prefix_hits"] += 1
+            best = max(scores[r.name] for r in cands)
             if best > 0:
                 self.stats["prefix_hits"] += 1
                 # ties on overlap (two replicas both hold the hot prefix)
                 # break by load, so affinity never builds a hotspot
-                cands = [r for r, s in zip(live, scores) if s == best]
-                return min(cands, key=lambda r: r.load)
+                tied = [r for r in cands if scores[r.name] == best]
+                return min(tied, key=lambda r: r.load)
             self.stats["fallback_least_loaded"] += 1
         if ctx is not None and ctx.route_policy is None:
             ctx.route_policy = self.policy
-            ctx.route_scores = {r.name: int(r.load) for r in live}
-        return min(live, key=lambda r: r.load)
+            ctx.route_scores = {r.name: int(r.load) for r in cands}
+        return min(cands, key=lambda r: r.load)
 
     def state(self) -> dict:
         return {"policy": self.policy,
                 "replicas": [r.name for r in self.replicas],
                 "live": [r.name for r in self.live()],
+                "roles": {r.name: getattr(r, "role", "mixed")
+                          for r in self.replicas},
                 **self.stats}

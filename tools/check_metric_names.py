@@ -9,6 +9,7 @@ registration uses a ``subsystem/name`` snake_case literal whose subsystem
 comes from the approved prefix set:
 
     train / serving / gateway / health / comm / checkpoint / cache / memory
+    / goodput / profile / handoff
 
 AST-checked with no package imports, so the gate runs anywhere:
 
@@ -41,7 +42,8 @@ DEFAULT_PKG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pa
                                "deepspeed_tpu")
 
 APPROVED_PREFIXES = ("train", "serving", "gateway", "health", "comm",
-                     "checkpoint", "cache", "memory", "goodput", "profile")
+                     "checkpoint", "cache", "memory", "goodput", "profile",
+                     "handoff")
 
 REGISTRATION_CALLS = ("counter", "gauge", "histogram")
 

@@ -69,12 +69,21 @@ NEUTRAL_NAMES = ("wall_s", "unattributed_s", "overbooked_s", "recovery_badput_s"
 # its flop/byte/wall accounting stays neutral
 HIGHER_BETTER_LEAVES = ("fairness_index", "mfu", "mbu")
 
+# explicit lower-is-better leaves that the suffix rules would misread:
+# ``handoff_fallback_rate`` ends in ``_rate`` (generically higher-better for
+# throughput rates) but a FALLING-back migration pipeline is a regressing
+# one, and ``handoff_p50_ms`` must stay lower-better even if the generic
+# latency suffix table ever changes — both pinned by tests/test_disagg.py
+LOWER_BETTER_LEAVES = ("handoff_p50_ms", "handoff_fallback_rate")
+
 
 def metric_direction(metric):
     """'lower' | 'higher' | None (unknown/neutral) for a dotted name."""
     leaf = metric.rsplit(".", 1)[-1]
     if leaf in HIGHER_BETTER_LEAVES:
         return "higher"
+    if leaf in LOWER_BETTER_LEAVES:
+        return "lower"
     if metric.startswith(NEUTRAL_PREFIXES) or leaf in NEUTRAL_NAMES:
         return None
     if leaf.endswith(HIGHER_BETTER_SUFFIXES) or leaf in HIGHER_BETTER_NAMES:

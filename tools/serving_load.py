@@ -248,11 +248,12 @@ def _latency_stats(done):
             "p95_ms": round(float(np.percentile(lats, 95)) * 1000, 1)}
 
 
-def build_engine(on_tpu, prefix_cache=False, speculative=None):
+def build_engine(on_tpu, prefix_cache=False, speculative=None, host_blocks=None):
     import jax.numpy as jnp
     from deepspeed_tpu.models import TransformerConfig, TransformerLM
-    from deepspeed_tpu.inference.v2 import (DSStateManagerConfig, InferenceEngineV2,
-                                            PrefixCacheConfig, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2 import (DSStateManagerConfig, HostTierConfig,
+                                            InferenceEngineV2, PrefixCacheConfig,
+                                            RaggedInferenceEngineConfig)
 
     if on_tpu:
         cfg = TransformerConfig(vocab_size=32000, hidden_size=2048, num_layers=12,
@@ -272,7 +273,12 @@ def build_engine(on_tpu, prefix_cache=False, speculative=None):
         icfg = RaggedInferenceEngineConfig(kv_block_size=8, num_kv_blocks=80,
                                            kv_dtype=jnp.float32, state_manager=sm,
                                            use_pallas_kernels="never")
-    icfg.prefix_cache = PrefixCacheConfig(enabled=bool(prefix_cache))
+    # host_blocks arms the pinned host tier (required transport for the
+    # disaggregated KV handoff — install_prefix_kv adopts host-tier nodes)
+    icfg.prefix_cache = PrefixCacheConfig(
+        enabled=bool(prefix_cache) or host_blocks is not None,
+        host_tier=(HostTierConfig(host_blocks=int(host_blocks))
+                   if host_blocks else None))
     if speculative is not None:
         icfg.speculative = speculative
     return InferenceEngineV2(TransformerLM(cfg), icfg)
@@ -917,12 +923,14 @@ def run_http_load(host, port, workload, concurrency=8, stream=True,
     return agg, recs
 
 
-def build_gateway(n_replicas=2, prefix_cache=True, on_tpu=False, **cfg_kwargs):
+def build_gateway(n_replicas=2, prefix_cache=True, on_tpu=False, host_blocks=None,
+                  **cfg_kwargs):
     """N fresh replicas (identical deterministic params — greedy outputs are
     placement-invariant) under one started gateway."""
     from deepspeed_tpu.serving import GatewayConfig, ServingGateway
 
-    engines = [build_engine(on_tpu, prefix_cache=prefix_cache)
+    engines = [build_engine(on_tpu, prefix_cache=prefix_cache,
+                            host_blocks=host_blocks)
                for _ in range(n_replicas)]
     cfg = GatewayConfig(enabled=True, port=0, **cfg_kwargs)
     return ServingGateway(engines, cfg).start()
@@ -1214,6 +1222,100 @@ def tracing_overhead_ab(on_tpu, n_requests=None, seed=0, n_replicas=2):
         shutil.rmtree(log_dir, ignore_errors=True)
 
 
+def disagg_ab(on_tpu, n_requests=None, seed=0):
+    """Disaggregated prefill/decode A/B (ISSUE 18): a decode-heavy
+    FOREGROUND stream measured while a BACKGROUND stream of pure long
+    prefills (``max_new_tokens=1`` — prefill completes the request) hammers
+    the fleet, through the full HTTP plane twice:
+
+      * ``colocated`` — two ``mixed`` replicas; background prefill chunks
+        share SplitFuse forwards with foreground decodes on BOTH replicas,
+        so every foreground token pays the arbitration (the interference
+        PR 7's stage attribution measures);
+      * ``disagg``    — ``("prefill", "decode")`` pools; the background
+        never leaves the prefill replica, and foreground requests migrate
+        their KV to the decode replica through the host-tier handoff and
+        decode in prefill-free forwards.
+
+    Both arms arm the host tier (the disagg arm NEEDS it as transport; the
+    baseline gets it too so capacity is equal). The headline is foreground
+    TPOT p50/p99 — the per-token decode interval the pool split exists to
+    protect — plus greedy token parity across arms and the handoff ledger's
+    migration stats (p50 latency, fallback rate, volume)."""
+    n_fg = n_requests or (24 if on_tpu else 12)
+    n_bg = 2 * n_fg
+    # foreground: decode-heavy, prompt + new inside the cpu-smoke
+    # max_context=64; background: the longest prefill the context takes,
+    # one token out (prefill IS the request)
+    fg_shape = dict(prompt_lo=16, prompt_hi=28, new_lo=12, new_hi=20)
+    bg_shape = dict(prompt_lo=40, prompt_hi=60, new_lo=1, new_hi=1)
+    concurrency = 8
+    host_blocks = 160
+    result = {"config": "disagg_ab", "n_foreground": n_fg, "n_background": n_bg,
+              "n_replicas": 2, "engine_config": "cpu_smoke",
+              "host_blocks": host_blocks}
+    tokens_by_arm = {}
+    for arm in ("colocated", "disagg"):
+        kwargs = {}
+        if arm == "disagg":
+            from deepspeed_tpu.serving import DisaggConfig
+
+            kwargs["disagg"] = DisaggConfig(enabled=True,
+                                            roles=("prefill", "decode"))
+        gw = build_gateway(n_replicas=2, prefix_cache=True,
+                           host_blocks=host_blocks, on_tpu=on_tpu, **kwargs)
+        try:
+            warm = (make_workload(n_fg, rate_rps=None, seed=seed + 7,
+                                  uid_base=90_000, **fg_shape)
+                    + make_workload(n_bg, rate_rps=None, seed=seed + 8,
+                                    uid_base=95_000, **bg_shape))
+            run_http_load(gw.config.host, gw.port, warm,
+                          concurrency=concurrency)
+            # one merged closed-loop run: the background is load, not a
+            # separate phase — arrival order interleaves the two streams
+            fg = make_workload(n_fg, rate_rps=None, seed=seed, uid_base=0,
+                               **fg_shape)
+            bg = make_workload(n_bg, rate_rps=None, seed=seed + 1,
+                               uid_base=500_000, **bg_shape)
+            _agg, recs = run_http_load(gw.config.host, gw.port, fg + bg,
+                                       concurrency=concurrency)
+            fg_done = [r for r in recs if r["uid"] < 500_000
+                       and r["status"] == 200 and r["error"] is None]
+            bg_done = [r for r in recs if r["uid"] >= 500_000
+                       and r["status"] == 200 and r["error"] is None]
+            line = {"fg_completed": len(fg_done), "bg_completed": len(bg_done),
+                    "errors": len(recs) - len(fg_done) - len(bg_done),
+                    "fg_ttft": _percentiles([r["ttft_ms"] for r in fg_done
+                                             if r["ttft_ms"]]),
+                    "fg_tpot": _percentiles([r["tpot_ms"] for r in fg_done
+                                             if r["tpot_ms"]]),
+                    "fg_latency": _percentiles([r["latency_ms"] for r in fg_done
+                                                if r["latency_ms"]])}
+            if arm == "disagg":
+                st = gw.disagg.state()
+                line.update({"pools": st["pools"], "migrated": st["migrated"],
+                             "fallbacks": st["fallbacks"],
+                             "blocks_moved": st["handoff"]["blocks_moved"],
+                             "handoff_p50_ms": st["handoff"]["handoff_p50_ms"],
+                             "handoff_p99_ms": st["handoff"]["handoff_p99_ms"],
+                             "handoff_fallback_rate":
+                                 st["handoff"]["handoff_fallback_rate"]})
+            tokens_by_arm[arm] = {r["uid"]: list(r["tokens"])
+                                  for r in fg_done + bg_done}
+            result[arm] = line
+        finally:
+            gw.stop()
+    common = sorted(set(tokens_by_arm["colocated"]) & set(tokens_by_arm["disagg"]))
+    result["token_parity"] = bool(common) and all(
+        tokens_by_arm["colocated"][u] == tokens_by_arm["disagg"][u]
+        for u in common)
+    co_p99 = result["colocated"]["fg_tpot"].get("p99_ms")
+    dg_p99 = result["disagg"]["fg_tpot"].get("p99_ms")
+    result["tpot_p99_improved"] = (co_p99 is not None and dg_p99 is not None
+                                   and dg_p99 < co_p99)
+    return result
+
+
 def gateway_bench(on_tpu, seed=0):
     """The bench.py serving-block entry: latency-under-load curves + the
     router A/B + the request-tracing attribution/overhead block, one dict."""
@@ -1255,6 +1357,8 @@ def main():
         out = cache_pressure_bench(on_tpu)
     elif "host_tier" in sys.argv[1:]:
         out = host_tier_ab(on_tpu)
+    elif "disagg" in sys.argv[1:]:
+        out = disagg_ab(on_tpu)
     elif "multi_tenant" in sys.argv[1:]:
         out = multi_tenant_bench(on_tpu)
     else:
