@@ -1219,6 +1219,41 @@ def run_bench():
             print(f"# WARNING: tenants bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
 
+    # --control: self-driving serving A/B (ISSUE 19) — the same interactive
+    # stream under a batch prefill storm, controller-off vs controller-on
+    # (admission policy sheds the batch victim class live). The leaves
+    # perf_sentinel trends: fg_{off,on}_miss_rate carry the _miss_rate
+    # lower-better suffix; actuations is a neutral accounting field.
+    # Outside the headline timed window; DS_TPU_BENCH_CONTROL=0 skips,
+    # failure never costs the headline.
+    control_line = None
+    if os.environ.get("DS_TPU_BENCH_CONTROL", "1") != "0":
+        try:
+            from tools.serving_load import control_ab
+
+            ca = control_ab(on_tpu)
+            off, on = ca["control_off"], ca["control_on"]
+            control_line = {
+                "ttft_target_ms": ca["ttft_target_ms"],
+                "fg_off_miss_rate": off["fg_miss_rate"],
+                "fg_on_miss_rate": on["fg_miss_rate"],
+                "fg_ttft_p99_off_ms": off["fg_ttft"].get("p99_ms"),
+                "fg_ttft_p99_on_ms": on["fg_ttft"].get("p99_ms"),
+                "slo_miss_improved": ca["slo_miss_improved"],
+                "token_parity": ca["token_parity"],
+                "actuations": on["actuations"],
+                "deferred": on["deferred"],
+                "controller_errors": on["errors"],
+                "decisions_justified": on["decisions_justified"],
+            }
+            print(f"# control: fg_miss_rate {off['fg_miss_rate']} -> "
+                  f"{on['fg_miss_rate']} (target {ca['ttft_target_ms']}ms) "
+                  f"improved={ca['slo_miss_improved']} parity={ca['token_parity']} "
+                  f"actuations={on['actuations']}", flush=True)
+        except Exception as e:
+            print(f"# WARNING: control bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+
     # --kernels: raw-speed microbench A/Bs (q-tiled paged attention, explicit
     # ZeRO-3 overlap, tuned-vs-default flash tiles). Outside the headline
     # timed window; DS_TPU_BENCH_KERNELS=0 skips, failure never costs the
@@ -1310,6 +1345,8 @@ def run_bench():
         line["memory"] = memory_line
     if tenants_line is not None:
         line["tenants"] = tenants_line
+    if control_line is not None:
+        line["control"] = control_line
     if not on_tpu:
         line["tpu_unavailable_reason"] = tpu_error or "no TPU device visible"
     if gate_note:

@@ -19,6 +19,8 @@ Design points beyond the happy path:
   uids named, and partial generations stay readable via ``results``.
 """
 
+import copy
+import dataclasses
 import time
 from typing import Dict, List, Optional
 
@@ -185,6 +187,41 @@ class DynamicSplitFuseScheduler:
         request never speculated. The gateway's request summary record
         carries the derived acceptance rate."""
         return self._spec_by_uid.get(uid)
+
+    def spec_params(self) -> Optional[Dict[str, int]]:
+        """The live speculative knobs (``{"k", "tree_width"}``), None when
+        this scheduler is not speculating. The serving control plane reads
+        this before proposing a K adaptation."""
+        if self._spec is None:
+            return None
+        return {"k": int(self._spec.k),
+                "tree_width": int(getattr(self._spec, "tree_width", 1))}
+
+    def set_spec_params(self, k: Optional[int] = None,
+                        tree_width: Optional[int] = None) -> Optional[Dict[str, int]]:
+        """Retarget speculative K / tree width for FUTURE draft rounds.
+        ``_spec`` may alias ``engine.config.speculative`` (shared with other
+        schedulers built from the same config), so the update REPLACES the
+        config object rather than mutating it in place. ``_spec_burst``
+        re-reads ``self._spec`` every round, so the new knobs apply from the
+        next round with no re-plumbing. No-op (returns None) when not
+        speculating; returns the applied params otherwise."""
+        if self._spec is None:
+            return None
+        kwargs = {}
+        if k is not None:
+            kwargs["k"] = max(1, int(k))
+        if tree_width is not None:
+            kwargs["tree_width"] = max(1, int(tree_width))
+        if kwargs:
+            try:
+                self._spec = dataclasses.replace(self._spec, **kwargs)
+            except TypeError:  # injected non-dataclass spec stub (tests)
+                sp = copy.copy(self._spec)
+                for name, v in kwargs.items():
+                    setattr(sp, name, v)
+                self._spec = sp
+        return self.spec_params()
 
     def new_tokens(self, uid: int, start: int) -> List[int]:
         """Tokens generated past position ``start`` for a pending/active/

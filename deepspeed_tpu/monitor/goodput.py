@@ -151,6 +151,9 @@ SPAN_ALLOWLIST = (
     # tenant metering (serving/metering.py): a starvation detection is a
     # zero-duration instant — it consumes no wall clock
     "serving/tenant_starvation",
+    # control plane (serving/control/): a controller decision is a
+    # zero-duration instant — it consumes no wall clock
+    "control/decision",
 )
 
 

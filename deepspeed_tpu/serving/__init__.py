@@ -28,7 +28,14 @@ Layering (request -> token):
     placed on the prefill pool, and completed prefills migrated to the
     decode pool by a gateway-brokered cross-replica KV handoff through
     the host tier (checksummed manifests, at-most-once, fallback-in-place
-    — never a lost request), ``GET /v1/pools``.
+    — never a lost request), ``GET /v1/pools``;
+  * :mod:`control`   — the feedback control plane
+    (``serving.gateway.control`` block): one decision thread reading the
+    sensor planes (goodput windows, SLO-miss counters, admission gauges,
+    spec accept rates, recompile-sentinel buckets) and driving admission
+    depths, replica drain/undrain/restart, background kernel re-tunes
+    and speculative K through narrow public setters, every decision
+    logged with its sensor justification, ``GET /v1/control``.
 
 Everything defaults OFF: importing this package starts no threads, and a
 constructed-but-never-started gateway allocates no queues' worth of
@@ -40,8 +47,8 @@ The request plane talks to the engine ONLY through its public API
 by the ``tools/check_gateway_api.py`` AST gate, run from tier-1.
 """
 
-from .config import (DisaggConfig, GatewayConfig, MeteringConfig,
-                     RequestTraceConfig, SLOClassConfig)
+from .config import (ControlConfig, DisaggConfig, GatewayConfig,
+                     MeteringConfig, RequestTraceConfig, SLOClassConfig)
 from .admission import AdmissionController
 from .router import ReplicaRouter
 from .replica import EngineReplica, GatewayRequest, TokenStream
@@ -52,4 +59,5 @@ from .reqtrace import (RequestContext, RequestLog, RequestTracing,
                        sanitize_request_id)
 from .metering import (DEFAULT_TENANT, EngineMeterView, TenantMeter,
                        sanitize_tenant_id)
+from .control import DecisionLog, ServingController
 from .gateway import ServingGateway, parse_sse, sse_frame

@@ -52,6 +52,72 @@ class AdmissionController:
         # per-SLO-class admitted/shed counts behind the scrapeable shed-rate
         # gauge (gauge_rows) — the aggregate stats above can't give per-class
         self.class_stats: Dict[str, Dict[str, int]] = {}
+        # control-plane depth overrides, keyed by class: the ONE sanctioned
+        # mutation point for live admission limits (check_control_actuators
+        # keeps the setter reachable only from serving/control/). Empty dict
+        # = configured limits apply untouched.
+        self._depth_overrides: Dict[str, Dict[str, int]] = {}
+        # per-class admit timestamps behind the admitted-rate gauge — a
+        # bounded deque per class, pruned to the rate window on read
+        self._admit_times: Dict[str, deque] = {}
+
+    ADMIT_RATE_WINDOW_S = 30.0
+
+    # -- control-plane actuators (serving/control/ only) ---------------------
+    def set_depth_override(self, slo_class: str,
+                           max_queue_depth: Optional[int] = None,
+                           max_queue_uncached_tokens: Optional[int] = None) -> dict:
+        """Override a class's configured queue bounds at runtime (the
+        admission actuator). ``None`` leaves that bound at its configured
+        value; the override is consulted by ``try_admit`` and
+        ``below_shed_threshold`` in place of the static config."""
+        ov = {}
+        if max_queue_depth is not None:
+            ov["max_queue_depth"] = max(0, int(max_queue_depth))
+        if max_queue_uncached_tokens is not None:
+            ov["max_queue_uncached_tokens"] = max(0, int(max_queue_uncached_tokens))
+        with self._lock:
+            self._depth_overrides[slo_class] = ov
+        return dict(ov)
+
+    def clear_depth_override(self, slo_class: str) -> None:
+        with self._lock:
+            self._depth_overrides.pop(slo_class, None)
+
+    def effective_limits(self, slo_class: str) -> Dict[str, int]:
+        """The bounds ``try_admit`` would enforce for ``slo_class`` right
+        now — configured values with any control override applied."""
+        with self._lock:
+            return dict(zip(("max_queue_depth", "max_queue_uncached_tokens"),
+                            self._limits_locked(slo_class)))
+
+    def _limits_locked(self, slo_class: str) -> Tuple[int, int]:
+        cls = self.config.slo_classes.get(slo_class)
+        depth = cls.max_queue_depth if cls is not None else 0
+        tokens = cls.max_queue_uncached_tokens if cls is not None else 0
+        ov = self._depth_overrides.get(slo_class)
+        if ov:
+            depth = ov.get("max_queue_depth", depth)
+            tokens = ov.get("max_queue_uncached_tokens", tokens)
+        return int(depth), int(tokens)
+
+    def admitted_rate(self, slo_class: str) -> float:
+        """Admits/s for ``slo_class`` over the trailing rate window."""
+        with self._lock:
+            return self._admitted_rate_locked(slo_class)
+
+    def _admitted_rate_locked(self, slo_class: str) -> float:
+        times = self._admit_times.get(slo_class)
+        if not times:
+            return 0.0
+        horizon = time.perf_counter() - self.ADMIT_RATE_WINDOW_S
+        while times and times[0] < horizon:
+            times.popleft()
+        if not times:
+            return 0.0
+        span = max(1e-3, min(self.ADMIT_RATE_WINDOW_S,
+                             time.perf_counter() - times[0]))
+        return len(times) / span
 
     def set_roles(self, roles: Dict[str, str]) -> None:
         """Arm the disaggregation role map (gateway wiring): queue-depth
@@ -72,9 +138,8 @@ class AdmissionController:
         when admission is already refusing work)."""
         with self._lock:
             for (r, c), q in self._queues.items():
-                cls = self.config.slo_classes.get(c)
-                if cls is not None and cls.max_queue_depth > 0 \
-                        and len(q) >= cls.max_queue_depth:
+                depth, _ = self._limits_locked(c)
+                if depth > 0 and len(q) >= depth:
                     return False
         return True
 
@@ -83,7 +148,7 @@ class AdmissionController:
         """Admit ``req`` onto ``replica``'s class queue, charging its
         uncached prompt tokens. Returns ``(True, None)`` or
         ``(False, reason)`` — a refusal mutates nothing (probe is pure)."""
-        cls = self.config.slo_classes[req.slo_class]
+        self.config.slo_classes[req.slo_class]  # KeyError on unknown class
         # the probe runs OUTSIDE the queue lock (it walks the radix tree);
         # single-writer per tree (only the replica driver mutates it), so
         # the credit is a floor — concurrent publishes only raise it
@@ -102,10 +167,11 @@ class AdmissionController:
                 self._queued_uncached[key] = 0
             cs = self.class_stats.setdefault(req.slo_class,
                                              {"admitted": 0, "shed": 0})
-            if cls.max_queue_depth > 0 and len(q) >= cls.max_queue_depth:
+            max_depth, max_tokens = self._limits_locked(req.slo_class)
+            if max_depth > 0 and len(q) >= max_depth:
                 reason = "queue_depth"
-            elif (cls.max_queue_uncached_tokens > 0
-                  and self._queued_uncached[key] + uncached > cls.max_queue_uncached_tokens):
+            elif (max_tokens > 0
+                  and self._queued_uncached[key] + uncached > max_tokens):
                 reason = "queue_tokens"
             else:
                 reason = None
@@ -134,6 +200,8 @@ class AdmissionController:
             self._queued_uncached[key] += uncached
             self.stats["admitted"] += 1
             cs["admitted"] += 1
+            self._admit_times.setdefault(req.slo_class,
+                                         deque(maxlen=4096)).append(req.t_admitted)
             self.stats["uncached_tokens_admitted"] += uncached
             self.stats["cached_tokens_admitted"] += int(n_cached)
         reg.counter(f"gateway/requests_{req.slo_class}_total").inc()
@@ -231,10 +299,14 @@ class AdmissionController:
                 total = cs["admitted"] + cs["shed"]
                 rows.append(("gateway/shed_rate", {"slo_class": c},
                              (cs["shed"] / total) if total else 0.0))
+                rows.append(("gateway/admitted_rate", {"slo_class": c},
+                             round(self._admitted_rate_locked(c), 4)))
         return rows
 
     def state(self) -> dict:
         with self._lock:
             queues = {f"{r}/{c}": len(q) for (r, c), q in self._queues.items() if q}
             per_class = {c: dict(cs) for c, cs in self.class_stats.items()}
-        return {"queues": queues, "per_class": per_class, **self.stats}
+            overrides = {c: dict(ov) for c, ov in self._depth_overrides.items()}
+        return {"queues": queues, "per_class": per_class,
+                "depth_overrides": overrides, **self.stats}

@@ -153,6 +153,83 @@ class DisaggConfig:
 
 
 @dataclass
+class ControlConfig:
+    """``serving.gateway.control`` block — the feedback control plane
+    (``serving/control/``). Presence-enables (the ``tracing``/``metering``/
+    ``profiling``/``disagg`` contract): an absent block means no controller
+    object, no thread, zero overhead on every request path (test-enforced).
+
+    The controller ticks every ``interval_s``, computes windowed sensor
+    deltas over the trailing ``window_s``, and lets each armed policy
+    propose actuations. Flap-proofing is three-layered: per-policy
+    hysteresis bands (the tighten threshold strictly above the relax
+    threshold), a per-policy ``cooldown_s`` after any applied actuation,
+    and a global ``max_actuations_per_window`` budget — a proposal past
+    the budget is logged as a DEFERRED decision, never applied."""
+
+    enabled: bool = False
+    # decision-loop tick period
+    interval_s: float = 0.25
+    # armed policies: 'admission' | 'scaling' | 'retune' | 'speculation'
+    policies: Tuple = ("admission", "scaling", "speculation")
+    # trailing sensor window the rates/deltas are computed over
+    window_s: float = 5.0
+    # global actuation budget per window — the provable flap bound
+    max_actuations_per_window: int = 4
+    # per-policy quiet period after an applied actuation
+    cooldown_s: float = 1.0
+    # consecutive ticks a condition must hold before a policy may act
+    # (one noisy sample never actuates)
+    sustain_ticks: int = 2
+    # bounded decision JSONL (the reqtrace RequestLog pattern);
+    # "" = in-memory ring only, no file
+    decision_log_path: str = ""
+    decision_log_max_bytes: int = 4 << 20
+    decision_log_max_files: int = 2
+    # in-memory decision ring (forensic dumps + GET /v1/control)
+    last_n: int = 128
+    # -- (a) admission policy: windowed SLO-miss-rate hysteresis band ------
+    # tighten the class's queue bound when the windowed miss rate crosses
+    # the high threshold; relax/clear once it falls under the low one
+    slo_miss_tighten: float = 0.5
+    slo_miss_relax: float = 0.1
+    # tightening halves the effective depth, never below this floor
+    min_queue_depth: int = 2
+    # windowed completions required before a miss rate is trusted
+    min_window_completions: int = 4
+    # -- (b) scaling policy: drain on sustained idle, un-drain on queue ----
+    # drain one replica when the fleet idles (goodput idle fraction at or
+    # past this, or zero load without a ledger) for the sustain window
+    idle_frac_drain: float = 0.9
+    # un-drain (or restart a dead replica) when total queued requests
+    # reach this for the sustain window
+    queue_depth_undrain: int = 1
+    # never drain below this many un-draining live replicas
+    min_active_replicas: int = 1
+    # -- (c) retune policy: sentinel buckets nominate autotuner sweeps -----
+    # unexpected steady-state compiles a bucket needs before nomination
+    retune_min_bucket_count: int = 3
+    # sweeps launched per controller lifetime (each sweep is minutes of
+    # device time — the budget is deliberately small)
+    retune_max_sweeps: int = 2
+    # autotuner artifact root (the registry JSON the sweeps persist into,
+    # unless a process-global registry is already configured)
+    retune_artifact_dir: str = "/tmp/dstpu_control_retune"
+    # -- (d) speculation policy: accept-rate band retunes K ----------------
+    spec_accept_high: float = 0.8
+    spec_accept_low: float = 0.4
+    spec_k_min: int = 1
+    spec_k_max: int = 8
+    # 0 = never touch tree_width; otherwise K raises may widen up to this
+    spec_tree_width_max: int = 0
+    # windowed drafted tokens required before an accept rate is trusted
+    spec_min_window_drafted: int = 16
+
+
+KNOWN_POLICIES = ("admission", "scaling", "retune", "speculation")
+
+
+@dataclass
 class GatewayConfig:
     enabled: bool = False
     host: str = "127.0.0.1"
@@ -197,6 +274,9 @@ class GatewayConfig:
     # disaggregated prefill/decode replica pools + KV handoff; off by
     # default with the same zero-overhead-absent contract
     disagg: DisaggConfig = field(default_factory=DisaggConfig)
+    # feedback control plane (serving/control/); off by default with the
+    # same zero-overhead-absent contract
+    control: ControlConfig = field(default_factory=ControlConfig)
 
     @classmethod
     def from_dict(cls, d) -> "GatewayConfig":
@@ -206,6 +286,7 @@ class GatewayConfig:
         metering = d.pop("metering", None)
         profiling = d.pop("profiling", None)
         disagg = d.pop("disagg", None)
+        control = d.pop("control", None)
         known = {f.name for f in fields(cls)}
         unknown = set(d) - known
         if unknown:
@@ -282,6 +363,54 @@ class GatewayConfig:
             if cfg.disagg.handoff_after_tokens < 1:
                 raise ValueError("serving.gateway.disagg: handoff_after_tokens must "
                                  f"be >= 1, got {cfg.disagg.handoff_after_tokens}")
+        if control is not None:
+            if isinstance(control, ControlConfig):
+                cfg.control = control
+            else:
+                body = dict(control)
+                ct_known = {f.name for f in fields(ControlConfig)}
+                bad = set(body) - ct_known
+                if bad:
+                    raise ValueError(f"serving.gateway.control: unknown keys {sorted(bad)}")
+                if "enabled" not in body:  # presence-enables
+                    body["enabled"] = True
+                cfg.control = ControlConfig(**body)
+            ct = cfg.control
+            ct.policies = tuple(str(p) for p in ct.policies)
+            bad_pols = [p for p in ct.policies if p not in KNOWN_POLICIES]
+            if bad_pols:
+                raise ValueError(f"serving.gateway.control: unknown policies "
+                                 f"{bad_pols}: {' | '.join(KNOWN_POLICIES)}")
+            if ct.interval_s <= 0 or ct.window_s <= 0:
+                raise ValueError("serving.gateway.control: interval_s and window_s "
+                                 f"must be > 0, got interval={ct.interval_s} "
+                                 f"window={ct.window_s}")
+            if ct.max_actuations_per_window < 1:
+                raise ValueError("serving.gateway.control: max_actuations_per_window "
+                                 f"must be >= 1, got {ct.max_actuations_per_window}")
+            if ct.cooldown_s < 0:
+                raise ValueError("serving.gateway.control: cooldown_s must be >= 0, "
+                                 f"got {ct.cooldown_s}")
+            if ct.sustain_ticks < 1:
+                raise ValueError("serving.gateway.control: sustain_ticks must be "
+                                 f">= 1, got {ct.sustain_ticks}")
+            if not ct.slo_miss_tighten > ct.slo_miss_relax >= 0:
+                raise ValueError("serving.gateway.control: the admission hysteresis "
+                                 "band needs slo_miss_tighten > slo_miss_relax >= 0, "
+                                 f"got tighten={ct.slo_miss_tighten} "
+                                 f"relax={ct.slo_miss_relax}")
+            if not ct.spec_accept_high > ct.spec_accept_low >= 0:
+                raise ValueError("serving.gateway.control: the speculation band "
+                                 "needs spec_accept_high > spec_accept_low >= 0, "
+                                 f"got high={ct.spec_accept_high} "
+                                 f"low={ct.spec_accept_low}")
+            if not 1 <= ct.spec_k_min <= ct.spec_k_max:
+                raise ValueError("serving.gateway.control: need 1 <= spec_k_min <= "
+                                 f"spec_k_max, got min={ct.spec_k_min} "
+                                 f"max={ct.spec_k_max}")
+            if ct.min_active_replicas < 1:
+                raise ValueError("serving.gateway.control: min_active_replicas must "
+                                 f"be >= 1, got {ct.min_active_replicas}")
         if classes is not None:
             slo_known = {f.name for f in fields(SLOClassConfig)}
             parsed = {}

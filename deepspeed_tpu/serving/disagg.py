@@ -82,8 +82,18 @@ class DisaggCoordinator:
         return replica.role == "prefill"
 
     def pick_decode_replica(self, src):
+        """Least-loaded live decode-capable replica, EXCLUDING saturated
+        and control-drained ones. Saturation reads the decode pool's own
+        back-pressure signal (``load`` = scheduler-inflight + admission
+        queue depth, against ``max_inflight``): a decode replica already
+        at capacity would queue the migrated request behind a backlog,
+        which is strictly worse than decoding in place on the source —
+        an all-saturated pool therefore returns None and the caller's
+        fallback-in-place path takes over."""
         cands = [r for r in self.replicas
-                 if r is not src and r.alive and r.role in ("decode", "mixed")]
+                 if r is not src and r.alive and r.role in ("decode", "mixed")
+                 and not getattr(r, "draining", False)
+                 and r.load < getattr(r, "max_inflight", float("inf"))]
         if not cands:
             return None
         return min(cands, key=lambda r: (r.load, r.name))

@@ -119,6 +119,13 @@ class ServingGateway:
             for r in self.replicas:
                 r.set_disagg(self.disagg)
             self.admission.set_roles({r.name: r.role for r in self.replicas})
+        # feedback control plane: exists ONLY when the control block asked
+        # for it — with it absent no controller object, no decision log, no
+        # thread (the same zero-overhead contract as the planes above)
+        self.controller = None
+        if self.config.control.enabled:
+            from .control import ServingController
+            self.controller = ServingController(self, self.config.control)
         self.router = ReplicaRouter(self.replicas, policy=self.config.router)
         self._uid_lock = threading.Lock()
         self._next_uid = 1
@@ -185,9 +192,17 @@ class ServingGateway:
             # + p50 once any migration completed) — ownership-checked too
             self._registered_handoff_gauges = self.disagg.ledger.gauge_rows
             health.set_gauge_provider("handoff", self._registered_handoff_gauges)
+        if self.controller is not None:
+            # the controller registers its own health providers and starts
+            # its decision thread LAST — every sensor it reads is live
+            self.controller.start()
         return self
 
     def stop(self, timeout: float = 10.0):
+        if self.controller is not None:
+            # FIRST: a live controller must not actuate against a gateway
+            # that is tearing down under it
+            self.controller.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -429,6 +444,8 @@ class ServingGateway:
             out["metering"] = self.meter.state()
         if self.disagg is not None:
             out["disagg"] = self.disagg.state()
+        if self.controller is not None:
+            out["control"] = self.controller.state()
         return out
 
     def inflight_request_summaries(self) -> dict:
@@ -514,10 +531,21 @@ class ServingGateway:
                                        rid=rid)
                         else:
                             self._json(200, outer.disagg.state(), rid=rid)
+                    elif path == "/v1/control":
+                        # the feedback controller: armed policies, actuation
+                        # stats, depth overrides, recent decisions — 404
+                        # when the control block is absent (there IS no
+                        # controller)
+                        if outer.controller is None:
+                            self._json(404, {"error": "control_disabled"},
+                                       rid=rid)
+                        else:
+                            self._json(200, outer.controller.state(), rid=rid)
                     else:
                         self._json(404, {"error": "not_found",
                                          "paths": ["/v1/generate", "/v1/usage",
-                                                   "/v1/pools", "/v1/profile",
+                                                   "/v1/pools", "/v1/control",
+                                                   "/v1/profile",
                                                    "/healthz", "/readyz"]},
                                    rid=rid)
                 except (BrokenPipeError, ConnectionResetError):

@@ -212,6 +212,11 @@ class EngineReplica:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self.paused = False
+        # controller-driven drain: distinct from ``paused`` (tests and the
+        # gateway drain pause replicas that must KEEP receiving placements
+        # so queues build); the router skips draining replicas whenever an
+        # un-draining live alternative exists
+        self.draining = False
         self.started = False
         self.warmed = False
         self.steps = 0
@@ -241,6 +246,24 @@ class EngineReplica:
         """Scheduler-inflight + class-queued requests bound for this replica
         (the router's least-loaded signal)."""
         return self._inflight + self._admission.depth(replica=self.name)
+
+    @property
+    def max_inflight(self) -> int:
+        """Concurrent-request capacity (the saturation denominator the
+        disagg coordinator and control plane compare ``load`` against)."""
+        return self._max_inflight
+
+    def spec_params(self):
+        """Live speculative knobs (``{"k", "tree_width"}``) or None when
+        this replica is not speculating — the control plane's read side."""
+        return self._scheduler.spec_params()
+
+    def set_spec_params(self, k=None, tree_width=None):
+        """Control-plane actuator: retarget speculative K / tree width for
+        future draft rounds (scheduler forwarder — the request plane stays
+        out of scheduler internals per the check_gateway_api contract).
+        Returns the applied params, or None when not speculating."""
+        return self._scheduler.set_spec_params(k=k, tree_width=tree_width)
 
     def prefix_overlap(self, prompt_tokens) -> int:
         """Routing oracle: tokens of ``prompt_tokens`` this replica's radix
@@ -361,6 +384,19 @@ class EngineReplica:
         self.paused = False
         self.wake()
 
+    def drain(self):
+        """Control-plane actuator: stop pulling queued work AND steer the
+        router away (new placements go to un-draining replicas while any
+        exist). In-flight requests finish; the replica stays alive and
+        warmed for an instant undrain."""
+        self.draining = True
+        self.paused = True
+
+    def undrain(self):
+        self.draining = False
+        self.paused = False
+        self.wake()
+
     def wake(self):
         self._wake.set()
 
@@ -456,6 +492,8 @@ class EngineReplica:
             get_goodput().sentinel.set_uid_resolver(self.name, self._rid_of)
         self._stop.clear()
         self._wake.clear()
+        self.paused = False
+        self.draining = False
         self._thread = threading.Thread(target=self._run,
                                         name=f"dstpu-serving-{self.name}", daemon=True)
         self.started = True
@@ -742,6 +780,7 @@ class EngineReplica:
     # -- introspection -------------------------------------------------------
     def state(self) -> dict:
         out = {"name": self.name, "alive": self.alive, "paused": self.paused,
+               "draining": self.draining,
                "warmed": self.warmed, "role": self.role,
                "inflight": self._inflight,
                "queued": self._admission.depth(replica=self.name),
@@ -750,5 +789,6 @@ class EngineReplica:
         if self._scheduler.speculating:
             sp = self._scheduler.spec_stats
             out["speculative"] = dict(sp, accept_rate=round(
-                sp["accepted"] / max(1, sp["drafted"]), 3))
+                sp["accepted"] / max(1, sp["drafted"]), 3),
+                **(self._scheduler.spec_params() or {}))
         return out

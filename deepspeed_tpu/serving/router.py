@@ -55,13 +55,18 @@ class ReplicaRouter:
         new requests go to prefill-capable replicas only. Every live
         replica mixed (or no role attr at all) = the full live set; an
         all-decode live fleet also falls back to the full set — degraded
-        placement beats a 503."""
-        if all(getattr(r, "role", "mixed") == "mixed" for r in live):
-            return live
-        pool = [r for r in live if getattr(r, "role", "mixed") in ("prefill", "mixed")]
-        if pool and len(pool) < len(live):
-            self.stats["pool_restricted"] += 1
-        return pool or live
+        placement beats a 503. Control-plane-drained replicas are skipped
+        while any un-draining candidate exists (a lone drained fleet still
+        takes placements — degraded beats a 503 here too; the queue then
+        holds the work the controller's un-drain will release)."""
+        if not all(getattr(r, "role", "mixed") == "mixed" for r in live):
+            pool = [r for r in live
+                    if getattr(r, "role", "mixed") in ("prefill", "mixed")]
+            if pool and len(pool) < len(live):
+                self.stats["pool_restricted"] += 1
+            live = pool or live
+        undrained = [r for r in live if not getattr(r, "draining", False)]
+        return undrained or live
 
     def select(self, prompt_tokens, ctx=None) -> Optional[object]:
         """Pick the replica for a prompt; None when no replica is live.

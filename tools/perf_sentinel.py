@@ -60,7 +60,12 @@ NEUTRAL_PREFIXES = ("goodput.", "tenants.", "roofline.")
 NEUTRAL_NAMES = ("wall_s", "unattributed_s", "overbooked_s", "recovery_badput_s",
                  # tier migration volume is workload attribution, not a verdict:
                  # more demotions under the same load is the tier doing its job
-                 "demotions", "promotions", "host_evictions", "disk_spills")
+                 "demotions", "promotions", "host_evictions", "disk_spills",
+                 # control-plane actuation counts are the loop reacting to
+                 # whatever the round threw at it — more (or fewer) decisions
+                 # under a different load is not a verdict; the verdict leaf
+                 # is slo_miss_rate below
+                 "actuations", "deferred")
 
 # direction overrides that win over the neutral prefixes: the fairness
 # index inside the tenants block IS a performance verdict (higher = the
@@ -76,13 +81,19 @@ HIGHER_BETTER_LEAVES = ("fairness_index", "mfu", "mbu")
 # latency suffix table ever changes — both pinned by tests/test_disagg.py
 LOWER_BETTER_LEAVES = ("handoff_p50_ms", "handoff_fallback_rate")
 
+# lower-is-better SUFFIX overrides checked before the generic suffix
+# tables: any ``*_miss_rate`` (SLO misses, cache misses) ends in ``_rate``
+# but a rising miss rate is a regressing system — the control plane's
+# audit leaves (``control.slo_miss_rate_*``) ride this rule
+LOWER_BETTER_SUFFIX_OVERRIDES = ("_miss_rate",)
+
 
 def metric_direction(metric):
     """'lower' | 'higher' | None (unknown/neutral) for a dotted name."""
     leaf = metric.rsplit(".", 1)[-1]
     if leaf in HIGHER_BETTER_LEAVES:
         return "higher"
-    if leaf in LOWER_BETTER_LEAVES:
+    if leaf in LOWER_BETTER_LEAVES or leaf.endswith(LOWER_BETTER_SUFFIX_OVERRIDES):
         return "lower"
     if metric.startswith(NEUTRAL_PREFIXES) or leaf in NEUTRAL_NAMES:
         return None

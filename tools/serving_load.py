@@ -787,11 +787,15 @@ def _http_generate(host, port, r, stream, timeout_s, slo_class):
 
     body = {"prompt": np.asarray(r["prompt"]).tolist(),
             "max_new_tokens": int(r["max_new_tokens"]), "stream": bool(stream)}
-    if slo_class:
-        body["slo_class"] = slo_class
+    # a per-row slo_class (mixed-class workloads, e.g. control_ab) beats the
+    # call-level default
+    cls = r.get("slo_class") or slo_class
+    if cls:
+        body["slo_class"] = cls
     rec = {"uid": r["uid"], "status": None, "tokens": [], "ttft_ms": None,
            "tpot_ms": None, "latency_ms": None, "error": None,
-           "request_id": None, "retry_after": None, "tenant": r.get("tenant")}
+           "request_id": None, "retry_after": None, "tenant": r.get("tenant"),
+           "slo_class": cls}
     t_send = time.time()
     conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
     try:
@@ -1316,6 +1320,130 @@ def disagg_ab(on_tpu, n_requests=None, seed=0):
     return result
 
 
+def control_ab(on_tpu, n_requests=None, seed=0, n_replicas=2):
+    """Controller-on vs controller-off A/B (ISSUE 19): the same
+    prefill-storm workload — an interactive foreground stream measured
+    while a batch stream of long pure prefills floods the queues — through
+    the full HTTP plane twice. Identical gateways/SLO classes except the
+    ``control`` block, so the delta IS the feedback loop:
+
+      * ``control_off`` — static admission limits; under the storm the
+        interactive queue runs deep and TTFT blows through its target;
+      * ``control_on``  — the admission policy watches the per-class
+        SLO-miss counters and tightens the interactive queue depth live,
+        trading shed (429, retryable) for conformance of what it admits.
+
+    The headline is the interactive SLO-miss rate among COMPLETED requests
+    (same server-side TTFT-vs-target rule the miss counters use), plus
+    greedy token parity over the uids both arms completed, plus the on-arm
+    decision ledger (every tighten/relax with its sensor justification).
+    The TTFT target itself is calibrated, not hardcoded: 2x the p50 of an
+    uncontended interactive pass on this host."""
+    from deepspeed_tpu.serving import ControlConfig, SLOClassConfig
+
+    n_fg = n_requests or (24 if on_tpu else 12)
+    n_bg = 2 * n_fg
+    fg_shape = dict(prompt_lo=8, prompt_hi=16, new_lo=4, new_hi=8)
+    bg_shape = dict(prompt_lo=40, prompt_hi=60, new_lo=1, new_hi=1)
+    concurrency = 8
+    result = {"config": "control_ab", "n_interactive": n_fg, "n_batch": n_bg,
+              "n_replicas": n_replicas, "engine_config": "cpu_smoke"}
+
+    # calibration: what does interactive TTFT look like UNCONTENDED on this
+    # host? (no slo_class sent — the calibration gateway carries defaults)
+    gw = build_gateway(n_replicas=n_replicas, prefix_cache=True, on_tpu=on_tpu)
+    try:
+        warm = make_workload(n_fg, rate_rps=None, seed=seed + 3,
+                             uid_base=700_000, **fg_shape)
+        run_http_load(gw.config.host, gw.port, warm, concurrency=2,
+                      stream=False)  # compile buckets
+        cal = make_workload(n_fg, rate_rps=None, seed=seed + 4,
+                            uid_base=710_000, **fg_shape)
+        _, cal_recs = run_http_load(gw.config.host, gw.port, cal,
+                                    concurrency=2, stream=False)
+        ttfts = [r["ttft_ms"] for r in cal_recs
+                 if r["status"] == 200 and r["ttft_ms"]]
+    finally:
+        gw.stop()
+    # 3x the uncontended p50 with a generous floor: the target must sit
+    # ABOVE the host's prompt-service floor (boundary noise is not a miss)
+    # and BELOW the storm's queueing delay (hundreds of ms) — the miss
+    # counter should answer "queued behind the storm?", nothing subtler
+    target_ms = round(max(3.0 * float(np.percentile(ttfts, 50)), 25.0), 1) \
+        if ttfts else 100.0
+    result["ttft_target_ms"] = target_ms
+
+    classes = {"interactive": SLOClassConfig(priority=0, max_queue_depth=16,
+                                             ttft_target_ms=target_ms),
+               "batch": SLOClassConfig(priority=1, max_queue_depth=64)}
+    tokens_by_arm = {}
+    for arm in ("control_off", "control_on"):
+        cfg_kwargs = {"slo_classes": dict(classes)}
+        if arm == "control_on":
+            cfg_kwargs["control"] = ControlConfig(
+                enabled=True, interval_s=0.05, window_s=1.0,
+                policies=("admission",), sustain_ticks=2,
+                max_actuations_per_window=8, cooldown_s=0.2,
+                slo_miss_tighten=0.3, slo_miss_relax=0.05,
+                min_queue_depth=1, min_window_completions=3)
+        gw = build_gateway(n_replicas=n_replicas, prefix_cache=True,
+                           on_tpu=on_tpu, **cfg_kwargs)
+        try:
+            warm = (make_workload(n_fg, rate_rps=None, seed=seed + 7,
+                                  uid_base=900_000, **fg_shape)
+                    + make_workload(n_bg, rate_rps=None, seed=seed + 8,
+                                    uid_base=950_000, **bg_shape))
+            run_http_load(gw.config.host, gw.port, warm,
+                          concurrency=concurrency, stream=False)
+            fg = make_workload(n_fg, rate_rps=None, seed=seed, uid_base=0,
+                               **fg_shape)
+            for r in fg:
+                r["slo_class"] = "interactive"
+            bg = make_workload(n_bg, rate_rps=None, seed=seed + 1,
+                               uid_base=500_000, **bg_shape)
+            for r in bg:
+                r["slo_class"] = "batch"
+            _agg, recs = run_http_load(gw.config.host, gw.port, fg + bg,
+                                       concurrency=concurrency, stream=False)
+            fg_done = [r for r in recs if r["uid"] < 500_000
+                       and r["status"] == 200 and r["error"] is None]
+            fg_shed = [r for r in recs if r["uid"] < 500_000
+                       and r["status"] == 429]
+            misses = [r for r in fg_done
+                      if r["ttft_ms"] and r["ttft_ms"] > target_ms]
+            line = {"fg_completed": len(fg_done), "fg_shed": len(fg_shed),
+                    "fg_miss_rate": (round(len(misses) / len(fg_done), 3)
+                                     if fg_done else None),
+                    "fg_ttft": _percentiles([r["ttft_ms"] for r in fg_done
+                                             if r["ttft_ms"]])}
+            if arm == "control_on":
+                st = gw.controller.state()
+                applied = [d for d in gw.controller.decisions.recent()
+                           if d["applied"]]
+                line.update({
+                    "actuations": st["applied"], "deferred": st["deferred"],
+                    "ticks": st["ticks"], "errors": st["errors"],
+                    "depth_overrides": st["overrides"],
+                    "decision_actions": sorted({d["action"] for d in applied}),
+                    "decisions_justified": all(d.get("sensors")
+                                               for d in applied)})
+            tokens_by_arm[arm] = {r["uid"]: list(r["tokens"]) for r in recs
+                                  if r["status"] == 200 and r["error"] is None}
+            result[arm] = line
+        finally:
+            gw.stop()
+    common = sorted(set(tokens_by_arm["control_off"])
+                    & set(tokens_by_arm["control_on"]))
+    result["token_parity"] = bool(common) and all(
+        tokens_by_arm["control_off"][u] == tokens_by_arm["control_on"][u]
+        for u in common)
+    off_miss = result["control_off"]["fg_miss_rate"]
+    on_miss = result["control_on"]["fg_miss_rate"]
+    result["slo_miss_improved"] = (off_miss is not None and on_miss is not None
+                                   and on_miss < off_miss)
+    return result
+
+
 def gateway_bench(on_tpu, seed=0):
     """The bench.py serving-block entry: latency-under-load curves + the
     router A/B + the request-tracing attribution/overhead block, one dict."""
@@ -1359,6 +1487,8 @@ def main():
         out = host_tier_ab(on_tpu)
     elif "disagg" in sys.argv[1:]:
         out = disagg_ab(on_tpu)
+    elif "control_ab" in sys.argv[1:]:
+        out = control_ab(on_tpu)
     elif "multi_tenant" in sys.argv[1:]:
         out = multi_tenant_bench(on_tpu)
     else:
