@@ -1254,6 +1254,43 @@ def run_bench():
             print(f"# WARNING: control bench phase failed "
                   f"({type(e).__name__}: {str(e)[:200]})", flush=True)
 
+    # --timeline: causal timeline rounds (ISSUE 20) — the same disagg
+    # workload captured clean vs under a seeded 80ms handoff stall, each
+    # round's assembled timelines written to disk and diffed with
+    # tools/trace_explain.py. The leaves perf_sentinel trends are neutral
+    # accounting fields (timeline. prefix); the attribution verdict
+    # (dominant stage = broker_verify) is the honesty check. Outside the
+    # headline window; DS_TPU_BENCH_TIMELINE=0 skips, failure never costs
+    # the headline.
+    timeline_line = None
+    if os.environ.get("DS_TPU_BENCH_TIMELINE", "1") != "0":
+        try:
+            from tools.serving_load import timeline_rounds
+
+            tr = timeline_rounds(on_tpu)
+            base, stalled = tr["rounds"]["base"], tr["rounds"]["stalled"]
+            timeline_line = {
+                "n_timelines_base": base["n_timelines"],
+                "n_timelines_stalled": stalled["n_timelines"],
+                "migrated_base": base["migrated"],
+                "migrated_stalled": stalled["migrated"],
+                "migrated_coverage_ok_frac": base["migrated_coverage_ok_frac"],
+                "chaos_stalls": stalled["chaos_stalls"],
+                "delta_e2e_ms": tr["explain"]["delta_e2e_ms"],
+                "dominant_stage": tr["explain"]["dominant_stage"],
+                "dominant_cause": tr["explain"]["dominant_cause"],
+                "rounds_dir": tr["out_dir"],
+            }
+            print(f"# timeline: {base['n_timelines']}/{stalled['n_timelines']} "
+                  f"timelines (migrated {base['migrated']}/{stalled['migrated']}, "
+                  f"coverage {base['migrated_coverage_ok_frac']}); stall delta "
+                  f"{tr['explain']['delta_e2e_ms']}ms -> "
+                  f"{tr['explain']['dominant_stage']}/"
+                  f"{tr['explain']['dominant_cause']}", flush=True)
+        except Exception as e:
+            print(f"# WARNING: timeline bench phase failed "
+                  f"({type(e).__name__}: {str(e)[:200]})", flush=True)
+
     # --kernels: raw-speed microbench A/Bs (q-tiled paged attention, explicit
     # ZeRO-3 overlap, tuned-vs-default flash tiles). Outside the headline
     # timed window; DS_TPU_BENCH_KERNELS=0 skips, failure never costs the
@@ -1347,6 +1384,8 @@ def run_bench():
         line["tenants"] = tenants_line
     if control_line is not None:
         line["control"] = control_line
+    if timeline_line is not None:
+        line["timeline"] = timeline_line
     if not on_tpu:
         line["tpu_unavailable_reason"] = tpu_error or "no TPU device visible"
     if gate_note:

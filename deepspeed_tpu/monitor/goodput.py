@@ -154,6 +154,13 @@ SPAN_ALLOWLIST = (
     # control plane (serving/control/): a controller decision is a
     # zero-duration instant — it consumes no wall clock
     "control/decision",
+    # timeline sub-stage OVERLAYS (serving/disagg.py, serving/reqtrace.py):
+    # export -> verify -> resume-adoption decompose the same wall window
+    # serving/handoff already books as `handoff` — booking them too would
+    # double-count every migrated request's broker seconds
+    "serving/handoff_export",
+    "serving/broker_verify",
+    "serving/resume_wait",
 )
 
 

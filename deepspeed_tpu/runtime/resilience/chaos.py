@@ -65,6 +65,12 @@ _lock = threading.Lock()
 # point -> {token: hook}; insertion-ordered, so hooks run in install order
 _hooks = {}
 _next_token = 0
+# token -> fn(point, ctx); passive listeners notified when a point with
+# installed hooks is ABOUT to fire (before the hooks run, so even a kill
+# fire is observed). Observers never see hook-less fires: fire()'s
+# ``if not _hooks`` short-circuit stays the first line, preserving the
+# zero-overhead contract for production paths with chaos disarmed.
+_observers = {}
 
 
 class Handle:
@@ -109,6 +115,40 @@ def inject(point, hook):
     return Handle(str(point), token)
 
 
+class ObserverHandle:
+    """Removal handle for one fire observer (idempotent; context manager)."""
+
+    __slots__ = ("_token",)
+
+    def __init__(self, token):
+        self._token = token
+
+    def remove(self):
+        with _lock:
+            _observers.pop(self._token, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.remove()
+        return False
+
+
+def observe(fn):
+    """Register ``fn(point, ctx)`` to be called whenever a chaos point with
+    installed hooks fires — the timeline plane's join source for 'which
+    fault landed inside this request'. Observers are passive (exceptions
+    swallowed, never mutate ctx) and run BEFORE the hooks, so a hook that
+    raises or kills still leaves its fire on record."""
+    global _next_token
+    with _lock:
+        token = _next_token
+        _next_token += 1
+        _observers[token] = fn
+    return ObserverHandle(token)
+
+
 def clear(points=None):
     """Remove every hook (``points=None``) or just the named points."""
     with _lock:
@@ -136,6 +176,13 @@ def fire(point, ctx=None):
     with _lock:
         bucket = _hooks.get(point)
         hooks = list(bucket.values()) if bucket else ()
+        observers = list(_observers.values()) if (hooks and _observers) else ()
+    for obs in observers:
+        try:
+            obs(point, ctx)
+        except Exception:  # noqa: BLE001 — observers are passive: a broken
+            # listener must never alter the drill's failure semantics
+            get_metrics().counter("health/chaos_observer_error_total").inc()
     for hook in hooks:
         hook(ctx)
 

@@ -29,6 +29,15 @@ Layering (request -> token):
     decode pool by a gateway-brokered cross-replica KV handoff through
     the host tier (checksummed manifests, at-most-once, fallback-in-place
     — never a lost request), ``GET /v1/pools``;
+  * :mod:`timeline`  — the causal timeline plane
+    (``serving.gateway.timeline`` block, requires ``tracing``): assembles,
+    for every terminal request, one cross-replica RequestTimeline joining
+    the stage stamps, handoff broker sub-stages, measured driver stalls,
+    recompile-sentinel events, chaos fires and overlapping control
+    actuations on one clock — segments sum to client e2e (within
+    tolerance, migrated requests included), critical path + dominant-cause
+    verdict, always-retained p99 TTFT/TPOT exemplars,
+    ``GET /v1/timeline/<request_id>``;
   * :mod:`control`   — the feedback control plane
     (``serving.gateway.control`` block): one decision thread reading the
     sensor planes (goodput windows, SLO-miss counters, admission gauges,
@@ -48,7 +57,8 @@ by the ``tools/check_gateway_api.py`` AST gate, run from tier-1.
 """
 
 from .config import (ControlConfig, DisaggConfig, GatewayConfig,
-                     MeteringConfig, RequestTraceConfig, SLOClassConfig)
+                     MeteringConfig, RequestTraceConfig, SLOClassConfig,
+                     TimelineConfig)
 from .admission import AdmissionController
 from .router import ReplicaRouter
 from .replica import EngineReplica, GatewayRequest, TokenStream
@@ -60,4 +70,5 @@ from .reqtrace import (RequestContext, RequestLog, RequestTracing,
 from .metering import (DEFAULT_TENANT, EngineMeterView, TenantMeter,
                        sanitize_tenant_id)
 from .control import DecisionLog, ServingController
+from .timeline import TimelineCollector
 from .gateway import ServingGateway, parse_sse, sse_frame

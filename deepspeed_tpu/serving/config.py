@@ -153,6 +153,28 @@ class DisaggConfig:
 
 
 @dataclass
+class TimelineConfig:
+    """``serving.gateway.timeline`` block — the causal timeline plane
+    (``serving/timeline.py`` + ``monitor/timeline.py``). Presence-enables
+    (the ``tracing``/``metering``/``disagg``/``control`` contract): an
+    absent block means no collector object, no per-request assembly, no
+    chaos observer, no thread (test-enforced). Requires the ``tracing``
+    block: the assembler joins the stage stamps request tracing owns."""
+
+    enabled: bool = False
+    # assembled timelines retained in the bounded ring (newest win);
+    # tail exemplars below survive past ring eviction
+    last_n: int = 256
+    # always-retained tail exemplars: the top-K requests by TTFT and by
+    # TPOT keep their COMPLETE assembled timelines regardless of ring age
+    # — the PR 7 tail-retention discipline applied to whole timelines
+    exemplar_slots: int = 8
+    # segments-sum acceptance tolerance as a fraction of client e2e
+    # (2 ms absolute floor) — PR 7's budget extended to migrated requests
+    tolerance: float = 0.10
+
+
+@dataclass
 class ControlConfig:
     """``serving.gateway.control`` block — the feedback control plane
     (``serving/control/``). Presence-enables (the ``tracing``/``metering``/
@@ -201,6 +223,12 @@ class ControlConfig:
     # drain one replica when the fleet idles (goodput idle fraction at or
     # past this, or zero load without a ledger) for the sustain window
     idle_frac_drain: float = 0.9
+    # optional EWMA smoothing over the windowed idle fraction (0 = off,
+    # raw signal). Bursty traffic dips the raw signal below the drain band
+    # for single ticks, resetting the sustain counter and under-triggering
+    # drains; alpha in (0, 1] blends alpha*raw + (1-alpha)*prev so a brief
+    # burst stops masking a genuinely idle fleet (smaller = smoother)
+    ewma_alpha: float = 0.0
     # un-drain (or restart a dead replica) when total queued requests
     # reach this for the sustain window
     queue_depth_undrain: int = 1
@@ -277,6 +305,9 @@ class GatewayConfig:
     # feedback control plane (serving/control/); off by default with the
     # same zero-overhead-absent contract
     control: ControlConfig = field(default_factory=ControlConfig)
+    # causal timeline plane (serving/timeline.py); off by default with the
+    # same zero-overhead-absent contract; requires the tracing block
+    timeline: TimelineConfig = field(default_factory=TimelineConfig)
 
     @classmethod
     def from_dict(cls, d) -> "GatewayConfig":
@@ -287,6 +318,7 @@ class GatewayConfig:
         profiling = d.pop("profiling", None)
         disagg = d.pop("disagg", None)
         control = d.pop("control", None)
+        timeline = d.pop("timeline", None)
         known = {f.name for f in fields(cls)}
         unknown = set(d) - known
         if unknown:
@@ -411,6 +443,35 @@ class GatewayConfig:
             if ct.min_active_replicas < 1:
                 raise ValueError("serving.gateway.control: min_active_replicas must "
                                  f"be >= 1, got {ct.min_active_replicas}")
+            if not 0.0 <= ct.ewma_alpha <= 1.0:
+                raise ValueError("serving.gateway.control: ewma_alpha must be "
+                                 f"in [0, 1] (0 = off), got {ct.ewma_alpha}")
+        if timeline is not None:
+            if isinstance(timeline, TimelineConfig):
+                cfg.timeline = timeline
+            else:
+                body = dict(timeline)
+                tl_known = {f.name for f in fields(TimelineConfig)}
+                bad = set(body) - tl_known
+                if bad:
+                    raise ValueError(f"serving.gateway.timeline: unknown keys {sorted(bad)}")
+                if "enabled" not in body:  # presence-enables
+                    body["enabled"] = True
+                cfg.timeline = TimelineConfig(**body)
+            tl = cfg.timeline
+            if tl.last_n < 1:
+                raise ValueError("serving.gateway.timeline: last_n must be >= 1, "
+                                 f"got {tl.last_n}")
+            if tl.exemplar_slots < 0:
+                raise ValueError("serving.gateway.timeline: exemplar_slots must "
+                                 f"be >= 0, got {tl.exemplar_slots}")
+            if not 0.0 < tl.tolerance <= 1.0:
+                raise ValueError("serving.gateway.timeline: tolerance must be in "
+                                 f"(0, 1], got {tl.tolerance}")
+            if tl.enabled and not cfg.tracing.enabled:
+                raise ValueError("serving.gateway.timeline requires the tracing "
+                                 "block: the assembler joins the stage stamps "
+                                 "request tracing owns")
         if classes is not None:
             slo_known = {f.name for f in fields(SLOClassConfig)}
             parsed = {}

@@ -59,6 +59,10 @@ class ServingController:
         # trailing raw samples the windowed deltas diff against
         self._samples = deque()
         self._last_snap: dict = {}
+        # EWMA state for the idle_frac sensor (ewma_alpha > 0): smooths
+        # bursty arrival dips so a momentary busy spike can't reset a
+        # drain proposal's sustain counter
+        self._idle_ewma: Optional[float] = None
         # injected by tests / built lazily on the first retune actuation
         self._tuner = None
         self._registered_gauges = None
@@ -191,11 +195,23 @@ class ServingController:
                     "tree_width": params.get("tree_width", 1)}
             replicas.append(row)
         idle_frac = None
+        idle_frac_raw = None
         if "goodput" in cur and "goodput" in base:
             d_wall = cur["goodput"]["wall_s"] - base["goodput"]["wall_s"]
             if d_wall > 1e-6:
-                idle_frac = max(0.0, min(1.0, (cur["goodput"]["idle_s"]
-                                               - base["goodput"]["idle_s"]) / d_wall))
+                idle_frac_raw = max(0.0, min(1.0, (cur["goodput"]["idle_s"]
+                                                   - base["goodput"]["idle_s"]) / d_wall))
+                idle_frac = idle_frac_raw
+                alpha = self.config.ewma_alpha
+                if alpha > 0.0:
+                    # optional EWMA (control.ewma_alpha, default off): one
+                    # bursty sub-window dip below the drain band otherwise
+                    # resets the policy's sustain counter every burst, so a
+                    # genuinely idle fleet never drains
+                    self._idle_ewma = (idle_frac_raw if self._idle_ewma is None
+                                       else alpha * idle_frac_raw
+                                       + (1.0 - alpha) * self._idle_ewma)
+                    idle_frac = self._idle_ewma
         buckets = {}
         gp = get_goodput()
         for src in gp.sentinel.report().values():
@@ -203,9 +219,26 @@ class ServingController:
                 buckets[bucket] = buckets.get(bucket, 0) + int(count)
         snap = {"now": now, "window_s": now - base["t"], "classes": classes,
                 "replicas": replicas, "depth_total": adm.depth(),
-                "idle_frac": idle_frac, "compile_buckets": buckets}
+                "idle_frac": idle_frac, "idle_frac_raw": idle_frac_raw,
+                "compile_buckets": buckets}
         self._last_snap = snap
         return snap
+
+    def _inflight_rids(self, cap: int = 64):
+        """Request ids in flight across the fleet AT actuation time — the
+        decision record's join key to the timeline plane (decisions stamp
+        ``time.time``; requests stamp ``perf_counter``; the roster is the
+        one clock-free 'this actuation overlapped that request' join).
+        Bounded: a decision record must stay one log line."""
+        rids = []
+        for r in self.gateway.replicas:
+            for row in r.inflight_summaries():
+                rid = row.get("request_id")
+                if rid:
+                    rids.append(rid)
+                    if len(rids) >= cap:
+                        return rids
+        return rids
 
     # -- actuation (the ONLY sanctioned actuator call sites) -----------------
     def _actuate(self, policy, prop, now: float) -> None:
@@ -218,7 +251,8 @@ class ServingController:
                                 reason="deferred: actuation budget exhausted "
                                        f"({self.config.max_actuations_per_window}"
                                        f"/{self.config.window_s}s)",
-                                sensors=prop["sensors"])
+                                sensors=prop["sensors"],
+                                inflight_rids=self._inflight_rids())
             self.stats["deferred"] += 1
             return
         apply_fn = getattr(self, f"_apply_{prop['kind']}")
@@ -242,7 +276,8 @@ class ServingController:
                 max_queue_uncached_tokens=args.get("max_queue_uncached_tokens"))
         self.decisions.emit(policy=policy.name, action=prop["action"],
                             applied=True, reason=prop["reason"],
-                            sensors=prop["sensors"], result=result)
+                            sensors=prop["sensors"], result=result,
+                            inflight_rids=self._inflight_rids())
         return True
 
     def _apply_scale(self, policy, prop) -> bool:
@@ -252,7 +287,8 @@ class ServingController:
         if rep is None:
             self.decisions.emit(policy=policy.name, action=prop["action"],
                                 applied=False, reason="replica gone",
-                                sensors=prop["sensors"])
+                                sensors=prop["sensors"],
+                                inflight_rids=self._inflight_rids())
             return False
         op = args["op"]
         if op == "drain":
@@ -264,7 +300,8 @@ class ServingController:
         self.decisions.emit(policy=policy.name, action=prop["action"],
                             applied=True, reason=prop["reason"],
                             sensors=prop["sensors"],
-                            result={"replica": rep.name, "op": op})
+                            result={"replica": rep.name, "op": op},
+                            inflight_rids=self._inflight_rids())
         return True
 
     def _apply_retune(self, policy, prop) -> bool:
@@ -284,7 +321,8 @@ class ServingController:
                             applied=applied, reason=prop["reason"],
                             sensors=prop["sensors"],
                             result={"bucket": args["bucket"], "best": best,
-                                    "error": error})
+                                    "error": error},
+                            inflight_rids=self._inflight_rids())
         return applied
 
     def _apply_spec(self, policy, prop) -> bool:
@@ -300,7 +338,8 @@ class ServingController:
                             applied=applied,
                             reason=prop["reason"] if applied
                             else "replica gone or not speculating",
-                            sensors=prop["sensors"], result=result)
+                            sensors=prop["sensors"], result=result,
+                            inflight_rids=self._inflight_rids())
         return applied
 
     def _get_tuner(self):

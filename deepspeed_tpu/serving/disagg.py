@@ -38,7 +38,7 @@ import time
 
 import numpy as np
 
-from ..monitor.trace import observe_latency
+from ..monitor.trace import get_tracer, observe_latency
 from ..runtime.resilience import chaos
 from .handoff import HandoffError, HandoffLedger
 
@@ -107,11 +107,18 @@ class DisaggCoordinator:
         ``src`` — every failure path lands here, never a lost request."""
         rid = req.rid or f"uid-{req.uid}"
         t0 = time.perf_counter()
+        # timeline stage stamps (one perf_counter clock, stored on the
+        # request): the handoff window decomposes into export -> verify ->
+        # install so the assembler can name WHICH broker stage ate a
+        # migrated request's gap instead of hiding it inside decode
+        req.t_handoff_start = t0
         self.stats["attempted"] += 1
         dst = self.pick_decode_replica(src)
         if not self.ledger.begin(rid, src.name, dst.name if dst else None):
             # at-most-once refusal: this rid already has a ledger entry
-            # (an earlier attempt got somewhere) — decode wherever it is
+            # (an earlier attempt got somewhere) — decode wherever it is.
+            # No broker work happened, so no handoff interval to attribute.
+            req.t_handoff_start = None
             return False
         try:
             if dst is None:
@@ -121,13 +128,23 @@ class DisaggCoordinator:
                 np.asarray(generated, np.int32).reshape(-1)])
             chunks, payloads = src.engine.export_sequence_kv(req.uid, tokens)
             self.ledger.record_manifest(rid, chunks, payloads)
+            req.t_handoff_export = time.perf_counter()
+            get_tracer().complete(
+                "serving/handoff_export", t0, req.t_handoff_export - t0,
+                tid="serving", args={"request_id": rid, "src": src.name,
+                                     "blocks": len(payloads)})
             # chaos drill: a hook here can raise (transport loss) or swap a
             # corrupted payload into the list (the verify gate must catch it)
-            chaos.fire("serving/handoff", {"rid": rid, "src": src.name,
-                                           "dst": dst.name,
+            chaos.fire("serving/handoff", {"rid": rid, "request_id": rid,
+                                           "src": src.name, "dst": dst.name,
                                            "payloads": payloads})
             if not self.ledger.verify(rid, payloads):
                 raise HandoffError("checksum_mismatch")
+            req.t_handoff_verify = time.perf_counter()
+            get_tracer().complete(
+                "serving/broker_verify", req.t_handoff_export,
+                req.t_handoff_verify - req.t_handoff_export, tid="serving",
+                args={"request_id": rid, "src": src.name, "dst": dst.name})
             installed = dst.engine.install_prefix_kv(chunks, payloads,
                                                      tenant=req.tenant)
             self.ledger.mark_installed(rid, installed)
@@ -141,16 +158,24 @@ class DisaggCoordinator:
             self.stats["migrated"] += 1
             dt = observe_latency(t0, "serving/handoff",
                                  hist_name="handoff/latency_ms",
-                                 span_args={"rid": rid, "src": src.name,
+                                 span_args={"request_id": rid, "src": src.name,
                                             "dst": dst.name,
                                             "blocks": len(payloads)})
+            # summary-record visibility (the PR 18 residual): the broker's
+            # whole wall cost rides the request without the plane armed
+            req.handoff_ms = dt * 1e3
             src.book_handoff(dt)
             return True
         except Exception as e:  # noqa: BLE001 — every failure = fallback
             # ledger.fail owns the handoff/fallback_total counter
             self.ledger.fail(rid, f"{type(e).__name__}: {e}")
             self.stats["fallbacks"] += 1
-            src.book_handoff(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            # the fallback's decode-in-place resumes HERE: the timeline's
+            # decode_fallback segment opens at the failed broker's exit
+            req.t_handoff_done = t0 + dt
+            req.handoff_ms = dt * 1e3
+            src.book_handoff(dt)
             return False
 
     def state(self) -> dict:

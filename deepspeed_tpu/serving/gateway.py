@@ -88,11 +88,26 @@ class ServingGateway:
 
     def __init__(self, engines, config: Optional[GatewayConfig] = None):
         self.config = config or GatewayConfig()
+        # causal timeline plane: exists ONLY when the timeline block asked
+        # for it — with it absent no collector, no chaos observer, no
+        # per-request assembly (zero-overhead-off like every plane here).
+        # It rides reqtrace's terminal path, so tracing is a hard
+        # prerequisite (from_dict enforces the same; this covers direct
+        # GatewayConfig construction)
+        self.timeline = None
+        if self.config.timeline.enabled:
+            if not self.config.tracing.enabled:
+                raise ValueError("serving.gateway.timeline requires the "
+                                 "tracing block: the assembler joins the "
+                                 "stage stamps request tracing owns")
+            from .timeline import TimelineCollector
+            self.timeline = TimelineCollector(self.config.timeline)
         # request-scoped tracing plane: exists ONLY when the config block
         # asked for it — with it absent the request path allocates no
         # contexts, opens no log, and emits nothing (the PR 1/5 bar)
         self.reqtrace = (RequestTracing(self.config.tracing,
-                                        slo_classes=self.config.slo_classes)
+                                        slo_classes=self.config.slo_classes,
+                                        timeline=self.timeline)
                          if self.config.tracing.enabled else None)
         # tenant metering plane: exists ONLY when the metering block asked
         # for it — with it absent no meter, no per-engine views, no stamp
@@ -126,6 +141,14 @@ class ServingGateway:
         if self.config.control.enabled:
             from .control import ServingController
             self.controller = ServingController(self, self.config.control)
+        if self.timeline is not None:
+            for r in self.replicas:
+                r.set_timeline(self.timeline)
+            if self.controller is not None:
+                # actuation join source: decisions carry inflight_rids, the
+                # roster-based (clock-free) decision -> request join key
+                self.timeline.set_decisions_provider(
+                    self.controller.decisions.recent)
         self.router = ReplicaRouter(self.replicas, policy=self.config.router)
         self._uid_lock = threading.Lock()
         self._next_uid = 1
@@ -138,6 +161,7 @@ class ServingGateway:
         self._registered_tenant_gauges = None
         self._registered_tenant_dump = None
         self._registered_handoff_gauges = None
+        self._registered_timeline_gauges = None
         self.started = False
         self.draining = False
 
@@ -192,6 +216,13 @@ class ServingGateway:
             # + p50 once any migration completed) — ownership-checked too
             self._registered_handoff_gauges = self.disagg.ledger.gauge_rows
             health.set_gauge_provider("handoff", self._registered_handoff_gauges)
+        if self.timeline is not None:
+            # arm the chaos-fire listener + assembly counters on /metrics
+            # BEFORE the controller starts: its first actuation must
+            # already be joinable
+            self.timeline.arm()
+            self._registered_timeline_gauges = self.timeline.gauge_rows
+            health.set_gauge_provider("timeline", self._registered_timeline_gauges)
         if self.controller is not None:
             # the controller registers its own health providers and starts
             # its decision thread LAST — every sensor it reads is live
@@ -228,6 +259,11 @@ class ServingGateway:
             if self.disagg is not None:
                 health.clear_gauge_provider("handoff",
                                             self._registered_handoff_gauges)
+            if self.timeline is not None:
+                health.clear_gauge_provider("timeline",
+                                            self._registered_timeline_gauges)
+        if self.timeline is not None:
+            self.timeline.disarm()
         if self.reqtrace is not None:
             self.reqtrace.close()
         if self.meter is not None:
@@ -446,6 +482,8 @@ class ServingGateway:
             out["disagg"] = self.disagg.state()
         if self.controller is not None:
             out["control"] = self.controller.state()
+        if self.timeline is not None:
+            out["timeline"] = self.timeline.state()
         return out
 
     def inflight_request_summaries(self) -> dict:
@@ -541,10 +579,34 @@ class ServingGateway:
                                        rid=rid)
                         else:
                             self._json(200, outer.controller.state(), rid=rid)
+                    elif path == "/v1/timeline" or path.startswith("/v1/timeline/"):
+                        # assembled causal timelines: the collection view
+                        # (collector stats + retained tail exemplars) or
+                        # one request's full timeline by id — 404 when the
+                        # timeline block is absent (nothing was assembled)
+                        if outer.timeline is None:
+                            self._json(404, {"error": "timeline_disabled"},
+                                       rid=rid)
+                        elif path == "/v1/timeline":
+                            self._json(200, {**outer.timeline.state(),
+                                             "exemplars":
+                                                 outer.timeline.exemplars()},
+                                       rid=rid)
+                        else:
+                            want = sanitize_request_id(
+                                path[len("/v1/timeline/"):])
+                            tl = (outer.timeline.get(want)
+                                  if want is not None else None)
+                            if tl is None:
+                                self._json(404, {"error": "unknown_request_id",
+                                                 "request_id": want}, rid=rid)
+                            else:
+                                self._json(200, tl, rid=rid)
                     else:
                         self._json(404, {"error": "not_found",
                                          "paths": ["/v1/generate", "/v1/usage",
                                                    "/v1/pools", "/v1/control",
+                                                   "/v1/timeline",
                                                    "/v1/profile",
                                                    "/healthz", "/readyz"]},
                                    rid=rid)
@@ -612,12 +674,22 @@ class ServingGateway:
 
             def _final_frame(self, req: GatewayRequest) -> dict:
                 st = req.stream
-                return {"done": True, "uid": req.uid, "request_id": req.rid,
-                        "n_tokens": st.produced,
-                        "finish_reason": st.finish_reason, "error": st.error,
-                        "ttft_ms": round(req.ttft_ms, 3) if req.ttft_ms else None,
-                        "tpot_ms": round(req.tpot_ms, 3) if req.tpot_ms else None,
-                        "cached_tokens": req.cached_tokens, "dropped": st.dropped}
+                out = {"done": True, "uid": req.uid, "request_id": req.rid,
+                       "n_tokens": st.produced,
+                       "finish_reason": st.finish_reason, "error": st.error,
+                       "ttft_ms": round(req.ttft_ms, 3) if req.ttft_ms else None,
+                       "tpot_ms": round(req.tpot_ms, 3) if req.tpot_ms else None,
+                       "cached_tokens": req.cached_tokens, "dropped": st.dropped}
+                if req.handoff_state is not None:
+                    # migrated/fallback requests disclose the broker cost
+                    # to the CLIENT, not just the operator surfaces
+                    out["handoff_state"] = req.handoff_state
+                    out["handoff_ms"] = (round(req.handoff_ms, 3)
+                                         if req.handoff_ms is not None else None)
+                    out["resume_wait_ms"] = (round(req.resume_wait_ms, 3)
+                                             if req.resume_wait_ms is not None
+                                             else None)
+                return out
 
             def _stream_response(self, req: GatewayRequest):
                 self._respond(200, "text/event-stream", rid=req.rid,

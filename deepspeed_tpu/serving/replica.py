@@ -122,7 +122,10 @@ class GatewayRequest:
     __slots__ = ("uid", "prompt", "max_new_tokens", "slo_class", "eos_token_id",
                  "stream", "replica_name", "t_admitted", "cached_tokens",
                  "uncached_tokens", "ttft_ms", "tpot_ms", "rid", "ctx", "sampling",
-                 "tenant", "resume_base", "handoff_state")
+                 "tenant", "resume_base", "handoff_state",
+                 "t_handoff_start", "t_handoff_export", "t_handoff_verify",
+                 "t_handoff_done", "t_resume_enqueued", "t_resume_submitted",
+                 "handoff_ms", "resume_wait_ms")
 
     def __init__(self, uid, prompt, max_new_tokens, slo_class, eos_token_id=None,
                  rid=None, ctx=None, sampling=None, tenant=None):
@@ -154,6 +157,21 @@ class GatewayRequest:
         # tried) | 'migrated' | 'fallback' (failed, decoding in place)
         self.resume_base = 0
         self.handoff_state = None
+        # migration stage stamps, all on perf_counter (the one-clock rule
+        # the timeline assembler's segments-sum acceptance rests on):
+        # broker boundaries stamped by DisaggCoordinator.try_handoff,
+        # resume boundaries by the DESTINATION replica. Plain float slots,
+        # always stamped when a migration runs — handoff_ms/resume_wait_ms
+        # reach the summary record and SSE final frame WITHOUT the timeline
+        # plane armed (the PR 18 residual)
+        self.t_handoff_start = None
+        self.t_handoff_export = None
+        self.t_handoff_verify = None
+        self.t_handoff_done = None   # failure path only (fallback-in-place)
+        self.t_resume_enqueued = None
+        self.t_resume_submitted = None
+        self.handoff_ms = None
+        self.resume_wait_ms = None
 
 
 class EngineReplica:
@@ -175,6 +193,7 @@ class EngineReplica:
         # "mixed" (the default) is the co-located baseline and never migrates
         self.role = str(role)
         self._disagg = None  # DisaggCoordinator, wired by the gateway
+        self._timeline = None  # TimelineCollector, wired by the gateway
         self._resume_lock = threading.Lock()
         self._resumes = []  # (req, tokens, remaining) adopted migrations
         self._admission = admission
@@ -332,6 +351,13 @@ class EngineReplica:
         prefill-role replicas begin offering completed prefills to it."""
         self._disagg = coordinator
 
+    def set_timeline(self, collector):
+        """Arm the timeline collector (gateway wiring, pre-start): the
+        driver loop starts reporting measured chaos-fire stall gaps to it
+        (the assembler's `stall` overlay source). None keeps the loop at
+        the same one-check cost as the un-timelined path."""
+        self._timeline = collector
+
     def detach_request(self, uid: int):
         """Surgically remove ``uid`` from this replica WITHOUT terminal
         accounting — the request is migrating, not finishing (the decode
@@ -354,6 +380,10 @@ class EngineReplica:
         iteration (the single-threaded-scheduler contract). ``tokens`` is
         prompt + everything generated so far; ``remaining`` is the new-token
         budget left."""
+        # resume_wait starts HERE (the source driver's enqueue): everything
+        # until this replica's driver submits is destination adoption-queue
+        # time — the dst half of the handoff gap PR 18 left unattributed
+        req.t_resume_enqueued = time.perf_counter()
         with self._resume_lock:
             self._resumes.append((req,
                                   np.asarray(tokens, np.int32).reshape(-1),
@@ -506,20 +536,28 @@ class EngineReplica:
         hb = get_health()
         src = self.heartbeat_source
         gl = self._goodput
+        tl = self._timeline
         stall_gap = get_goodput().stall_gap_s
         try:
             while not self._stop.is_set():
                 # chaos injection point: a storm's replica kill lands here,
                 # between scheduler steps (no-op-when-unhooked fire())
-                t_fire = time.perf_counter() if gl is not None else 0.0
+                t_fire = time.perf_counter() if (gl is not None
+                                                 or tl is not None) else 0.0
                 chaos.fire("serving/driver", {"replica": self.name})
-                if gl is not None:
+                if gl is not None or tl is not None:
                     gap = time.perf_counter() - t_fire
                     if gap >= stall_gap:
                         # a fire hook wedged the driver — the same gap the
                         # serving watchdog trips on. Booked as `stalled`,
                         # NOT idle: the replica had (or was denied) work.
-                        gl.book("stalled", gap)
+                        if gl is not None:
+                            gl.book("stalled", gap)
+                        if tl is not None:
+                            # the measured interval, not a flag: the
+                            # assembler re-attributes exactly the overlap
+                            # with each in-flight request's segments
+                            tl.on_stall(self.name, t_fire, gap)
                 busy = False
                 self._process_cancellations()
                 if not self.paused:
@@ -674,6 +712,12 @@ class EngineReplica:
                 continue
             req.resume_base = req.stream.produced
             req.replica_name = self.name
+            req.t_resume_submitted = time.perf_counter()
+            if req.t_resume_enqueued is not None:
+                req.resume_wait_ms = (req.t_resume_submitted
+                                      - req.t_resume_enqueued) * 1e3
+                if self._reqtrace is not None and req.ctx is not None:
+                    self._reqtrace.on_resume_wait(req)
             self._streams[req.uid] = req
             self._inflight += 1
             get_metrics().counter("gateway/resumed_requests_total").inc()

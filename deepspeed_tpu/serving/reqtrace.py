@@ -206,9 +206,14 @@ class RequestTracing:
     last-N terminal ring. One instance per ServingGateway, shared (by
     reference) with its admission controller and replicas."""
 
-    def __init__(self, config, slo_classes=None):
+    def __init__(self, config, slo_classes=None, timeline=None):
         self.config = config
         self.slo_classes = dict(slo_classes or {})
+        # causal timeline collector (serving/timeline.py): finalize hands
+        # it every terminal request for assembly. None (the default, and
+        # whenever serving.gateway.timeline is absent) keeps the terminal
+        # path at one attribute check — no assembly, no allocations.
+        self._timeline = timeline
         self.log = (RequestLog(config.log_path, config.log_max_bytes,
                                config.log_max_files) if config.log_path else None)
         self._lock = threading.Lock()
@@ -293,6 +298,20 @@ class RequestTracing:
         get_tracer().instant("serving/first_token", tid="serving",
                              request_id=ctx.rid, ttft_ms=round(ttft_ms, 3),
                              slo_class=ctx.slo_class, replica=req.replica_name)
+
+    def on_resume_wait(self, req):
+        """A migrated request's adoption gap: source-driver enqueue on the
+        decode replica -> that replica's own scheduler submit (the dst
+        half of the handoff window PR 18 left unattributed). Emitted by
+        the DESTINATION driver from ``_pull_resumes``; both stamps are
+        perf_counter, so the span composes with the broker stages."""
+        ctx = req.ctx
+        wait = max(0.0, req.t_resume_submitted - req.t_resume_enqueued)
+        get_tracer().complete(
+            "serving/resume_wait", req.t_resume_enqueued, wait, tid="serving",
+            args={"request_id": ctx.rid, "replica": req.replica_name,
+                  "resume_wait_ms": round(wait * 1e3, 3)})
+        get_metrics().histogram("gateway/resume_wait_ms").observe(wait * 1e3)
 
     def on_respond(self, ctx: RequestContext, status):
         """Gateway parse/respond span: the HTTP handler's own walltime for
@@ -390,6 +409,15 @@ class RequestTracing:
             record["spec_drafted_tokens"] = int(spec["drafted"])
             record["spec_accepted_tokens"] = int(spec["accepted"])
             record["spec_accept_rate"] = round(spec["accepted"] / spec["drafted"], 3)
+        if req.handoff_state is not None:
+            # migrated/fallback requests carry the broker cost in their own
+            # summary record (and SSE final frame) — the PR 18 residual:
+            # previously the handoff window hid inside decode_ms
+            record["handoff_state"] = req.handoff_state
+            record["handoff_ms"] = (round(req.handoff_ms, 3)
+                                    if req.handoff_ms is not None else None)
+            record["resume_wait_ms"] = (round(req.resume_wait_ms, 3)
+                                        if req.resume_wait_ms is not None else None)
         record.update({k: (round(v, 3) if v is not None else None)
                        for k, v in stages.items()})
         get_tracer().instant("serving/request_done", tid="serving",
@@ -401,6 +429,8 @@ class RequestTracing:
                                      finish_reason=finish_reason,
                                      slo_verdict=verdict, error=error)
         self._record_terminal(record, healthy and verdict == "ok")
+        if self._timeline is not None:
+            self._timeline.assemble(req, record)
 
     def finalize_rejected(self, ctx: RequestContext, status, reason,
                           replica=None):
@@ -433,6 +463,8 @@ class RequestTracing:
         record.update({k: (round(v, 3) if v is not None else None)
                        for k, v in ctx.stages().items()})
         self._record_terminal(record, healthy=False)
+        if self._timeline is not None:
+            self._timeline.assemble_rejected(ctx, record)
 
     def _record_terminal(self, record, healthy):
         """Tail-aware retention: unhealthy terminals (SLO miss, shed,

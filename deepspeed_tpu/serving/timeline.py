@@ -1,0 +1,271 @@
+"""Causal timeline collector: the serving-side join of every sensor plane.
+
+``monitor/timeline.py`` owns the pure assembly model (segments, overlays,
+verdicts); this module owns the LIVE half — collecting, per gateway, the
+overlay events no single request carries on itself, and assembling one
+:class:`RequestTimeline` dict for every terminal request the moment
+reqtrace finalizes it:
+
+  * **stage stamps** ride the request objects themselves (``RequestContext``
+    + the ``GatewayRequest`` handoff/resume stamps), all perf_counter;
+  * **driver stall gaps** arrive via :meth:`on_stall` from the replica
+    drivers (the same measured gap the goodput ledger books as
+    ``stalled``);
+  * **recompile events** are joined from the recompile sentinel's recent
+    ring by request id / engine uid;
+  * **chaos fires** are joined from a passive ``chaos.observe`` listener
+    (armed only while the gateway runs) by the fire ctx's request id;
+  * **control actuations** are joined from the decision log through the
+    ``inflight_rids`` roster each decision records at actuation time —
+    never by timestamp (decisions stamp ``time.time``; requests stamp
+    ``time.perf_counter``; the roster is the one clock-free join key).
+
+The collector is deliberately passive: no thread, no timers — assembly
+runs synchronously on whichever driver/handler thread finalizes the
+request, bounded by the ring size, and never raises into the driver.
+Retention is tail-aware like the request log: beyond the last-N ring, the
+worst ``exemplar_slots`` requests by TTFT and by TPOT are ALWAYS retained
+(the p99 exemplar a regression hunt needs is exactly the one a ring
+forgets first). Zero overhead with the config block absent: the gateway
+holds no collector, replicas carry a None, reqtrace's terminal path stays
+one attribute check.
+"""
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+from ..monitor.goodput import get_goodput
+from ..monitor.timeline import assemble_timeline
+from ..runtime.resilience import chaos
+
+__all__ = ["TimelineCollector"]
+
+# stalls are bounded per replica (a wedged drill can fire repeatedly);
+# chaos fires bounded fleet-wide — both are JOIN sources, not archives
+_STALLS_PER_REPLICA = 64
+_CHAOS_RING = 128
+
+
+class TimelineCollector:
+    """Per-gateway assembler state. One instance when the
+    ``serving.gateway.timeline`` block is present; replicas get it via
+    ``set_timeline`` and reqtrace holds it for terminal assembly."""
+
+    def __init__(self, config):
+        self.config = config
+        self._lock = threading.Lock()
+        self._ring = OrderedDict()  # rid -> timeline, bounded config.last_n
+        # kind -> {rid: (value_ms, timeline)}: the always-retained tail
+        self._exemplars = {"ttft": {}, "tpot": {}}
+        self._stalls = {}  # replica name -> deque[(t0, t1)] perf_counter
+        self._chaos = deque(maxlen=_CHAOS_RING)
+        self._decisions_provider = None
+        self._chaos_handle = None
+        self.stats = {"assembled": 0, "coverage_failures": 0, "errors": 0}
+
+    # -- wiring (gateway start/stop) ------------------------------------
+    def set_decisions_provider(self, fn):
+        """``fn() -> recent decision records`` (the control plane's ring);
+        None with the control block absent — actuation joins just no-op."""
+        self._decisions_provider = fn
+
+    def arm(self):
+        """Install the passive chaos-fire listener (gateway start)."""
+        if self._chaos_handle is None:
+            self._chaos_handle = chaos.observe(self._on_chaos_fire)
+
+    def disarm(self):
+        """Remove the listener + drop join state (gateway stop)."""
+        if self._chaos_handle is not None:
+            self._chaos_handle.remove()
+            self._chaos_handle = None
+        with self._lock:
+            self._stalls.clear()
+            self._chaos.clear()
+
+    # -- overlay event feeds --------------------------------------------
+    def on_stall(self, replica_name, t0, gap_s):
+        """One measured driver stall gap (replica driver thread, same
+        detection the goodput ledger books as ``stalled``)."""
+        with self._lock:
+            dq = self._stalls.get(replica_name)
+            if dq is None:
+                dq = self._stalls[replica_name] = deque(maxlen=_STALLS_PER_REPLICA)
+            dq.append((t0, t0 + gap_s))
+
+    def _on_chaos_fire(self, point, ctx):
+        """chaos.observe listener — runs on the firing thread BEFORE the
+        hooks, so even a kill fire lands in the join ring."""
+        rid = None
+        if isinstance(ctx, dict):
+            rid = ctx.get("request_id") or ctx.get("rid")
+        with self._lock:
+            self._chaos.append({"point": str(point), "t": time.perf_counter(),
+                                "request_id": rid})
+
+    # -- joins -----------------------------------------------------------
+    def _join_stalls(self, replicas, t_recv, t_done):
+        out = []
+        with self._lock:
+            for name in replicas:
+                for (s0, s1) in self._stalls.get(name, ()):
+                    if s1 >= t_recv and s0 <= t_done:
+                        out.append((s0, s1))
+        return out
+
+    def _join_recompiles(self, rid, uid, t_recv, t_done):
+        out = []
+        for sc in get_goodput().sentinel.report().values():
+            for ev in sc.get("recent", ()):
+                if not (t_recv <= float(ev.get("t", 0.0)) <= t_done):
+                    continue
+                if rid in (ev.get("rids") or ()) or uid in (ev.get("uids") or ()):
+                    out.append(ev)
+        return out
+
+    def _join_chaos(self, rid, t_recv, t_done):
+        with self._lock:
+            fires = list(self._chaos)
+        return [{"point": f["point"],
+                 "t_ms": round((f["t"] - t_recv) * 1e3, 3)}
+                for f in fires
+                if f["request_id"] == rid and t_recv <= f["t"] <= t_done]
+
+    def _join_actuations(self, rid):
+        provider = self._decisions_provider
+        if provider is None:
+            return []
+        # join key: the in-flight roster the controller stamped at
+        # actuation time — decisions live on the time.time clock, so a
+        # timestamp window against perf_counter stamps would be garbage
+        return [d for d in provider()
+                if d.get("applied") and rid in (d.get("inflight_rids") or ())]
+
+    # -- assembly (reqtrace terminal path) -------------------------------
+    def assemble(self, req, record):
+        """Assemble + retain the timeline of one ADMITTED terminal request.
+        Runs on the finalizing thread (driver/handler/stop path) — never
+        raises into it."""
+        try:
+            ctx = req.ctx
+            stamps = {
+                "t_recv": ctx.t_recv, "t_admitted": ctx.t_admitted,
+                "t_dequeued": ctx.t_dequeued,
+                "t_first_token": ctx.t_first_token,
+                "t_last_token": ctx.t_last_token, "t_done": ctx.t_done,
+                "t_handoff_start": req.t_handoff_start,
+                "t_handoff_export": req.t_handoff_export,
+                "t_handoff_verify": req.t_handoff_verify,
+                "t_handoff_done": req.t_handoff_done,
+                "t_resume_enqueued": req.t_resume_enqueued,
+                "t_resume_submitted": req.t_resume_submitted,
+            }
+            if ctx.t_recv is None or ctx.t_done is None:
+                return
+            replicas = {n for n in (record.get("replica"), ctx.route_choice)
+                        if n is not None}
+            tl = assemble_timeline(
+                stamps, record=record,
+                stalls=self._join_stalls(replicas, ctx.t_recv, ctx.t_done),
+                recompiles=self._join_recompiles(ctx.rid, req.uid,
+                                                 ctx.t_recv, ctx.t_done),
+                chaos_fires=self._join_chaos(ctx.rid, ctx.t_recv, ctx.t_done),
+                actuations=self._join_actuations(ctx.rid),
+                tolerance=self.config.tolerance)
+            self._store(tl, record)
+        except Exception:  # noqa: BLE001 — assembly is forensics: it must
+            # cost the timeline, never the driver loop behind it
+            self.stats["errors"] += 1
+
+    def assemble_rejected(self, ctx, record):
+        """Refused-before-admission terminal (400/429/503): the timeline is
+        the ingress/queue stub — still assembled, so 'every terminal
+        request has one' holds for the shed tail too."""
+        try:
+            if ctx.t_recv is None or ctx.t_done is None:
+                return
+            stamps = {"t_recv": ctx.t_recv, "t_admitted": ctx.t_admitted,
+                      "t_dequeued": ctx.t_dequeued,
+                      "t_first_token": ctx.t_first_token,
+                      "t_last_token": ctx.t_last_token, "t_done": ctx.t_done}
+            tl = assemble_timeline(stamps, record=record,
+                                   actuations=self._join_actuations(ctx.rid),
+                                   tolerance=self.config.tolerance)
+            self._store(tl, record)
+        except Exception:  # noqa: BLE001
+            self.stats["errors"] += 1
+
+    def _store(self, tl, record):
+        with self._lock:
+            self.stats["assembled"] += 1
+            if not tl["coverage_ok"]:
+                self.stats["coverage_failures"] += 1
+            rid = tl.get("request_id")
+            if rid is not None:
+                self._ring[rid] = tl
+                self._ring.move_to_end(rid)
+                while len(self._ring) > self.config.last_n:
+                    self._ring.popitem(last=False)
+            slots = int(self.config.exemplar_slots)
+            if slots > 0 and rid is not None:
+                for kind in ("ttft", "tpot"):
+                    v = record.get(f"{kind}_ms")
+                    if v is None:
+                        continue
+                    pool = self._exemplars[kind]
+                    if rid in pool or len(pool) < slots:
+                        pool[rid] = (float(v), tl)
+                        continue
+                    worst_floor = min(pool, key=lambda r: pool[r][0])
+                    if float(v) > pool[worst_floor][0]:
+                        del pool[worst_floor]
+                        pool[rid] = (float(v), tl)
+
+    # -- read side -------------------------------------------------------
+    def get(self, rid):
+        """One assembled timeline by request id: the ring first, then the
+        always-retained tail exemplars (a p99 request must stay
+        addressable after the ring forgot it)."""
+        with self._lock:
+            tl = self._ring.get(rid)
+            if tl is not None:
+                return tl
+            for pool in self._exemplars.values():
+                hit = pool.get(rid)
+                if hit is not None:
+                    return hit[1]
+        return None
+
+    def recent(self, n=None):
+        """Newest-last assembled timelines from the ring."""
+        with self._lock:
+            out = list(self._ring.values())
+        return out[-int(n):] if n else out
+
+    def exemplars(self):
+        """The retained tail, worst-first per kind."""
+        with self._lock:
+            return {kind: [{"request_id": rid, "value_ms": v,
+                            "timeline": tl}
+                           for rid, (v, tl) in sorted(pool.items(),
+                                                      key=lambda kv: -kv[1][0])]
+                    for kind, pool in self._exemplars.items()}
+
+    def state(self) -> dict:
+        with self._lock:
+            return {**self.stats, "ring": len(self._ring),
+                    "last_n": self.config.last_n,
+                    "tolerance": self.config.tolerance,
+                    "exemplars": {k: len(p) for k, p in self._exemplars.items()},
+                    "chaos_observer_armed": self._chaos_handle is not None}
+
+    def gauge_rows(self):
+        """Labelled rows for the health exporter's ``/metrics`` scrape."""
+        with self._lock:
+            return [("timeline/assembled_total", {},
+                     float(self.stats["assembled"])),
+                    ("timeline/coverage_failures_total", {},
+                     float(self.stats["coverage_failures"])),
+                    ("timeline/errors_total", {}, float(self.stats["errors"])),
+                    ("timeline/ring_size", {}, float(len(self._ring)))]

@@ -73,6 +73,12 @@ def test_control_config_parses_and_validates():
                                              "slo_miss_relax": 0.5}})
     with pytest.raises(ValueError):
         GatewayConfig.from_dict({"control": {"spec_k_min": 5, "spec_k_max": 2}})
+    # ewma_alpha is a [0, 1] smoothing weight (0 = off)
+    assert GatewayConfig.from_dict({"control": {}}).control.ewma_alpha == 0.0
+    assert GatewayConfig.from_dict(
+        {"control": {"ewma_alpha": 0.2}}).control.ewma_alpha == 0.2
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        GatewayConfig.from_dict({"control": {"ewma_alpha": 1.5}})
 
 
 # ---------------------------------------------------------------------------
@@ -311,6 +317,78 @@ def test_flap_budget_defers_past_max_actuations(direct_engine):
     deferred = [d for d in ctl.decisions.recent() if not d["applied"]]
     assert deferred and "budget" in deferred[-1]["reason"]
     assert deferred[-1]["sensors"]  # a deferred decision still justifies
+
+
+def test_ewma_smooths_bursty_idle_band_walk(direct_engine):
+    """Satellite 2 (ISSUE 20): the idle_frac sensor walks the drain band
+    under a BURSTY synthetic-clock trace — three fully-idle ticks, one
+    half-busy burst, repeating. Raw sensing (ewma_alpha=0) resets the
+    scaling policy's sustain counter at every burst, so a fleet that is
+    87.5% idle never drains; ewma_alpha=0.1 smooths the dips inside the
+    band and the drain fires. The snapshot keeps BOTH values (idle_frac
+    vs idle_frac_raw) so decision records stay auditable, and the applied
+    record carries the satellite-3 inflight_rids roster."""
+    eng2 = build_engine(on_tpu=False)
+    try:
+        applied_by_alpha = {}
+        drains = None
+        for alpha in (0.0, 0.1):
+            cfg = GatewayConfig(
+                enabled=True,
+                control=ControlConfig(enabled=True, interval_s=0.05,
+                                      window_s=1.0, policies=("scaling",),
+                                      sustain_ticks=5, cooldown_s=0.0,
+                                      max_actuations_per_window=100,
+                                      idle_frac_drain=0.85,
+                                      queue_depth_undrain=10_000,
+                                      min_active_replicas=1,
+                                      ewma_alpha=alpha))
+            g = ServingGateway([direct_engine, eng2], cfg)  # NOT started
+            ctl = g.controller
+            acc = {"wall": 0.0, "idle": 0.0}
+
+            def raw(now, _acc=acc):
+                return {"t": now, "classes": {}, "spec": {},
+                        "goodput": {"idle_s": _acc["idle"],
+                                    "wall_s": _acc["wall"]}}
+
+            ctl._raw_sample = raw
+            # the gateway is never started (no driver threads to race the
+            # synthetic clock), so the liveness the scaling policy needs is
+            # part of the synthetic snapshot too
+            orig_sense = ctl._sense
+
+            def sense(now, _orig=orig_sense):
+                snap = _orig(now)
+                for row in snap["replicas"]:
+                    row["alive"] = True
+                return snap
+
+            ctl._sense = sense
+            ctl.tick(now=0.0)  # baseline sample (no delta yet)
+            for k, r in enumerate([1.0, 1.0, 1.0, 0.5] * 3):
+                acc["wall"] += 1.0
+                acc["idle"] += r
+                ctl.tick(now=float(k + 1))
+            applied_by_alpha[alpha] = ctl.stats["applied"]
+            snap = ctl._last_snap
+            assert snap["idle_frac_raw"] == pytest.approx(0.5)  # last burst
+            if alpha == 0.0:
+                assert snap["idle_frac"] == snap["idle_frac_raw"]
+            else:
+                assert snap["idle_frac"] > 0.85  # smoothed inside the band
+                drains = [d for d in ctl.decisions.recent() if d["applied"]]
+        # raw: the burst resets sustain every period -> never drains;
+        # smoothed: the EWMA never leaves the band -> exactly one drain
+        assert applied_by_alpha[0.0] == 0
+        assert applied_by_alpha[0.1] == 1
+        assert [d["action"] for d in drains] == ["drain_replica"]
+        assert "idle_frac" in drains[0]["sensors"]
+        # satellite 3: every decision record carries the in-flight roster
+        # at actuation time (the timeline plane's clock-free join key)
+        assert drains[0]["inflight_rids"] == []
+    finally:
+        eng2.shutdown()
 
 
 # ---------------------------------------------------------------------------

@@ -9,7 +9,8 @@ anywhere):
    ``deepspeed_tpu/`` except the implementing package
    ``runtime/resilience/``) may reach :mod:`chaos` / :mod:`fault_injection`
    ONLY through no-op-when-unhooked points: a module-top-level import of
-   the module object plus calls to ``fire`` (and the ``armed`` guard).
+   the module object plus calls to ``fire`` (and the ``armed`` guard, and
+   the passive read-side ``observe`` listener registration).
    Conditional imports (inside ``if``/``try``/function bodies) and calls to
    the hook-installing surface (``inject``/``crash_at``/``clear``/
    ``ChaosSchedule``…) are violations — they are how "test-only branches"
@@ -32,8 +33,12 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _PKG = os.path.join(_HERE, os.pardir, "deepspeed_tpu")
 
 CHAOS_MODULES = {"chaos", "fault_injection"}
-# the only attributes production code may touch on the chaos module object
-ALLOWED_ATTRS = {"fire", "armed"}
+# the only attributes production code may touch on the chaos module object.
+# `observe` is read-side: a passive listener registration that never
+# installs hooks and is a no-op while nothing fires (the timeline plane's
+# chaos-fire join source) — unlike inject/crash_at/ChaosSchedule it cannot
+# arm a fault in production.
+ALLOWED_ATTRS = {"fire", "armed", "observe"}
 EXCEPT_DIRS = (
     os.path.join(_PKG, "elasticity"),
     os.path.join(_PKG, "runtime", "resilience"),

@@ -43,7 +43,7 @@ DEFAULT_PKG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pa
 
 APPROVED_PREFIXES = ("train", "serving", "gateway", "health", "comm",
                      "checkpoint", "cache", "memory", "goodput", "profile",
-                     "handoff", "control")
+                     "handoff", "control", "timeline")
 
 REGISTRATION_CALLS = ("counter", "gauge", "histogram")
 

@@ -1,0 +1,166 @@
+"""Static check: timeline joinability of the serving emission surface.
+
+The causal timeline plane (``deepspeed_tpu/monitor/timeline.py`` +
+``deepspeed_tpu/serving/timeline.py``) joins sensor records to requests by
+``request_id``. A span or instant emitted from the handoff/disagg/control
+paths WITHOUT one is silently unjoinable: the assembler never sees it, the
+critical path quietly loses a stage, and no test fails — exactly the drift
+this gate exists to catch (the ``check_request_tracing`` lesson applied to
+the join surface).
+
+Scope — the modules whose emissions the assembler joins:
+``serving/handoff.py``, ``serving/disagg.py``, ``serving/timeline.py``,
+and everything under ``serving/control/``. Checked forms, all AST-only
+(no package imports, runs anywhere):
+
+  * ``.instant(...)`` / ``.span(...)`` must pass a ``request_id=`` keyword;
+  * ``.complete(...)`` must pass a LITERAL ``args={...}`` dict containing
+    a ``"request_id"`` key;
+  * ``observe_latency(..., span_args={...})`` must carry ``"request_id"``
+    in the literal span_args dict (it forwards to a ``.complete``).
+
+Fleet-scoped emissions with genuinely no request (a ledger-wide gauge
+sweep, a controller decision covering the whole fleet) go on the
+documented ``NO_REQUEST_ALLOWLIST`` — (file, span-name) -> why — so every
+exemption is visible in review instead of silently grandfathered. A tier-1
+test (``tests/test_timeline.py``) runs this on every CI pass and asserts
+the gate still CATCHES a violation planted in a temp file.
+"""
+
+import ast
+import os
+import sys
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+DEFAULT_SERVING_DIR = os.path.join(_REPO, "deepspeed_tpu", "serving")
+
+# files (relative to the serving dir) whose emissions the assembler joins
+TARGET_FILES = ("handoff.py", "disagg.py", "timeline.py")
+TARGET_SUBDIRS = ("control",)
+
+KEYWORD_EMITTERS = ("instant", "span")
+ARGSDICT_EMITTERS = ("complete",)
+SPAN_ARGS_EMITTERS = ("observe_latency",)
+
+# (file basename, span/instant name) -> documented reason there is no
+# request to join. Keep this SHORT: every row is an emission the timeline
+# plane can never attribute.
+NO_REQUEST_ALLOWLIST = {
+    # a controller decision is fleet-scoped; the record's inflight_rids
+    # roster (not the instant) is the sanctioned decision->request join
+    ("decisions.py", "control/decision"): "fleet-scoped; joined via inflight_rids",
+}
+
+
+def _call_name(node):
+    """Attribute calls -> the attribute name; bare-name calls -> the name
+    (observe_latency is imported as a function, not a method)."""
+    if not isinstance(node, ast.Call):
+        return None
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def _literal_dict_has_request_id(node, kw_name):
+    for kw in node.keywords:
+        if kw.arg == kw_name and isinstance(kw.value, ast.Dict):
+            for key in kw.value.keys:
+                if isinstance(key, ast.Constant) and key.value == "request_id":
+                    return True
+    return False
+
+
+def _span_name(node):
+    """The first positional string constant of the emission (the span /
+    instant / latency name) — what the allowlist keys on."""
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    # observe_latency(t0, "name", ...) carries the name second
+    if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        return node.args[1].value
+    return None
+
+
+def _allowlisted(fname, node):
+    name = _span_name(node)
+    return name is not None and (fname, name) in NO_REQUEST_ALLOWLIST
+
+
+def _check_file(path):
+    violations = []
+    fname = os.path.basename(path)
+    with open(path) as f:
+        src = f.read()
+    lines = src.splitlines()
+    tree = ast.parse(src, filename=path)
+    for node in ast.walk(tree):
+        name = _call_name(node)
+        if name is None:
+            continue
+        why = None
+        if name in KEYWORD_EMITTERS:
+            if not any(kw.arg == "request_id" for kw in node.keywords) \
+                    and not _allowlisted(fname, node):
+                why = (f"'{name}' emission without a request_id= keyword "
+                       f"(unjoinable by the timeline assembler)")
+        elif name in ARGSDICT_EMITTERS:
+            if not _literal_dict_has_request_id(node, "args") \
+                    and not _allowlisted(fname, node):
+                why = (f"'{name}' emission without a literal "
+                       f"args={{'request_id': ...}} entry")
+        elif name in SPAN_ARGS_EMITTERS:
+            if not _literal_dict_has_request_id(node, "span_args") \
+                    and not _allowlisted(fname, node):
+                why = (f"'{name}' call without a literal "
+                       f"span_args={{'request_id': ...}} entry")
+        if why:
+            snippet = (lines[node.lineno - 1].strip()
+                       if node.lineno <= len(lines) else "")
+            violations.append((fname, node.lineno, snippet, why))
+    return violations
+
+
+def _target_paths(serving_dir):
+    paths = [os.path.join(serving_dir, f) for f in TARGET_FILES]
+    for sub in TARGET_SUBDIRS:
+        d = os.path.join(serving_dir, sub)
+        if os.path.isdir(d):
+            paths.extend(os.path.join(d, f) for f in sorted(os.listdir(d))
+                         if f.endswith(".py"))
+    return [p for p in paths if os.path.exists(p)]
+
+
+def find_violations(serving_dir=DEFAULT_SERVING_DIR):
+    """[(file, lineno, snippet, why)] across the join surface."""
+    violations = []
+    for path in _target_paths(serving_dir):
+        violations.extend(_check_file(path))
+    return violations
+
+
+def check(serving_dir=DEFAULT_SERVING_DIR):
+    """Return the violation list (empty = every emission is joinable)."""
+    return find_violations(serving_dir)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    serving_dir = argv[0] if argv else DEFAULT_SERVING_DIR
+    bad = check(serving_dir)
+    if bad:
+        print(f"check_timeline_joins: unjoinable emissions in {serving_dir}:")
+        for rel, lineno, snippet, why in bad:
+            print(f"  {rel}:{lineno}: {why}: {snippet}")
+        return 1
+    print("check_timeline_joins: every handoff/disagg/control emission "
+          "carries request_id (or a documented no-request exemption)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

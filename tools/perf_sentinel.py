@@ -56,7 +56,13 @@ HIGHER_BETTER_NAMES = ("value", "mfu", "mbu", "accept_rate", "hit_rate", "ratio"
 # goodput neutrality rule: per-tenant counters/seconds are ATTRIBUTION of
 # whatever the round consumed (a different tenant mix is not a
 # regression) — only its fairness index carries a direction.
-NEUTRAL_PREFIXES = ("goodput.", "tenants.", "roofline.")
+NEUTRAL_PREFIXES = ("goodput.", "tenants.", "roofline.",
+                    # timeline rounds are ATTRIBUTION captures: counts of
+                    # assembled/migrated timelines and the seeded-stall
+                    # delta are accounting of what the round did, not a
+                    # performance verdict (the verdict is the dominant
+                    # stage naming the seeded stage, checked in tests)
+                    "timeline.")
 NEUTRAL_NAMES = ("wall_s", "unattributed_s", "overbooked_s", "recovery_badput_s",
                  # tier migration volume is workload attribution, not a verdict:
                  # more demotions under the same load is the tier doing its job

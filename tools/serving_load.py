@@ -1170,6 +1170,26 @@ def attribution_table(records):
             if abs(sum(parts) - r["e2e_ms"]) <= max(0.1 * r["e2e_ms"], 2.0):
                 ok += 1
     out["breakdown_ok_frac"] = round(ok / checked, 3) if checked else None
+    # migrated/fallback rows (ISSUE 20 satellite): the broker's cost is in
+    # the summary records themselves now — surface it alongside the stages
+    migrated = [r for r in records if r.get("handoff_state") == "migrated"]
+    fallback = [r for r in records if r.get("handoff_state") == "fallback"]
+    if migrated or fallback:
+        hand = [r["handoff_ms"] for r in migrated + fallback
+                if r.get("handoff_ms") is not None]
+        waits = [r["resume_wait_ms"] for r in migrated
+                 if r.get("resume_wait_ms") is not None]
+        out["handoff"] = {
+            "migrated": len(migrated), "fallbacks": len(fallback),
+            "handoff_ms_p50": (round(float(np.percentile(hand, 50)), 2)
+                               if hand else None),
+            "handoff_ms_p99": (round(float(np.percentile(hand, 99)), 2)
+                               if hand else None),
+            "resume_wait_ms_p50": (round(float(np.percentile(waits, 50)), 2)
+                                   if waits else None),
+            "resume_wait_ms_p99": (round(float(np.percentile(waits, 99)), 2)
+                                   if waits else None),
+        }
     return out
 
 
@@ -1317,6 +1337,92 @@ def disagg_ab(on_tpu, n_requests=None, seed=0):
     dg_p99 = result["disagg"]["fg_tpot"].get("p99_ms")
     result["tpot_p99_improved"] = (co_p99 is not None and dg_p99 is not None
                                    and dg_p99 < co_p99)
+    return result
+
+
+def timeline_rounds(on_tpu, n_requests=None, seed=0, out_dir=None):
+    """Two captured timeline rounds for ``tools/trace_explain.py`` (ISSUE
+    20): the SAME disagg foreground workload through the full HTTP plane
+    twice — once clean (``base``), once with a deterministic 100%-rate
+    150 ms chaos stall AT ``serving/handoff`` (``stalled``), which lands
+    between the broker's export and verify, so the regression lives inside
+    every migrated request's ``broker_verify`` segment. The measured round
+    is foreground-only at concurrency 1: sequential requests have no
+    queueing neighbors, so the seeded stall's milliseconds land in the
+    stalled request's OWN broker segment instead of bleeding into other
+    requests' queue/prefill/resume waits (warmup still drives both pools
+    with the mixed workload to pin compile buckets). Each arm writes one
+    round file (``{"meta": backend stamp, "timelines": [...]}``, measured
+    rids only) and the summary runs the differential explain across them:
+    the dominant stage must be the stalled broker stage, not a neighbor."""
+    from bench import backend_stamp
+    from deepspeed_tpu.runtime.resilience.chaos import ChaosSchedule, ChaosSpec
+    from deepspeed_tpu.serving import (DisaggConfig, RequestTraceConfig,
+                                       TimelineConfig)
+    from tools.trace_explain import explain, load_round
+
+    n_fg = n_requests or (16 if on_tpu else 8)
+    n_bg = n_fg
+    fg_shape = dict(prompt_lo=16, prompt_hi=28, new_lo=12, new_hi=20)
+    bg_shape = dict(prompt_lo=40, prompt_hi=60, new_lo=1, new_hi=1)
+    out_dir = out_dir or os.path.join(tempfile.gettempdir(),
+                                      "dstpu_timeline_rounds")
+    os.makedirs(out_dir, exist_ok=True)
+    result = {"config": "timeline_rounds", "n_foreground": n_fg,
+              "n_background": n_bg, "out_dir": out_dir, "rounds": {}}
+    for arm in ("base", "stalled"):
+        gw = build_gateway(
+            n_replicas=2, prefix_cache=True, host_blocks=160, on_tpu=on_tpu,
+            disagg=DisaggConfig(enabled=True, roles=("prefill", "decode")),
+            tracing=RequestTraceConfig(enabled=True),
+            timeline=TimelineConfig(enabled=True, last_n=1024))
+        sched = None
+        try:
+            warm = (make_workload(n_fg, rate_rps=None, seed=seed + 7,
+                                  uid_base=90_000, **fg_shape)
+                    + make_workload(n_bg, rate_rps=None, seed=seed + 8,
+                                    uid_base=95_000, **bg_shape))
+            run_http_load(gw.config.host, gw.port, warm, concurrency=8)
+            if arm == "stalled":
+                # armed AFTER warmup: the measured rounds differ by exactly
+                # the seeded stall, nothing else
+                sched = ChaosSchedule(seed + 11, [
+                    ChaosSpec("stall", "serving/handoff", rate=1.0,
+                              duration_s=0.15)]).install()
+            fg = make_workload(n_fg, rate_rps=None, seed=seed, uid_base=0,
+                               **fg_shape)
+            run_http_load(gw.config.host, gw.port, fg, concurrency=1)
+            want = {f"load-{r['uid']}" for r in fg}
+            timelines = [t for t in gw.timeline.recent()
+                         if t.get("request_id") in want]
+            path = os.path.join(out_dir, f"timeline_{arm}.json")
+            with open(path, "w") as f:
+                json.dump({"meta": {**backend_stamp(on_tpu), "arm": arm},
+                           "timelines": timelines}, f, default=repr)
+            migrated = [t for t in timelines if t.get("migrated")]
+            result["rounds"][arm] = {
+                "path": path, "n_timelines": len(timelines),
+                "migrated": len(migrated),
+                "migrated_coverage_ok_frac":
+                    (round(sum(bool(t["coverage_ok"]) for t in migrated)
+                           / len(migrated), 3) if migrated else None),
+                "chaos_stalls": (sched.counts().get("stall", 0)
+                                 if sched is not None else 0),
+            }
+        finally:
+            if sched is not None:
+                sched.uninstall()
+            gw.stop()
+    report = explain(load_round(result["rounds"]["base"]["path"]),
+                     load_round(result["rounds"]["stalled"]["path"]))
+    result["explain"] = {
+        "refused": report["refused"],
+        "delta_e2e_ms": report.get("delta_e2e_ms"),
+        "dominant_stage": report.get("dominant_stage"),
+        "dominant_cause": report.get("dominant_cause"),
+        "broker_verify_delta_ms": (report.get("by_stage", {})
+                                   .get("broker_verify", {}).get("delta_ms")),
+    }
     return result
 
 
@@ -1489,6 +1595,8 @@ def main():
         out = disagg_ab(on_tpu)
     elif "control_ab" in sys.argv[1:]:
         out = control_ab(on_tpu)
+    elif "timeline" in sys.argv[1:]:
+        out = timeline_rounds(on_tpu)
     elif "multi_tenant" in sys.argv[1:]:
         out = multi_tenant_bench(on_tpu)
     else:

@@ -1,0 +1,109 @@
+"""Differential timeline explain: WHICH stage owns a round-over-round delta.
+
+``tools/perf_sentinel.py`` says THAT a headline moved; this tool says WHY —
+it diffs two captured timeline populations (``monitor/timeline.py``'s
+``explain_delta``) and names the stage and cause that own the end-to-end
+delta. The canonical producer is ``tools/serving_load.py timeline``, which
+writes one round file per arm; any file of the same shape works:
+
+    {"meta": {"backend": "cpu"|"tpu", "chip": ..., ...},
+     "timelines": [<assembled RequestTimeline dicts>, ...]}
+
+Comparability discipline is inherited, not reimplemented: the same
+``bench.comparability_refusal`` that gates the perf sentinel's ratios
+refuses cross-backend / cross-chip timeline diffs here (a CPU-fallback
+round's stage profile explains nothing about an on-chip regression — the
+BENCH_r04/r05 lesson applies to stage attribution exactly as it does to
+headlines).
+
+Usage::
+
+    python tools/trace_explain.py BASE.json CUR.json
+
+Exit codes: 0 = explained, 1 = bad input, 2 = comparison refused.
+"""
+
+import json
+import os
+import sys
+
+# `python tools/trace_explain.py` puts tools/ first on sys.path; the
+# repo root (bench.py, deepspeed_tpu/) must be importable too
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from bench import comparability_refusal  # noqa: E402
+from deepspeed_tpu.monitor.timeline import explain_delta  # noqa: E402
+
+
+def load_round(path: str) -> dict:
+    """One captured round: ``{"meta": {...}, "timelines": [...]}``. A bare
+    timeline list is accepted (meta-less — only comparable to another
+    meta-less capture if the caller forces it; the refusal will fire)."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return {"meta": {}, "timelines": data}
+    if not isinstance(data, dict) or "timelines" not in data:
+        raise ValueError(f"{path}: expected a round object with a "
+                         "'timelines' list (or a bare timeline list)")
+    return {"meta": dict(data.get("meta") or {}),
+            "timelines": list(data["timelines"])}
+
+
+def explain(base_round: dict, cur_round: dict) -> dict:
+    """The differential verdict, or a refusal. Returns ``explain_delta``'s
+    report plus ``refused`` (None = the diff is meaningful)."""
+    refusal = comparability_refusal(base_round.get("meta") or {},
+                                    cur_round.get("meta") or {})
+    if refusal is not None:
+        return {"refused": refusal}
+    report = explain_delta(base_round["timelines"], cur_round["timelines"])
+    report["refused"] = None
+    report["base_meta"] = base_round.get("meta") or {}
+    report["cur_meta"] = cur_round.get("meta") or {}
+    return report
+
+
+def _fmt_rows(rows, top=5):
+    ranked = sorted(rows.items(), key=lambda kv: -abs(kv[1]["delta_ms"]))[:top]
+    return [f"    {name:>16}: {r['base_mean_ms']:9.3f} -> {r['cur_mean_ms']:9.3f} ms "
+            f"({r['delta_ms']:+9.3f}"
+            + (f", {r['share']:+.0%} of delta" if r["share"] is not None else "")
+            + ")"
+            for name, r in ranked]
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2:
+        print("usage: python tools/trace_explain.py BASE.json CUR.json",
+              file=sys.stderr)
+        return 1
+    try:
+        base_round = load_round(argv[0])
+        cur_round = load_round(argv[1])
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace_explain: {e}", file=sys.stderr)
+        return 1
+    report = explain(base_round, cur_round)
+    print(json.dumps(report, indent=2, default=repr))
+    if report["refused"] is not None:
+        print(f"\ntrace_explain: REFUSED: {report['refused']}", file=sys.stderr)
+        return 2
+    print(f"\ntrace_explain: {report['n_base']} base vs {report['n_cur']} cur "
+          f"timelines; mean e2e {report.get('base_e2e_mean_ms')} -> "
+          f"{report.get('cur_e2e_mean_ms')} ms "
+          f"({report['delta_e2e_ms']:+.3f} ms)")
+    print(f"  dominant stage: {report['dominant_stage']}   "
+          f"dominant cause: {report['dominant_cause']}")
+    print("  by stage (top movers):")
+    print("\n".join(_fmt_rows(report["by_stage"])))
+    print("  by cause (top movers):")
+    print("\n".join(_fmt_rows(report["by_cause"])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
